@@ -1,0 +1,28 @@
+"""rafiki_tpu — a TPU-native AutoML Machine-Learning-as-a-Service framework.
+
+A ground-up, JAX/XLA-first re-design of the capability surface of Rafiki
+(reference: /root/reference, vivansxu/rafiki): users register *model templates*
+(Python classes with tunable knobs), launch *train jobs* that run parallel
+hyperparameter-search *trials* under a Bayesian-optimization advisor, and
+deploy the best trials as ensembled, continuously-batched *inference jobs*.
+
+Where the reference orchestrates per-GPU Docker containers over Docker Swarm
+with Redis-polled serving (reference rafiki/admin/services_manager.py,
+rafiki/container/docker_swarm.py, rafiki/predictor/predictor.py), this system
+is designed for TPU VM slices:
+
+- the model SDK (`rafiki_tpu.sdk`) has an explicit JAX backend — models are
+  pytree params + jitted step functions, sharded over a `jax.sharding.Mesh`;
+- trial executors are placed with *chip affinity* onto mesh sub-slices by an
+  in-process placement layer (`rafiki_tpu.placement`) instead of containers;
+- the advisor (`rafiki_tpu.advisor`) is a native Gaussian-process Bayesian
+  optimizer shared across parallel workers of a sub-train-job (fixing the
+  reference's uncoordinated per-worker advisors, reference worker/train.py:213);
+- the predictor (`rafiki_tpu.predictor`) replaces the 0.25 s Redis poll
+  pipeline with a deadline-based continuous batching queue feeding a jitted
+  predict function.
+"""
+
+__version__ = "0.1.0"
+
+from rafiki_tpu import constants  # noqa: F401

@@ -1,0 +1,12 @@
+"""Hyperparameter-optimization advisor (reference rafiki/advisor/).
+
+A native Gaussian-process Bayesian optimizer replaces the reference's
+``baytune``/BTB dependency (reference rafiki/advisor/btb_gp_advisor.py). The
+advisor is a *library* first — workers use it in-process or through the admin
+HTTP API — and one advisor is shared per sub-train-job so parallel trials
+coordinate through constant-liar fantasies (the reference spawned an
+independent GP per worker, reference rafiki/worker/train.py:213, making
+parallel HPO uncoordinated).
+"""
+
+from rafiki_tpu.advisor.advisor import Advisor, AdvisorStore, BaseAdvisor, RandomAdvisor  # noqa: F401

@@ -1,0 +1,145 @@
+"""Advisor sessions: propose/feedback over knob configs.
+
+Parity with the reference's advisor layer (reference
+rafiki/advisor/advisor.py:8-62 and advisor/service.py:15-79): a ``BaseAdvisor``
+contract, a GP-backed default, and a sessionized store keyed by advisor id.
+The store is thread-safe (the reference instead forced its Flask advisor app
+single-threaded, reference scripts/start_advisor.py:10).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rafiki_tpu.advisor.gp import BayesOpt
+from rafiki_tpu.sdk.knob import (
+    KnobConfig,
+    knob_config_dims,
+    knobs_from_unit,
+    knobs_to_unit,
+)
+
+
+def _jsonify(value: Any) -> Any:
+    """Simplify numpy scalars into JSON-native types (reference
+    rafiki/advisor/advisor.py:44-62 did the same for BTB proposals)."""
+    if isinstance(value, np.integer):
+        return int(value)
+    if isinstance(value, np.floating):
+        return float(value)
+    if isinstance(value, np.bool_):
+        return bool(value)
+    if isinstance(value, dict):
+        return {k: _jsonify(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonify(v) for v in value]
+    return value
+
+
+class BaseAdvisor:
+    """Contract: propose a knob assignment; feed back its achieved score."""
+
+    def __init__(self, knob_config: KnobConfig):
+        self.knob_config = knob_config
+
+    def propose(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def feedback(self, knobs: Dict[str, Any], score: float) -> None:
+        raise NotImplementedError
+
+
+class Advisor(BaseAdvisor):
+    """GP Bayesian-optimization advisor (the default).
+
+    Thread-safe: one instance is shared by all parallel workers of a
+    sub-train-job, with in-flight proposals fantasized (constant liar) so
+    concurrent trials explore different regions.
+    """
+
+    def __init__(self, knob_config: KnobConfig, seed: int = 0):
+        super().__init__(knob_config)
+        self._opt = BayesOpt(knob_config_dims(knob_config), seed=seed)
+        self._lock = threading.Lock()
+
+    def propose(self) -> Dict[str, Any]:
+        with self._lock:
+            u = self._opt.suggest(register_pending=False)
+            knobs = knobs_from_unit(self.knob_config, u)
+            # register the *quantized* point (integer/categorical knobs round
+            # to a grid) so feedback's re-encoding retires it by value
+            self._opt.mark_pending(knobs_to_unit(self.knob_config, knobs))
+        return _jsonify(knobs)
+
+    def feedback(self, knobs: Dict[str, Any], score: float) -> None:
+        u = knobs_to_unit(self.knob_config, knobs)
+        with self._lock:
+            self._opt.observe(u, float(score))
+
+    @property
+    def history(self) -> List[Tuple[np.ndarray, float]]:
+        return list(zip(self._opt.observed_X, self._opt.observed_y))
+
+
+class RandomAdvisor(BaseAdvisor):
+    """Uniform random search baseline."""
+
+    def __init__(self, knob_config: KnobConfig, seed: int = 0):
+        super().__init__(knob_config)
+        self._rng = np.random.default_rng(seed)
+        self._dims = knob_config_dims(knob_config)
+
+    def propose(self) -> Dict[str, Any]:
+        return _jsonify(knobs_from_unit(self.knob_config, self._rng.random(self._dims)))
+
+    def feedback(self, knobs: Dict[str, Any], score: float) -> None:
+        pass
+
+
+class AdvisorStore:
+    """Sessionized advisor registry (reference rafiki/advisor/service.py kept
+    an in-memory dict behind Flask; here it's an explicit thread-safe store
+    usable in-process or behind the admin HTTP API)."""
+
+    _TYPES = {"GP": Advisor, "RANDOM": RandomAdvisor}
+
+    def __init__(self) -> None:
+        self._advisors: Dict[str, BaseAdvisor] = {}
+        self._lock = threading.Lock()
+
+    def create_advisor(
+        self,
+        knob_config: KnobConfig,
+        advisor_id: Optional[str] = None,
+        advisor_type: str = "GP",
+    ) -> str:
+        advisor_id = advisor_id or uuid.uuid4().hex
+        with self._lock:
+            if advisor_id not in self._advisors:
+                self._advisors[advisor_id] = self._TYPES[advisor_type](knob_config)
+        return advisor_id
+
+    def get(self, advisor_id: str) -> BaseAdvisor:
+        with self._lock:
+            if advisor_id not in self._advisors:
+                raise KeyError(f"No such advisor: {advisor_id}")
+            return self._advisors[advisor_id]
+
+    def propose(self, advisor_id: str) -> Dict[str, Any]:
+        return self.get(advisor_id).propose()
+
+    def feedback(self, advisor_id: str, knobs: Dict[str, Any], score: float) -> Dict[str, Any]:
+        """Record a score; returns the next proposal (matching the
+        reference's feedback-returns-next-proposal API, reference
+        advisor/service.py:62-70)."""
+        advisor = self.get(advisor_id)
+        advisor.feedback(knobs, score)
+        return advisor.propose()
+
+    def delete_advisor(self, advisor_id: str) -> None:
+        with self._lock:
+            self._advisors.pop(advisor_id, None)

@@ -1,0 +1,76 @@
+"""Code-level configuration constants (analogue of reference rafiki/config.py).
+
+Environment-variable-first, mirroring the reference's config tiers
+(SURVEY.md §5.6): deployment config comes from the environment; these are the
+in-code defaults. Path-like values are resolved *lazily* (module
+``__getattr__``) so tests and the placement layer can repoint
+``RAFIKI_WORKDIR`` at runtime.
+"""
+
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, default))
+
+
+SUPERADMIN_EMAIL = os.environ.get("SUPERADMIN_EMAIL", "superadmin@rafiki")
+SUPERADMIN_PASSWORD = os.environ.get("SUPERADMIN_PASSWORD", "rafiki")
+
+APP_SECRET = os.environ.get("APP_SECRET", "rafiki-tpu-dev-secret")
+TOKEN_TTL_HOURS = _env_int("TOKEN_TTL_HOURS", 24)
+
+# Serving fleet shape per inference job (reference rafiki/config.py:10-11).
+INFERENCE_MAX_BEST_TRIALS = _env_int("INFERENCE_MAX_BEST_TRIALS", 2)
+INFERENCE_WORKER_REPLICAS_PER_TRIAL = _env_int(
+    "INFERENCE_WORKER_REPLICAS_PER_TRIAL", 1
+)
+
+# Continuous-batching predictor knobs. The reference's serving pipeline had a
+# hard p50 floor of ~0.25-0.5 s from sleep-polling (reference rafiki/config.py:14,17
+# and predictor/predictor.py:46-59); here queries are handed to the batcher via
+# condition variables and flushed either when the batch fills or after
+# PREDICT_BATCH_DEADLINE_MS, whichever is first.
+PREDICT_MAX_BATCH_SIZE = _env_int("PREDICT_MAX_BATCH_SIZE", 64)
+PREDICT_BATCH_DEADLINE_MS = _env_float("PREDICT_BATCH_DEADLINE_MS", 5.0)
+PREDICT_TIMEOUT_S = _env_float("PREDICT_TIMEOUT_S", 30.0)
+
+DEFAULT_TRIAL_COUNT = _env_int("DEFAULT_TRIAL_COUNT", 5)
+
+ADMIN_HOST = os.environ.get("ADMIN_HOST", "127.0.0.1")
+ADMIN_PORT = _env_int("ADMIN_PORT", 3000)
+
+SERVICE_DEPLOY_TIMEOUT_S = _env_float("SERVICE_DEPLOY_TIMEOUT_S", 60.0)
+
+
+def workdir() -> str:
+    return os.environ.get("RAFIKI_WORKDIR", os.path.abspath("."))
+
+
+# Filesystem layout (shared volume in the reference, local dirs here).
+# Resolved lazily against the current environment on every access.
+_DYNAMIC_PATHS = {
+    "WORKDIR": lambda: workdir(),
+    "DATA_DIR": lambda: os.environ.get(
+        "RAFIKI_DATA_DIR", os.path.join(workdir(), "data")
+    ),
+    "PARAMS_DIR": lambda: os.environ.get(
+        "RAFIKI_PARAMS_DIR", os.path.join(workdir(), "params")
+    ),
+    "LOGS_DIR": lambda: os.environ.get(
+        "RAFIKI_LOGS_DIR", os.path.join(workdir(), "logs")
+    ),
+    "DB_PATH": lambda: os.environ.get(
+        "RAFIKI_DB_PATH", os.path.join(workdir(), "rafiki.sqlite3")
+    ),
+}
+
+
+def __getattr__(name: str) -> str:
+    if name in _DYNAMIC_PATHS:
+        return _DYNAMIC_PATHS[name]()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,15 @@
+"""Parallelism layer: device meshes, sharding rules, and collectives.
+
+This is the TPU-native replacement for the reference's entire distribution
+story — per-GPU Docker containers plus a single in-graph NCCL all-reduce
+(reference pg_gans.py:1165-1170, rafiki/container/docker_swarm.py). Here,
+parallelism is expressed as shardings over a `jax.sharding.Mesh`; XLA inserts
+the collectives (psum/all-gather/reduce-scatter) over ICI.
+"""
+
+from rafiki_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    get_default_mesh,
+    make_mesh,
+    visible_devices,
+)

@@ -1,0 +1,94 @@
+"""Device-mesh construction with chip-affine sub-slicing.
+
+The reference pins a worker to GPUs via ``CUDA_VISIBLE_DEVICES`` set by the
+swarm placement layer (reference rafiki/container/docker_swarm.py:122-126).
+The TPU analogue here: the placement layer grants an executor a *subset of
+mesh devices* via the ``RAFIKI_VISIBLE_DEVICES`` env var (comma-separated
+``jax.devices()`` indices), and every model builds its mesh through
+``get_default_mesh()`` so trials running side-by-side on one host occupy
+disjoint chips.
+
+Mesh axes follow the scaling-book convention: ``data`` (DP) innermost-most
+plentiful, ``model`` (TP) over fast ICI neighbours, plus optional ``seq`` (SP)
+and ``expert`` (EP) axes for long-context / MoE models.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+PIPELINE_AXIS = "pipe"
+
+
+def visible_devices() -> List[jax.Device]:
+    """Devices this process may use, honouring the placement layer's grant."""
+    devices = jax.devices()
+    spec = os.environ.get("RAFIKI_VISIBLE_DEVICES", "").strip()
+    if not spec:
+        return devices
+    idxs = [int(s) for s in spec.split(",") if s.strip()]
+    return [devices[i] for i in idxs]
+
+
+@dataclass
+class MeshSpec:
+    """Declarative mesh shape. ``-1`` on one axis means "all remaining
+    devices"."""
+
+    axes: Dict[str, int] = field(default_factory=lambda: {DATA_AXIS: -1})
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        fixed = {k: v for k, v in self.axes.items() if v != -1}
+        known = int(np.prod(list(fixed.values()))) if fixed else 1
+        free = [k for k, v in self.axes.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError("At most one mesh axis may be -1")
+        out = dict(fixed)
+        if free:
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            out[free[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(f"Mesh {self.axes} needs {known} devices, have {n_devices}")
+        # preserve declaration order
+        return {k: out[k] for k in self.axes}
+
+
+def make_mesh(
+    spec: Optional[MeshSpec] = None, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a Mesh over the granted devices (default: pure data-parallel)."""
+    devices = list(devices if devices is not None else visible_devices())
+    spec = spec or MeshSpec()
+    shape = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(tuple(shape.values()))
+    return Mesh(arr, tuple(shape.keys()))
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def get_default_mesh() -> Mesh:
+    """Process-wide default mesh over the granted devices (data axis only).
+    Rebuilt if the device grant changed (tests flip RAFIKI_VISIBLE_DEVICES)."""
+    global _default_mesh
+    devs = visible_devices()
+    if _default_mesh is None or list(_default_mesh.devices.flat) != devs:
+        _default_mesh = make_mesh(devices=devs)
+    return _default_mesh
+
+
+def mesh_shape(mesh: Mesh) -> Tuple[int, ...]:
+    return tuple(mesh.devices.shape)

@@ -1,0 +1,4 @@
+"""Serving layer: continuous-batching predictor + ensembling
+(reference rafiki/predictor/)."""
+
+from rafiki_tpu.predictor.ensemble import ensemble_predictions  # noqa: F401

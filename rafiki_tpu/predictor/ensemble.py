@@ -1,0 +1,46 @@
+"""Prediction ensembling across the best trials of a train job
+(reference rafiki/predictor/ensemble.py:6-33).
+
+IMAGE_CLASSIFICATION / TEXT_CLASSIFICATION: predictions are per-class
+probability vectors — ensemble by elementwise mean. Other tasks: take the
+first worker's predictions. All outputs are JSON-native (numpy stripped).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+_PROB_TASKS = {"IMAGE_CLASSIFICATION", "TEXT_CLASSIFICATION"}
+
+
+def _to_json_native(value: Any) -> Any:
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    if isinstance(value, dict):
+        return {k: _to_json_native(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_json_native(v) for v in value]
+    return value
+
+
+def ensemble_predictions(
+    worker_predictions: List[List[Any]], task: Optional[str]
+) -> List[Any]:
+    """Combine per-worker prediction lists (one list per model worker, one
+    entry per query) into a single prediction list."""
+    worker_predictions = [p for p in worker_predictions if p is not None]
+    if not worker_predictions:
+        return []
+    if task in _PROB_TASKS:
+        try:
+            stacked = np.asarray(worker_predictions, dtype=np.float64)
+            return _to_json_native(stacked.mean(axis=0))
+        except (ValueError, TypeError):
+            pass  # ragged/non-numeric predictions: fall through
+    return _to_json_native(worker_predictions[0])

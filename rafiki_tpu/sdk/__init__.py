@@ -1,0 +1,30 @@
+"""Model SDK (L1): the contract user model templates implement, plus the
+JAX/XLA training backend, knob types, dataset utilities, parameter
+serialization, and structured in-model logging.
+
+Reference analogue: rafiki/model/ (SURVEY.md §2.1)."""
+
+from rafiki_tpu.sdk.dataset import dataset_utils  # noqa: F401
+from rafiki_tpu.sdk.jax_backend import (  # noqa: F401
+    DataParallelTrainer,
+    classification_accuracy,
+    softmax_classifier_loss,
+)
+from rafiki_tpu.sdk.knob import (  # noqa: F401
+    BaseKnob,
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    deserialize_knob_config,
+    serialize_knob_config,
+)
+from rafiki_tpu.sdk.log import ModelLogger, logger, parse_logs  # noqa: F401
+from rafiki_tpu.sdk.model import (  # noqa: F401
+    BaseModel,
+    InvalidModelClassError,
+    load_model_class,
+    test_model_class,
+    validate_model_dependencies,
+)
+from rafiki_tpu.sdk.params import dump_params, load_params  # noqa: F401

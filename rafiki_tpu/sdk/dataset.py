@@ -1,0 +1,270 @@
+"""Dataset utilities for model templates (reference rafiki/model/dataset.py).
+
+Capability parity:
+- URI fetch with a local cache (file paths, ``file://``, ``http(s)://``) —
+  reference dataset.py:80-120;
+- ``CorpusDataset``: zip archive containing ``corpus.tsv`` of tab-separated
+  token/tag rows with blank-line sentence boundaries — reference
+  dataset.py:140-209 and docs/src/user/datasets.rst;
+- ``ImageFilesDataset``: zip archive containing ``images.csv`` (columns
+  ``path,class``) plus image files, lazily decoded — reference
+  dataset.py:211-268;
+- ``resize_as_images`` — reference dataset.py:68.
+
+TPU-first addition: ``NumpyDataset`` (a ``.npz`` of dense arrays) as the fast
+path — image datasets decode once to a dense ``float32``/``int32`` array pair
+so the training loop feeds the chip from pinned host memory instead of
+re-decoding PNGs per epoch.
+"""
+
+from __future__ import annotations
+
+import csv
+import hashlib
+import io
+import os
+import shutil
+import tempfile
+import urllib.request
+import zipfile
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class InvalidDatasetError(Exception):
+    pass
+
+
+class DatasetUtils:
+    """Singleton facade exposed to model code as ``dataset_utils``
+    (reference rafiki/model/dataset.py:25)."""
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        self._cache_dir = cache_dir or os.path.join(
+            tempfile.gettempdir(), "rafiki_tpu_datasets"
+        )
+
+    def download_dataset_from_uri(self, uri: str) -> str:
+        """Resolve a dataset URI to a local file path, downloading through a
+        content-addressed cache when remote."""
+        if uri.startswith("file://"):
+            return uri[len("file://") :]
+        if uri.startswith("http://") or uri.startswith("https://"):
+            os.makedirs(self._cache_dir, exist_ok=True)
+            key = hashlib.sha256(uri.encode()).hexdigest()[:24]
+            dest = os.path.join(self._cache_dir, key + os.path.basename(uri))
+            if not os.path.exists(dest):
+                tmp = dest + ".part"
+                with urllib.request.urlopen(uri) as r, open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+                os.replace(tmp, dest)
+            return dest
+        # plain (possibly relative) filesystem path — allowed by the reference
+        # loader too (reference dataset.py:113-114)
+        if not os.path.exists(uri):
+            raise InvalidDatasetError(f"Dataset not found: {uri}")
+        return uri
+
+    def load_dataset_of_corpus(self, uri: str) -> "CorpusDataset":
+        return CorpusDataset(self.download_dataset_from_uri(uri))
+
+    def load_dataset_of_image_files(
+        self, uri: str, image_size: Optional[Tuple[int, int]] = None
+    ) -> "ImageFilesDataset":
+        return ImageFilesDataset(self.download_dataset_from_uri(uri), image_size)
+
+    def load_dataset_of_arrays(self, uri: str) -> "NumpyDataset":
+        return NumpyDataset(self.download_dataset_from_uri(uri))
+
+    def resize_as_images(
+        self, images: Sequence[Any], image_size: Tuple[int, int]
+    ) -> np.ndarray:
+        """Resize a batch of images (arrays or PIL images) to
+        ``image_size=(H, W)``, returning a float32 array in [0, 1] of shape
+        (N, H, W, C). (PIL's own convention is (W, H); the conversion is
+        handled here so callers stay in array-land.)"""
+        from PIL import Image
+
+        out = []
+        for img in images:
+            if isinstance(img, np.ndarray):
+                arr = img
+                if arr.dtype != np.uint8:
+                    arr = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+                pil = Image.fromarray(arr.squeeze() if arr.ndim == 3 and arr.shape[-1] == 1 else arr)
+            else:
+                pil = img
+            pil = pil.resize((image_size[1], image_size[0]))
+            arr = np.asarray(pil, dtype=np.float32) / 255.0
+            if arr.ndim == 2:
+                arr = arr[..., None]
+            out.append(arr)
+        return np.stack(out)
+
+
+class CorpusDataset:
+    """Zip of ``corpus.tsv``: tab-separated token + tag columns, sentences
+    separated by blank lines. Exposes (tokens, tags) sentence pairs and the
+    tag vocabulary."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.sentences: List[Tuple[List[str], List[List[str]]]] = []
+        tag_vocab: List[set] = []
+        with zipfile.ZipFile(path) as zf:
+            if "corpus.tsv" not in zf.namelist():
+                raise InvalidDatasetError("corpus zip must contain corpus.tsv")
+            with zf.open("corpus.tsv") as f:
+                text = io.TextIOWrapper(f, encoding="utf-8")
+                tokens: List[str] = []
+                tags: List[List[str]] = []
+                for line in text:
+                    line = line.rstrip("\n")
+                    if not line.strip():
+                        if tokens:
+                            self.sentences.append((tokens, tags))
+                            tokens, tags = [], []
+                        continue
+                    cols = line.split("\t")
+                    tokens.append(cols[0])
+                    row_tags = cols[1:]
+                    tags.append(row_tags)
+                    while len(tag_vocab) < len(row_tags):
+                        tag_vocab.append(set())
+                    for i, t in enumerate(row_tags):
+                        tag_vocab[i].add(t)
+                if tokens:
+                    self.sentences.append((tokens, tags))
+        self.tag_num_classes = [len(v) for v in tag_vocab]
+        self.tag_vocabs = [sorted(v) for v in tag_vocab]
+        self.size = len(self.sentences)
+        self.max_len = max((len(t) for t, _ in self.sentences), default=0)
+
+    def __iter__(self) -> Iterator[Tuple[List[str], List[List[str]]]]:
+        return iter(self.sentences)
+
+    def __len__(self) -> int:
+        return self.size
+
+
+class ImageFilesDataset:
+    """Zip of ``images.csv`` (columns ``path,class``) + image files.
+
+    Iterating yields (PIL image, class) lazily; ``load_as_arrays`` decodes the
+    whole dataset once into dense arrays for the TPU input path.
+    ``image_size`` is (H, W), matching the (N, H, W, C) array convention.
+    """
+
+    def __init__(self, path: str, image_size: Optional[Tuple[int, int]] = None):
+        self.path = path
+        self._image_size = image_size
+        with zipfile.ZipFile(path) as zf:
+            if "images.csv" not in zf.namelist():
+                raise InvalidDatasetError("image dataset zip must contain images.csv")
+            with zf.open("images.csv") as f:
+                rows = list(csv.DictReader(io.TextIOWrapper(f, encoding="utf-8")))
+        if not rows or "path" not in rows[0] or "class" not in rows[0]:
+            raise InvalidDatasetError("images.csv must have columns: path, class")
+        self._rows = [(r["path"], int(r["class"])) for r in rows]
+        self.classes = sorted({c for _, c in self._rows})
+        self.label_num_classes = max(self.classes) + 1 if self.classes else 0
+        self.size = len(self._rows)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __iter__(self):
+        from PIL import Image
+
+        with zipfile.ZipFile(self.path) as zf:
+            for rel, cls in self._rows:
+                with zf.open(rel) as f:
+                    img = Image.open(io.BytesIO(f.read()))
+                    if self._image_size is not None:
+                        h, w = self._image_size
+                        img = img.resize((w, h))
+                    yield img, cls
+
+    def load_as_arrays(
+        self, image_size: Optional[Tuple[int, int]] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Decode all images to (N, H, W, C) float32 in [0,1] + int32 labels."""
+        size = image_size or self._image_size
+        xs: List[np.ndarray] = []
+        ys: List[int] = []
+        for img, cls in self:
+            if size is not None and img.size != (size[1], size[0]):
+                img = img.resize((size[1], size[0]))
+            arr = np.asarray(img, dtype=np.float32) / 255.0
+            if arr.ndim == 2:
+                arr = arr[..., None]
+            xs.append(arr)
+            ys.append(cls)
+        return np.stack(xs), np.asarray(ys, dtype=np.int32)
+
+
+class NumpyDataset:
+    """A ``.npz`` with arrays ``x`` and ``y`` — the dense fast path."""
+
+    def __init__(self, path: str):
+        with np.load(path) as z:
+            if "x" not in z or "y" not in z:
+                raise InvalidDatasetError(".npz dataset must contain arrays x and y")
+            self.x = z["x"]
+            self.y = z["y"]
+        if len(self.x) != len(self.y):
+            raise InvalidDatasetError("x and y lengths differ")
+        self.size = len(self.x)
+        self.label_num_classes = int(self.y.max()) + 1 if self.size else 0
+
+    def __len__(self) -> int:
+        return self.size
+
+
+def write_image_files_dataset(
+    images: np.ndarray, labels: np.ndarray, out_path: str
+) -> str:
+    """Helper to build an IMAGE_FILES zip from dense arrays (the inverse of
+    ImageFilesDataset; analogue of the reference's dataset converters at
+    examples/datasets/image_classification/load_mnist_format.py)."""
+    from PIL import Image
+
+    with zipfile.ZipFile(out_path, "w", zipfile.ZIP_STORED) as zf:
+        lines = ["path,class"]
+        for i, (img, lbl) in enumerate(zip(images, labels)):
+            arr = img
+            if arr.dtype != np.uint8:
+                arr = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+            if arr.ndim == 3 and arr.shape[-1] == 1:
+                arr = arr[..., 0]
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="PNG")
+            rel = f"images/{i}.png"
+            zf.writestr(rel, buf.getvalue())
+            lines.append(f"{rel},{int(lbl)}")
+        zf.writestr("images.csv", "\n".join(lines) + "\n")
+    return out_path
+
+
+def write_corpus_dataset(
+    sentences: Sequence[Tuple[Sequence[str], Sequence[Sequence[str]]]], out_path: str
+) -> str:
+    """Helper to build a CORPUS zip from (tokens, tags) sentence pairs."""
+    lines: List[str] = []
+    for tokens, tags in sentences:
+        for tok, row_tags in zip(tokens, tags):
+            lines.append("\t".join([tok, *row_tags]))
+        lines.append("")
+    with zipfile.ZipFile(out_path, "w") as zf:
+        zf.writestr("corpus.tsv", "\n".join(lines) + "\n")
+    return out_path
+
+
+def write_numpy_dataset(x: np.ndarray, y: np.ndarray, out_path: str) -> str:
+    np.savez_compressed(out_path, x=x, y=y)
+    return out_path
+
+
+#: module singleton, mirroring the reference's `dataset_utils`
+dataset_utils = DatasetUtils()

@@ -1,0 +1,96 @@
+"""In-model structured logging (reference rafiki/model/log.py:14-192).
+
+Model code logs messages, metrics, and plot definitions through a
+``ModelLogger``; each line is a typed JSON record. The train worker installs a
+sink that persists every line to the trial's log in the metadata store, and
+``parse_logs`` reassembles records into messages/metrics/plots for UIs
+(reference usage: worker/train.py:158-165, admin/admin.py:333,
+web TrialDetailPage.tsx:205).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+LogLine = str
+Sink = Callable[[LogLine], None]
+
+
+class LogType:
+    MESSAGE = "MESSAGE"
+    METRICS = "METRICS"
+    PLOT = "PLOT"
+
+
+class ModelLogger:
+    """Structured logger injected into models as ``self.logger`` / the module
+    singleton ``logger``. Thread-safe enough for one trial per logger instance
+    (the worker swaps sinks per trial, mirroring reference set_logger at
+    rafiki/model/log.py:104)."""
+
+    def __init__(self) -> None:
+        self._sink: Optional[Sink] = None
+        self._echo = True
+
+    def set_sink(self, sink: Optional[Sink], echo: bool = False) -> None:
+        self._sink = sink
+        self._echo = echo or sink is None
+
+    def log(self, msg: str = "", **metrics: float) -> None:
+        """Log a free-form message and/or named numeric metrics."""
+        if msg:
+            self._emit({"type": LogType.MESSAGE, "message": str(msg)})
+        if metrics:
+            clean = {k: float(v) for k, v in metrics.items()}
+            self._emit({"type": LogType.METRICS, "metrics": clean})
+
+    def define_plot(
+        self, title: str, metrics: List[str], x_axis: Optional[str] = None
+    ) -> None:
+        """Declare that `metrics` should be plotted against `x_axis`
+        (default: log time)."""
+        self._emit(
+            {"type": LogType.PLOT, "title": title, "metrics": list(metrics), "x_axis": x_axis}
+        )
+
+    def _emit(self, record: Dict[str, Any]) -> None:
+        record["time"] = time.time()
+        line = json.dumps(record)
+        if self._sink is not None:
+            self._sink(line)
+        if self._echo:
+            print(f"[model] {line}")
+
+
+def parse_logs(lines: List[LogLine]) -> Dict[str, List[Dict[str, Any]]]:
+    """Reassemble raw log lines into messages / metrics / plots
+    (reference rafiki/model/log.py:125-158)."""
+    messages: List[Dict[str, Any]] = []
+    metrics: List[Dict[str, Any]] = []
+    plots: List[Dict[str, Any]] = []
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, TypeError):
+            messages.append({"message": str(line), "time": None})
+            continue
+        rtype = rec.get("type")
+        if rtype == LogType.MESSAGE:
+            messages.append({"message": rec.get("message"), "time": rec.get("time")})
+        elif rtype == LogType.METRICS:
+            metrics.append({**rec.get("metrics", {}), "time": rec.get("time")})
+        elif rtype == LogType.PLOT:
+            plots.append(
+                {
+                    "title": rec.get("title"),
+                    "metrics": rec.get("metrics"),
+                    "x_axis": rec.get("x_axis"),
+                }
+            )
+    return {"messages": messages, "metrics": metrics, "plots": plots}
+
+
+#: module singleton used by model code: `from rafiki_tpu.sdk import logger`
+logger = ModelLogger()

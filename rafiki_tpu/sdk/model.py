@@ -1,0 +1,216 @@
+"""The model-template contract — what users implement and upload.
+
+Capability parity with the reference's BaseModel (reference
+rafiki/model/model.py:20-127): ``get_knob_config`` (static), ``train``,
+``evaluate`` -> float score, ``predict`` -> JSON-able list, parameter
+dump/load, ``destroy``; plus ``load_model_class`` (deserialize an uploaded
+``.py``, reference model.py:221-242) and the local contract harness
+``test_model_class`` (reference model.py:129-219).
+
+Differences by design:
+- parameters are msgpack'd pytrees, not pickles (see sdk/params.py);
+- declared dependencies are *validated as importable*, not pip-installed per
+  worker boot (the reference ran ``pip install`` in every container,
+  reference scripts/start_worker.py:6-9 — dead time the TPU build eliminates);
+- models get a device mesh from the placement layer (chip affinity) instead
+  of CUDA_VISIBLE_DEVICES.
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib.util
+import inspect
+import json
+import os
+import sys
+import tempfile
+import traceback
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from rafiki_tpu.sdk.knob import (
+    BaseKnob,
+    KnobConfig,
+    serialize_knob_config,
+    validate_knobs,
+)
+from rafiki_tpu.sdk.log import ModelLogger, logger as _module_logger
+
+
+class InvalidModelClassError(Exception):
+    pass
+
+
+class BaseModel(abc.ABC):
+    """Abstract contract every model template implements.
+
+    Subclasses are instantiated once per trial as ``Model(**knobs)`` with a
+    concrete knob assignment proposed by the advisor.
+    """
+
+    #: declared dependencies: {package_name: version_spec_or_None}
+    dependencies: Dict[str, Optional[str]] = {}
+
+    def __init__(self, **knobs: Any):
+        self._knobs = knobs
+        self.logger: ModelLogger = _module_logger
+
+    @staticmethod
+    @abc.abstractmethod
+    def get_knob_config() -> KnobConfig:
+        """The tunable hyperparameter space for this template."""
+
+    @abc.abstractmethod
+    def train(self, dataset_uri: str) -> None:
+        """Train on the dataset at `dataset_uri`."""
+
+    @abc.abstractmethod
+    def evaluate(self, dataset_uri: str) -> float:
+        """Return a scalar score (higher is better) on the dataset."""
+
+    @abc.abstractmethod
+    def predict(self, queries: List[Any]) -> List[Any]:
+        """Return one JSON-able prediction per query."""
+
+    @abc.abstractmethod
+    def dump_parameters(self) -> Any:
+        """Return a serializable pytree of trained parameters."""
+
+    @abc.abstractmethod
+    def load_parameters(self, params: Any) -> None:
+        """Restore trained parameters produced by ``dump_parameters``."""
+
+    def destroy(self) -> None:
+        """Release resources (default: no-op)."""
+
+
+def load_model_class(
+    model_bytes: bytes, class_name: str, temp_dir: Optional[str] = None
+) -> type:
+    """Import an uploaded model template's ``.py`` bytes and return its class
+    (reference rafiki/model/model.py:221-242)."""
+    tmp = tempfile.NamedTemporaryFile(
+        "wb", suffix=".py", dir=temp_dir, delete=False
+    )
+    try:
+        tmp.write(model_bytes)
+        tmp.close()
+        mod_name = f"rafiki_model_{os.path.basename(tmp.name)[:-3]}"
+        spec = importlib.util.spec_from_file_location(mod_name, tmp.name)
+        assert spec is not None and spec.loader is not None
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = module
+        spec.loader.exec_module(module)
+        clazz = getattr(module, class_name, None)
+        if clazz is None or not inspect.isclass(clazz):
+            raise InvalidModelClassError(
+                f"Class {class_name!r} not found in uploaded model file"
+            )
+        if not issubclass(clazz, BaseModel):
+            raise InvalidModelClassError(
+                f"{class_name} must subclass rafiki_tpu BaseModel"
+            )
+        return clazz
+    finally:
+        try:
+            os.unlink(tmp.name)
+        except OSError:
+            pass
+
+
+def validate_model_dependencies(clazz: type) -> List[str]:
+    """Check declared dependencies are importable in this environment; return
+    the missing ones. Replaces the reference's install-command synthesis
+    (reference rafiki/model/model.py:244-273)."""
+    _ALIASES = {"scikit-learn": "sklearn", "pillow": "PIL", "pyyaml": "yaml"}
+    missing = []
+    for dep in getattr(clazz, "dependencies", {}) or {}:
+        mod = _ALIASES.get(dep.lower(), dep.replace("-", "_"))
+        if importlib.util.find_spec(mod) is None:
+            missing.append(dep)
+    return missing
+
+
+def test_model_class(
+    model_file_path: Optional[str] = None,
+    model_class: Optional[str] = None,
+    task: Optional[str] = None,
+    dependencies: Optional[Dict[str, Optional[str]]] = None,
+    train_dataset_uri: Optional[str] = None,
+    test_dataset_uri: Optional[str] = None,
+    queries: Optional[List[Any]] = None,
+    clazz: Optional[type] = None,
+    knobs: Optional[Dict[str, Any]] = None,
+) -> List[Any]:
+    """Local contract-conformance harness (reference rafiki/model/model.py:129-219).
+
+    Runs the full lifecycle a deployed trial would: dependency check ->
+    knob-config check -> in-process advisor proposal -> train -> evaluate ->
+    parameter dump/restore round-trip through bytes -> destroy + fresh
+    instance -> predict -> JSON-serializability check -> ensembling smoke
+    test. Returns the predictions.
+
+    Call with either ``clazz=`` (an already-imported class) or
+    ``model_file_path=`` + ``model_class=``.
+    """
+    from rafiki_tpu.advisor.advisor import Advisor
+    from rafiki_tpu.predictor.ensemble import ensemble_predictions
+    from rafiki_tpu.sdk.params import dump_params, load_params
+
+    if clazz is None:
+        assert model_file_path is not None and model_class is not None
+        with open(model_file_path, "rb") as f:
+            clazz = load_model_class(f.read(), model_class)
+
+    missing = validate_model_dependencies(clazz)
+    if missing:
+        raise InvalidModelClassError(f"Missing dependencies: {missing}")
+
+    knob_config = clazz.get_knob_config()
+    for name, knob in knob_config.items():
+        if not isinstance(knob, BaseKnob):
+            raise InvalidModelClassError(f"Knob {name!r} is not a BaseKnob")
+    # knob config must survive the HTTP wire format
+    serialize_knob_config(knob_config)
+
+    if knobs is None:
+        advisor = Advisor(knob_config)
+        knobs = advisor.propose()
+    validate_knobs(knob_config, knobs)
+    print(f"[test_model_class] knobs: {knobs}")
+
+    model = clazz(**knobs)
+    assert train_dataset_uri is not None and test_dataset_uri is not None
+    model.train(train_dataset_uri)
+    score = model.evaluate(test_dataset_uri)
+    try:
+        score = float(score)  # accepts python/numpy/jax scalars alike
+    except (TypeError, ValueError):
+        raise InvalidModelClassError("evaluate() must return a float score")
+    print(f"[test_model_class] score: {score}")
+
+    # round-trip parameters through bytes, as the worker/predictor would
+    params_bytes = dump_params(model.dump_parameters())
+    model.destroy()
+
+    model = clazz(**knobs)
+    model.load_parameters(load_params(params_bytes))
+
+    queries = queries if queries is not None else []
+    predictions = model.predict(queries)
+    if not isinstance(predictions, list) or len(predictions) != len(queries):
+        raise InvalidModelClassError("predict() must return one prediction per query")
+    try:
+        json.dumps(predictions)
+    except (TypeError, ValueError) as e:
+        raise InvalidModelClassError(f"Predictions not JSON-serializable: {e}")
+
+    if queries:
+        # ensembling smoke test across two copies of the same predictions
+        ensemble_predictions([predictions, predictions], task)
+
+    model.destroy()
+    print("[test_model_class] OK")
+    return predictions

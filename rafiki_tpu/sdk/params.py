@@ -1,0 +1,40 @@
+"""Model parameter serialization: pytree <-> bytes.
+
+The reference pickles arbitrary ``dump_parameters()`` dicts to a shared volume
+(reference rafiki/worker/train.py:177-183) and unpickles them in inference
+workers and clients (reference rafiki/worker/inference.py:86-92,
+rafiki/client/client.py:487-506). Pickle executes arbitrary code on load and
+can't represent device arrays portably, so here parameters are a *pytree* of
+numpy/JAX arrays + JSON-able scalars, serialized with msgpack (flax's
+serialization extension handles ndarray leaves). Device arrays are pulled to
+host numpy on save; models re-shard on load.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_host(tree: Any) -> Any:
+    """Convert all array leaves to host numpy (device -> host transfer)."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def dump_params(params: Any) -> bytes:
+    """Serialize a parameter pytree to bytes (msgpack)."""
+    return serialization.msgpack_serialize(_to_host(params))
+
+
+def load_params(data: bytes) -> Any:
+    """Deserialize bytes back into a parameter pytree of numpy leaves."""
+    return serialization.msgpack_restore(data)

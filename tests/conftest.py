@@ -1,0 +1,32 @@
+"""Test configuration: fake an 8-device TPU topology on CPU.
+
+Must run before JAX initializes its backends, hence the env mutation at
+import time. This gives unit tests a real multi-device mesh to shard over —
+the distributed-test simulation layer the reference never had (SURVEY.md §4).
+"""
+
+import os
+
+if not os.environ.get("RAFIKI_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # jax may already be imported (e.g. a sitecustomize TPU tunnel hook); a
+    # config update still wins as long as no computation has run yet.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture()
+def tmp_workdir(tmp_path, monkeypatch):
+    """An isolated workdir (data/params/logs/db) for stack tests."""
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    for sub in ("data", "params", "logs"):
+        (tmp_path / sub).mkdir()
+    return tmp_path

@@ -1,0 +1,126 @@
+import numpy as np
+
+from rafiki_tpu.advisor import Advisor, AdvisorStore, RandomAdvisor
+from rafiki_tpu.advisor.gp import BayesOpt, GaussianProcess
+from rafiki_tpu.sdk.knob import (
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    validate_knobs,
+)
+
+
+def _config():
+    return {
+        "x": FloatKnob(0.0, 1.0),
+        "n": IntegerKnob(1, 10),
+        "c": CategoricalKnob(["a", "b"]),
+        "f": FixedKnob("const"),
+    }
+
+
+def test_gp_fits_and_predicts():
+    rng = np.random.default_rng(0)
+    X = rng.random((20, 2))
+    y = np.sin(3 * X[:, 0]) + X[:, 1]
+    gp = GaussianProcess()
+    gp.fit(X, y)
+    mu, sigma = gp.predict(X)
+    # near-interpolation at observed points
+    assert np.abs(mu - y).max() < 0.05
+    assert (sigma >= 0).all()
+    # uncertainty grows away from data
+    far = np.full((1, 2), 0.5) + 10.0
+    _, s_far = gp.predict(far)
+    assert s_far[0] > sigma.mean()
+
+
+def test_bayesopt_improves_over_random():
+    def objective(x):
+        return -((x[0] - 0.3) ** 2) - (x[1] - 0.7) ** 2
+
+    def run(opt_cls_seed):
+        opt = BayesOpt(2, seed=opt_cls_seed)
+        best = -np.inf
+        for _ in range(25):
+            x = opt.suggest()
+            y = objective(x)
+            opt.observe(x, y)
+            best = max(best, y)
+        return best
+
+    best_bo = np.mean([run(s) for s in range(3)])
+    # pure random baseline
+    rng = np.random.default_rng(0)
+    best_rand = np.mean(
+        [
+            max(objective(rng.random(2)) for _ in range(25))
+            for _ in range(3)
+        ]
+    )
+    assert best_bo >= best_rand - 1e-3
+
+
+def test_pending_points_spread_out():
+    opt = BayesOpt(1, seed=0)
+    for _ in range(5):
+        x = opt.suggest()
+        opt.observe(x, -float((x[0] - 0.5) ** 2))
+    # two concurrent proposals without feedback should differ (constant liar)
+    a = opt.suggest()
+    b = opt.suggest()
+    assert not np.allclose(a, b)
+
+
+def test_advisor_proposals_valid_and_json():
+    import json
+
+    cfg = _config()
+    adv = Advisor(cfg)
+    for i in range(8):
+        knobs = adv.propose()
+        validate_knobs(cfg, knobs)
+        json.dumps(knobs)  # JSON-native (no numpy scalars)
+        assert knobs["f"] == "const"
+        adv.feedback(knobs, float(i))
+
+
+def test_random_advisor():
+    cfg = _config()
+    adv = RandomAdvisor(cfg)
+    knobs = adv.propose()
+    validate_knobs(cfg, knobs)
+    adv.feedback(knobs, 1.0)
+
+
+def test_advisor_store_sessions():
+    store = AdvisorStore()
+    cfg = _config()
+    aid = store.create_advisor(cfg, advisor_id="sub-job-1")
+    # idempotent create: same id returns the same session (shared advisor per
+    # sub-train-job — the coordination fix over the reference)
+    assert store.create_advisor(cfg, advisor_id="sub-job-1") == aid
+    knobs = store.propose(aid)
+    validate_knobs(cfg, knobs)
+    nxt = store.feedback(aid, knobs, 0.5)
+    validate_knobs(cfg, nxt)
+    store.delete_advisor(aid)
+    try:
+        store.get(aid)
+        assert False
+    except KeyError:
+        pass
+
+
+def test_pending_retired_on_feedback_with_grid_knobs():
+    # regression: integer/categorical quantization must not leak fantasies
+    from rafiki_tpu.sdk.knob import IntegerKnob
+
+    cfg = {"n": IntegerKnob(1, 10)}
+    adv = Advisor(cfg)
+    for i in range(10):
+        knobs = adv.propose()
+        assert len(adv._opt.pending_X) == 1
+        adv.feedback(knobs, float(i))
+        assert len(adv._opt.pending_X) == 0

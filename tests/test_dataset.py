@@ -1,0 +1,67 @@
+import numpy as np
+import pytest
+
+from rafiki_tpu.sdk.dataset import (
+    dataset_utils,
+    write_corpus_dataset,
+    write_image_files_dataset,
+    write_numpy_dataset,
+)
+
+
+def test_image_files_dataset_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.random((12, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 3, 12)
+    path = write_image_files_dataset(x, y, str(tmp_path / "imgs.zip"))
+    ds = dataset_utils.load_dataset_of_image_files(path)
+    assert len(ds) == 12
+    assert ds.label_num_classes == 3
+    xs, ys = ds.load_as_arrays()
+    assert xs.shape == (12, 8, 8, 3)
+    np.testing.assert_array_equal(ys, y)
+    # PNG roundtrip is 8-bit: within 1/255
+    assert np.abs(xs - x).max() < 1.5 / 255
+
+
+def test_corpus_dataset_roundtrip(tmp_path):
+    sents = [
+        (["the", "cat", "sat"], [["DT"], ["NN"], ["VB"]]),
+        (["dogs", "run"], [["NNS"], ["VB"]]),
+    ]
+    path = write_corpus_dataset(sents, str(tmp_path / "corpus.zip"))
+    ds = dataset_utils.load_dataset_of_corpus(path)
+    assert len(ds) == 2
+    assert ds.max_len == 3
+    assert ds.tag_num_classes == [4]  # DT, NN, VB, NNS
+    toks, tags = ds.sentences[0]
+    assert toks == ["the", "cat", "sat"]
+    assert tags == [["DT"], ["NN"], ["VB"]]
+
+
+def test_numpy_dataset(tmp_path):
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10) % 4
+    path = write_numpy_dataset(x, y, str(tmp_path / "d.npz"))
+    ds = dataset_utils.load_dataset_of_arrays(path)
+    assert len(ds) == 10
+    assert ds.label_num_classes == 4
+    np.testing.assert_array_equal(ds.x, x)
+
+
+def test_file_uri_and_missing(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"hi")
+    assert dataset_utils.download_dataset_from_uri(f"file://{p}") == str(p)
+    assert dataset_utils.download_dataset_from_uri(str(p)) == str(p)
+    from rafiki_tpu.sdk.dataset import InvalidDatasetError
+
+    with pytest.raises(InvalidDatasetError):
+        dataset_utils.download_dataset_from_uri(str(tmp_path / "nope"))
+
+
+def test_resize_as_images():
+    imgs = [np.zeros((4, 4, 3), np.float32), np.ones((6, 6, 3), np.float32)]
+    out = dataset_utils.resize_as_images(imgs, (8, 8))
+    assert out.shape == (2, 8, 8, 3)
+    assert out.max() <= 1.0

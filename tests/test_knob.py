@@ -1,0 +1,77 @@
+import numpy as np
+import pytest
+
+from rafiki_tpu.sdk.knob import (
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    deserialize_knob_config,
+    knob_config_dims,
+    knobs_from_unit,
+    knobs_to_unit,
+    serialize_knob_config,
+    validate_knobs,
+)
+
+
+def _config():
+    return {
+        "units": IntegerKnob(8, 128),
+        "lr": FloatKnob(1e-5, 1e-1, is_exp=True),
+        "act": CategoricalKnob(["relu", "tanh", "gelu"]),
+        "epochs": FixedKnob(3),
+    }
+
+
+def test_json_roundtrip():
+    cfg = _config()
+    j = serialize_knob_config(cfg)
+    cfg2 = deserialize_knob_config(j)
+    assert cfg == cfg2
+
+
+def test_unit_roundtrip():
+    cfg = _config()
+    assert knob_config_dims(cfg) == 3  # fixed knob contributes 0 dims
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        u = rng.random(3)
+        knobs = knobs_from_unit(cfg, u)
+        validate_knobs(cfg, knobs)
+        u2 = knobs_to_unit(cfg, knobs)
+        # decoding the re-encoded point gives the same knobs (stable grid)
+        assert knobs_from_unit(cfg, u2) == knobs
+
+
+def test_exp_knob_log_spacing():
+    k = FloatKnob(1e-4, 1e-1, is_exp=True)
+    lo = k.from_unit(np.array([0.0]))
+    mid = k.from_unit(np.array([0.5]))
+    hi = k.from_unit(np.array([1.0]))
+    assert lo == pytest.approx(1e-4)
+    assert hi == pytest.approx(1e-1)
+    assert mid == pytest.approx(10 ** (-2.5), rel=1e-6)
+
+
+def test_integer_knob_bounds_and_validation():
+    k = IntegerKnob(2, 9)
+    vals = {k.from_unit(np.array([x])) for x in np.linspace(0, 1, 100)}
+    assert min(vals) == 2 and max(vals) == 9
+    assert k.validate(5) and not k.validate(10) and not k.validate(2.5)
+
+
+def test_categorical_knob_midpoints():
+    k = CategoricalKnob(["a", "b", "c"])
+    for v in ["a", "b", "c"]:
+        assert k.from_unit(k.to_unit(v)) == v
+
+
+def test_validate_knobs_rejects_mismatch():
+    cfg = _config()
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {"units": 16})
+    with pytest.raises(ValueError):
+        validate_knobs(
+            cfg, {"units": 999, "lr": 1e-3, "act": "relu", "epochs": 3}
+        )

@@ -1,0 +1,40 @@
+import jax.numpy as jnp
+import numpy as np
+
+from rafiki_tpu.sdk.log import ModelLogger, parse_logs
+from rafiki_tpu.sdk.params import dump_params, load_params
+
+
+def test_params_roundtrip_numpy_and_jax():
+    params = {
+        "dense": {"w": np.ones((4, 3), np.float32), "b": jnp.zeros((3,))},
+        "scale": 2.5,
+        "meta": {"classes": [0, 1, 2], "name": "m"},
+    }
+    data = dump_params(params)
+    assert isinstance(data, bytes)
+    out = load_params(data)
+    np.testing.assert_array_equal(out["dense"]["w"], params["dense"]["w"])
+    np.testing.assert_array_equal(out["dense"]["b"], np.zeros((3,)))
+    assert out["scale"] == 2.5
+    assert out["meta"]["name"] == "m"
+
+
+def test_logger_sink_and_parse():
+    lines = []
+    lg = ModelLogger()
+    lg.set_sink(lines.append)
+    lg.define_plot("loss curve", ["loss"], x_axis="epoch")
+    lg.log("starting")
+    lg.log(loss=1.5, epoch=0)
+    lg.log(loss=0.5, epoch=1)
+    parsed = parse_logs(lines)
+    assert parsed["messages"][0]["message"] == "starting"
+    assert [m["loss"] for m in parsed["metrics"]] == [1.5, 0.5]
+    assert parsed["plots"][0]["title"] == "loss curve"
+    assert parsed["plots"][0]["x_axis"] == "epoch"
+
+
+def test_parse_logs_tolerates_plain_lines():
+    parsed = parse_logs(["not json at all"])
+    assert parsed["messages"][0]["message"] == "not json at all"
