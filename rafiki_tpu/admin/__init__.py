@@ -1,0 +1,5 @@
+"""Orchestration core (L5): job lifecycle + service deployment
+(reference rafiki/admin/)."""
+
+from rafiki_tpu.admin.admin import Admin  # noqa: F401
+from rafiki_tpu.admin.services import ServicesManager  # noqa: F401

@@ -1,0 +1,503 @@
+"""Admin: all orchestration business logic (reference rafiki/admin/admin.py:29-675).
+
+Capability parity: user management with RBAC + seeded superadmin, model CRUD
+(template file stored as bytes, validated at upload), train-job lifecycle with
+app auto-versioning, trial introspection (status/logs/params), inference-job
+lifecycle (requires train job STOPPED, one running inference job per train
+job), worker events driving job status.
+
+Architectural difference: Admin composes the in-process stack directly —
+store, placement manager, advisor store, broker — instead of shelling out to
+Docker through a socket. The HTTP layer (admin/http.py) is a thin shell over
+this class, so library use (tests, notebooks, single-host deployments) and
+REST use are the same code path.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.advisor.advisor import AdvisorStore
+from rafiki_tpu.admin.services import ServicesManager
+from rafiki_tpu.cache.queue import InProcessBroker
+from rafiki_tpu.constants import (
+    InferenceJobStatus,
+    ModelAccessRight,
+    TrainJobStatus,
+    UserType,
+)
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+from rafiki_tpu.sdk.knob import serialize_knob_config
+from rafiki_tpu.sdk.log import parse_logs
+from rafiki_tpu.sdk.model import (
+    InvalidModelClassError,
+    load_model_class,
+    validate_model_dependencies,
+)
+from rafiki_tpu.utils.auth import (
+    UnauthorizedError,
+    generate_token,
+    hash_password,
+    verify_password,
+)
+from rafiki_tpu.worker.train import EVENT_BUDGET_REACHED
+
+logger = logging.getLogger(__name__)
+
+
+class InvalidRequestError(Exception):
+    pass
+
+
+class Admin:
+    def __init__(
+        self,
+        db: Optional[Database] = None,
+        placement: Optional[LocalPlacementManager] = None,
+        params_dir: Optional[str] = None,
+    ):
+        self.db = db or Database()
+        self.advisor_store = AdvisorStore()
+        self.broker = InProcessBroker()
+        self.placement = placement or LocalPlacementManager(
+            on_status=self._on_service_status
+        )
+        if self.placement.on_status is None:
+            self.placement.on_status = self._on_service_status
+        self.services = ServicesManager(
+            self.db,
+            self.placement,
+            self.advisor_store,
+            self.broker,
+            send_event=self.handle_event,
+            params_dir=params_dir,
+        )
+        self._seed_superadmin()
+
+    # -- users ---------------------------------------------------------------
+
+    def _seed_superadmin(self) -> None:
+        if self.db.get_user_by_email(config.SUPERADMIN_EMAIL) is None:
+            self.db.create_user(
+                config.SUPERADMIN_EMAIL,
+                hash_password(config.SUPERADMIN_PASSWORD),
+                UserType.SUPERADMIN,
+            )
+
+    def authenticate_user(self, email: str, password: str) -> Dict[str, Any]:
+        user = self.db.get_user_by_email(email)
+        if user is None or not verify_password(password, user["password_hash"]):
+            raise UnauthorizedError("Invalid email or password")
+        if user["banned"]:
+            raise UnauthorizedError("User is banned")
+        token = generate_token(
+            {"user_id": user["id"], "user_type": user["user_type"]}
+        )
+        return {
+            "user_id": user["id"],
+            "user_type": user["user_type"],
+            "token": token,
+        }
+
+    def create_user(self, email: str, password: str, user_type: str) -> Dict:
+        if self.db.get_user_by_email(email) is not None:
+            raise InvalidRequestError(f"User {email} already exists")
+        user = self.db.create_user(email, hash_password(password), user_type)
+        return self._user_view(user)
+
+    def get_users(self) -> List[Dict]:
+        return [self._user_view(u) for u in self.db.get_users()]
+
+    def ban_user(self, email: str) -> Dict:
+        user = self.db.get_user_by_email(email)
+        if user is None:
+            raise InvalidRequestError(f"No such user {email}")
+        self.db.ban_user(user["id"])
+        return self._user_view({**user, "banned": 1})
+
+    @staticmethod
+    def _user_view(user: Dict) -> Dict:
+        return {
+            "id": user["id"],
+            "email": user["email"],
+            "user_type": user["user_type"],
+            "banned": bool(user["banned"]),
+        }
+
+    # -- models ----------------------------------------------------------------
+
+    def create_model(
+        self,
+        user_id: str,
+        name: str,
+        task: str,
+        model_file_bytes: bytes,
+        model_class: str,
+        dependencies: Optional[Dict[str, Optional[str]]] = None,
+        access_right: str = ModelAccessRight.PRIVATE,
+    ) -> Dict:
+        # validate at upload, not at trial time: class loads, subclasses
+        # BaseModel, declares a sane knob config, deps importable
+        clazz = load_model_class(model_file_bytes, model_class)
+        missing = validate_model_dependencies(clazz)
+        if missing:
+            raise InvalidModelClassError(
+                f"Dependencies not available in this environment: {missing}"
+            )
+        serialize_knob_config(clazz.get_knob_config())
+        if self.db.get_model_by_name(user_id, name) is not None:
+            raise InvalidRequestError(f"Model {name} already exists for user")
+        model = self.db.create_model(
+            user_id,
+            name,
+            task,
+            model_file_bytes,
+            model_class,
+            dependencies or dict(getattr(clazz, "dependencies", {}) or {}),
+            access_right,
+        )
+        return self._model_view(model)
+
+    def get_models(
+        self, user_id: str, task: Optional[str] = None
+    ) -> List[Dict]:
+        """Models visible to `user_id`: their own + PUBLIC ones."""
+        return [
+            self._model_view(m)
+            for m in self.db.get_models(task)
+            if m["user_id"] == user_id
+            or m["access_right"] == ModelAccessRight.PUBLIC
+        ]
+
+    def get_model(self, user_id: str, name: str, owner_id: Optional[str] = None) -> Dict:
+        model = self.db.get_model_by_name(owner_id or user_id, name)
+        if model is None:
+            raise InvalidRequestError(f"No such model {name}")
+        self._check_model_access(model, user_id)
+        return self._model_view(model)
+
+    def get_model_file(
+        self, user_id: str, name: str, owner_id: Optional[str] = None
+    ) -> bytes:
+        model = self.db.get_model_by_name(owner_id or user_id, name)
+        if model is None:
+            raise InvalidRequestError(f"No such model {name}")
+        self._check_model_access(model, user_id)
+        return model["model_file_bytes"]
+
+    def delete_model(self, user_id: str, name: str) -> None:
+        model = self.db.get_model_by_name(user_id, name)
+        if model is None:
+            raise InvalidRequestError(f"No such model {name}")
+        self.db.delete_model(model["id"])
+
+    @staticmethod
+    def _check_model_access(model: Dict, user_id: str) -> None:
+        if (
+            model["user_id"] != user_id
+            and model["access_right"] != ModelAccessRight.PUBLIC
+        ):
+            raise UnauthorizedError("Model is private")
+
+    @staticmethod
+    def _model_view(model: Dict) -> Dict:
+        return {
+            "id": model["id"],
+            "user_id": model["user_id"],
+            "name": model["name"],
+            "task": model["task"],
+            "model_class": model["model_class"],
+            "dependencies": model["dependencies"],
+            "access_right": model["access_right"],
+        }
+
+    # -- train jobs -------------------------------------------------------------
+
+    def create_train_job(
+        self,
+        user_id: str,
+        app: str,
+        task: str,
+        train_dataset_uri: str,
+        test_dataset_uri: str,
+        budget: Optional[Dict[str, Any]] = None,
+        model_names: Optional[List[str]] = None,
+    ) -> Dict:
+        budget = budget or {}
+        # pick the models: named ones, or all visible models for the task
+        # (reference admin.py:118-161)
+        visible = {
+            m["name"]: m
+            for m in self.db.get_models(task)
+            if m["user_id"] == user_id
+            or m["access_right"] == ModelAccessRight.PUBLIC
+        }
+        if model_names is not None:
+            missing = [n for n in model_names if n not in visible]
+            if missing:
+                raise InvalidRequestError(
+                    f"Models not found (or private): {missing}"
+                )
+            models = [visible[n] for n in model_names]
+        else:
+            models = list(visible.values())
+        if not models:
+            raise InvalidRequestError(f"No usable models for task {task}")
+
+        version = self.db.get_next_app_version(user_id, app)
+        job = self.db.create_train_job(
+            user_id,
+            app,
+            version,
+            task,
+            train_dataset_uri,
+            test_dataset_uri,
+            budget,
+        )
+        for m in models:
+            self.db.create_sub_train_job(job["id"], m["id"])
+        self.services.create_train_services(job["id"])
+        return self.get_train_job(user_id, app, version)
+
+    def get_train_job(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        workers = self.db.get_workers_of_train_job(job["id"])
+        services = [self.db.get_service(w["service_id"]) for w in workers]
+        return {
+            "id": job["id"],
+            "app": job["app"],
+            "app_version": job["app_version"],
+            "task": job["task"],
+            "status": job["status"],
+            "budget": job["budget"],
+            "train_dataset_uri": job["train_dataset_uri"],
+            "test_dataset_uri": job["test_dataset_uri"],
+            "datetime_started": job["datetime_started"],
+            "datetime_stopped": job["datetime_stopped"],
+            "workers": [
+                {
+                    "service_id": s["id"],
+                    "status": s["status"],
+                    "chips": s["chips"],
+                }
+                for s in services
+                if s
+            ],
+        }
+
+    def get_train_jobs_of_app(self, user_id: str, app: str) -> List[Dict]:
+        return [
+            self.get_train_job(user_id, app, j["app_version"])
+            for j in self.db.get_train_jobs_of_app(user_id, app)
+        ]
+
+    def stop_train_job(self, user_id: str, app: str, app_version: int = -1) -> Dict:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        self.services.stop_train_services(job["id"])
+        self.db.mark_train_job_as_stopped(job["id"])
+        return self.get_train_job(user_id, app, job["app_version"])
+
+    def wait_until_train_job_stopped(
+        self, user_id: str, app: str, app_version: int = -1, timeout_s: float = 600
+    ) -> Dict:
+        """Convenience for tests/CLI: poll until the job leaves RUNNING."""
+        import time as _time
+
+        deadline = _time.time() + timeout_s
+        while True:
+            job = self.get_train_job(user_id, app, app_version)
+            if job["status"] in (TrainJobStatus.STOPPED, TrainJobStatus.ERRORED):
+                return job
+            if _time.time() > deadline:
+                raise TimeoutError(f"Train job still {job['status']}")
+            _time.sleep(0.1)
+
+    # -- trials -----------------------------------------------------------------
+
+    def get_trials_of_train_job(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> List[Dict]:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        return [self._trial_view(t) for t in self.db.get_trials_of_train_job(job["id"])]
+
+    def get_best_trials_of_train_job(
+        self, user_id: str, app: str, app_version: int = -1, max_count: int = 2
+    ) -> List[Dict]:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        return [
+            self._trial_view(t)
+            for t in self.db.get_best_trials_of_train_job(job["id"], max_count)
+        ]
+
+    def get_trial(self, trial_id: str) -> Dict:
+        trial = self.db.get_trial(trial_id)
+        if trial is None:
+            raise InvalidRequestError(f"No such trial {trial_id}")
+        return self._trial_view(trial)
+
+    def get_trial_logs(self, trial_id: str) -> Dict:
+        if self.db.get_trial(trial_id) is None:
+            raise InvalidRequestError(f"No such trial {trial_id}")
+        return parse_logs(self.db.get_trial_logs(trial_id))
+
+    def get_trial_params(self, trial_id: str) -> bytes:
+        trial = self.db.get_trial(trial_id)
+        if trial is None or not trial.get("params_file_path"):
+            raise InvalidRequestError(f"No params for trial {trial_id}")
+        with open(trial["params_file_path"], "rb") as f:
+            return f.read()
+
+    @staticmethod
+    def _trial_view(trial: Dict) -> Dict:
+        return {
+            "id": trial["id"],
+            "sub_train_job_id": trial["sub_train_job_id"],
+            "model_id": trial["model_id"],
+            "knobs": trial["knobs"],
+            "score": trial["score"],
+            "status": trial["status"],
+            "datetime_started": trial["datetime_started"],
+            "datetime_stopped": trial["datetime_stopped"],
+        }
+
+    # -- inference jobs ----------------------------------------------------------
+
+    def create_inference_job(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        if job["status"] != TrainJobStatus.STOPPED:
+            # train must have fully stopped first (reference admin.py:360-361)
+            raise InvalidRequestError(
+                f"Train job must be STOPPED, is {job['status']}"
+            )
+        if self.db.get_running_inference_job_of_train_job(job["id"]) is not None:
+            # one running inference job per train job (reference :363-366)
+            raise InvalidRequestError(
+                "An inference job is already running for this train job"
+            )
+        inf = self.db.create_inference_job(user_id, job["id"])
+        self.services.create_inference_services(inf["id"])
+        return self.get_inference_job(user_id, app, job["app_version"])
+
+    def get_inference_job(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        infs = self.db.get_inference_jobs_of_train_job(job["id"])
+        if not infs:
+            raise InvalidRequestError("No inference job for this train job")
+        inf = infs[0]
+        workers = self.db.get_workers_of_inference_job(inf["id"])
+        return {
+            "id": inf["id"],
+            "train_job_id": job["id"],
+            "app": app,
+            "app_version": job["app_version"],
+            "status": inf["status"],
+            "datetime_started": inf["datetime_started"],
+            "datetime_stopped": inf["datetime_stopped"],
+            "workers": [
+                {"service_id": w["service_id"], "trial_id": w["trial_id"]}
+                for w in workers
+            ],
+        }
+
+    def stop_inference_job(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        inf = self.db.get_running_inference_job_of_train_job(job["id"])
+        if inf is None:
+            raise InvalidRequestError("No running inference job")
+        self.services.stop_inference_services(inf["id"])
+        return self.get_inference_job(user_id, app, job["app_version"])
+
+    def predict(
+        self, user_id: str, app: str, queries: List[Any], app_version: int = -1
+    ) -> List[Any]:
+        """Serving entrypoint: route queries to the app's running predictor."""
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such app {app}")
+        inf = self.db.get_running_inference_job_of_train_job(job["id"])
+        if inf is None:
+            raise InvalidRequestError("No running inference job for this app")
+        predictor = self.services.get_predictor(inf["id"])
+        if predictor is None:
+            raise InvalidRequestError("Predictor not available")
+        return predictor.predict_batch(queries)
+
+    def stop_all_jobs(self) -> None:
+        """Stop every running train/inference job (reference client
+        stop_all_jobs, rafiki/client/client.py:647), marking the job rows —
+        not just their services — so job state stays consistent."""
+        for inf in self.db.get_inference_jobs_by_statuses(
+            [InferenceJobStatus.STARTED, InferenceJobStatus.RUNNING]
+        ):
+            self.services.stop_inference_services(inf["id"])
+        for job in self.db.get_train_jobs_by_statuses(
+            [TrainJobStatus.STARTED, TrainJobStatus.RUNNING]
+        ):
+            self.services.stop_train_services(job["id"])
+            self.db.mark_train_job_as_stopped(job["id"])
+        # sweep any stragglers (e.g. services of already-errored jobs)
+        for svc in self.db.get_services():
+            if svc["status"] in ("STARTED", "DEPLOYING", "RUNNING"):
+                self.services._destroy_service(svc["id"], wait=False)
+
+    # -- events ------------------------------------------------------------------
+
+    def handle_event(self, name: str, payload: Dict[str, Any]) -> None:
+        """Worker events drive job status (reference admin.py:595-616)."""
+        try:
+            if name == EVENT_BUDGET_REACHED:
+                # Graceful drain: each worker exits on its own once the shared
+                # budget is consumed (the reference instead destroyed the
+                # sub-job's containers, terminating peers mid-trial and
+                # discarding their work, reference admin.py:607). Nothing to
+                # kill — just fold the exit into job status.
+                self.services.refresh_train_job_status(payload["train_job_id"])
+            elif name in ("train_job_worker_started", "train_job_worker_stopped"):
+                self.services.refresh_train_job_status(payload["train_job_id"])
+        except Exception:
+            logger.exception("event %s failed", name)
+
+    def _on_service_status(self, service_id: str, status: str) -> None:
+        if status == "RUNNING":
+            self.db.mark_service_as_running(service_id)
+        elif status == "STOPPED":
+            self.db.mark_service_as_stopped(service_id)
+        elif status == "ERRORED":
+            self.db.mark_service_as_errored(service_id)
+        # a train worker stopping may complete its train job
+        worker = self.db.get_train_job_worker(service_id)
+        if worker is not None and status in ("STOPPED", "ERRORED"):
+            sub = self.db.get_sub_train_job(worker["sub_train_job_id"])
+            if sub is not None:
+                self.services.refresh_train_job_status(sub["train_job_id"])
+
+    def shutdown(self) -> None:
+        self.stop_all_jobs()
+        if hasattr(self.placement, "stop_all"):
+            self.placement.stop_all()

@@ -1,0 +1,220 @@
+"""REST API over Admin (reference rafiki/admin/app.py:13-397).
+
+Same resource model and JWT-style auth with per-route allowed user types
+(reference utils/auth.py:28-45). Built on the stdlib threading HTTP server —
+no Flask dependency — as a thin shell over the Admin library; every route
+body is one Admin call.
+
+Model upload: JSON with the template file base64-encoded (the reference used
+multipart; base64-in-JSON keeps the stdlib server simple and the client SDK
+hides the encoding either way).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+from rafiki_tpu.constants import UserType
+from rafiki_tpu.sdk.model import InvalidModelClassError
+from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
+
+_ANY = None  # any authenticated user
+_ADMINS = [UserType.ADMIN, UserType.SUPERADMIN]
+_MODEL_DEVS = [UserType.MODEL_DEVELOPER] + _ADMINS
+_APP_DEVS = [UserType.APP_DEVELOPER] + _ADMINS
+
+Route = Tuple[str, re.Pattern, Optional[List[str]], Callable]
+
+
+class AdminServer:
+    """HTTP façade; start() binds and serves on a daemon thread."""
+
+    def __init__(self, admin: Admin, host: str = "127.0.0.1", port: int = 0):
+        self.admin = admin
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.routes: List[Route] = self._build_routes()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "AdminServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                server._dispatch(self, "GET")
+
+            def do_POST(self):
+                server._dispatch(self, "POST")
+
+            def do_DELETE(self):
+                server._dispatch(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- routing -----------------------------------------------------------
+
+    def _build_routes(self) -> List[Route]:
+        A = self.admin
+
+        def r(method: str, pattern: str, allowed, fn) -> Route:
+            return (method, re.compile(f"^{pattern}$"), allowed, fn)
+
+        return [
+            r("GET", "/", "public", lambda au, m, b, q: {
+                "name": "rafiki_tpu admin", "status": "ok"}),
+            r("POST", "/tokens", "public", lambda au, m, b, q: A.authenticate_user(
+                b["email"], b["password"])),
+            # users
+            r("POST", "/users", _ADMINS, lambda au, m, b, q: A.create_user(
+                b["email"], b["password"], b["user_type"])),
+            r("GET", "/users", _ADMINS, lambda au, m, b, q: A.get_users()),
+            r("DELETE", "/users", _ADMINS, lambda au, m, b, q: A.ban_user(
+                b["email"])),
+            # models
+            r("POST", "/models", _MODEL_DEVS, lambda au, m, b, q: A.create_model(
+                au["user_id"], b["name"], b["task"],
+                base64.b64decode(b["model_file_base64"]), b["model_class"],
+                b.get("dependencies"), b.get("access_right", "PRIVATE"))),
+            r("GET", "/models", _ANY, lambda au, m, b, q: A.get_models(
+                au["user_id"], q.get("task"))),
+            r("GET", r"/models/(?P<name>[^/]+)", _ANY, lambda au, m, b, q:
+                A.get_model(au["user_id"], m["name"])),
+            r("GET", r"/models/(?P<name>[^/]+)/file", _ANY, lambda au, m, b, q:
+                {"model_file_base64": base64.b64encode(
+                    A.get_model_file(au["user_id"], m["name"])).decode()}),
+            r("DELETE", r"/models/(?P<name>[^/]+)", _MODEL_DEVS,
+                lambda au, m, b, q: A.delete_model(au["user_id"], m["name"]) or {}),
+            # train jobs
+            r("POST", "/train_jobs", _APP_DEVS, lambda au, m, b, q:
+                A.create_train_job(
+                    au["user_id"], b["app"], b["task"], b["train_dataset_uri"],
+                    b["test_dataset_uri"], b.get("budget"), b.get("models"))),
+            r("GET", r"/train_jobs/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:
+                A.get_train_jobs_of_app(au["user_id"], m["app"])),
+            r("GET", r"/train_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)", _ANY,
+                lambda au, m, b, q: A.get_train_job(
+                    au["user_id"], m["app"], int(m["v"]))),
+            r("POST", r"/train_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/stop", _APP_DEVS,
+                lambda au, m, b, q: A.stop_train_job(
+                    au["user_id"], m["app"], int(m["v"]))),
+            r("GET", r"/train_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/trials", _ANY,
+                lambda au, m, b, q: A.get_trials_of_train_job(
+                    au["user_id"], m["app"], int(m["v"]))),
+            r("GET", r"/train_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/best_trials",
+                _ANY, lambda au, m, b, q: A.get_best_trials_of_train_job(
+                    au["user_id"], m["app"], int(m["v"]),
+                    int(q.get("max_count", 2)))),
+            # trials
+            r("GET", r"/trials/(?P<tid>[^/]+)/logs", _ANY, lambda au, m, b, q:
+                A.get_trial_logs(m["tid"])),
+            r("GET", r"/trials/(?P<tid>[^/]+)/parameters", _ANY,
+                lambda au, m, b, q: {"params_base64": base64.b64encode(
+                    A.get_trial_params(m["tid"])).decode()}),
+            r("GET", r"/trials/(?P<tid>[^/]+)", _ANY, lambda au, m, b, q:
+                A.get_trial(m["tid"])),
+            # inference jobs
+            r("POST", "/inference_jobs", _APP_DEVS, lambda au, m, b, q:
+                A.create_inference_job(
+                    au["user_id"], b["app"], b.get("app_version", -1))),
+            r("GET", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)", _ANY,
+                lambda au, m, b, q: A.get_inference_job(
+                    au["user_id"], m["app"], int(m["v"]))),
+            r("POST", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/stop",
+                _APP_DEVS, lambda au, m, b, q: A.stop_inference_job(
+                    au["user_id"], m["app"], int(m["v"]))),
+            # serving (the reference exposed this on a separate predictor app,
+            # reference predictor/app.py:23-31)
+            r("POST", r"/predict/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:
+                {"predictions": A.predict(
+                    au["user_id"], m["app"], b["queries"],
+                    b.get("app_version", -1))}),
+            # advisor sessions (reference advisor/app.py:17-50)
+            r("POST", "/advisors", _ANY, lambda au, m, b, q: {
+                "advisor_id": A.advisor_store.create_advisor(
+                    __import__("rafiki_tpu.sdk.knob", fromlist=["x"])
+                    .deserialize_knob_config(b["knob_config"]),
+                    advisor_id=b.get("advisor_id"))}),
+            r("POST", r"/advisors/(?P<aid>[^/]+)/propose", _ANY,
+                lambda au, m, b, q: {"knobs": A.advisor_store.propose(m["aid"])}),
+            r("POST", r"/advisors/(?P<aid>[^/]+)/feedback", _ANY,
+                lambda au, m, b, q: {"knobs": A.advisor_store.feedback(
+                    m["aid"], b["knobs"], b["score"])}),
+            r("DELETE", r"/advisors/(?P<aid>[^/]+)", _ANY, lambda au, m, b, q:
+                A.advisor_store.delete_advisor(m["aid"]) or {}),
+            # internal events (reference admin/app.py:360). Workers
+            # authenticate as superadmin (as the reference's did, reference
+            # worker/train.py:261-263); plain users must not be able to stop
+            # other tenants' services through this.
+            r("POST", r"/event/(?P<name>[^/]+)", _ADMINS, lambda au, m, b, q:
+                A.handle_event(m["name"], b) or {}),
+        ]
+
+    def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            parsed = urlparse(handler.path)
+            path = parsed.path.rstrip("/") or "/"
+            query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            body: Dict[str, Any] = {}
+            length = int(handler.headers.get("Content-Length") or 0)
+            if length:
+                body = json.loads(handler.rfile.read(length) or b"{}")
+
+            for m, pattern, allowed, fn in self.routes:
+                if m != method:
+                    continue
+                match = pattern.match(path)
+                if not match:
+                    continue
+                if allowed == "public":
+                    auth: Dict[str, Any] = {}
+                else:
+                    token = (handler.headers.get("Authorization") or "").removeprefix(
+                        "Bearer "
+                    )
+                    auth = decode_token(token)
+                    if allowed is not _ANY:
+                        auth_check(auth, allowed)
+                result = fn(auth, match.groupdict(), body, query)
+                self._respond(handler, 200, {"data": result})
+                return
+            self._respond(handler, 404, {"error": f"No route {method} {path}"})
+        except UnauthorizedError as e:
+            self._respond(handler, 401, {"error": str(e)})
+        except (InvalidRequestError, InvalidModelClassError, KeyError) as e:
+            self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception:
+            self._respond(handler, 500, {"error": traceback.format_exc()})
+
+    @staticmethod
+    def _respond(handler, code: int, payload: Dict[str, Any]) -> None:
+        data = json.dumps(payload).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)

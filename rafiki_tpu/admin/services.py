@@ -1,0 +1,249 @@
+"""Deployment engine: turns job rows into running executor services.
+
+Parity with the reference's ServicesManager (reference
+rafiki/admin/services_manager.py:28-403):
+
+- train jobs: the chip budget is split evenly across sub-train-jobs (one per
+  model), one executor per chip with a no-chip fallback executor when the
+  budget is 0 (reference :190-202, :107-135 — there per GPU container, here
+  per granted chip);
+- inference jobs: for each of the best ``INFERENCE_MAX_BEST_TRIALS`` trials,
+  ``INFERENCE_WORKER_REPLICAS_PER_TRIAL`` serving executors plus one predictor
+  (reference :53-87);
+- deployment waits until services report RUNNING and rolls back on failure
+  (reference :279-290, :131-135);
+- train-job status is derived from worker-service states (reference :160-184).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.advisor.advisor import AdvisorStore
+from rafiki_tpu.cache.queue import Broker
+from rafiki_tpu.constants import (
+    BudgetType,
+    InferenceJobStatus,
+    ServiceStatus,
+    ServiceType,
+    TrainJobStatus,
+)
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.manager import PlacementManager
+from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.worker.inference import InferenceWorker
+from rafiki_tpu.worker.train import TrainWorker
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceDeploymentError(Exception):
+    pass
+
+
+class ServicesManager:
+    def __init__(
+        self,
+        db: Database,
+        placement: PlacementManager,
+        advisor_store: AdvisorStore,
+        broker: Broker,
+        send_event,
+        params_dir: Optional[str] = None,
+    ):
+        self._db = db
+        self._placement = placement
+        self._advisors = advisor_store
+        self._broker = broker
+        self._send_event = send_event
+        self._params_dir = params_dir or config.PARAMS_DIR
+        self._predictors: Dict[str, Predictor] = {}
+        self._lock = threading.Lock()
+
+    # -- train -------------------------------------------------------------
+
+    def create_train_services(self, train_job_id: str) -> None:
+        job = self._db.get_train_job(train_job_id)
+        assert job is not None
+        sub_jobs = self._db.get_sub_train_jobs_of_train_job(train_job_id)
+        budget = job["budget"]
+        total_chips = int(
+            budget.get(
+                BudgetType.CHIP_COUNT, budget.get(BudgetType.GPU_COUNT, 0)
+            )
+        )
+        avail = getattr(self._placement, "allocator", None)
+        if avail is not None:
+            total_chips = min(total_chips, avail.total_chips)
+        chips_per_sub = total_chips // len(sub_jobs) if sub_jobs else 0
+
+        created: List[str] = []
+        try:
+            for sub in sub_jobs:
+                # one executor per chip; 0-chip fallback executor otherwise
+                n_workers = max(chips_per_sub, 1)
+                n_chips_each = 1 if chips_per_sub > 0 else 0
+                for _ in range(n_workers):
+                    sid = self._create_train_worker(sub["id"], n_chips_each)
+                    created.append(sid)
+            self._wait_until_services_running(created)
+            self._db.mark_train_job_as_running(train_job_id)
+        except Exception:
+            # roll back partial deployments (reference :131-135)
+            for sid in created:
+                self._destroy_service(sid, wait=False)
+            self._db.mark_train_job_as_errored(train_job_id)
+            raise
+
+    def _create_train_worker(self, sub_train_job_id: str, n_chips: int) -> str:
+        service = self._db.create_service(ServiceType.TRAIN, replicas=1)
+        self._db.create_train_job_worker(service["id"], sub_train_job_id)
+        worker = TrainWorker(
+            sub_train_job_id,
+            self._db,
+            self._advisors,
+            send_event=self._send_event,
+            params_dir=self._params_dir,
+        )
+        ctx = self._placement.create_service(
+            service["id"], ServiceType.TRAIN, worker.start, n_chips=n_chips
+        )
+        # record the chip indices actually granted by the allocator
+        self._db.update_service_chips(service["id"], ctx.chips)
+        return service["id"]
+
+    def stop_sub_train_job_services(self, sub_train_job_id: str) -> None:
+        for w in self._db.get_workers_of_sub_train_job(sub_train_job_id):
+            self._destroy_service(w["service_id"], wait=False)
+
+    def stop_train_services(self, train_job_id: str) -> None:
+        for w in self._db.get_workers_of_train_job(train_job_id):
+            self._destroy_service(w["service_id"], wait=False)
+        self.refresh_train_job_status(train_job_id)
+
+    def refresh_train_job_status(self, train_job_id: str) -> None:
+        """Derive job status from worker service states (reference :160-184)."""
+        job = self._db.get_train_job(train_job_id)
+        if job is None or job["status"] in (
+            TrainJobStatus.STOPPED,
+            TrainJobStatus.ERRORED,
+        ):
+            return
+        workers = self._db.get_workers_of_train_job(train_job_id)
+        statuses = []
+        for w in workers:
+            svc = self._db.get_service(w["service_id"])
+            if svc:
+                statuses.append(svc["status"])
+        if not statuses:
+            return
+        if all(
+            s in (ServiceStatus.STOPPED, ServiceStatus.ERRORED) for s in statuses
+        ):
+            if any(s == ServiceStatus.ERRORED for s in statuses):
+                self._db.mark_train_job_as_errored(train_job_id)
+            else:
+                self._db.mark_train_job_as_stopped(train_job_id)
+
+    # -- inference -----------------------------------------------------------
+
+    def create_inference_services(self, inference_job_id: str) -> Predictor:
+        inf_job = self._db.get_inference_job(inference_job_id)
+        assert inf_job is not None
+        train_job = self._db.get_train_job(inf_job["train_job_id"])
+        assert train_job is not None
+        best_trials = self._db.get_best_trials_of_train_job(
+            train_job["id"], max_count=config.INFERENCE_MAX_BEST_TRIALS
+        )
+        if not best_trials:
+            self._db.mark_inference_job_as_errored(inference_job_id)
+            raise ServiceDeploymentError(
+                f"Train job {train_job['id']} has no completed trials"
+            )
+        created: List[str] = []
+        try:
+            for trial in best_trials:
+                for _ in range(config.INFERENCE_WORKER_REPLICAS_PER_TRIAL):
+                    service = self._db.create_service(ServiceType.INFERENCE)
+                    self._db.create_inference_job_worker(
+                        service["id"], inference_job_id, trial["id"]
+                    )
+                    worker = InferenceWorker(
+                        inference_job_id, trial["id"], self._db, self._broker
+                    )
+                    # serving executors prefer an exclusive chip but fall
+                    # back to shared devices when training holds them all
+                    ctx = self._placement.create_service(
+                        service["id"],
+                        ServiceType.INFERENCE,
+                        worker.start,
+                        n_chips=1,
+                        best_effort_chips=True,
+                    )
+                    self._db.update_service_chips(service["id"], ctx.chips)
+                    created.append(service["id"])
+            predictor_service = self._db.create_service(ServiceType.PREDICT)
+            self._db.update_inference_job_predictor(
+                inference_job_id, predictor_service["id"]
+            )
+            predictor = Predictor(
+                inference_job_id, self._broker, train_job["task"]
+            )
+            with self._lock:
+                self._predictors[inference_job_id] = predictor
+            self._wait_until_services_running(created)
+            self._db.mark_service_as_running(predictor_service["id"])
+            self._db.mark_inference_job_as_running(inference_job_id)
+            return predictor
+        except Exception:
+            for sid in created:
+                self._destroy_service(sid, wait=False)
+            self._db.mark_inference_job_as_errored(inference_job_id)
+            raise
+
+    def get_predictor(self, inference_job_id: str) -> Optional[Predictor]:
+        with self._lock:
+            return self._predictors.get(inference_job_id)
+
+    def stop_inference_services(self, inference_job_id: str) -> None:
+        for w in self._db.get_workers_of_inference_job(inference_job_id):
+            self._destroy_service(w["service_id"], wait=False)
+        inf_job = self._db.get_inference_job(inference_job_id)
+        if inf_job and inf_job.get("predictor_service_id"):
+            self._db.mark_service_as_stopped(inf_job["predictor_service_id"])
+        with self._lock:
+            self._predictors.pop(inference_job_id, None)
+        self._db.mark_inference_job_as_stopped(inference_job_id)
+
+    # -- shared --------------------------------------------------------------
+
+    def _destroy_service(self, service_id: str, wait: bool = True) -> None:
+        try:
+            self._placement.destroy_service(service_id, wait=wait)
+        except Exception:
+            logger.exception("destroying service %s failed", service_id)
+        self._db.mark_service_as_stopped(service_id)
+
+    def _wait_until_services_running(self, service_ids: List[str]) -> None:
+        """Poll the store until all services are RUNNING (reference :279-290)."""
+        deadline = time.time() + config.SERVICE_DEPLOY_TIMEOUT_S
+        pending = set(service_ids)
+        while pending:
+            for sid in list(pending):
+                svc = self._db.get_service(sid)
+                if svc is None or svc["status"] == ServiceStatus.ERRORED:
+                    raise ServiceDeploymentError(f"Service {sid} errored on deploy")
+                if svc["status"] in (ServiceStatus.RUNNING, ServiceStatus.STOPPED):
+                    # STOPPED is fine: a fast worker may have already finished
+                    pending.discard(sid)
+            if pending:
+                if time.time() > deadline:
+                    raise ServiceDeploymentError(
+                        f"Services not running after "
+                        f"{config.SERVICE_DEPLOY_TIMEOUT_S}s: {pending}"
+                    )
+                time.sleep(0.05)

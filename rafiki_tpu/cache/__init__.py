@@ -1,0 +1,4 @@
+"""Serving data plane: query/prediction transport between predictor and
+inference workers (reference rafiki/cache/ — Redis lists/sets)."""
+
+from rafiki_tpu.cache.queue import InProcessBroker, QueryFuture, WorkerQueue  # noqa: F401

@@ -1,0 +1,147 @@
+"""Continuous-batching query transport.
+
+The reference's serving data plane was Redis lists polled on 0.25 s sleeps on
+*both* sides (reference rafiki/cache/cache.py:36-78, predictor/predictor.py:46-59,
+worker/inference.py:43-65), giving every request a ~0.25-0.5 s latency floor
+before any model time. Here the transport is a condition-variable handoff:
+
+- the predictor submits queries and gets futures back;
+- each inference worker blocks on its queue, waking the moment work arrives,
+  and drains *up to* a max batch with a short deadline so TPU batches fill
+  under load but single queries don't wait (deadline <= a few ms, not 250);
+- workers resolve futures directly — no scan-and-remove.
+
+``Broker`` is the seam (the reference's Cache class shape, reference
+cache/cache.py:10-79): `InProcessBroker` serves the single-host stack; a
+remote broker implementing the same interface can back multi-host serving.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class QueryFuture:
+    """A pending prediction for one query."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def set_result(self, value: Any) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("prediction timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class WorkerQueue:
+    """A single inference worker's inbox of (future, query) pairs."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items: List[Tuple[QueryFuture, Any]] = []
+        self._closed = False
+
+    def submit(self, query: Any) -> QueryFuture:
+        fut = QueryFuture()
+        with self._cond:
+            if self._closed:
+                fut.set_error(RuntimeError("worker queue closed"))
+                return fut
+            self._items.append((fut, query))
+            self._cond.notify()
+        return fut
+
+    def take_batch(
+        self,
+        max_size: int,
+        deadline_s: float,
+        wait_timeout_s: float = 0.5,
+    ) -> List[Tuple[QueryFuture, Any]]:
+        """Block until work arrives (or `wait_timeout_s` elapses), then keep
+        draining until the batch fills or `deadline_s` passes since the first
+        item. Returns [] on timeout/closure so callers can check stop flags."""
+        with self._cond:
+            if not self._items and not self._closed:
+                self._cond.wait(wait_timeout_s)
+            if not self._items:
+                return []
+            first_t = time.monotonic()
+            batch = self._items[:max_size]
+            del self._items[: len(batch)]
+            while len(batch) < max_size and not self._closed:
+                remaining = deadline_s - (time.monotonic() - first_t)
+                if remaining <= 0:
+                    break
+                if not self._items:
+                    self._cond.wait(remaining)
+                take = min(max_size - len(batch), len(self._items))
+                if take:
+                    batch.extend(self._items[:take])
+                    del self._items[:take]
+            return batch
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            for fut, _ in self._items:
+                fut.set_error(RuntimeError("worker queue closed"))
+            self._items.clear()
+            self._cond.notify_all()
+
+
+class Broker(abc.ABC):
+    """Transport seam between predictors and inference workers."""
+
+    @abc.abstractmethod
+    def register_worker(self, inference_job_id: str, worker_id: str) -> WorkerQueue:
+        ...
+
+    @abc.abstractmethod
+    def unregister_worker(self, inference_job_id: str, worker_id: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_worker_queues(self, inference_job_id: str) -> Dict[str, WorkerQueue]:
+        ...
+
+
+class InProcessBroker(Broker):
+    """Single-host broker: queues live in process memory."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._queues: Dict[str, Dict[str, WorkerQueue]] = {}
+
+    def register_worker(self, inference_job_id: str, worker_id: str) -> WorkerQueue:
+        with self._lock:
+            q = WorkerQueue()
+            self._queues.setdefault(inference_job_id, {})[worker_id] = q
+            return q
+
+    def unregister_worker(self, inference_job_id: str, worker_id: str) -> None:
+        with self._lock:
+            q = self._queues.get(inference_job_id, {}).pop(worker_id, None)
+        if q is not None:
+            q.close()
+
+    def get_worker_queues(self, inference_job_id: str) -> Dict[str, WorkerQueue]:
+        with self._lock:
+            return dict(self._queues.get(inference_job_id, {}))

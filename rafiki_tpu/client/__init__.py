@@ -1,0 +1,3 @@
+"""User-facing Python client SDK (L7, reference rafiki/client/)."""
+
+from rafiki_tpu.client.client import Client  # noqa: F401

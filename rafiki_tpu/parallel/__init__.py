@@ -10,6 +10,8 @@ the collectives (psum/all-gather/reduce-scatter) over ICI.
 from rafiki_tpu.parallel.mesh import (  # noqa: F401
     MeshSpec,
     get_default_mesh,
+    get_device_grant,
     make_mesh,
+    set_device_grant,
     visible_devices,
 )

@@ -2,11 +2,17 @@
 
 The reference pins a worker to GPUs via ``CUDA_VISIBLE_DEVICES`` set by the
 swarm placement layer (reference rafiki/container/docker_swarm.py:122-126).
-The TPU analogue here: the placement layer grants an executor a *subset of
-mesh devices* via the ``RAFIKI_VISIBLE_DEVICES`` env var (comma-separated
-``jax.devices()`` indices), and every model builds its mesh through
+The TPU analogue here: the placement layer grants an executor thread a
+*subset of mesh devices* via ``set_device_grant`` (thread-local, since
+executors share one process), and every model builds its mesh through
 ``get_default_mesh()`` so trials running side-by-side on one host occupy
-disjoint chips.
+disjoint chips. The ``RAFIKI_VISIBLE_DEVICES`` env var (comma-separated
+``jax.devices()`` indices) is the process-wide fallback for single-executor
+deployments and tests.
+
+Caveat: the grant is per-thread. Model code that spawns its own helper
+threads must propagate it with ``set_device_grant(get_device_grant())`` in
+the child thread, or the child sees all devices.
 
 Mesh axes follow the scaling-book convention: ``data`` (DP) innermost-most
 plentiful, ``model`` (TP) over fast ICI neighbours, plus optional ``seq`` (SP)
@@ -16,6 +22,7 @@ and ``expert`` (EP) axes for long-context / MoE models.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -29,10 +36,30 @@ SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 PIPELINE_AXIS = "pipe"
 
+# Thread-local grant: executors run as threads sharing one process, so the
+# env var (process-global) can't express per-trial chip affinity. The
+# placement layer sets this at executor-thread start.
+_thread_grant = threading.local()
+
+
+def set_device_grant(indices: Optional[Sequence[int]]) -> None:
+    """Restrict this thread's default devices to `indices` of jax.devices().
+    ``None`` clears the grant."""
+    _thread_grant.indices = tuple(indices) if indices else None
+
+
+def get_device_grant() -> Optional[Tuple[int, ...]]:
+    """This thread's device grant (for propagating into helper threads)."""
+    return getattr(_thread_grant, "indices", None)
+
 
 def visible_devices() -> List[jax.Device]:
-    """Devices this process may use, honouring the placement layer's grant."""
+    """Devices this thread may use: the thread grant if set, else the
+    ``RAFIKI_VISIBLE_DEVICES`` env grant, else all devices."""
     devices = jax.devices()
+    grant = getattr(_thread_grant, "indices", None)
+    if grant:
+        return [devices[i] for i in grant]
     spec = os.environ.get("RAFIKI_VISIBLE_DEVICES", "").strip()
     if not spec:
         return devices
@@ -77,17 +104,18 @@ def make_mesh(
     return Mesh(arr, tuple(shape.keys()))
 
 
-_default_mesh: Optional[Mesh] = None
+_default_mesh = threading.local()
 
 
 def get_default_mesh() -> Mesh:
-    """Process-wide default mesh over the granted devices (data axis only).
-    Rebuilt if the device grant changed (tests flip RAFIKI_VISIBLE_DEVICES)."""
-    global _default_mesh
+    """This thread's default mesh over its granted devices (data axis only).
+    Rebuilt if the device grant changed (placement layer or test env)."""
     devs = visible_devices()
-    if _default_mesh is None or list(_default_mesh.devices.flat) != devs:
-        _default_mesh = make_mesh(devices=devs)
-    return _default_mesh
+    cached: Optional[Mesh] = getattr(_default_mesh, "mesh", None)
+    if cached is None or list(cached.devices.flat) != devs:
+        cached = make_mesh(devices=devs)
+        _default_mesh.mesh = cached
+    return cached
 
 
 def mesh_shape(mesh: Mesh) -> Tuple[int, ...]:

@@ -1,0 +1,11 @@
+"""Placement layer (L4): chip-affine executor placement on TPU VM hosts
+(replaces the reference's Docker Swarm container manager,
+reference rafiki/container/)."""
+
+from rafiki_tpu.placement.manager import (  # noqa: F401
+    ChipAllocator,
+    InsufficientChipsError,
+    LocalPlacementManager,
+    PlacementManager,
+    ServiceContext,
+)

@@ -1,0 +1,2 @@
+"""Cross-cutting utilities: auth tokens, password hashing, logging setup
+(reference rafiki/utils/)."""

@@ -1,0 +1,5 @@
+"""Worker runtime (L3): trial execution and model serving loops
+(reference rafiki/worker/)."""
+
+from rafiki_tpu.worker.train import TrainWorker  # noqa: F401
+from rafiki_tpu.worker.inference import InferenceWorker  # noqa: F401

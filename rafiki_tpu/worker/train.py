@@ -1,0 +1,164 @@
+"""Train worker: the AutoML trial loop.
+
+Parity with the reference's TrainWorker (reference rafiki/worker/train.py:37-132):
+read job info -> budget check -> propose knobs -> instantiate model -> train ->
+evaluate -> persist params -> record trial -> feed back the score -> repeat,
+with crash handling (trial marked ERRORED, loop continues — the reference
+instead exited the container and let swarm restart it) and termination
+handling (in-flight trial marked TERMINATED on stop, reference train.py:134-148).
+
+TPU-native differences:
+- the worker is an *executor thread* with a chip grant; the model's mesh is
+  built from exactly the granted devices (set_device_grant), so parallel
+  trials occupy disjoint chips of the host slice;
+- the advisor is shared per sub-train-job through AdvisorStore (keyed by
+  sub_train_job_id, not worker service id), so parallel workers coordinate —
+  fixing reference train.py:213;
+- no per-boot pip install: dependencies are validated as importable once at
+  model registration (dead time removed from every trial).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.advisor.advisor import AdvisorStore
+from rafiki_tpu.constants import BudgetType
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.parallel.mesh import set_device_grant
+from rafiki_tpu.placement.manager import ServiceContext
+from rafiki_tpu.sdk.log import ModelLogger
+from rafiki_tpu.sdk.model import load_model_class
+from rafiki_tpu.sdk.params import dump_params
+
+logger = logging.getLogger(__name__)
+
+# Event name the worker sends when its sub-train-job exhausts its budget
+# (reference train.py:198-205).
+EVENT_BUDGET_REACHED = "sub_train_job_budget_reached"
+
+EventFn = Callable[[str, Dict[str, Any]], None]
+
+
+class TrainWorker:
+    """One trial executor for a sub-train-job."""
+
+    def __init__(
+        self,
+        sub_train_job_id: str,
+        db: Database,
+        advisor_store: AdvisorStore,
+        send_event: Optional[EventFn] = None,
+        params_dir: Optional[str] = None,
+    ):
+        self._sub_id = sub_train_job_id
+        self._db = db
+        self._advisors = advisor_store
+        self._send_event = send_event or (lambda name, payload: None)
+        self._params_dir = params_dir or config.PARAMS_DIR
+
+    def start(self, ctx: ServiceContext) -> None:
+        """The trial loop; returns when budget is reached or stop is set."""
+        set_device_grant(ctx.chips)
+        try:
+            self._loop(ctx)
+        finally:
+            set_device_grant(None)
+
+    # -- internals ---------------------------------------------------------
+
+    def _loop(self, ctx: ServiceContext) -> None:
+        sub = self._db.get_sub_train_job(self._sub_id)
+        assert sub is not None, f"no sub_train_job {self._sub_id}"
+        job = self._db.get_train_job(sub["train_job_id"])
+        model = self._db.get_model(sub["model_id"])
+        assert job is not None and model is not None
+
+        budget = job["budget"]
+        max_trials = int(
+            budget.get(BudgetType.MODEL_TRIAL_COUNT, config.DEFAULT_TRIAL_COUNT)
+        )
+        # optional wall-clock budget, measured from job start (a capability
+        # the reference lacked: its only budgets were trials and GPUs)
+        time_budget_h = budget.get(BudgetType.TIME_HOURS)
+        deadline = (
+            job["datetime_started"] + float(time_budget_h) * 3600
+            if time_budget_h is not None
+            else None
+        )
+        clazz = load_model_class(model["model_file_bytes"], model["model_class"])
+        knob_config = clazz.get_knob_config()
+        advisor_id = self._advisors.create_advisor(
+            knob_config, advisor_id=self._sub_id
+        )
+        self._db.update_sub_train_job_advisor(self._sub_id, advisor_id)
+
+        while not ctx.stopping:
+            # shared budget accounting through the DB (reference
+            # train.py:227-232)
+            over_time = deadline is not None and time.time() >= deadline
+            if over_time or (
+                self._db.count_trials_of_sub_train_job(self._sub_id) >= max_trials
+            ):
+                self._send_event(
+                    EVENT_BUDGET_REACHED,
+                    {
+                        "sub_train_job_id": self._sub_id,
+                        "train_job_id": job["id"],
+                    },
+                )
+                return
+
+            knobs = self._advisors.propose(advisor_id)
+            trial = self._db.create_trial(
+                self._sub_id, model["id"], knobs, worker_id=ctx.service_id
+            )
+            trial_logger = ModelLogger()
+            trial_logger.set_sink(
+                lambda line, _tid=trial["id"]: self._db.add_trial_log(_tid, line)
+            )
+            try:
+                score, params_path = self._run_trial(
+                    clazz, knobs, job, trial["id"], trial_logger
+                )
+                if ctx.stopping:
+                    self._db.mark_trial_as_terminated(trial["id"])
+                    return
+                self._db.mark_trial_as_complete(trial["id"], score, params_path)
+                self._advisors.get(advisor_id).feedback(knobs, score)
+            except Exception:
+                if ctx.stopping:
+                    self._db.mark_trial_as_terminated(trial["id"])
+                    return
+                logger.error(
+                    "trial %s errored:\n%s", trial["id"], traceback.format_exc()
+                )
+                self._db.mark_trial_as_errored(trial["id"])
+                # errored trials count toward budget (reference train.py:231);
+                # keep looping — the executor survives a bad knob combination
+
+    def _run_trial(
+        self,
+        clazz: type,
+        knobs: Dict[str, Any],
+        job: Dict[str, Any],
+        trial_id: str,
+        trial_logger: ModelLogger,
+    ) -> tuple:
+        model = clazz(**knobs)
+        model.logger = trial_logger
+        try:
+            model.train(job["train_dataset_uri"])
+            score = float(model.evaluate(job["test_dataset_uri"]))
+            os.makedirs(self._params_dir, exist_ok=True)
+            params_path = os.path.join(self._params_dir, f"{trial_id}.params")
+            with open(params_path, "wb") as f:
+                f.write(dump_params(model.dump_parameters()))
+            return score, params_path
+        finally:
+            model.destroy()

@@ -1,0 +1,50 @@
+"""A trivially fast fake model exercising every knob type — the system-test
+workhorse (pattern from reference test/data/Model.py: no-op train, random
+evaluate, picklable dummy params, 4-knob config)."""
+
+import random
+
+from rafiki_tpu.sdk import (
+    BaseModel,
+    CategoricalKnob,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+)
+
+
+class FakeModel(BaseModel):
+    dependencies = {"numpy": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "int_knob": IntegerKnob(1, 32),
+            "float_knob": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "cat_knob": CategoricalKnob(["a", "b", "c"]),
+            "fixed_knob": FixedKnob("fixed"),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+
+    def train(self, dataset_uri):
+        self.logger.define_plot("fake metric", ["metric"], x_axis="step")
+        for i in range(3):
+            self.logger.log(metric=float(i), step=float(i))
+        self.logger.log("train done")
+        self._params = {"weight": [1.0, 2.0], "knob_echo": self._knobs["int_knob"]}
+
+    def evaluate(self, dataset_uri):
+        return random.random()
+
+    def predict(self, queries):
+        return [[0.5, 0.5] for _ in queries]
+
+    def dump_parameters(self):
+        return self._params
+
+    def load_parameters(self, params):
+        self._params = params

@@ -1,0 +1,96 @@
+import pytest
+
+from rafiki_tpu.constants import (
+    ServiceType,
+    TrainJobStatus,
+    TrialStatus,
+    UserType,
+)
+from rafiki_tpu.db.database import Database
+
+
+@pytest.fixture()
+def db():
+    d = Database(":memory:")
+    yield d
+    d.close()
+
+
+def _seed(db):
+    user = db.create_user("u@x", "hash", UserType.APP_DEVELOPER)
+    model = db.create_model(
+        user["id"], "m1", "IMAGE_CLASSIFICATION", b"code", "M", {"jax": None}, "PUBLIC"
+    )
+    job = db.create_train_job(
+        user["id"], "app1", 1, "IMAGE_CLASSIFICATION", "train", "test",
+        {"MODEL_TRIAL_COUNT": 3},
+    )
+    sub = db.create_sub_train_job(job["id"], model["id"])
+    return user, model, job, sub
+
+
+def test_user_crud(db):
+    u = db.create_user("a@b", "h", UserType.ADMIN)
+    assert db.get_user_by_email("a@b")["id"] == u["id"]
+    db.ban_user(u["id"])
+    assert db.get_user(u["id"])["banned"] == 1
+
+
+def test_model_unique_per_user(db):
+    u = db.create_user("a@b", "h", UserType.MODEL_DEVELOPER)
+    db.create_model(u["id"], "m", "T", b"x", "M", {}, "PRIVATE")
+    import sqlite3
+
+    with pytest.raises(sqlite3.IntegrityError):
+        db.create_model(u["id"], "m", "T", b"x", "M", {}, "PRIVATE")
+
+
+def test_app_versioning(db):
+    u = db.create_user("a@b", "h", UserType.APP_DEVELOPER)
+    assert db.get_next_app_version(u["id"], "app") == 1
+    db.create_train_job(u["id"], "app", 1, "T", "tr", "te", {})
+    assert db.get_next_app_version(u["id"], "app") == 2
+    db.create_train_job(u["id"], "app", 2, "T", "tr", "te", {})
+    latest = db.get_train_job_by_app_version(u["id"], "app", -1)
+    assert latest["app_version"] == 2
+
+
+def test_trials_budget_and_best(db):
+    user, model, job, sub = _seed(db)
+    scores = [0.3, 0.9, 0.6]
+    for s in scores:
+        t = db.create_trial(sub["id"], model["id"], {"k": 1})
+        db.mark_trial_as_complete(t["id"], s, None)
+    errored = db.create_trial(sub["id"], model["id"], {"k": 2})
+    db.mark_trial_as_errored(errored["id"])
+    terminated = db.create_trial(sub["id"], model["id"], {"k": 3})
+    db.mark_trial_as_terminated(terminated["id"])
+    # errored counts toward budget, terminated doesn't
+    assert db.count_trials_of_sub_train_job(sub["id"]) == 4
+    best = db.get_best_trials_of_train_job(job["id"], max_count=2)
+    assert [b["score"] for b in best] == [0.9, 0.6]
+
+
+def test_trial_logs(db):
+    user, model, job, sub = _seed(db)
+    t = db.create_trial(sub["id"], model["id"], {})
+    db.add_trial_log(t["id"], "line1")
+    db.add_trial_log(t["id"], "line2")
+    assert db.get_trial_logs(t["id"]) == ["line1", "line2"]
+
+
+def test_service_lifecycle(db):
+    s = db.create_service(ServiceType.TRAIN, chips=[0, 1])
+    assert s["chips"] == [0, 1]
+    db.mark_service_as_running(s["id"])
+    assert db.get_service(s["id"])["status"] == "RUNNING"
+    db.mark_service_as_stopped(s["id"])
+    assert db.get_service(s["id"])["status"] == "STOPPED"
+
+
+def test_inference_job_queries(db):
+    user, model, job, sub = _seed(db)
+    inf = db.create_inference_job(user["id"], job["id"])
+    assert db.get_running_inference_job_of_train_job(job["id"])["id"] == inf["id"]
+    db.mark_inference_job_as_stopped(inf["id"])
+    assert db.get_running_inference_job_of_train_job(job["id"]) is None

@@ -1,0 +1,145 @@
+"""End-to-end over real HTTP: the reference's integration suites
+(test/test_users.py, test_models.py, test_train_jobs.py) driven through the
+Client SDK against a live AdminServer."""
+
+import os
+
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.admin.http import AdminServer
+from rafiki_tpu.client.client import Client, RafikiError
+from rafiki_tpu.constants import UserType
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fake_model.py")
+
+
+@pytest.fixture()
+def server(tmp_path):
+    admin = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0, 1])),
+        params_dir=str(tmp_path / "params"),
+    )
+    srv = AdminServer(admin, port=0).start()
+    yield srv
+    srv.stop()
+    admin.shutdown()
+
+
+@pytest.fixture()
+def superadmin(server):
+    c = Client("127.0.0.1", server.port)
+    c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+    return c
+
+
+def test_banner_no_auth(server):
+    import requests
+
+    resp = requests.get(f"http://127.0.0.1:{server.port}/")
+    assert resp.json()["data"]["status"] == "ok"
+
+
+def test_login_and_rbac(server, superadmin):
+    superadmin.create_user("appdev@x", "pw", UserType.APP_DEVELOPER)
+    appdev = Client("127.0.0.1", server.port)
+    appdev.login("appdev@x", "pw")
+    # app developers cannot manage users (reference test_users.py RBAC matrix)
+    with pytest.raises(RafikiError):
+        appdev.create_user("nope@x", "pw", UserType.APP_DEVELOPER)
+    with pytest.raises(RafikiError):
+        appdev.get_users()
+    # bad password
+    bad = Client("127.0.0.1", server.port)
+    with pytest.raises(RafikiError):
+        bad.login("appdev@x", "wrong")
+    # banned user can't log in
+    superadmin.ban_user("appdev@x")
+    with pytest.raises(RafikiError):
+        Client("127.0.0.1", server.port).login("appdev@x", "pw")
+
+
+def test_model_crud_and_visibility(server, superadmin):
+    superadmin.create_user("dev1@x", "pw", UserType.MODEL_DEVELOPER)
+    superadmin.create_user("dev2@x", "pw", UserType.MODEL_DEVELOPER)
+    dev1 = Client("127.0.0.1", server.port)
+    dev1.login("dev1@x", "pw")
+    dev2 = Client("127.0.0.1", server.port)
+    dev2.login("dev2@x", "pw")
+
+    dev1.create_model(
+        "pub", "IMAGE_CLASSIFICATION", FIXTURE, "FakeModel", access_right="PUBLIC"
+    )
+    dev1.create_model(
+        "priv", "IMAGE_CLASSIFICATION", FIXTURE, "FakeModel", access_right="PRIVATE"
+    )
+    names2 = {m["name"] for m in dev2.get_models()}
+    assert "pub" in names2 and "priv" not in names2
+
+    # file download equality (reference test_models.py:47-53)
+    with open(FIXTURE, "rb") as f:
+        original = f.read()
+    assert dev1.download_model_file("pub") == original
+
+    dev1.delete_model("priv")
+    assert {m["name"] for m in dev1.get_models()} == {"pub"}
+
+
+def test_full_cycle_over_http(server, superadmin):
+    c = superadmin
+    c.create_model(
+        "fake", "IMAGE_CLASSIFICATION", FIXTURE, "FakeModel",
+        access_right="PUBLIC",
+    )
+    job = c.create_train_job(
+        "httpapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+        budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 2},
+    )
+    assert job["status"] in ("RUNNING", "STOPPED")
+
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        job = c.get_train_job("httpapp")
+        if job["status"] == "STOPPED":
+            break
+        time.sleep(0.1)
+    assert job["status"] == "STOPPED"
+
+    trials = c.get_trials_of_train_job("httpapp")
+    assert len([t for t in trials if t["status"] == "COMPLETED"]) >= 2
+    best = c.get_best_trials_of_train_job("httpapp", max_count=1)
+    logs = c.get_trial_logs(best[0]["id"])
+    assert logs["metrics"]
+
+    # local model reconstruction (reference client.py:487-506)
+    model = c.load_trial_model(best[0]["id"], "fake")
+    assert model.predict([[1.0]]) == [[0.5, 0.5]]
+
+    c.create_inference_job("httpapp")
+    preds = c.predict("httpapp", [[0.1], [0.2], [0.3]])
+    assert preds == [[0.5, 0.5]] * 3
+    c.stop_inference_job("httpapp")
+
+
+def test_advisor_over_http(server, superadmin):
+    from rafiki_tpu.sdk.knob import FloatKnob, serialize_knob_config
+
+    cfg_json = serialize_knob_config({"lr": FloatKnob(1e-4, 1e-1, is_exp=True)})
+    aid = superadmin.create_advisor(cfg_json)
+    knobs = superadmin.propose_knobs(aid)
+    assert 1e-4 <= knobs["lr"] <= 1e-1
+    nxt = superadmin.feedback_knobs(aid, knobs, 0.7)
+    assert "lr" in nxt
+    superadmin.delete_advisor(aid)
+
+
+def test_unauthenticated_request_rejected(server):
+    c = Client("127.0.0.1", server.port)
+    with pytest.raises(RafikiError):
+        c.get_models()

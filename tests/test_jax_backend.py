@@ -143,3 +143,42 @@ def test_fit_trains_on_tiny_and_odd_datasets():
         )
         assert len(logs) == 2  # a loss was logged => steps ran
         assert not np.allclose(np.asarray(params["w"]), 0.0)  # params moved
+
+
+def test_thread_device_grant_precedence_and_isolation(monkeypatch):
+    import threading
+
+    from rafiki_tpu.parallel.mesh import (
+        get_default_mesh,
+        get_device_grant,
+        set_device_grant,
+        visible_devices,
+    )
+
+    # thread grant takes precedence over the env var
+    monkeypatch.setenv("RAFIKI_VISIBLE_DEVICES", "0,1")
+    set_device_grant([4, 5, 6])
+    try:
+        assert len(visible_devices()) == 3
+        assert get_default_mesh().devices.size == 3
+        assert get_device_grant() == (4, 5, 6)
+
+        # another thread sees no grant (falls back to env) and its default
+        # mesh cache doesn't leak into ours
+        result = {}
+
+        def child():
+            result["n"] = len(visible_devices())
+            result["mesh_n"] = get_default_mesh().devices.size
+            set_device_grant(get_device_grant() or [7])  # propagation idiom
+            result["propagated"] = len(visible_devices())
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+        assert result["n"] == 2  # env fallback
+        assert result["mesh_n"] == 2
+        assert result["propagated"] == 1  # [7]
+        assert get_default_mesh().devices.size == 3  # ours unchanged
+    finally:
+        set_device_grant(None)

@@ -1,0 +1,72 @@
+import threading
+import time
+
+import pytest
+
+from rafiki_tpu.placement.manager import (
+    ChipAllocator,
+    InsufficientChipsError,
+    LocalPlacementManager,
+)
+
+
+def test_chip_allocator_accounting():
+    alloc = ChipAllocator([0, 1, 2, 3])
+    a = alloc.allocate(2)
+    b = alloc.allocate(2)
+    assert sorted(a + b) == [0, 1, 2, 3]
+    with pytest.raises(InsufficientChipsError):
+        alloc.allocate(1)
+    alloc.release(a)
+    assert alloc.free_chips == 2
+
+
+def test_service_runs_with_chip_grant_and_stops():
+    statuses = []
+    mgr = LocalPlacementManager(
+        allocator=ChipAllocator([0, 1, 2, 3]),
+        on_status=lambda sid, st: statuses.append((sid, st)),
+    )
+    seen = {}
+    done = threading.Event()
+
+    def run(ctx):
+        seen["chips"] = ctx.chips
+        done.set()
+        while not ctx.stopping:
+            time.sleep(0.01)
+
+    mgr.create_service("svc1", "TRAIN", run, n_chips=2)
+    assert done.wait(2)
+    assert len(seen["chips"]) == 2
+    assert mgr.allocator.free_chips == 2
+    mgr.destroy_service("svc1")
+    assert mgr.allocator.free_chips == 4
+    assert ("svc1", "RUNNING") in statuses
+    assert ("svc1", "STOPPED") in statuses
+
+
+def test_service_restarts_then_errors():
+    statuses = []
+    mgr = LocalPlacementManager(
+        allocator=ChipAllocator([]),
+        on_status=lambda sid, st: statuses.append(st),
+        max_restarts=2,
+    )
+    calls = []
+
+    def crash(ctx):
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    mgr.create_service("svc2", "TRAIN", crash)
+    deadline = time.time() + 5
+    while "ERRORED" not in statuses and time.time() < deadline:
+        time.sleep(0.01)
+    assert "ERRORED" in statuses
+    assert len(calls) == 3  # initial + 2 restarts
+
+
+def test_destroy_unknown_service_is_noop():
+    mgr = LocalPlacementManager(allocator=ChipAllocator([]))
+    mgr.destroy_service("nope")  # tolerated, like concurrent deletion

@@ -1,0 +1,226 @@
+"""Full-stack integration: the reference's quickstart cycle
+(examples/scripts/quickstart.py:66-140) through the in-process Admin —
+create user -> upload model -> train job with parallel HPO trials ->
+inference job -> predict -> stop. Uses the fast fake model so the suite
+stays quick while exercising the whole machinery (pattern from reference
+test/data/Model.py)."""
+
+import os
+import time
+
+import pytest
+
+from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+from rafiki_tpu.constants import (
+    InferenceJobStatus,
+    ModelAccessRight,
+    TrainJobStatus,
+    TrialStatus,
+    UserType,
+)
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fake_model.py")
+
+
+@pytest.fixture()
+def admin(tmp_path):
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0, 1, 2, 3])),
+        params_dir=str(tmp_path / "params"),
+    )
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture()
+def model_bytes():
+    with open(FIXTURE, "rb") as f:
+        return f.read()
+
+
+def _login(admin):
+    from rafiki_tpu import config
+
+    return admin.authenticate_user(
+        config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD
+    )
+
+
+def test_full_train_inference_cycle(admin, model_bytes):
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PUBLIC,
+    )
+    job = admin.create_train_job(
+        uid, "myapp", "IMAGE_CLASSIFICATION", "uri://train", "uri://test",
+        budget={"MODEL_TRIAL_COUNT": 4, "CHIP_COUNT": 2},
+    )
+    assert job["app_version"] == 1
+    assert len(job["workers"]) == 2  # CHIP_COUNT=2 -> 2 one-chip executors
+
+    job = admin.wait_until_train_job_stopped(uid, "myapp", timeout_s=30)
+    assert job["status"] == TrainJobStatus.STOPPED
+
+    trials = admin.get_trials_of_train_job(uid, "myapp")
+    completed = [t for t in trials if t["status"] == TrialStatus.COMPLETED]
+    assert len(completed) >= 4  # budget is a lower bound with parallel workers
+    for t in completed:
+        assert t["score"] is not None
+        assert t["knobs"]["fixed_knob"] == "fixed"
+
+    best = admin.get_best_trials_of_train_job(uid, "myapp", max_count=2)
+    scores = [b["score"] for b in best]
+    assert scores == sorted(scores, reverse=True)
+
+    logs = admin.get_trial_logs(best[0]["id"])
+    assert any("train done" == m["message"] for m in logs["messages"])
+    assert logs["plots"] and logs["plots"][0]["title"] == "fake metric"
+
+    params = admin.get_trial_params(best[0]["id"])
+    assert isinstance(params, bytes) and len(params) > 0
+
+    # inference
+    inf = admin.create_inference_job(uid, "myapp")
+    assert inf["status"] == InferenceJobStatus.RUNNING
+    assert len(inf["workers"]) >= 1
+
+    t0 = time.monotonic()
+    preds = admin.predict(uid, "myapp", [[0.0], [1.0]])
+    latency = time.monotonic() - t0
+    assert len(preds) == 2
+    assert preds[0] == [0.5, 0.5]
+    # the poll-free pipeline must beat the reference's 0.25s floor cold
+    assert latency < 0.25, f"serving latency {latency:.3f}s"
+
+    admin.stop_inference_job(uid, "myapp")
+    with pytest.raises(InvalidRequestError):
+        admin.predict(uid, "myapp", [[0.0]])
+
+
+def test_train_job_auto_versioning_and_isolation(admin, model_bytes):
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PRIVATE,
+    )
+    for expect_version in (1, 2):
+        job = admin.create_train_job(
+            uid, "vapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+            budget={"MODEL_TRIAL_COUNT": 1},
+        )
+        assert job["app_version"] == expect_version
+        admin.wait_until_train_job_stopped(uid, "vapp", timeout_s=30)
+
+    # another user can't see the first user's app or private model
+    admin.create_user("other@x", "pw", UserType.APP_DEVELOPER)
+    other = admin.authenticate_user("other@x", "pw")
+    with pytest.raises(InvalidRequestError):
+        admin.get_train_job(other["user_id"], "vapp")
+    with pytest.raises(InvalidRequestError):
+        admin.create_train_job(
+            other["user_id"], "oapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+            model_names=["fake"],
+        )
+
+
+def test_inference_requires_stopped_train_job(admin, model_bytes):
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PUBLIC,
+    )
+    admin.create_train_job(
+        uid, "iapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+        budget={"MODEL_TRIAL_COUNT": 50},  # long-running
+    )
+    with pytest.raises(InvalidRequestError):
+        admin.create_inference_job(uid, "iapp")
+    admin.stop_train_job(uid, "iapp")
+
+
+def test_shared_advisor_across_parallel_workers(admin, model_bytes):
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PUBLIC,
+    )
+    admin.create_train_job(
+        uid, "sapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+        budget={"MODEL_TRIAL_COUNT": 6, "CHIP_COUNT": 4},
+    )
+    admin.wait_until_train_job_stopped(uid, "sapp", timeout_s=30)
+    # exactly one advisor session exists for the sub-train-job, shared by all
+    # 4 workers (the reference created one per worker)
+    subs = admin.db.get_sub_train_jobs_of_train_job(
+        admin.db.get_train_job_by_app_version(uid, "sapp", -1)["id"]
+    )
+    assert len(subs) == 1
+    advisor = admin.advisor_store.get(subs[0]["id"])
+    assert len(advisor.history) >= 6
+
+
+def test_stop_all_jobs_marks_job_rows(admin, model_bytes):
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PUBLIC,
+    )
+    admin.create_train_job(
+        uid, "stopapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+        budget={"MODEL_TRIAL_COUNT": 1},
+    )
+    admin.wait_until_train_job_stopped(uid, "stopapp", timeout_s=30)
+    admin.create_inference_job(uid, "stopapp")
+    admin.stop_all_jobs()
+    inf = admin.get_inference_job(uid, "stopapp")
+    assert inf["status"] == InferenceJobStatus.STOPPED
+    # and a new inference job can start afterwards (no phantom RUNNING row)
+    inf2 = admin.create_inference_job(uid, "stopapp")
+    assert inf2["status"] == InferenceJobStatus.RUNNING
+
+
+def test_chips_recorded_and_released(admin, model_bytes):
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PUBLIC,
+    )
+    admin.create_train_job(
+        uid, "chipapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+        budget={"MODEL_TRIAL_COUNT": 8, "CHIP_COUNT": 4},
+    )
+    job = admin.get_train_job(uid, "chipapp")
+    granted = sorted(c for w in job["workers"] for c in w["chips"])
+    assert granted == [0, 1, 2, 3]  # real allocator indices, disjoint
+    admin.wait_until_train_job_stopped(uid, "chipapp", timeout_s=30)
+    deadline = time.time() + 5
+    while admin.placement.allocator.free_chips < 4 and time.time() < deadline:
+        time.sleep(0.05)
+    assert admin.placement.allocator.free_chips == 4  # all released on exit
+
+
+def test_time_budget_enforced(admin, model_bytes):
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PUBLIC,
+    )
+    # TIME_HOURS=0 -> deadline already passed -> no trials run
+    admin.create_train_job(
+        uid, "tapp", "IMAGE_CLASSIFICATION", "u://t", "u://e",
+        budget={"MODEL_TRIAL_COUNT": 100, "TIME_HOURS": 0},
+    )
+    job = admin.wait_until_train_job_stopped(uid, "tapp", timeout_s=30)
+    assert job["status"] == TrainJobStatus.STOPPED
+    assert admin.get_trials_of_train_job(uid, "tapp") == []
