@@ -173,21 +173,35 @@ class Admin:
             or m["access_right"] == ModelAccessRight.PUBLIC
         ]
 
-    def get_model(self, user_id: str, name: str, owner_id: Optional[str] = None) -> Dict:
+    def _resolve_model(
+        self, user_id: str, name: str, owner_id: Optional[str]
+    ) -> Dict:
+        """Resolve a model by name: explicit owner if given, else the
+        caller's own, else any PUBLIC model of that name (so listed public
+        models are actually fetchable)."""
         model = self.db.get_model_by_name(owner_id or user_id, name)
+        if model is None and owner_id is None:
+            model = next(
+                (
+                    m
+                    for m in self.db.get_models()
+                    if m["name"] == name
+                    and m["access_right"] == ModelAccessRight.PUBLIC
+                ),
+                None,
+            )
         if model is None:
             raise InvalidRequestError(f"No such model {name}")
         self._check_model_access(model, user_id)
-        return self._model_view(model)
+        return model
+
+    def get_model(self, user_id: str, name: str, owner_id: Optional[str] = None) -> Dict:
+        return self._model_view(self._resolve_model(user_id, name, owner_id))
 
     def get_model_file(
         self, user_id: str, name: str, owner_id: Optional[str] = None
     ) -> bytes:
-        model = self.db.get_model_by_name(owner_id or user_id, name)
-        if model is None:
-            raise InvalidRequestError(f"No such model {name}")
-        self._check_model_access(model, user_id)
-        return model["model_file_bytes"]
+        return self._resolve_model(user_id, name, owner_id)["model_file_bytes"]
 
     def delete_model(self, user_id: str, name: str) -> None:
         model = self.db.get_model_by_name(user_id, name)
@@ -230,12 +244,18 @@ class Admin:
         budget = budget or {}
         # pick the models: named ones, or all visible models for the task
         # (reference admin.py:118-161)
+        # public models first, then the caller's own — so a same-named PUBLIC
+        # model from another user can never shadow the caller's own model
+        all_models = self.db.get_models(task)
         visible = {
             m["name"]: m
-            for m in self.db.get_models(task)
-            if m["user_id"] == user_id
-            or m["access_right"] == ModelAccessRight.PUBLIC
+            for m in all_models
+            if m["access_right"] == ModelAccessRight.PUBLIC
+            and m["user_id"] != user_id
         }
+        visible.update(
+            {m["name"]: m for m in all_models if m["user_id"] == user_id}
+        )
         if model_names is not None:
             missing = [n for n in model_names if n not in visible]
             if missing:

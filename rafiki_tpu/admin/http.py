@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import re
 import threading
 import traceback
@@ -25,6 +26,8 @@ from rafiki_tpu.admin.admin import Admin, InvalidRequestError
 from rafiki_tpu.constants import UserType
 from rafiki_tpu.sdk.model import InvalidModelClassError
 from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
+
+logger = logging.getLogger(__name__)
 
 _ANY = None  # any authenticated user
 _ADMINS = [UserType.ADMIN, UserType.SUPERADMIN]
@@ -103,10 +106,10 @@ class AdminServer:
             r("GET", "/models", _ANY, lambda au, m, b, q: A.get_models(
                 au["user_id"], q.get("task"))),
             r("GET", r"/models/(?P<name>[^/]+)", _ANY, lambda au, m, b, q:
-                A.get_model(au["user_id"], m["name"])),
+                A.get_model(au["user_id"], m["name"], q.get("owner_id"))),
             r("GET", r"/models/(?P<name>[^/]+)/file", _ANY, lambda au, m, b, q:
-                {"model_file_base64": base64.b64encode(
-                    A.get_model_file(au["user_id"], m["name"])).decode()}),
+                {"model_file_base64": base64.b64encode(A.get_model_file(
+                    au["user_id"], m["name"], q.get("owner_id"))).decode()}),
             r("DELETE", r"/models/(?P<name>[^/]+)", _MODEL_DEVS,
                 lambda au, m, b, q: A.delete_model(au["user_id"], m["name"]) or {}),
             # train jobs
@@ -205,10 +208,21 @@ class AdminServer:
             self._respond(handler, 404, {"error": f"No route {method} {path}"})
         except UnauthorizedError as e:
             self._respond(handler, 401, {"error": str(e)})
-        except (InvalidRequestError, InvalidModelClassError, KeyError) as e:
+        except (
+            InvalidRequestError,
+            InvalidModelClassError,
+            KeyError,
+            # malformed client input: bad JSON body (json.JSONDecodeError),
+            # invalid base64 (binascii.Error) — both ValueError subclasses
+            ValueError,
+            TypeError,
+        ) as e:
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
         except Exception:
-            self._respond(handler, 500, {"error": traceback.format_exc()})
+            # log the traceback server-side; never leak it to callers
+            logger.error("unhandled error on %s %s:\n%s", method,
+                         handler.path, traceback.format_exc())
+            self._respond(handler, 500, {"error": "internal server error"})
 
     @staticmethod
     def _respond(handler, code: int, payload: Dict[str, Any]) -> None:

@@ -78,7 +78,9 @@ class ServicesManager:
         )
         avail = getattr(self._placement, "allocator", None)
         if avail is not None:
-            total_chips = min(total_chips, avail.total_chips)
+            # clamp to chips actually free right now — clamping to the host
+            # total would still over-ask whenever another job holds chips
+            total_chips = min(total_chips, avail.free_chips)
         chips_per_sub = total_chips // len(sub_jobs) if sub_jobs else 0
 
         created: List[str] = []
@@ -119,10 +121,15 @@ class ServicesManager:
     def stop_sub_train_job_services(self, sub_train_job_id: str) -> None:
         for w in self._db.get_workers_of_sub_train_job(sub_train_job_id):
             self._destroy_service(w["service_id"], wait=False)
+        # the advisor session is keyed by sub_train_job_id; drop its GP
+        # history now that no more trials will be proposed
+        self._advisors.delete_advisor(sub_train_job_id)
 
     def stop_train_services(self, train_job_id: str) -> None:
         for w in self._db.get_workers_of_train_job(train_job_id):
             self._destroy_service(w["service_id"], wait=False)
+        for sub in self._db.get_sub_train_jobs_of_train_job(train_job_id):
+            self._advisors.delete_advisor(sub["id"])
         self.refresh_train_job_status(train_job_id)
 
     def refresh_train_job_status(self, train_job_id: str) -> None:

@@ -1,0 +1,11 @@
+"""First-party model zoo: pure-pytree JAX models designed for the MXU.
+
+Every model here is a pair of pure functions — ``init(rng, cfg) -> params``
+and ``apply(params, inputs, ...) -> outputs`` — over plain dict pytrees, plus
+a ``partition_specs(cfg)`` pytree of :class:`jax.sharding.PartitionSpec` so
+the parallel layer (rafiki_tpu/parallel) can shard them over any mesh without
+model-specific code. No framework classes, no tracing magic: everything is
+jit-/scan-/shard_map-compatible by construction.
+"""
+
+from rafiki_tpu.models import core  # noqa: F401

@@ -1,0 +1,87 @@
+"""BERT-style encoder for text classification.
+
+Backs the BASELINE.json "BERT-base text classification with ENAS search"
+config: a bidirectional transformer encoder (models/transformer.py stack,
+non-causal) with token/position embeddings and first-token pooling. The
+ENAS advisor (advisor/enas.py) searches over depth/heads/dim knobs of this
+family.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rafiki_tpu.models import core
+from rafiki_tpu.models.transformer import (
+    TransformerConfig,
+    block_partition_specs,
+    stack_apply,
+    stack_init,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 30522
+    max_len: int = 512
+    num_classes: int = 2
+    encoder: TransformerConfig = field(default_factory=TransformerConfig)
+
+
+def bert_base(num_classes: int = 2) -> BertConfig:
+    return BertConfig(num_classes=num_classes,
+                      encoder=TransformerConfig(dim=768, depth=12, heads=12))
+
+
+def tiny(vocab: int = 1000, max_len: int = 64, num_classes: int = 2,
+         dim: int = 64, depth: int = 2, heads: int = 4) -> BertConfig:
+    return BertConfig(vocab=vocab, max_len=max_len, num_classes=num_classes,
+                      encoder=TransformerConfig(dim=dim, depth=depth,
+                                                heads=heads))
+
+
+def init(rng: jax.Array, cfg: BertConfig) -> Params:
+    k_emb, k_pos, k_blocks, k_pool, k_head = jax.random.split(rng, 5)
+    return {
+        "embed": core.embedding_init(k_emb, cfg.vocab, cfg.encoder.dim),
+        "pos": core.normal_init(k_pos, (1, cfg.max_len, cfg.encoder.dim)),
+        "blocks": stack_init(k_blocks, cfg.encoder),
+        "ln_f": core.layernorm_init(cfg.encoder.dim),
+        "pool": core.dense_init(k_pool, cfg.encoder.dim, cfg.encoder.dim),
+        "head": core.dense_init(k_head, cfg.encoder.dim, cfg.num_classes),
+    }
+
+
+def apply(params: Params, ids: jax.Array, cfg: BertConfig,
+          rng: Optional[jax.Array] = None,
+          deterministic: bool = True) -> jax.Array:
+    """ids: (B, S) int32 -> logits (B, num_classes)."""
+    s = ids.shape[1]
+    x = core.embedding(params["embed"], ids)
+    x = x + params["pos"][:, :s, :].astype(x.dtype)
+    x, _ = stack_apply(params["blocks"], x, cfg.encoder, rng, deterministic)
+    x = core.layernorm(params["ln_f"], x)
+    pooled = jnp.tanh(core.dense(params["pool"], x[:, 0]))
+    return core.dense(params["head"], pooled).astype(jnp.float32)
+
+
+def partition_specs(cfg: BertConfig) -> Params:
+    return {
+        "embed": {"table": P(None, "model")},
+        "pos": P(None, None, None),
+        "blocks": block_partition_specs(cfg.encoder, stacked=True),
+        "ln_f": {"scale": P(None), "bias": P(None)},
+        "pool": {"kernel": P(None, "model"), "bias": P("model")},
+        "head": {"kernel": P(None, None), "bias": P(None)},
+    }
+
+
+def batch_spec() -> Any:
+    return P("data", None)

@@ -1,0 +1,46 @@
+"""Small dense feed-forward classifier — the quickstart workhorse.
+
+Parity target: the reference's TfFeedForward example (reference
+examples/models/image_classification/TfFeedForward.py:14-164) — a flattened-
+image MLP with knob-tunable depth/width/lr/epochs — re-expressed as pure
+init/apply functions consumed by either trainer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.models import core
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class FeedForwardConfig:
+    in_dim: int = 784
+    hidden_layers: int = 1
+    hidden_units: int = 128
+    num_classes: int = 10
+
+
+def init(rng: jax.Array, cfg: FeedForwardConfig) -> Params:
+    keys = jax.random.split(rng, cfg.hidden_layers + 1)
+    layers = []
+    d = cfg.in_dim
+    for i in range(cfg.hidden_layers):
+        layers.append(core.dense_init(keys[i], d, cfg.hidden_units))
+        d = cfg.hidden_units
+    return {"layers": layers,
+            "head": core.dense_init(keys[-1], d, cfg.num_classes)}
+
+
+def apply(params: Params, x: jax.Array, cfg: FeedForwardConfig) -> jax.Array:
+    """x: (B, ...) flattened to (B, in_dim) -> logits (B, classes)."""
+    x = core.cast_for_compute(x.reshape(x.shape[0], -1))
+    for layer in params["layers"]:
+        x = jax.nn.relu(core.dense(layer, x))
+    return core.dense(params["head"], x).astype(jnp.float32)

@@ -1,0 +1,99 @@
+"""Decoder-only transformer LM — the long-context / MoE vehicle.
+
+Causal transformer over token ids with optional expert-parallel MoE FFNs
+(TransformerConfig.moe_experts) and tied-embedding output head. Exercises
+every mesh axis: data (batch), model (TP heads/MLP), seq (SP activations /
+ring attention), expert (MoE), pipe (stacked depth). The reference system
+has no language model at all; this backs the BASELINE.json BERT/ENAS config
+and the long-context requirement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rafiki_tpu.models import core
+from rafiki_tpu.models.transformer import (
+    TransformerConfig,
+    block_partition_specs,
+    stack_apply,
+    stack_init,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    vocab: int = 32000
+    max_len: int = 2048
+    encoder: TransformerConfig = field(
+        default_factory=lambda: TransformerConfig(causal=True))
+
+
+def tiny(vocab: int = 256, max_len: int = 128, dim: int = 64, depth: int = 2,
+         heads: int = 4, moe_experts: int = 0) -> LMConfig:
+    return LMConfig(vocab=vocab, max_len=max_len,
+                    encoder=TransformerConfig(dim=dim, depth=depth,
+                                              heads=heads, causal=True,
+                                              moe_experts=moe_experts))
+
+
+def init(rng: jax.Array, cfg: LMConfig) -> Params:
+    k_emb, k_pos, k_blocks = jax.random.split(rng, 3)
+    return {
+        "embed": core.embedding_init(k_emb, cfg.vocab, cfg.encoder.dim),
+        "pos": core.normal_init(k_pos, (1, cfg.max_len, cfg.encoder.dim)),
+        "blocks": stack_init(k_blocks, cfg.encoder),
+        "ln_f": core.layernorm_init(cfg.encoder.dim),
+    }
+
+
+def apply(params: Params, ids: jax.Array, cfg: LMConfig,
+          rng: Optional[jax.Array] = None, deterministic: bool = True
+          ) -> Tuple[jax.Array, jax.Array]:
+    """ids: (B, S) int32 -> (logits (B, S, V) f32, moe aux loss)."""
+    s = ids.shape[1]
+    x = core.embedding(params["embed"], ids)
+    x = x + params["pos"][:, :s, :].astype(x.dtype)
+    x, aux = stack_apply(params["blocks"], x, cfg.encoder, rng, deterministic)
+    x = core.layernorm(params["ln_f"], x)
+    # tied output head: logits = x @ E^T
+    logits = jnp.einsum("bsd,vd->bsv", x,
+                        params["embed"]["table"].astype(x.dtype))
+    return logits.astype(jnp.float32), aux
+
+
+def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
+            rng: jax.Array, cfg: LMConfig,
+            aux_weight: float = 1e-2) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy; batch = (ids, mask)."""
+    import optax
+
+    ids, mask = batch
+    logits, aux = apply(params, ids, cfg, rng, deterministic=False)
+    targets = ids[:, 1:]
+    lm_mask = mask[:, 1:].astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], targets)
+    loss = jnp.sum(ce * lm_mask) / jnp.maximum(jnp.sum(lm_mask), 1.0)
+    total = loss + aux_weight * aux
+    return total, {"ce": loss, "moe_aux": aux}
+
+
+def partition_specs(cfg: LMConfig) -> Params:
+    return {
+        "embed": {"table": P(None, "model")},
+        "pos": P(None, None, None),
+        "blocks": block_partition_specs(cfg.encoder, stacked=True),
+        "ln_f": {"scale": P(None), "bias": P(None)},
+    }
+
+
+def batch_spec() -> Any:
+    return (P("data", "seq"), P("data", "seq"))

@@ -1,0 +1,158 @@
+"""ResNet (v1.5 bottleneck / basic-block) for the CIFAR/ImageNet configs.
+
+Backs the BASELINE.json "CIFAR-10 ResNet-50 with advisor Bayesian HPO"
+config. NHWC layout, bf16 compute, BatchNorm folded as (scale, bias, moving
+stats) with stats updated functionally — params and batch-stats are separate
+subtrees so the train step can donate both.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.models import core
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    bottleneck: bool = True
+    width: int = 64
+    num_classes: int = 1000
+    small_inputs: bool = False  # CIFAR stem: 3x3/1 conv, no maxpool
+
+
+def resnet18(num_classes: int = 10, small_inputs: bool = True) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(2, 2, 2, 2), bottleneck=False,
+                        num_classes=num_classes, small_inputs=small_inputs)
+
+
+def resnet50(num_classes: int = 1000, small_inputs: bool = False) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), bottleneck=True,
+                        num_classes=num_classes, small_inputs=small_inputs)
+
+
+def _bn_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32),
+            "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def _bn_stats_init(dim: int) -> Params:
+    return {"mean": jnp.zeros((dim,), jnp.float32),
+            "var": jnp.ones((dim,), jnp.float32)}
+
+
+def _batchnorm(p: Params, stats: Params, x: jax.Array, train: bool,
+               momentum: float = 0.9, eps: float = 1e-5
+               ) -> Tuple[jax.Array, Params]:
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        new_stats = {
+            "mean": momentum * stats["mean"] + (1 - momentum) * mean,
+            "var": momentum * stats["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = stats["mean"], stats["var"]
+        new_stats = stats
+    y = (xf - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_stats
+
+
+def _block_channels(cfg: ResNetConfig, stage: int) -> Tuple[int, int]:
+    width = cfg.width * (2 ** stage)
+    out = width * 4 if cfg.bottleneck else width
+    return width, out
+
+
+def init(rng: jax.Array, cfg: ResNetConfig) -> Tuple[Params, Params]:
+    """Returns (params, batch_stats)."""
+    keys = iter(jax.random.split(rng, 1024))
+    params: Params = {}
+    stats: Params = {}
+    stem_k = 3 if cfg.small_inputs else 7
+    params["stem"] = core.conv2d_init(next(keys), stem_k, stem_k, 3, cfg.width)
+    params["stem_bn"] = _bn_init(cfg.width)
+    stats["stem_bn"] = _bn_stats_init(cfg.width)
+    cin = cfg.width
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        width, cout = _block_channels(cfg, si)
+        for bi in range(n_blocks):
+            name = f"s{si}b{bi}"
+            blk: Params = {}
+            bst: Params = {}
+            if cfg.bottleneck:
+                blk["conv1"] = core.conv2d_init(next(keys), 1, 1, cin, width)
+                blk["conv2"] = core.conv2d_init(next(keys), 3, 3, width, width)
+                blk["conv3"] = core.conv2d_init(next(keys), 1, 1, width, cout)
+                for i, d in (("bn1", width), ("bn2", width), ("bn3", cout)):
+                    blk[i] = _bn_init(d)
+                    bst[i] = _bn_stats_init(d)
+            else:
+                blk["conv1"] = core.conv2d_init(next(keys), 3, 3, cin, width)
+                blk["conv2"] = core.conv2d_init(next(keys), 3, 3, width, cout)
+                for i, d in (("bn1", width), ("bn2", cout)):
+                    blk[i] = _bn_init(d)
+                    bst[i] = _bn_stats_init(d)
+            if cin != cout or (bi == 0 and si > 0):
+                blk["proj"] = core.conv2d_init(next(keys), 1, 1, cin, cout)
+                blk["proj_bn"] = _bn_init(cout)
+                bst["proj_bn"] = _bn_stats_init(cout)
+            params[name] = blk
+            stats[name] = bst
+            cin = cout
+    params["head"] = core.dense_init(next(keys), cin, cfg.num_classes)
+    return params, stats
+
+
+def apply(params: Params, stats: Params, images: jax.Array, cfg: ResNetConfig,
+          train: bool = False) -> Tuple[jax.Array, Params]:
+    """images (B, H, W, 3) -> (logits, new_batch_stats)."""
+    new_stats: Params = {}
+    x = core.cast_for_compute(images)
+    stride = 1 if cfg.small_inputs else 2
+    x = core.conv2d(params["stem"], x, stride=stride)
+    x, new_stats["stem_bn"] = _batchnorm(
+        params["stem_bn"], stats["stem_bn"], x, train)
+    x = jax.nn.relu(x)
+    if not cfg.small_inputs:
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for si, n_blocks in enumerate(cfg.stage_sizes):
+        for bi in range(n_blocks):
+            name = f"s{si}b{bi}"
+            blk, bst = params[name], stats[name]
+            nst: Params = {}
+            stride = 2 if (bi == 0 and si > 0) else 1
+            residual = x
+            if cfg.bottleneck:
+                y = core.conv2d(blk["conv1"], x)
+                y, nst["bn1"] = _batchnorm(blk["bn1"], bst["bn1"], y, train)
+                y = jax.nn.relu(y)
+                y = core.conv2d(blk["conv2"], y, stride=stride)
+                y, nst["bn2"] = _batchnorm(blk["bn2"], bst["bn2"], y, train)
+                y = jax.nn.relu(y)
+                y = core.conv2d(blk["conv3"], y)
+                y, nst["bn3"] = _batchnorm(blk["bn3"], bst["bn3"], y, train)
+            else:
+                y = core.conv2d(blk["conv1"], x, stride=stride)
+                y, nst["bn1"] = _batchnorm(blk["bn1"], bst["bn1"], y, train)
+                y = jax.nn.relu(y)
+                y = core.conv2d(blk["conv2"], y)
+                y, nst["bn2"] = _batchnorm(blk["bn2"], bst["bn2"], y, train)
+            if "proj" in blk:
+                residual = core.conv2d(blk["proj"], x, stride=stride)
+                residual, nst["proj_bn"] = _batchnorm(
+                    blk["proj_bn"], bst["proj_bn"], residual, train)
+            x = jax.nn.relu(y + residual)
+            new_stats[name] = nst
+    x = jnp.mean(x, axis=(1, 2))
+    logits = core.dense(params["head"], x).astype(jnp.float32)
+    return logits, new_stats

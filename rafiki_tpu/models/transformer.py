@@ -1,0 +1,149 @@
+"""Shared pre-LN transformer stack, scan-over-layers, sharding-annotated.
+
+The layer stack is a single pytree whose leaves carry a leading ``depth``
+axis (models/core.py ``stack_layers``), consumed by ``lax.scan`` — one
+compiled block body regardless of depth. Partition specs shard:
+
+- attention heads and MLP hidden over the ``model`` (TP) axis,
+- the scanned ``depth`` axis over the ``pipe`` axis when pipeline parallelism
+  is on (parallel/pipeline.py),
+- activations batch over ``data`` and sequence over ``seq`` (SP).
+
+This stack is what ViT/BERT instantiate; the reference has no transformer
+at all (its deepest model is a TF1 ProGAN, reference pg_gans.py), so this
+subsystem is part of the BASELINE.json north-star configs (ViT-B/16,
+BERT-base) rather than a port.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rafiki_tpu.models import core
+from rafiki_tpu.ops.attention import attention_init, multi_head_attention
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    dim: int = 768
+    depth: int = 12
+    heads: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    causal: bool = False
+    use_flash: Optional[bool] = None  # None = auto by backend/seq-len
+    moe_experts: int = 0  # >0 replaces the MLP with an expert-parallel MoE
+    moe_capacity_factor: float = 1.25
+
+
+def block_init(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    from rafiki_tpu.parallel.moe import moe_init
+
+    k_attn, k_mlp1, k_mlp2 = jax.random.split(rng, 3)
+    hidden = cfg.dim * cfg.mlp_ratio
+    params = {
+        "ln1": core.layernorm_init(cfg.dim),
+        "attn": attention_init(k_attn, cfg.dim, cfg.heads),
+        "ln2": core.layernorm_init(cfg.dim),
+    }
+    if cfg.moe_experts > 0:
+        params["moe"] = moe_init(k_mlp1, cfg.dim, hidden, cfg.moe_experts)
+    else:
+        params["mlp"] = {
+            "w1": core.dense_init(k_mlp1, cfg.dim, hidden),
+            "w2": core.dense_init(k_mlp2, hidden, cfg.dim),
+        }
+    return params
+
+
+def block_apply(params: Params, x: jax.Array, cfg: TransformerConfig,
+                rng: Optional[jax.Array] = None,
+                deterministic: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Returns (x, aux_loss) — aux is the MoE load-balancing term (0 for
+    dense blocks)."""
+    from rafiki_tpu.parallel.moe import moe_apply
+    from rafiki_tpu.parallel.sharding import shard_activations
+
+    x = shard_activations(x, ("data", "seq", None))
+    r1 = r2 = None
+    if rng is not None:
+        r1, r2 = jax.random.split(rng)
+    h = multi_head_attention(params["attn"], core.layernorm(params["ln1"], x),
+                             causal=cfg.causal, use_flash=cfg.use_flash)
+    x = x + core.dropout(r1, h, cfg.dropout, deterministic)
+    h = core.layernorm(params["ln2"], x)
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.moe_experts > 0:
+        h, aux = moe_apply(params["moe"], h, cfg.moe_capacity_factor)
+    else:
+        h = core.dense(params["mlp"]["w1"], h)
+        h = jax.nn.gelu(h)
+        h = core.dense(params["mlp"]["w2"], h)
+    x = x + core.dropout(r2, h, cfg.dropout, deterministic)
+    return x, aux
+
+
+def stack_init(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    keys = jax.random.split(rng, cfg.depth)
+    return core.stack_layers([block_init(k, cfg) for k in keys])
+
+
+def stack_apply(stacked: Params, x: jax.Array, cfg: TransformerConfig,
+                rng: Optional[jax.Array] = None,
+                deterministic: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """scan over the depth-stacked block params -> (x, summed aux loss)."""
+
+    def body(carry, layer):
+        x, key = carry
+        sub = None
+        if key is not None:
+            key, sub = jax.random.split(key)
+        y, aux = block_apply(layer, x, cfg, sub, deterministic)
+        return (y, key), aux
+
+    (x, _), auxs = jax.lax.scan(body, (x, rng), stacked)
+    return x, jnp.sum(auxs)
+
+
+def block_partition_specs(cfg: TransformerConfig, stacked: bool = True) -> Params:
+    """PartitionSpecs for one block (or the depth-stacked pytree).
+
+    TP sharding follows the megatron split: column-parallel qkv/w1, row-
+    parallel wo/w2 — XLA inserts the psum on the row-parallel matmul's
+    output over ICI.
+    """
+    from rafiki_tpu.parallel.moe import moe_partition_specs
+
+    lead = ("pipe",) if stacked else ()
+
+    def spec(*axes):
+        return P(*(lead + axes))
+
+    specs = {
+        "ln1": {"scale": spec(None), "bias": spec(None)},
+        "attn": {
+            "wq": spec(None, "model", None),
+            "wk": spec(None, "model", None),
+            "wv": spec(None, "model", None),
+            "wo": spec("model", None, None),
+            "bo": spec(None),
+        },
+        "ln2": {"scale": spec(None), "bias": spec(None)},
+    }
+    if cfg.moe_experts > 0:
+        specs["moe"] = jax.tree.map(
+            lambda s: P(*(lead + tuple(s))), moe_partition_specs(),
+            is_leaf=lambda x: isinstance(x, P))
+    else:
+        specs["mlp"] = {
+            "w1": {"kernel": spec(None, "model"), "bias": spec("model")},
+            "w2": {"kernel": spec("model", None), "bias": spec(None)},
+        }
+    return specs

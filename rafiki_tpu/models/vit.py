@@ -1,0 +1,99 @@
+"""Vision Transformer — the flagship model of the TPU build.
+
+ViT-B/16 is one of the driver's north-star configs (BASELINE.json: "ImageNet
+ViT-B/16 multi-worker pjit train job + predictor batched serving"). The
+design is MXU-shaped end to end: patchify is a single strided conv, the
+encoder is the scan-stacked transformer (models/transformer.py), pooling is
+GAP (no ragged cls-token gather, and the sequence axis stays uniformly
+shardable for SP), and the whole forward runs in bfloat16.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rafiki_tpu.models import core
+from rafiki_tpu.models.transformer import (
+    TransformerConfig,
+    block_partition_specs,
+    stack_apply,
+    stack_init,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_classes: int = 1000
+    encoder: TransformerConfig = field(default_factory=TransformerConfig)
+
+    @property
+    def seq_len(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_b16(num_classes: int = 1000, image_size: int = 224) -> ViTConfig:
+    return ViTConfig(image_size=image_size, num_classes=num_classes,
+                     encoder=TransformerConfig(dim=768, depth=12, heads=12))
+
+
+def tiny(num_classes: int = 10, image_size: int = 32, patch_size: int = 4,
+         dim: int = 64, depth: int = 2, heads: int = 4) -> ViTConfig:
+    """A test-scale config (compiles in seconds; used by unit tests and the
+    multichip dry run)."""
+    return ViTConfig(image_size=image_size, patch_size=patch_size,
+                     num_classes=num_classes,
+                     encoder=TransformerConfig(dim=dim, depth=depth, heads=heads))
+
+
+def init(rng: jax.Array, cfg: ViTConfig) -> Params:
+    k_patch, k_pos, k_blocks, k_head = jax.random.split(rng, 4)
+    p = cfg.patch_size
+    return {
+        "patch": core.conv2d_init(k_patch, p, p, cfg.channels, cfg.encoder.dim),
+        "pos": core.normal_init(k_pos, (1, cfg.seq_len, cfg.encoder.dim)),
+        "blocks": stack_init(k_blocks, cfg.encoder),
+        "ln_f": core.layernorm_init(cfg.encoder.dim),
+        "head": core.dense_init(k_head, cfg.encoder.dim, cfg.num_classes),
+    }
+
+
+def apply(params: Params, images: jax.Array, cfg: ViTConfig,
+          rng: Optional[jax.Array] = None,
+          deterministic: bool = True) -> jax.Array:
+    """images: (B, H, W, C) float -> logits (B, num_classes)."""
+    x = core.cast_for_compute(images)
+    x = core.conv2d(params["patch"], x, stride=cfg.patch_size, padding="VALID")
+    b = x.shape[0]
+    x = x.reshape(b, cfg.seq_len, cfg.encoder.dim)
+    x = x + params["pos"].astype(x.dtype)
+    x, _ = stack_apply(params["blocks"], x, cfg.encoder, rng, deterministic)
+    x = core.layernorm(params["ln_f"], x)
+    x = jnp.mean(x, axis=1)  # GAP — SP-friendly (uniform over sequence)
+    return core.dense(params["head"], x).astype(jnp.float32)
+
+
+def partition_specs(cfg: ViTConfig) -> Params:
+    """Param PartitionSpecs: transformer blocks TP-sharded (and pipe-sharded
+    on their stacked depth axis); everything else replicated."""
+    return {
+        "patch": {"kernel": P(None, None, None, None), "bias": P(None)},
+        "pos": P(None, None, None),
+        "blocks": block_partition_specs(cfg.encoder, stacked=True),
+        "ln_f": {"scale": P(None), "bias": P(None)},
+        "head": {"kernel": P(None, None), "bias": P(None)},
+    }
+
+
+def batch_spec() -> Any:
+    """Activations: batch over data, sequence over seq (SP), features full."""
+    return P("data", None, None, None)

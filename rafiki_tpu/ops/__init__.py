@@ -1,0 +1,11 @@
+"""Hot-path compute ops: pallas TPU kernels with XLA fallbacks.
+
+Every op here has (a) a pure-XLA reference implementation that works on any
+backend and defines the semantics + gradients, and (b) where it pays off, a
+pallas kernel for TPU (flash attention, fused softmax-cross-entropy). Kernels
+run in interpreter mode off-TPU so the unit-test mesh (8 fake CPU devices)
+exercises the same code path.
+"""
+
+from rafiki_tpu.ops.attention import multi_head_attention, mha_reference  # noqa: F401
+from rafiki_tpu.ops.flash_attention import flash_attention  # noqa: F401

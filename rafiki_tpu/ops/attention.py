@@ -1,0 +1,73 @@
+"""Multi-head attention: XLA reference semantics + flash-kernel dispatch.
+
+``mha_reference`` is the ground truth (used for gradients and for unit-test
+comparison); ``multi_head_attention`` is the layer the model zoo calls —
+projections + attention + output projection over a plain param dict, routing
+the inner attention to the pallas flash kernel when profitable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.models.core import xavier_uniform
+
+Params = Dict[str, Any]
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = False,
+                  sm_scale: Optional[float] = None) -> jax.Array:
+    """Plain attention over (B, H, S, Dh); softmax statistics in f32."""
+    dh = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(dh)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, skv = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def attention_init(rng: jax.Array, dim: int, heads: int) -> Params:
+    """QKV + output projection params. Head axis kept explicit so tensor
+    parallelism can shard it (heads over the ``model`` mesh axis)."""
+    dh = dim // heads
+    kq, kk, kv, ko = jax.random.split(rng, 4)
+    shape = (dim, heads, dh)
+    return {
+        "wq": xavier_uniform(kq, shape, in_axis=0, out_axis=2),
+        "wk": xavier_uniform(kk, shape, in_axis=0, out_axis=2),
+        "wv": xavier_uniform(kv, shape, in_axis=0, out_axis=2),
+        "wo": xavier_uniform(ko, (heads, dh, dim), in_axis=1, out_axis=2),
+        "bo": jnp.zeros((dim,), jnp.float32),
+    }
+
+
+def multi_head_attention(params: Params, x: jax.Array,
+                         causal: bool = False,
+                         use_flash: Optional[bool] = None) -> jax.Array:
+    """Self-attention over (B, S, D). ``use_flash=None`` auto-selects the
+    pallas kernel for sequences long enough that materializing (S, S) scores
+    would be HBM-bound."""
+    from rafiki_tpu.ops.flash_attention import flash_attention
+
+    b, s, d = x.shape
+    dt = x.dtype
+    q = jnp.einsum("bsd,dhk->bhsk", x, params["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bhsk", x, params["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bhsk", x, params["wv"].astype(dt))
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu" and s >= 1024
+    if use_flash:
+        o = flash_attention(q, k, v, causal=causal)
+    else:
+        o = mha_reference(q, k, v, causal=causal)
+    out = jnp.einsum("bhsk,hkd->bsd", o, params["wo"].astype(dt))
+    return out + params["bo"].astype(dt)

@@ -1,0 +1,154 @@
+"""Blockwise (flash) attention as a pallas TPU kernel.
+
+Computes softmax(q k^T * scale [+ causal mask]) v without materializing the
+(S, S) score matrix in HBM: the kv sequence is streamed through VMEM in
+blocks while running max/sum statistics keep the softmax numerically exact
+(online softmax). This is the memory-bound op where HBM traffic — not FLOPs
+— sets the ceiling, hence a hand kernel rather than trusting XLA fusion.
+
+The backward pass is defined by recomputation: the custom VJP re-runs the
+reference attention under ``jax.vjp``. That trades one extra forward of
+FLOPs for never storing the attention matrix — the same rematerialisation
+flash-attention backward does, without a second hand kernel to maintain.
+
+The reference system has no analogue (its deepest compute is a TF1 GAN,
+reference pg_gans.py); this exists for the transformer model zoo (ViT/BERT)
+and the long-context path (parallel/ring.py reuses it per-block).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
+                  q_len: int, kv_len: int, block_k: int):
+    """One (batch*head, q-block) program: stream kv blocks, online softmax."""
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (Bq, Dh)
+    block_q, dh = q.shape
+    n_kv = k_ref.shape[1] // block_k
+    q_start = pl.program_id(1) * block_q
+    # End-aligned causal offset, matching mha_reference's tril(k=skv-sq):
+    # query i attends keys j <= i + (kv_len - q_len). With sq == skv this is
+    # the usual triangle; in decode shapes (sq=1) the query sees all keys.
+    causal_off = kv_len - q_len
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (Bq, Bk)
+        k_idx = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_idx < kv_len
+        if causal:
+            q_idx = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, q_idx + causal_off >= k_idx)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    if causal:
+        # only blocks intersecting the causal band contribute
+        n_kv_eff = jnp.clip(
+            pl.cdiv(q_start + block_q + causal_off, block_k), 0, n_kv
+        ).astype(jnp.int32)
+    else:
+        n_kv_eff = n_kv
+    acc0 = jnp.zeros((block_q, dh), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kv_eff, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+                   sm_scale: Optional[float], block_q: int, block_k: int
+                   ) -> jax.Array:
+    """q,k,v: (B, H, S, Dh) -> (B, H, Sq, Dh)."""
+    b, h, sq, dh = q.shape
+    skv = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(dh)
+    qf = _pad_to(q.reshape(b * h, sq, dh), 1, block_q)
+    kf = _pad_to(k.reshape(b * h, skv, dh), 1, block_k)
+    vf = _pad_to(v.reshape(b * h, skv, dh), 1, block_k)
+    n_q = qf.shape[1] // block_q
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=scale, causal=causal, q_len=sq, kv_len=skv,
+        block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda bh, i: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, kf.shape[1], dh), lambda bh, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, vf.shape[1], dh), lambda bh, i: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh), lambda bh, i: (bh, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, qf.shape[1], dh), q.dtype),
+        interpret=_use_interpret(),
+    )(qf, kf, vf)
+    return out[:, :sq, :].reshape(b, h, sq, dh)
+
+
+def _reference(q, k, v, causal, sm_scale):
+    from rafiki_tpu.ops.attention import mha_reference
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Flash attention over (B, H, S, Dh) tensors."""
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k), (q, k, v)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal, sm_scale),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

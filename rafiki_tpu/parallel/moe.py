@@ -1,0 +1,83 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Switch-style top-1 routing with a static capacity: tokens are dispatched to
+experts through one-hot einsums (dense dispatch — static shapes, no gathers,
+exactly what XLA tiles well), experts are sharded over the ``expert`` mesh
+axis, and GSPMD turns the dispatch/combine einsums into the all-to-alls.
+Returns the load-balancing auxiliary loss (Switch Transformer eq. 4) so the
+trainer can add it to the objective.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from rafiki_tpu.models.core import normal_init
+
+Params = Dict[str, Any]
+
+
+def moe_init(rng: jax.Array, dim: int, hidden: int, n_experts: int) -> Params:
+    kr, k1, k2 = jax.random.split(rng, 3)
+    std1 = math.sqrt(2.0 / dim)
+    std2 = math.sqrt(2.0 / hidden)
+    return {
+        "router": normal_init(kr, (dim, n_experts), std=0.02),
+        "w1": normal_init(k1, (n_experts, dim, hidden), std=std1),
+        "b1": jnp.zeros((n_experts, hidden), jnp.float32),
+        "w2": normal_init(k2, (n_experts, hidden, dim), std=std2),
+        "b2": jnp.zeros((n_experts, dim), jnp.float32),
+    }
+
+
+def moe_partition_specs() -> Params:
+    return {
+        "router": P(None, None),
+        "w1": P("expert", None, "model"),
+        "b1": P("expert", "model"),
+        "w2": P("expert", "model", None),
+        "b2": P("expert", None),
+    }
+
+
+def moe_apply(params: Params, x: jax.Array, capacity_factor: float = 1.25
+              ) -> Tuple[jax.Array, jax.Array]:
+    """x: (B, S, D) -> (y, aux_loss). Tokens over capacity are dropped
+    (residual connection carries them — standard Switch behavior)."""
+    b, s, d = x.shape
+    n_tok = b * s
+    xt = x.reshape(n_tok, d)
+    n_exp = params["router"].shape[-1]
+    capacity = int(math.ceil(n_tok / n_exp * capacity_factor))
+
+    logits = jnp.dot(xt.astype(jnp.float32), params["router"])
+    gates = jax.nn.softmax(logits, axis=-1)          # (N, E)
+    expert = jnp.argmax(gates, axis=-1)              # (N,)
+    gate = jnp.take_along_axis(gates, expert[:, None], axis=-1)[:, 0]
+
+    exp_oh = jax.nn.one_hot(expert, n_exp, dtype=jnp.float32)  # (N, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(exp_oh, axis=0) * exp_oh - 1.0            # (N, E)
+    keep = (pos >= 0) & (pos < capacity)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32) * keep[..., None]  # (N, E, C)
+
+    dispatch = pos_oh                                 # (N, E, C)
+    combine = dispatch * gate[:, None, None]          # (N, E, C)
+
+    xe = jnp.einsum("nec,nd->ecd", dispatch, xt.astype(jnp.float32))
+    he = jax.nn.gelu(
+        jnp.einsum("ecd,edh->ech", xe, params["w1"]) + params["b1"][:, None, :])
+    ye = jnp.einsum("ech,ehd->ecd", he, params["w2"]) + params["b2"][:, None, :]
+    y = jnp.einsum("nec,ecd->nd", combine, ye)
+
+    # Switch load-balancing loss: E * sum_e f_e * p_e
+    frac_tokens = jnp.mean(exp_oh, axis=0)
+    frac_router = jnp.mean(gates, axis=0)
+    aux = n_exp * jnp.sum(frac_tokens * frac_router)
+    return y.reshape(b, s, d).astype(x.dtype), aux

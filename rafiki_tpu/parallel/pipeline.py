@@ -1,0 +1,88 @@
+"""GPipe-style pipeline parallelism over the ``pipe`` mesh axis.
+
+The layer stack's leading depth axis (models/core.py ``stack_layers``) is
+sharded over ``pipe``, so each stage holds depth/n_stages contiguous layers
+in HBM — the memory-scaling lever. The batch is split into M microbatches;
+activations hop stage-to-stage via ``lax.ppermute`` (point-to-point ICI) on
+a schedule of M + n_stages - 1 ticks, and every tick every stage computes —
+bubble fraction (n_stages-1)/(M+n_stages-1), the GPipe number.
+
+Differentiable end-to-end (scan + ppermute), so one ``jax.grad`` over the
+pipelined forward gives pipeline-parallel training without a hand-written
+backward schedule.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rafiki_tpu.parallel.mesh import PIPELINE_AXIS
+
+
+def _stage_local(params_local: Any, x_mbs: jax.Array, *, block_fn,
+                 axis_name: str, n_microbatches: int) -> jax.Array:
+    """Per-stage body (inside shard_map).
+
+    params_local: this stage's layer stack (L_local, ...).
+    x_mbs: (M, mb, ...) full input microbatches (replicated; only stage 0
+    reads them).
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    m = n_microbatches
+
+    def apply_stage(x):
+        def body(h, layer):
+            return block_fn(layer, h), None
+        h, _ = jax.lax.scan(body, x, params_local)
+        return h
+
+    fwd_perm = [(r, (r + 1) % n) for r in range(n)]
+    mb_shape = x_mbs.shape[1:]
+
+    def tick(carry, t):
+        buf = carry  # activation arriving from the previous stage
+        feed = x_mbs[jnp.minimum(t, m - 1)]
+        inp = jnp.where(my == 0, feed, buf)
+        out = apply_stage(inp)
+        nxt = jax.lax.ppermute(out, axis_name, fwd_perm)
+        return nxt, out
+
+    t_total = m + n - 1
+    _, outs = jax.lax.scan(tick, jnp.zeros(mb_shape, x_mbs.dtype),
+                           jnp.arange(t_total))
+    # the last stage emitted microbatch j at tick j + (n-1)
+    y = outs[n - 1:]                      # (M, mb, ...)
+    y = jnp.where(my == n - 1, y, 0.0)
+    # broadcast the final activations to every stage
+    return jax.lax.psum(y, axis_name)
+
+
+def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
+                stacked_params: Any, x: jax.Array, mesh: Mesh,
+                n_microbatches: int,
+                pipe_axis: str = PIPELINE_AXIS) -> jax.Array:
+    """Run ``block_fn`` over the pipe-sharded layer stack with microbatched
+    pipelining. ``x``: (B, ...) with B divisible by n_microbatches; layer
+    stack depth divisible by the pipe axis size."""
+    n_stages = mesh.shape[pipe_axis]
+    b = x.shape[0]
+    assert b % n_microbatches == 0, "batch must divide into microbatches"
+    x_mbs = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
+
+    param_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    fn = jax.shard_map(
+        partial(_stage_local, block_fn=block_fn, axis_name=pipe_axis,
+                n_microbatches=n_microbatches),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    y = fn(stacked_params, x_mbs)
+    return y.reshape(b, *y.shape[2:])

@@ -89,10 +89,18 @@ class ServiceContext:
     chips: List[int]
     stop_event: threading.Event
     extra: Dict[str, Any] = field(default_factory=dict)
+    on_ready: Optional[Callable[[], None]] = None
 
     @property
     def stopping(self) -> bool:
         return self.stop_event.is_set()
+
+    def ready(self) -> None:
+        """Services call this once initialized (model loaded, job info read)
+        — only then is the service reported RUNNING, so the deploy-time wait
+        and rollback actually gate on successful startup."""
+        if self.on_ready:
+            self.on_ready()
 
     def devices(self) -> List[Any]:
         """The granted jax devices (all visible devices if the grant is
@@ -144,6 +152,7 @@ class _ServiceRunner:
         self.on_status = on_status
         self.max_restarts = max_restarts
         self.on_exit = on_exit
+        ctx.on_ready = lambda: self._status("RUNNING")
         self.thread = threading.Thread(
             target=self._run, name=f"svc-{ctx.service_id[:8]}", daemon=True
         )
@@ -156,9 +165,11 @@ class _ServiceRunner:
                 logger.exception("status callback failed")
 
     def _run(self) -> None:
+        # RUNNING is reported by ctx.ready() from inside run_fn, after the
+        # service has actually initialized — a run_fn that crashes on startup
+        # lands ERRORED without ever having claimed to run
         try:
             restarts = 0
-            self._status("RUNNING")
             while not self.ctx.stop_event.is_set():
                 try:
                     self.run_fn(self.ctx)

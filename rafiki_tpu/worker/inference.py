@@ -61,6 +61,7 @@ class InferenceWorker:
         queue = self._broker.register_worker(self._job_id, ctx.service_id)
         try:
             model = self._load_model()
+            ctx.ready()  # model + params loaded: startup succeeded
             while not ctx.stopping:
                 batch = queue.take_batch(
                     max_size=config.PREDICT_MAX_BATCH_SIZE,

@@ -97,6 +97,7 @@ class TrainWorker:
             knob_config, advisor_id=self._sub_id
         )
         self._db.update_sub_train_job_advisor(self._sub_id, advisor_id)
+        ctx.ready()  # job info read + model class loaded: startup succeeded
 
         while not ctx.stopping:
             # shared budget accounting through the DB (reference

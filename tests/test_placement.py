@@ -32,6 +32,7 @@ def test_service_runs_with_chip_grant_and_stops():
 
     def run(ctx):
         seen["chips"] = ctx.chips
+        ctx.ready()  # services report RUNNING only once initialized
         done.set()
         while not ctx.stopping:
             time.sleep(0.01)
@@ -44,6 +45,25 @@ def test_service_runs_with_chip_grant_and_stops():
     assert mgr.allocator.free_chips == 4
     assert ("svc1", "RUNNING") in statuses
     assert ("svc1", "STOPPED") in statuses
+
+
+def test_startup_failure_never_reports_running():
+    statuses = []
+    mgr = LocalPlacementManager(
+        allocator=ChipAllocator([]),
+        on_status=lambda sid, st: statuses.append(st),
+        max_restarts=1,
+    )
+
+    def crash_on_startup(ctx):
+        raise RuntimeError("model load failed")  # before ctx.ready()
+
+    mgr.create_service("svc-bad", "INFERENCE", crash_on_startup)
+    deadline = time.time() + 2
+    while "ERRORED" not in statuses and time.time() < deadline:
+        time.sleep(0.01)
+    assert "ERRORED" in statuses
+    assert "RUNNING" not in statuses
 
 
 def test_service_restarts_then_errors():
