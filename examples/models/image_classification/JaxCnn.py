@@ -1,0 +1,180 @@
+"""JaxCnn — a JAX/XLA convolutional image classifier model template.
+
+The TPU-native analogue of the reference's TF1/Keras example template
+(reference examples/models/image_classification/TfFeedForward.py:14-164):
+a small CNN with tunable knobs for depth/width/lr/batch-size, trained
+through the SDK's DataParallelTrainer so the same template runs on one
+chip or a whole slice (the mesh comes from the placement layer's device
+grant — no CUDA_VISIBLE_DEVICES analogue in model code).
+
+Run `python examples/models/image_classification/JaxCnn.py` for a local
+contract-conformance check (reference pattern: every example template
+invokes test_model_class in __main__, e.g. TfFeedForward.py:168).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rafiki_tpu.models import core
+from rafiki_tpu.sdk import (
+    BaseModel,
+    CategoricalKnob,
+    DataParallelTrainer,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    classification_accuracy,
+    dataset_utils,
+    softmax_classifier_loss,
+)
+
+
+class JaxCnn(BaseModel):
+    """Conv -> [Conv-Conv-pool] x num_stages -> GAP -> Dense softmax."""
+
+    dependencies = {"jax": None, "optax": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "epochs": IntegerKnob(1, 5),
+            "num_stages": IntegerKnob(1, 3),
+            "base_channels": CategoricalKnob([16, 32, 64]),
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([64, 128, 256]),
+            "image_size": FixedKnob(32),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+        self._trainer = None
+        self._num_classes = None
+
+    # -- architecture ------------------------------------------------------
+
+    def _make_init(self, channels_in, num_classes):
+        stages = self._knobs["num_stages"]
+        base = self._knobs["base_channels"]
+
+        def init_fn(rng):
+            keys = core.split_keys(rng, 2 * stages + 2)
+            params = {"stem": core.conv2d_init(keys[0], 3, 3, channels_in, base)}
+            cin = base
+            for s in range(stages):
+                cout = base * (2**s)
+                params[f"conv{s}a"] = core.conv2d_init(keys[2 * s + 1], 3, 3, cin, cout)
+                params[f"conv{s}b"] = core.conv2d_init(keys[2 * s + 2], 3, 3, cout, cout)
+                cin = cout
+            params["head"] = core.dense_init(keys[-1], cin, num_classes)
+            return params
+
+        return init_fn
+
+    def _apply(self, params, x):
+        stages = self._knobs["num_stages"]
+        x = core.cast_for_compute(x)
+        x = jax.nn.relu(core.conv2d(params["stem"], x))
+        for s in range(stages):
+            x = jax.nn.relu(core.conv2d(params[f"conv{s}a"], x))
+            x = jax.nn.relu(core.conv2d(params[f"conv{s}b"], x))
+            # 2x2 mean-pool: reduce-window maps cleanly onto the VPU
+            x = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            ) / 4.0
+        x = jnp.mean(x, axis=(1, 2))  # GAP
+        return core.dense(params["head"], x).astype(jnp.float32)
+
+    def _build_trainer(self):
+        return DataParallelTrainer(
+            softmax_classifier_loss(self._apply),
+            optax.adamw(self._knobs["learning_rate"]),
+            predict_fn=lambda p, x: jax.nn.softmax(self._apply(p, x), axis=-1),
+        )
+
+    # -- data --------------------------------------------------------------
+
+    def _load(self, dataset_uri):
+        size = self._knobs["image_size"]
+        if dataset_uri.endswith(".npz"):
+            ds = dataset_utils.load_dataset_of_arrays(dataset_uri)
+            x, y = ds.x.astype(np.float32), ds.y.astype(np.int32)
+        else:
+            ds = dataset_utils.load_dataset_of_image_files(
+                dataset_uri, image_size=(size, size)
+            )
+            x, y = ds.load_as_arrays()
+        return x, y
+
+    # -- BaseModel contract ------------------------------------------------
+
+    def train(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        self._num_classes = int(y.max()) + 1
+        self._trainer = self._build_trainer()
+        init_fn = self._make_init(x.shape[-1], self._num_classes)
+        params, opt_state = self._trainer.init(init_fn)
+        self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        params, _ = self._trainer.fit(
+            params,
+            opt_state,
+            (x, y),
+            epochs=self._knobs["epochs"],
+            batch_size=self._knobs["batch_size"],
+            log=self.logger.log,
+        )
+        self._params = params
+
+    def evaluate(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        return classification_accuracy(self._trainer, self._params, x, y)
+
+    def predict(self, queries):
+        x = np.asarray(queries, dtype=np.float32)
+        probs = self._trainer.predict_batched(self._params, x)
+        return [p.tolist() for p in probs]
+
+    def dump_parameters(self):
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "num_classes": self._num_classes,
+        }
+
+    def load_parameters(self, params):
+        self._params = params["params"]
+        self._num_classes = params["num_classes"]
+        if self._trainer is None:
+            self._trainer = self._build_trainer()
+        self._params = self._trainer.device_put_params(self._params)
+
+
+if __name__ == "__main__":
+    import os
+    import tempfile
+
+    from rafiki_tpu.sdk import test_model_class
+    from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        x = rng.normal(size=(256, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, size=256).astype(np.int32)
+        train_uri = write_numpy_dataset(x, y, os.path.join(d, "train.npz"))
+        test_uri = write_numpy_dataset(x[:64], y[:64], os.path.join(d, "test.npz"))
+        test_model_class(
+            clazz=JaxCnn,
+            task="IMAGE_CLASSIFICATION",
+            train_dataset_uri=train_uri,
+            test_dataset_uri=test_uri,
+            queries=[x[0].tolist()],
+        )

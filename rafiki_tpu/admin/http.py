@@ -13,6 +13,7 @@ hides the encoding either way).
 from __future__ import annotations
 
 import base64
+import binascii
 import json
 import logging
 import re
@@ -24,6 +25,7 @@ from urllib.parse import parse_qs, urlparse
 
 from rafiki_tpu.admin.admin import Admin, InvalidRequestError
 from rafiki_tpu.constants import UserType
+from rafiki_tpu.placement.manager import InsufficientChipsError
 from rafiki_tpu.sdk.model import InvalidModelClassError
 from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
 
@@ -169,6 +171,9 @@ class AdminServer:
                     m["aid"], b["knobs"], b["score"])}),
             r("DELETE", r"/advisors/(?P<aid>[^/]+)", _ANY, lambda au, m, b, q:
                 A.advisor_store.delete_advisor(m["aid"]) or {}),
+            # admin actions (reference scripts/stop_all_jobs.py via client)
+            r("POST", "/actions/stop_all_jobs", _ADMINS,
+                lambda au, m, b, q: A.stop_all_jobs() or {}),
             # internal events (reference admin/app.py:360). Workers
             # authenticate as superadmin (as the reference's did, reference
             # worker/train.py:261-263); plain users must not be able to stop
@@ -212,12 +217,13 @@ class AdminServer:
             InvalidRequestError,
             InvalidModelClassError,
             KeyError,
-            # malformed client input: bad JSON body (json.JSONDecodeError),
-            # invalid base64 (binascii.Error) — both ValueError subclasses
-            ValueError,
-            TypeError,
+            # malformed client input: bad JSON body, invalid base64
+            json.JSONDecodeError,
+            binascii.Error,
         ) as e:
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
+        except InsufficientChipsError as e:
+            self._respond(handler, 503, {"error": f"{type(e).__name__}: {e}"})
         except Exception:
             # log the traceback server-side; never leak it to callers
             logger.error("unhandled error on %s %s:\n%s", method,

@@ -78,9 +78,13 @@ class ServicesManager:
         )
         avail = getattr(self._placement, "allocator", None)
         if avail is not None:
-            # clamp to chips actually free right now — clamping to the host
-            # total would still over-ask whenever another job holds chips
-            total_chips = min(total_chips, avail.free_chips)
+            # Clamp to the host's static capacity (asking for more chips than
+            # exist downsizes the job, like the reference's even GPU split,
+            # reference services_manager.py:190-202). Chips merely *busy* are
+            # NOT clamped away: allocating them raises InsufficientChipsError
+            # and the deploy rolls back — never silently share devices with
+            # a running job.
+            total_chips = min(total_chips, avail.total_chips)
         chips_per_sub = total_chips // len(sub_jobs) if sub_jobs else 0
 
         created: List[str] = []

@@ -243,8 +243,6 @@ class Client:
         self._call("POST", f"/event/{name}", payload)
 
     def stop_all_jobs(self) -> None:
-        # best-effort: stop running inference+train jobs of every app the
-        # user owns is an admin-side operation; exposed via events for parity
-        raise NotImplementedError(
-            "use Admin.stop_all_jobs() server-side; per-job stops are on Client"
-        )
+        """Stop all running train and inference jobs (admin-only; reference
+        client.py:647 / scripts/stop_all_jobs.py)."""
+        self._call("POST", "/actions/stop_all_jobs")

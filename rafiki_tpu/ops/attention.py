@@ -14,8 +14,6 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from rafiki_tpu.models.core import xavier_uniform
-
 Params = Dict[str, Any]
 
 
@@ -40,12 +38,19 @@ def attention_init(rng: jax.Array, dim: int, heads: int) -> Params:
     parallelism can shard it (heads over the ``model`` mesh axis)."""
     dh = dim // heads
     kq, kk, kv, ko = jax.random.split(rng, 4)
+
+    def xavier3(key, shape, fan_in, fan_out):
+        # fans of the *logical* dim -> heads*dh projection, not the per-head
+        # slice — matches the standard init of the fused (dim, dim) matmul
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit)
+
     shape = (dim, heads, dh)
     return {
-        "wq": xavier_uniform(kq, shape, in_axis=0, out_axis=2),
-        "wk": xavier_uniform(kk, shape, in_axis=0, out_axis=2),
-        "wv": xavier_uniform(kv, shape, in_axis=0, out_axis=2),
-        "wo": xavier_uniform(ko, (heads, dh, dim), in_axis=1, out_axis=2),
+        "wq": xavier3(kq, shape, dim, heads * dh),
+        "wk": xavier3(kk, shape, dim, heads * dh),
+        "wv": xavier3(kv, shape, dim, heads * dh),
+        "wo": xavier3(ko, (heads, dh, dim), heads * dh, dim),
         "bo": jnp.zeros((dim,), jnp.float32),
     }
 
