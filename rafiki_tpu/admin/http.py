@@ -13,7 +13,6 @@ hides the encoding either way).
 from __future__ import annotations
 
 import base64
-import binascii
 import json
 import logging
 import re
@@ -37,6 +36,22 @@ _MODEL_DEVS = [UserType.MODEL_DEVELOPER] + _ADMINS
 _APP_DEVS = [UserType.APP_DEVELOPER] + _ADMINS
 
 Route = Tuple[str, re.Pattern, Optional[List[str]], Callable]
+
+
+def _b64_field(body: Dict[str, Any], name: str) -> bytes:
+    """Decode a base64 body field; malformed input is a client error, not a
+    server bug — keep broad except clauses out of the dispatch loop."""
+    try:
+        return base64.b64decode(body[name])
+    except (ValueError, TypeError) as e:
+        raise InvalidRequestError(f"field '{name}' is not valid base64: {e}")
+
+
+def _int_param(query: Dict[str, str], name: str, default: int) -> int:
+    try:
+        return int(query.get(name, default))
+    except (ValueError, TypeError) as e:
+        raise InvalidRequestError(f"query param '{name}' must be an int: {e}")
 
 
 class AdminServer:
@@ -103,7 +118,7 @@ class AdminServer:
             # models
             r("POST", "/models", _MODEL_DEVS, lambda au, m, b, q: A.create_model(
                 au["user_id"], b["name"], b["task"],
-                base64.b64decode(b["model_file_base64"]), b["model_class"],
+                _b64_field(b, "model_file_base64"), b["model_class"],
                 b.get("dependencies"), b.get("access_right", "PRIVATE"))),
             r("GET", "/models", _ANY, lambda au, m, b, q: A.get_models(
                 au["user_id"], q.get("task"))),
@@ -133,7 +148,7 @@ class AdminServer:
             r("GET", r"/train_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/best_trials",
                 _ANY, lambda au, m, b, q: A.get_best_trials_of_train_job(
                     au["user_id"], m["app"], int(m["v"]),
-                    int(q.get("max_count", 2)))),
+                    _int_param(q, "max_count", 2))),
             # trials
             r("GET", r"/trials/(?P<tid>[^/]+)/logs", _ANY, lambda au, m, b, q:
                 A.get_trial_logs(m["tid"])),
@@ -188,9 +203,15 @@ class AdminServer:
             path = parsed.path.rstrip("/") or "/"
             query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             body: Dict[str, Any] = {}
-            length = int(handler.headers.get("Content-Length") or 0)
-            if length:
-                body = json.loads(handler.rfile.read(length) or b"{}")
+            try:
+                length = int(handler.headers.get("Content-Length") or 0)
+                if length:
+                    body = json.loads(handler.rfile.read(length) or b"{}")
+            except (ValueError, UnicodeDecodeError) as e:
+                # bad Content-Length, malformed JSON, or non-UTF-8 bytes
+                raise InvalidRequestError(f"malformed request body: {e}")
+            if length and not isinstance(body, dict):
+                raise InvalidRequestError("request body must be a JSON object")
 
             for m, pattern, allowed, fn in self.routes:
                 if m != method:
@@ -216,10 +237,7 @@ class AdminServer:
         except (
             InvalidRequestError,
             InvalidModelClassError,
-            KeyError,
-            # malformed client input: bad JSON body, invalid base64
-            json.JSONDecodeError,
-            binascii.Error,
+            KeyError,  # missing body field
         ) as e:
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
         except InsufficientChipsError as e:

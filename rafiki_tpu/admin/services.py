@@ -115,9 +115,17 @@ class ServicesManager:
             send_event=self._send_event,
             params_dir=self._params_dir,
         )
-        ctx = self._placement.create_service(
-            service["id"], ServiceType.TRAIN, worker.start, n_chips=n_chips
-        )
+        try:
+            ctx = self._placement.create_service(
+                service["id"], ServiceType.TRAIN, worker.start, n_chips=n_chips
+            )
+        except Exception:
+            # the DB rows exist but placement never started the service
+            # (e.g. chips busy) — close the row so the rollback in
+            # create_train_services (which only sees *returned* sids)
+            # doesn't leave a phantom STARTED service behind
+            self._db.mark_service_as_stopped(service["id"])
+            raise
         # record the chip indices actually granted by the allocator
         self._db.update_service_chips(service["id"], ctx.chips)
         return service["id"]
@@ -188,13 +196,19 @@ class ServicesManager:
                     )
                     # serving executors prefer an exclusive chip but fall
                     # back to shared devices when training holds them all
-                    ctx = self._placement.create_service(
-                        service["id"],
-                        ServiceType.INFERENCE,
-                        worker.start,
-                        n_chips=1,
-                        best_effort_chips=True,
-                    )
+                    try:
+                        ctx = self._placement.create_service(
+                            service["id"],
+                            ServiceType.INFERENCE,
+                            worker.start,
+                            n_chips=1,
+                            best_effort_chips=True,
+                        )
+                    except Exception:
+                        # close the row: it was never placed, and rollback
+                        # only iterates sids in `created`
+                        self._db.mark_service_as_stopped(service["id"])
+                        raise
                     self._db.update_service_chips(service["id"], ctx.chips)
                     created.append(service["id"])
             predictor_service = self._db.create_service(ServiceType.PREDICT)

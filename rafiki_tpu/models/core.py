@@ -26,9 +26,16 @@ Params = Dict[str, Any]
 # ---------------------------------------------------------------------------
 
 def xavier_uniform(rng: jax.Array, shape: Sequence[int], in_axis: int = -2,
-                   out_axis: int = -1, dtype=jnp.float32) -> jax.Array:
-    fan_in = shape[in_axis]
-    fan_out = shape[out_axis]
+                   out_axis: int = -1, dtype=jnp.float32,
+                   fan_in: Optional[int] = None,
+                   fan_out: Optional[int] = None) -> jax.Array:
+    """Explicit fan_in/fan_out override the axis-derived fans — used when the
+    logical matmul shape differs from the stored param shape (e.g. a
+    (dim, heads, dh) projection whose logical fan_out is heads*dh)."""
+    if fan_in is None:
+        fan_in = shape[in_axis]
+    if fan_out is None:
+        fan_out = shape[out_axis]
     limit = math.sqrt(6.0 / (fan_in + fan_out))
     return jax.random.uniform(rng, tuple(shape), dtype, -limit, limit)
 

@@ -1,0 +1,529 @@
+"""Progressive GAN, re-designed TPU-first.
+
+Capability parity with the reference fork's signature feature — the 1,447-line
+TF1 Progressive-GAN mini-framework (reference pg_gans.py:34-1447: `Network`
+graph templates :601-1090, multi-GPU `Optimizer` with NCCL all-reduce
+:1093-1225, `TrainingSchedule` :1227-1274, WGAN-GP+ACGAN losses :1276-1330) —
+with a fundamentally different architecture:
+
+- **No graph surgery.** The reference clones TF graph templates per device and
+  re-wires them as resolution grows (pg_gans.py:293-311, :601-670). Here the
+  generator/discriminator are pure pytree functions with *static* shapes; the
+  level-of-detail (lod) is a traced scalar that cross-fades per-stage RGB
+  heads, so growth never retraces. Only the integer "highest active stage"
+  is a static argument — at most log2(resolution)-2 recompiles per run,
+  each cached by XLA.
+- **GSPMD data parallelism.** The reference splits the minibatch across GPUs
+  by hand and all-reduces gradients with `tf.contrib.nccl.all_sum`
+  (pg_gans.py:1165-1170). Here the train step is jitted over a
+  `jax.sharding.Mesh` with the batch sharded on the `data` axis; XLA inserts
+  the gradient all-reduce over ICI itself.
+- **bf16 compute, f32 params/optimizer.** Matmuls/convs ride the MXU in
+  bfloat16; parameters, the generator EMA, and Adam state stay float32.
+
+Components: equalized-learning-rate layers, pixel norm, minibatch stddev,
+WGAN-GP + ACGAN losses, generator EMA ("Gs", reference pg_gans.py:730-741),
+`training_schedule` (reference :1227-1274 semantics), and `PgganTrainer`
+orchestrating the D_repeats/minibatch_repeats loop (reference :328-343).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# config
+
+@dataclass(frozen=True)
+class PgganConfig:
+    resolution: int = 32          # final output resolution (power of 2, >= 8)
+    num_channels: int = 3
+    label_size: int = 0           # >0 enables ACGAN conditioning
+    latent_size: int = 128
+    fmap_base: int = 1024
+    fmap_decay: float = 1.0
+    fmap_max: int = 128
+    gp_lambda: float = 10.0       # WGAN-GP gradient penalty weight
+    eps_drift: float = 1e-3       # drift penalty on real scores
+    cond_weight: float = 1.0      # ACGAN label-loss weight
+    mbstd_group_size: int = 4
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def num_stages(self) -> int:
+        """Stage s renders at 4*2**s; stage 0 is 4x4."""
+        return int(math.log2(self.resolution)) - 1
+
+    def nf(self, stage: int) -> int:
+        return min(
+            int(self.fmap_base / (2.0 ** (stage * self.fmap_decay))),
+            self.fmap_max,
+        )
+
+
+# ---------------------------------------------------------------------------
+# primitive layers (equalized learning rate: weights are stored N(0,1) and
+# rescaled by the He constant at apply time, so Adam's per-parameter scale
+# is uniform across layers)
+
+def eq_dense_init(rng: jax.Array, in_dim: int, out_dim: int) -> Params:
+    return {"w": jax.random.normal(rng, (in_dim, out_dim), jnp.float32),
+            "b": jnp.zeros((out_dim,), jnp.float32)}
+
+
+def eq_dense(p: Params, x: jax.Array, gain: float = math.sqrt(2.0)) -> jax.Array:
+    scale = gain / math.sqrt(p["w"].shape[0])
+    return x @ (p["w"] * scale).astype(x.dtype) + p["b"].astype(x.dtype)
+
+
+def eq_conv_init(rng: jax.Array, k: int, cin: int, cout: int) -> Params:
+    return {"w": jax.random.normal(rng, (k, k, cin, cout), jnp.float32),
+            "b": jnp.zeros((cout,), jnp.float32)}
+
+
+def eq_conv(p: Params, x: jax.Array, gain: float = math.sqrt(2.0)) -> jax.Array:
+    k, _, cin, _ = p["w"].shape
+    scale = gain / math.sqrt(k * k * cin)
+    y = jax.lax.conv_general_dilated(
+        x, (p["w"] * scale).astype(x.dtype), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + p["b"].astype(y.dtype)
+
+
+def pixel_norm(x: jax.Array, eps: float = 1e-8) -> jax.Array:
+    return x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+
+
+def upscale2d(x: jax.Array, factor: int = 2) -> jax.Array:
+    n, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :],
+                         (n, h, factor, w, factor, c))
+    return x.reshape(n, h * factor, w * factor, c)
+
+
+def downscale2d(x: jax.Array, factor: int = 2) -> jax.Array:
+    # reshape-mean avg-pool: unlike reduce_window it supports the
+    # second-order autodiff the WGAN gradient penalty needs
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // factor, factor, w // factor, factor, c)
+    return jnp.mean(x, axis=(2, 4))
+
+
+def minibatch_stddev(x: jax.Array, group_size: int) -> jax.Array:
+    """Append one channel of batch-group stddev (mode-collapse detector)."""
+    n, h, w, c = x.shape
+    g = min(group_size, n)
+    g = n // (n // g) if n % g else g          # ensure divisibility
+    y = x.reshape(g, n // g, h, w, c).astype(jnp.float32)
+    y = y - jnp.mean(y, axis=0, keepdims=True)
+    y = jnp.sqrt(jnp.mean(jnp.square(y), axis=0) + 1e-8)
+    y = jnp.mean(y, axis=(1, 2, 3), keepdims=True)          # (n//g,1,1,1)
+    y = jnp.broadcast_to(y[:, :, :, 0][None], (g, n // g, h, w))
+    y = y.reshape(n, h, w, 1).astype(x.dtype)
+    return jnp.concatenate([x, y], axis=-1)
+
+
+def _lrelu(x: jax.Array) -> jax.Array:
+    return jax.nn.leaky_relu(x, 0.2)
+
+
+def stage_weights(lod: jax.Array, num_stages: int) -> jax.Array:
+    """Triangle cross-fade weights per stage for a scalar lod.
+
+    lod == num_stages-1 selects stage 0 (4x4); lod == 0 selects the full
+    resolution; fractional lods linearly blend two adjacent stages — the
+    fade-in the reference implements with per-level lerps inside the TF
+    graph (pg_gans.py `G_paper`/`D_paper` growing structure).
+    """
+    stage_lods = jnp.arange(num_stages - 1, -1, -1, dtype=jnp.float32)
+    return jnp.clip(1.0 - jnp.abs(lod - stage_lods), 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# generator
+
+def g_init(rng: jax.Array, cfg: PgganConfig) -> Params:
+    keys = iter(jax.random.split(rng, 4 * cfg.num_stages + 4))
+    in_dim = cfg.latent_size + cfg.label_size
+    p: Params = {
+        "latent_dense": eq_dense_init(next(keys), in_dim, cfg.nf(0) * 16),
+        "stage0_conv": eq_conv_init(next(keys), 3, cfg.nf(0), cfg.nf(0)),
+        "torgb": [eq_conv_init(next(keys), 1, cfg.nf(0), cfg.num_channels)],
+        "blocks": [],
+    }
+    for s in range(1, cfg.num_stages):
+        p["blocks"].append({
+            "conv0": eq_conv_init(next(keys), 3, cfg.nf(s - 1), cfg.nf(s)),
+            "conv1": eq_conv_init(next(keys), 3, cfg.nf(s), cfg.nf(s)),
+        })
+        p["torgb"].append(eq_conv_init(next(keys), 1, cfg.nf(s), cfg.num_channels))
+    return p
+
+
+def g_apply(p: Params, latents: jax.Array, labels: Optional[jax.Array],
+            lod: jax.Array, cfg: PgganConfig,
+            max_stage: Optional[int] = None) -> jax.Array:
+    """latents (B, latent_size) -> images (B, R, R, C) in [-1, 1] range.
+
+    ``max_stage`` (static) bounds the computed stages: during progressive
+    growth the trainer passes the highest stage with nonzero fade weight so
+    XLA never executes the dormant high-resolution convs.
+    """
+    top = cfg.num_stages - 1 if max_stage is None else max_stage
+    dt = cfg.compute_dtype
+    z = latents.astype(dt)
+    if cfg.label_size:
+        assert labels is not None
+        z = jnp.concatenate([z, labels.astype(dt)], axis=-1)
+    z = pixel_norm(z)
+    x = eq_dense(p["latent_dense"], z, gain=math.sqrt(2.0) / 4.0)
+    x = x.reshape(-1, 4, 4, cfg.nf(0))
+    x = pixel_norm(_lrelu(x))
+    x = pixel_norm(_lrelu(eq_conv(p["stage0_conv"], x)))
+
+    w = stage_weights(lod, cfg.num_stages).astype(dt)
+    img = eq_conv(p["torgb"][0], x, gain=1.0) * w[0]
+    for s in range(1, top + 1):
+        blk = p["blocks"][s - 1]
+        x = upscale2d(x)
+        x = pixel_norm(_lrelu(eq_conv(blk["conv0"], x)))
+        x = pixel_norm(_lrelu(eq_conv(blk["conv1"], x)))
+        img = upscale2d(img) + eq_conv(p["torgb"][s], x, gain=1.0) * w[s]
+    # bring to final resolution regardless of how far we grew
+    for _ in range(top + 1, cfg.num_stages):
+        img = upscale2d(img)
+    return img.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# discriminator
+
+def d_init(rng: jax.Array, cfg: PgganConfig) -> Params:
+    keys = iter(jax.random.split(rng, 4 * cfg.num_stages + 6))
+    p: Params = {"fromrgb": [], "blocks": []}
+    for s in range(cfg.num_stages):
+        p["fromrgb"].append(eq_conv_init(next(keys), 1, cfg.num_channels, cfg.nf(s)))
+    for s in range(cfg.num_stages - 1, 0, -1):
+        p["blocks"].append({
+            "conv0": eq_conv_init(next(keys), 3, cfg.nf(s), cfg.nf(s)),
+            "conv1": eq_conv_init(next(keys), 3, cfg.nf(s), cfg.nf(s - 1)),
+        })
+    p["stage0_conv"] = eq_conv_init(next(keys), 3, cfg.nf(0) + 1, cfg.nf(0))
+    p["stage0_dense"] = eq_dense_init(next(keys), cfg.nf(0) * 16, cfg.nf(0))
+    p["head"] = eq_dense_init(next(keys), cfg.nf(0), 1 + cfg.label_size)
+    return p
+
+
+def d_apply(p: Params, images: jax.Array, lod: jax.Array, cfg: PgganConfig,
+            max_stage: Optional[int] = None
+            ) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """images (B, R, R, C) -> (critic scores (B,), label logits or None).
+
+    Skip-style growing: the (suitably downscaled) image is injected through
+    each stage's fromRGB head with the same fade weights the generator uses —
+    equivalent in the limit to the reference's lerp-based `D_paper` growth,
+    but with no data-dependent structure for XLA to re-trace.
+    """
+    top = cfg.num_stages - 1 if max_stage is None else max_stage
+    dt = cfg.compute_dtype
+    img = images.astype(dt)
+    w = stage_weights(lod, cfg.num_stages).astype(dt)
+
+    # image pyramid down to 4x4
+    pyramid = [img]
+    for _ in range(cfg.num_stages - 1):
+        pyramid.append(downscale2d(pyramid[-1]))
+    # pyramid[i] has resolution of stage (num_stages-1-i)
+
+    x = None
+    for s in range(top, 0, -1):
+        inject = _lrelu(eq_conv(p["fromrgb"][s], pyramid[cfg.num_stages - 1 - s])) * w[s]
+        x = inject if x is None else x + inject
+        blk = p["blocks"][cfg.num_stages - 1 - s]
+        x = _lrelu(eq_conv(blk["conv0"], x))
+        x = _lrelu(eq_conv(blk["conv1"], x))
+        x = downscale2d(x)
+    inject = _lrelu(eq_conv(p["fromrgb"][0], pyramid[-1])) * w[0]
+    x = inject if x is None else x + inject
+
+    x = minibatch_stddev(x, cfg.mbstd_group_size)
+    x = _lrelu(eq_conv(p["stage0_conv"], x))
+    x = x.reshape(x.shape[0], -1)
+    x = _lrelu(eq_dense(p["stage0_dense"], x))
+    out = eq_dense(p["head"], x, gain=1.0).astype(jnp.float32)
+    scores = out[:, 0]
+    logits = out[:, 1:] if cfg.label_size else None
+    return scores, logits
+
+
+# ---------------------------------------------------------------------------
+# losses (WGAN-GP + ACGAN — reference pg_gans.py:1276-1330 behavior)
+
+def _acgan_term(logits: Optional[jax.Array], labels: Optional[jax.Array],
+                cfg: PgganConfig) -> jax.Array:
+    if not cfg.label_size:
+        return jnp.zeros(())
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -cfg.cond_weight * jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def g_loss_fn(g_params: Params, d_params: Params, latents: jax.Array,
+              labels: Optional[jax.Array], lod: jax.Array, cfg: PgganConfig,
+              max_stage: Optional[int]) -> jax.Array:
+    fakes = g_apply(g_params, latents, labels, lod, cfg, max_stage)
+    scores, logits = d_apply(d_params, fakes, lod, cfg, max_stage)
+    return -jnp.mean(scores) + _acgan_term(logits, labels, cfg)
+
+
+def d_loss_fn(d_params: Params, g_params: Params, reals: jax.Array,
+              latents: jax.Array, labels: Optional[jax.Array], lod: jax.Array,
+              rng: jax.Array, cfg: PgganConfig,
+              max_stage: Optional[int]) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    fakes = g_apply(g_params, latents, labels, lod, cfg, max_stage)
+    real_scores, real_logits = d_apply(d_params, reals, lod, cfg, max_stage)
+    fake_scores, fake_logits = d_apply(d_params, fakes, lod, cfg, max_stage)
+    wdist = jnp.mean(real_scores) - jnp.mean(fake_scores)
+    loss = -wdist
+
+    # gradient penalty on real/fake interpolates (second-order autodiff —
+    # the reference assembles this by hand with tf.gradients, :1295-1310)
+    eps = jax.random.uniform(rng, (reals.shape[0], 1, 1, 1), jnp.float32)
+    mixed = reals + eps * (fakes - reals)
+
+    def critic_sum(imgs):
+        s, _ = d_apply(d_params, imgs, lod, cfg, max_stage)
+        return jnp.sum(s)
+
+    grads = jax.grad(critic_sum)(mixed)
+    norms = jnp.sqrt(jnp.sum(jnp.square(grads.astype(jnp.float32)),
+                             axis=(1, 2, 3)) + 1e-8)
+    loss = loss + cfg.gp_lambda * jnp.mean(jnp.square(norms - 1.0))
+    loss = loss + cfg.eps_drift * jnp.mean(jnp.square(real_scores))
+    loss = loss + _acgan_term(real_logits, labels, cfg)
+    loss = loss + _acgan_term(fake_logits, labels, cfg)
+    return loss, {"wdist": wdist, "gp_norm": jnp.mean(norms)}
+
+
+# ---------------------------------------------------------------------------
+# schedule (reference TrainingSchedule, pg_gans.py:1227-1274 semantics)
+
+@dataclass(frozen=True)
+class Schedule:
+    lod: float
+    resolution: int
+    minibatch: int
+    max_stage: int
+    G_lrate: float
+    D_lrate: float
+
+
+def training_schedule(cur_nimg: int, cfg: PgganConfig,
+                      minibatch_base: int = 16,
+                      G_lrate: float = 1e-3, D_lrate: float = 1e-3,
+                      lod_initial_resolution: int = 4,
+                      lod_training_kimg: float = 600.0,
+                      lod_transition_kimg: float = 600.0,
+                      minibatch_dict: Optional[Dict[int, int]] = None,
+                      ) -> Schedule:
+    """Map training progress (images shown) to lod / minibatch / lrates.
+
+    Phases of ``training+transition`` kimg per resolution doubling: hold lod
+    constant for ``lod_training_kimg``, then fade it down linearly over
+    ``lod_transition_kimg``.
+    """
+    kimg = cur_nimg / 1000.0
+    max_lod = cfg.num_stages - 1
+    lod = max_lod - (math.log2(lod_initial_resolution) - 2.0)
+    phase_dur = lod_training_kimg + lod_transition_kimg
+    phase_idx = math.floor(kimg / phase_dur) if phase_dur > 0 else 0
+    phase_kimg = kimg - phase_idx * phase_dur
+    lod -= phase_idx
+    if lod_transition_kimg > 0:
+        lod -= max(phase_kimg - lod_training_kimg, 0.0) / lod_transition_kimg
+    lod = float(np.clip(lod, 0.0, max_lod))
+    cur_stage_pos = max_lod - lod
+    max_stage = min(cfg.num_stages - 1, int(math.ceil(cur_stage_pos - 1e-8)))
+    resolution = 4 * 2 ** max_stage
+    minibatch = (minibatch_dict or {}).get(resolution, minibatch_base)
+    return Schedule(lod=lod, resolution=resolution, minibatch=minibatch,
+                    max_stage=max_stage, G_lrate=G_lrate, D_lrate=D_lrate)
+
+
+# ---------------------------------------------------------------------------
+# trainer
+
+class PgganTrainer:
+    """Owns G/D/Gs params, per-stage-bucket jitted steps, and the growth loop.
+
+    Data parallelism: batch args carry a NamedSharding over the mesh's
+    ``data`` axis; params are replicated. XLA turns the batched gradient
+    into an ICI all-reduce — the GSPMD replacement for the reference's
+    explicit per-GPU graph clones + NCCL all_sum (pg_gans.py:1165-1170).
+    """
+
+    def __init__(self, cfg: PgganConfig, mesh: Optional[jax.sharding.Mesh] = None,
+                 g_smoothing: float = 0.99, seed: int = 0):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.g_smoothing = g_smoothing
+        kg, kd = jax.random.split(jax.random.PRNGKey(seed))
+        self.g_params = g_init(kg, cfg)
+        self.d_params = d_init(kd, cfg)
+        self.gs_params = jax.tree.map(jnp.copy, self.g_params)
+        self._opt: Dict[str, Any] = {}
+        self._opt_state: Dict[str, Any] = {}
+        self._steps: Dict[Tuple[int, int], Tuple[Callable, Callable]] = {}
+
+        def ema(gs, g):
+            b = self.g_smoothing
+            return jax.tree.map(lambda a, c: a * b + c * (1.0 - b), gs, g)
+
+        self._ema = jax.jit(ema)
+
+    def _data_sharding(self):
+        if self.mesh is None:
+            return None
+        return jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec("data"))
+
+    def init_optimizers(self, g_lr: float, d_lr: float) -> None:
+        # Adam(0, 0.99) as the reference configures (pg_gans.py:297-299)
+        self._opt["g"] = optax.adam(g_lr, b1=0.0, b2=0.99, eps=1e-8)
+        self._opt["d"] = optax.adam(d_lr, b1=0.0, b2=0.99, eps=1e-8)
+        self._steps.clear()  # jitted steps close over the optimizers
+        self.reset_optimizer_state()
+
+    def reset_optimizer_state(self) -> None:
+        """Reference resets Adam moments at each lod change (:336-339)."""
+        self._opt_state["g"] = self._opt["g"].init(self.g_params)
+        self._opt_state["d"] = self._opt["d"].init(self.d_params)
+
+    def _get_steps(self, max_stage: int, minibatch: int):
+        key = (max_stage, minibatch)
+        if key in self._steps:
+            return self._steps[key]
+        cfg = self.cfg
+
+        def d_step(d_params, g_params, opt_state, reals, labels, lod, rng):
+            zkey, gpkey = jax.random.split(rng)
+            latents = jax.random.normal(zkey, (minibatch, cfg.latent_size))
+            (loss, aux), grads = jax.value_and_grad(d_loss_fn, has_aux=True)(
+                d_params, g_params, reals, latents, labels, lod, gpkey,
+                cfg, max_stage)
+            updates, opt_state = self._opt["d"].update(grads, opt_state, d_params)
+            return optax.apply_updates(d_params, updates), opt_state, loss, aux
+
+        def g_step(g_params, d_params, opt_state, labels, lod, rng):
+            latents = jax.random.normal(rng, (minibatch, cfg.latent_size))
+            loss, grads = jax.value_and_grad(g_loss_fn)(
+                g_params, d_params, latents, labels, lod, cfg, max_stage)
+            updates, opt_state = self._opt["g"].update(grads, opt_state, g_params)
+            return optax.apply_updates(g_params, updates), opt_state, loss
+
+        jd = jax.jit(d_step, donate_argnums=(0, 2))
+        jg = jax.jit(g_step, donate_argnums=(0, 2))
+        self._steps[key] = (jd, jg)
+        return jd, jg
+
+    def train(self, images: np.ndarray, labels: Optional[np.ndarray] = None,
+              total_kimg: float = 2.0, D_repeats: int = 1,
+              minibatch_repeats: int = 4, minibatch_base: int = 16,
+              G_lrate: float = 1e-3, D_lrate: float = 1e-3,
+              lod_initial_resolution: int = 4,
+              reset_opt_for_new_lod: bool = True,
+              lod_training_kimg: float = 600.0,
+              lod_transition_kimg: float = 600.0,
+              log: Optional[Callable[..., None]] = None,
+              seed: int = 0) -> Dict[str, float]:
+        """The growth loop (reference pg_gans.py:328-343 behavior).
+
+        ``images`` are NHWC float32 in [-1, 1] at ``cfg.resolution``; when
+        the schedule renders below full resolution the reals are average-
+        pooled down and nearest-upscaled back (reference `_process_reals`
+        blending, pg_gans.py:345-378) — done here by D's own image pyramid,
+        so reals are fed at full resolution always.
+        """
+        cfg = self.cfg
+        self.init_optimizers(G_lrate, D_lrate)
+        host_rng = np.random.default_rng(seed)
+        key = jax.random.PRNGKey(seed + 1)
+        sharding = self._data_sharding()
+        n_shards = 1 if self.mesh is None else self.mesh.shape["data"]
+
+        cur_nimg, prev_lod, metrics = 0, -1.0, {}
+        while cur_nimg < total_kimg * 1000:
+            sched = training_schedule(
+                cur_nimg, cfg, minibatch_base=minibatch_base,
+                G_lrate=G_lrate, D_lrate=D_lrate,
+                lod_initial_resolution=lod_initial_resolution,
+                lod_training_kimg=lod_training_kimg,
+                lod_transition_kimg=lod_transition_kimg)
+            mb = max(n_shards, (sched.minibatch // n_shards) * n_shards)
+            if reset_opt_for_new_lod and prev_lod >= 0 and (
+                    math.floor(sched.lod) != math.floor(prev_lod)
+                    or math.ceil(sched.lod) != math.ceil(prev_lod)):
+                self.reset_optimizer_state()
+            prev_lod = sched.lod
+            d_step, g_step = self._get_steps(sched.max_stage, mb)
+            lod = jnp.float32(sched.lod)
+
+            for _ in range(minibatch_repeats):
+                for _ in range(D_repeats):
+                    idx = host_rng.integers(0, images.shape[0], size=mb)
+                    reals = jnp.asarray(images[idx])
+                    lbls = (jnp.asarray(labels[idx]) if labels is not None
+                            and cfg.label_size else None)
+                    if sharding is not None:
+                        reals = jax.device_put(reals, sharding)
+                    key, sub = jax.random.split(key)
+                    self.d_params, self._opt_state["d"], d_loss, aux = d_step(
+                        self.d_params, self.g_params, self._opt_state["d"],
+                        reals, lbls, lod, sub)
+                    cur_nimg += mb
+                key, sub = jax.random.split(key)
+                lbls = None
+                if labels is not None and cfg.label_size:
+                    idx = host_rng.integers(0, labels.shape[0], size=mb)
+                    lbls = jnp.asarray(labels[idx])
+                self.g_params, self._opt_state["g"], g_loss = g_step(
+                    self.g_params, self.d_params, self._opt_state["g"],
+                    lbls, lod, sub)
+                # EMA once per G update (the reference ties its Gs update to
+                # the D step instead, pg_gans.py:335 — updating after the G
+                # step is the original ProGAN semantics and ensures the last
+                # G update is always folded into Gs)
+                self.gs_params = self._ema(self.gs_params, self.g_params)
+
+            metrics = {"d_loss": float(d_loss), "g_loss": float(g_loss),
+                       "wdist": float(aux["wdist"]), "lod": sched.lod,
+                       "kimg": cur_nimg / 1000.0}
+            if log is not None:
+                log("pggan tick", **metrics)
+        return metrics
+
+    def generate(self, n: int, labels: Optional[np.ndarray] = None,
+                 seed: int = 0, use_ema: bool = True) -> np.ndarray:
+        """Sample n images in [-1, 1] from Gs (the EMA generator)."""
+        params = self.gs_params if use_ema else self.g_params
+        key = jax.random.PRNGKey(seed)
+        latents = jax.random.normal(key, (n, self.cfg.latent_size))
+        lbls = jnp.asarray(labels) if labels is not None else None
+        imgs = jax.jit(g_apply, static_argnums=(4,))(
+            params, latents, lbls, jnp.float32(0.0), self.cfg)
+        return np.asarray(imgs)
+
+
+def partition_specs(cfg: PgganConfig) -> Any:
+    """GAN training is pure data parallelism: params fully replicated."""
+    P = jax.sharding.PartitionSpec
+    return {"g": P(), "d": P()}

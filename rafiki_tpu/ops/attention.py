@@ -14,6 +14,8 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from rafiki_tpu.models import core
+
 Params = Dict[str, Any]
 
 
@@ -38,19 +40,15 @@ def attention_init(rng: jax.Array, dim: int, heads: int) -> Params:
     parallelism can shard it (heads over the ``model`` mesh axis)."""
     dh = dim // heads
     kq, kk, kv, ko = jax.random.split(rng, 4)
-
-    def xavier3(key, shape, fan_in, fan_out):
-        # fans of the *logical* dim -> heads*dh projection, not the per-head
-        # slice — matches the standard init of the fused (dim, dim) matmul
-        limit = math.sqrt(6.0 / (fan_in + fan_out))
-        return jax.random.uniform(key, shape, jnp.float32, -limit, limit)
-
+    # fans of the *logical* dim -> heads*dh projection, not the per-head
+    # slice — matches the standard init of the fused (dim, dim) matmul
     shape = (dim, heads, dh)
     return {
-        "wq": xavier3(kq, shape, dim, heads * dh),
-        "wk": xavier3(kk, shape, dim, heads * dh),
-        "wv": xavier3(kv, shape, dim, heads * dh),
-        "wo": xavier3(ko, (heads, dh, dim), heads * dh, dim),
+        "wq": core.xavier_uniform(kq, shape, fan_in=dim, fan_out=heads * dh),
+        "wk": core.xavier_uniform(kk, shape, fan_in=dim, fan_out=heads * dh),
+        "wv": core.xavier_uniform(kv, shape, fan_in=dim, fan_out=heads * dh),
+        "wo": core.xavier_uniform(ko, (heads, dh, dim), fan_in=heads * dh,
+                                  fan_out=dim),
         "bo": jnp.zeros((dim,), jnp.float32),
     }
 

@@ -37,6 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+LANES = 128  # TPU lane width: minor dim of any Mosaic-lowered block tile
 
 
 def _use_interpret() -> bool:
@@ -72,9 +73,15 @@ def _when_live(causal, cond_fn):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                sm_scale: float, causal: bool, q_len: int, kv_len: int,
-                block_q: int, block_k: int, n_kv: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, sm_scale: float,
+                causal: bool, q_len: int, kv_len: int, block_q: int,
+                block_k: int, n_kv: int, save_lse: bool):
+    # the lse output exists only when the forward runs under the VJP — the
+    # primal-only path never writes row statistics to HBM
+    if save_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        lse_ref, (acc_ref, m_ref, l_ref) = None, rest
     j = pl.program_id(2)
     q_start = pl.program_id(1) * block_q
     causal_off = kv_len - q_len
@@ -96,7 +103,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # explicit re-mask: rows with no visible keys have m_new == NEG_INF
+        # and would otherwise get p = exp(0) = 1 on every masked column
+        # (possible when causal and q block only partially intersects the
+        # band), polluting l, o, and the backward's dk/dv
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
@@ -107,11 +118,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         l = l_ref[...]
         o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        # rows that saw no keys (possible only when causal and kv_len <
-        # q_len) get lse=+inf so the backward's exp(s - lse) underflows to 0
-        lse = jnp.where(l > 0, m_ref[...] + jnp.log(jnp.maximum(l, 1e-30)),
-                        jnp.inf)
-        lse_ref[0, 0] = lse[:, 0]
+        if save_lse:
+            # rows that saw no keys (causal with kv_len < q_len) get
+            # lse=+inf so the backward's exp(s - lse) underflows to 0
+            lse = jnp.where(l > 0,
+                            m_ref[...] + jnp.log(jnp.maximum(l, 1e-30)),
+                            jnp.inf)
+            # broadcast across the 128 lanes: row statistics live in a
+            # (block_q, 128) tile because Mosaic requires the minor block
+            # dim to be a lane multiple — (1, block_q) is not lowerable
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -125,8 +141,10 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
 
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-                   sm_scale: Optional[float], block_q: int, block_k: int):
-    """q,k,v: (B, H, S, Dh) -> out (B, H, Sq, Dh), lse (B*H, Sq_padded)."""
+                   sm_scale: Optional[float], block_q: int, block_k: int,
+                   save_lse: bool):
+    """q,k,v: (B, H, S, Dh) -> out (B, H, Sq, Dh), lse (B*H, Sq_padded) or
+    None. lse is only computed (and written to HBM) under the VJP."""
     b, h, sq, dh = q.shape
     skv = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(dh)
@@ -138,8 +156,21 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=scale, causal=causal, q_len=sq, kv_len=skv,
-        block_q=block_q, block_k=block_k, n_kv=n_kv)
-    out, lse = pl.pallas_call(
+        block_q=block_q, block_k=block_k, n_kv=n_kv, save_lse=save_lse)
+    out_specs = [
+        pl.BlockSpec((1, block_q, dh), lambda bh, i, j: (bh, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((b * h, qf.shape[1], dh), q.dtype)]
+    if save_lse:
+        # (bh, S, 128): row statistics broadcast across lanes so every
+        # block tile is (block_q, 128) — the minimum Mosaic f32 tile
+        out_specs.append(
+            pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0),
+                         memory_space=pltpu.VMEM))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, qf.shape[1], LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(b * h, n_q, n_kv),
         in_specs=[
@@ -150,18 +181,8 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
             pl.BlockSpec((1, block_k, dh), lambda bh, i, j: (bh, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, dh), lambda bh, i, j: (bh, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, qf.shape[1], dh), q.dtype),
-            # (bh, 1, S): the unit middle dim keeps the (1, block_q) VMEM
-            # tile legal on TPU (block dim == array dim)
-            jax.ShapeDtypeStruct((b * h, 1, qf.shape[1]), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, dh), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -169,6 +190,13 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         ],
         interpret=_use_interpret(),
     )(qf, kf, vf)
+    if save_lse:
+        out, lse = res
+        # keep only lane 0 as the residual — the broadcast costs 128x the
+        # O(S) statistics memory flash attention exists to save
+        lse = lse[:, :, 0]
+    else:
+        (out,), lse = res, None
     return out[:, :sq, :].reshape(b, h, sq, dh), lse
 
 
@@ -197,9 +225,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         mask = _band_mask(q_start, j, block_q, block_k, kv_len, causal,
                           causal_off)
         s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])                  # (Bq, Bk)
+        p = jnp.exp(s - lse_ref[0][:, :1])                       # (Bq, Bk)
         dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         acc_ref[...] += sm_scale * jnp.dot(
             ds, k, preferred_element_type=jnp.float32)
 
@@ -234,10 +262,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
         mask = _band_mask(q_start, jblk, block_q, block_k,
                           kv_len, causal, causal_off)
         s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])                  # (Bq, Bk)
+        p = jnp.exp(s - lse_ref[0][:, :1])                       # (Bq, Bk)
         dv_acc[...] += jnp.dot(p.T, g, preferred_element_type=jnp.float32)
         dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0, 0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         dk_acc[...] += sm_scale * jnp.dot(
             ds.T, q, preferred_element_type=jnp.float32)
 
@@ -259,9 +287,15 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
     n_q = qf.shape[1] // block_q
     n_kv = kf.shape[1] // block_k
     # delta_i = sum_d dO_i O_i — the rowwise correction term of the flash
-    # backward (d(softmax) along its normalization)
-    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
-                    axis=-1)[:, None, :]  # (bh, 1, S) — see lse layout note
+    # backward (d(softmax) along its normalization); both row statistics
+    # are lanes-broadcast to (bh, S, 128) here, transiently (the saved
+    # residual is the compact (bh, S) lse)
+    delta = jnp.broadcast_to(
+        jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                axis=-1)[:, :, None],
+        (qf.shape[0], qf.shape[1], LANES))
+    lse = jnp.broadcast_to(lse[:, :, None],
+                           (qf.shape[0], qf.shape[1], LANES))
 
     common = dict(sm_scale=scale, causal=causal, q_len=sq, kv_len=skv,
                   block_q=block_q, block_k=block_k)
@@ -269,7 +303,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
                           memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec((1, block_k, dh), lambda bh, i, j: (bh, j, 0),
                            memory_space=pltpu.VMEM)
-    row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i),
+    row_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -287,7 +321,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
                            memory_space=pltpu.VMEM)
     kv_spec2 = pl.BlockSpec((1, block_k, dh), lambda bh, j, i: (bh, j, 0),
                             memory_space=pltpu.VMEM)
-    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i),
+    row_spec2 = pl.BlockSpec((1, block_q, LANES), lambda bh, j, i: (bh, i, 0),
                              memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, n_q=n_q, **common),
@@ -317,12 +351,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
     """Flash attention over (B, H, S, Dh) tensors."""
-    out, _ = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
+    out, _ = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                            save_lse=False)
     return out
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k)
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                              save_lse=True)
     return out, (q, k, v, out, lse)
 
 

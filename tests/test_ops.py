@@ -60,3 +60,23 @@ def test_flash_gradients_match_reference():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+def test_flash_causal_dead_rows():
+    """Causal with kv_len < q_len: rows attending zero keys must output
+    exactly 0 and contribute nothing to dk/dv (regression: fully-masked
+    rows inside a partially-live q block once got p = exp(0) = 1)."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 32, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 16, 16))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 16, 16))
+    out = flash_attention(q, k, v, True, None, 32, 16)
+    ref = mha_reference(q, k, v, causal=True)
+    # rows 0..15 see no keys (end-aligned causal): ours are exactly zero
+    assert float(jnp.abs(out[:, :, :16]).max()) == 0.0
+    assert float(jnp.abs(out[:, :, 16:] - ref[:, :, 16:]).max()) < 2e-2
+    g = jax.grad(lambda a, b, c: flash_attention(
+        a, b, c, True, None, 32, 16)[:, :, 16:].sum())(q, k, v)
+    gr = jax.grad(lambda a, b, c: mha_reference(
+        a, b, c, causal=True)[:, :, 16:].sum())(q, k, v)
+    for x, y in zip(g, gr):
+        assert float(jnp.abs(x - y).max()) < 5e-2

@@ -1,0 +1,132 @@
+"""Progressive GAN unit tests: shapes, lod semantics, schedule, training
+step sanity, and data-parallel parity — all on the 8-device CPU mesh
+(conftest.py)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rafiki_tpu.models import pggan
+from rafiki_tpu.models.pggan import (
+    PgganConfig,
+    PgganTrainer,
+    d_apply,
+    d_init,
+    g_apply,
+    g_init,
+    stage_weights,
+    training_schedule,
+)
+from rafiki_tpu.parallel.sharding import make_train_mesh
+
+CFG = PgganConfig(resolution=16, latent_size=16, fmap_base=64, fmap_max=32,
+                  compute_dtype=jnp.float32)
+
+
+def test_generator_shapes_and_range():
+    params = g_init(jax.random.PRNGKey(0), CFG)
+    z = jax.random.normal(jax.random.PRNGKey(1), (4, CFG.latent_size))
+    img = g_apply(params, z, None, jnp.float32(0.0), CFG)
+    assert img.shape == (4, 16, 16, 3)
+    assert np.all(np.isfinite(np.asarray(img)))
+
+
+def test_lod_selects_resolution():
+    """At max lod the output is a 4x4 image nearest-upscaled to full res —
+    every 4x4 block of pixels must be constant."""
+    params = g_init(jax.random.PRNGKey(0), CFG)
+    z = jax.random.normal(jax.random.PRNGKey(1), (2, CFG.latent_size))
+    max_lod = CFG.num_stages - 1
+    img = np.asarray(g_apply(params, z, None, jnp.float32(max_lod), CFG))
+    blocks = img.reshape(2, 4, 4, 4, 4, 3)
+    assert np.allclose(blocks, blocks[:, :, :1, :, :1, :], atol=1e-5)
+    # at lod 0 the full-res head contributes; blocks are not constant
+    img0 = np.asarray(g_apply(params, z, None, jnp.float32(0.0), CFG))
+    blocks0 = img0.reshape(2, 4, 4, 4, 4, 3)
+    assert not np.allclose(blocks0, blocks0[:, :, :1, :, :1, :], atol=1e-5)
+
+
+def test_stage_weights_fade():
+    w = np.asarray(stage_weights(jnp.float32(1.3), 3))
+    # stage lods are (2,1,0); lod=1.3 blends stages 0 (w=0.3) and 1 (w=0.7)
+    assert w == pytest.approx([0.3, 0.7, 0.0], abs=1e-6)
+    assert w.sum() == pytest.approx(1.0, abs=1e-6)
+
+
+def test_max_stage_consistency():
+    """Bounding computation to the active stages must not change outputs."""
+    params = g_init(jax.random.PRNGKey(0), CFG)
+    z = jax.random.normal(jax.random.PRNGKey(1), (2, CFG.latent_size))
+    lod = jnp.float32(CFG.num_stages - 1 - 0.5)  # stages 0,1 active
+    full = g_apply(params, z, None, lod, CFG)
+    bounded = g_apply(params, z, None, lod, CFG, max_stage=1)
+    assert np.allclose(np.asarray(full), np.asarray(bounded), atol=1e-5)
+
+
+def test_discriminator_shapes_and_labels():
+    cfg = PgganConfig(resolution=16, latent_size=16, fmap_base=64,
+                      fmap_max=32, label_size=5, compute_dtype=jnp.float32)
+    params = d_init(jax.random.PRNGKey(0), cfg)
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3))
+    scores, logits = d_apply(params, imgs, jnp.float32(0.7), cfg)
+    assert scores.shape == (8,)
+    assert logits.shape == (8, 5)
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_training_schedule_progression():
+    cfg = PgganConfig(resolution=32)
+    s0 = training_schedule(0, cfg, lod_training_kimg=1.0,
+                           lod_transition_kimg=1.0)
+    assert s0.lod == cfg.num_stages - 1 and s0.resolution == 4
+    # halfway through the first transition: fractional lod, next stage active
+    s1 = training_schedule(1500, cfg, lod_training_kimg=1.0,
+                           lod_transition_kimg=1.0)
+    assert s0.lod - 1 < s1.lod < s0.lod and s1.max_stage == 1
+    # far enough in: full resolution
+    s2 = training_schedule(100_000, cfg, lod_training_kimg=1.0,
+                           lod_transition_kimg=1.0)
+    assert s2.lod == 0.0 and s2.resolution == 32
+    assert s2.max_stage == cfg.num_stages - 1
+
+
+def test_trainer_step_and_ema():
+    trainer = PgganTrainer(CFG, seed=0)
+    rng = np.random.default_rng(0)
+    images = rng.uniform(-1, 1, size=(32, 16, 16, 3)).astype(np.float32)
+    g_before = jax.tree.map(np.asarray, trainer.g_params)
+    metrics = trainer.train(images, total_kimg=0.032, minibatch_repeats=1,
+                            minibatch_base=8, lod_training_kimg=1.0,
+                            lod_transition_kimg=1.0)
+    assert math.isfinite(metrics["d_loss"]) and math.isfinite(metrics["g_loss"])
+    moved = jax.tree_util.tree_leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(b) - a).max()),
+        g_before, trainer.g_params))
+    assert max(moved) > 0.0
+    # Gs tracks G but lags it (EMA)
+    gs_dist = jax.tree_util.tree_leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        trainer.gs_params, trainer.g_params))
+    assert max(gs_dist) > 0.0
+    imgs = trainer.generate(4, seed=7)
+    assert imgs.shape == (4, 16, 16, 3) and np.all(np.isfinite(imgs))
+
+
+def test_trainer_data_parallel_mesh():
+    mesh = make_train_mesh(dp=8)
+    flat = jax.sharding.Mesh(np.array(mesh.devices).reshape(-1), ("data",))
+    trainer = PgganTrainer(CFG, mesh=flat, seed=0)
+    rng = np.random.default_rng(0)
+    images = rng.uniform(-1, 1, size=(32, 16, 16, 3)).astype(np.float32)
+    metrics = trainer.train(images, total_kimg=0.016, minibatch_repeats=1,
+                            minibatch_base=8, lod_training_kimg=1.0,
+                            lod_transition_kimg=1.0)
+    assert math.isfinite(metrics["d_loss"])
+
+
+def test_partition_specs():
+    specs = pggan.partition_specs(CFG)
+    assert specs["g"] == jax.sharding.PartitionSpec()
