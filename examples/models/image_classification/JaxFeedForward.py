@@ -1,0 +1,141 @@
+"""JaxFeedForward — dense feed-forward image classifier template.
+
+Parity with the reference's TfFeedForward (reference
+examples/models/image_classification/TfFeedForward.py:14-164): identical
+knob surface (epochs / hidden_layer_count / hidden_layer_units /
+learning_rate / batch_size / image_size, reference :20-28), but the model
+is the pure-pytree MLP from rafiki_tpu.models.feedforward trained through
+DataParallelTrainer — one chip or a whole slice, decided by the placement
+layer's device grant rather than CUDA_VISIBLE_DEVICES.
+
+Run this file directly for the local contract check (reference pattern:
+TfFeedForward.py:168).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+import jax
+import numpy as np
+import optax
+
+from rafiki_tpu.models import feedforward
+from rafiki_tpu.sdk import (
+    BaseModel,
+    CategoricalKnob,
+    DataParallelTrainer,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    classification_accuracy,
+    dataset_utils,
+    softmax_classifier_loss,
+)
+
+
+class JaxFeedForward(BaseModel):
+
+    dependencies = {"jax": None, "optax": None}
+
+    @staticmethod
+    def get_knob_config():
+        # reference TfFeedForward.py:20-28
+        return {
+            "epochs": FixedKnob(2),
+            "hidden_layer_count": IntegerKnob(1, 2),
+            "hidden_layer_units": IntegerKnob(2, 128),
+            "learning_rate": FloatKnob(1e-5, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([16, 32, 64, 128]),
+            "image_size": FixedKnob(32),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+        self._trainer = None
+        self._cfg = None
+
+    def _build_trainer(self):
+        apply_fn = lambda p, x: feedforward.apply(p, x, self._cfg)
+        return DataParallelTrainer(
+            softmax_classifier_loss(apply_fn),
+            optax.adam(self._knobs["learning_rate"]),
+            predict_fn=lambda p, x: jax.nn.softmax(apply_fn(p, x), axis=-1),
+        )
+
+    def _load(self, dataset_uri):
+        size = self._knobs["image_size"]
+        if dataset_uri.endswith(".npz"):
+            ds = dataset_utils.load_dataset_of_arrays(dataset_uri)
+            return ds.x.astype(np.float32), ds.y.astype(np.int32)
+        ds = dataset_utils.load_dataset_of_image_files(
+            dataset_uri, image_size=(size, size))
+        x, y = ds.load_as_arrays()
+        return x.astype(np.float32), y.astype(np.int32)
+
+    def train(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        num_classes = int(y.max()) + 1
+        self._cfg = feedforward.FeedForwardConfig(
+            in_dim=int(np.prod(x.shape[1:])),
+            hidden_layers=self._knobs["hidden_layer_count"],
+            hidden_units=self._knobs["hidden_layer_units"],
+            num_classes=num_classes,
+        )
+        self._trainer = self._build_trainer()
+        params, opt_state = self._trainer.init(
+            lambda rng: feedforward.init(rng, self._cfg))
+        self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        self._params, _ = self._trainer.fit(
+            params, opt_state, (x, y),
+            epochs=self._knobs["epochs"],
+            batch_size=self._knobs["batch_size"],
+            log=self.logger.log,
+        )
+
+    def evaluate(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        return classification_accuracy(self._trainer, self._params, x, y)
+
+    def predict(self, queries):
+        x = np.asarray(queries, dtype=np.float32)
+        probs = self._trainer.predict_batched(self._params, x)
+        return [p.tolist() for p in probs]
+
+    def dump_parameters(self):
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "cfg": self._cfg.__dict__,
+        }
+
+    def load_parameters(self, params):
+        self._cfg = feedforward.FeedForwardConfig(**params["cfg"])
+        if self._trainer is None:
+            self._trainer = self._build_trainer()
+        self._params = self._trainer.device_put_params(params["params"])
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    from rafiki_tpu.sdk import test_model_class
+    from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        x = rng.normal(size=(256, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, size=256).astype(np.int32)
+        train_uri = write_numpy_dataset(x, y, os.path.join(d, "train.npz"))
+        test_uri = write_numpy_dataset(x[:64], y[:64], os.path.join(d, "test.npz"))
+        test_model_class(
+            clazz=JaxFeedForward,
+            task="IMAGE_CLASSIFICATION",
+            train_dataset_uri=train_uri,
+            test_dataset_uri=test_uri,
+            queries=[x[0].tolist()],
+        )

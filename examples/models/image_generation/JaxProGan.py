@@ -87,6 +87,10 @@ class JaxProGan(BaseModel):
     dependencies = {"jax": None, "optax": None}
 
     TOTAL_KIMG = float(os.environ.get("JAXPROGAN_TOTAL_KIMG", 2.0))
+    # per-resolution phase length; the reference holds 600 kimg per lod
+    # (pg_gans.py TrainingSchedule defaults) — shrink via env for demo runs
+    # so growth is actually exercised within TOTAL_KIMG
+    PHASE_KIMG = float(os.environ.get("JAXPROGAN_PHASE_KIMG", 600.0))
 
     @staticmethod
     def get_knob_config():
@@ -138,6 +142,8 @@ class JaxProGan(BaseModel):
             G_lrate=self._knobs["G_lrate"],
             D_lrate=self._knobs["D_lrate"],
             lod_initial_resolution=self._knobs["lod_initial_resolution"],
+            lod_training_kimg=self.PHASE_KIMG,
+            lod_transition_kimg=self.PHASE_KIMG,
             log=self.logger.log,
         )
 
@@ -175,6 +181,7 @@ class JaxProGan(BaseModel):
             "d": jax.tree.map(np.asarray, self._trainer.d_params),
             "resolution": self._cfg.resolution,
             "num_channels": self._cfg.num_channels,
+            "last_lod": self._trainer.last_lod,
         }
 
     def load_parameters(self, params):
@@ -184,6 +191,7 @@ class JaxProGan(BaseModel):
         self._trainer.gs_params = params["gs"]
         self._trainer.g_params = params["g"]
         self._trainer.d_params = params["d"]
+        self._trainer.last_lod = params.get("last_lod", 0.0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,160 @@
+"""BigramHmm — bigram hidden-Markov POS tagger, pure Python/numpy.
+
+Parity with the reference's BigramHmm (reference
+examples/models/pos_tagging/BigramHmm.py:17-202: count-based transition and
+emission probabilities with Viterbi decoding, empty knob config). Tags in
+and out are string labels from the corpus's tag vocabulary (the reference
+works on integer tag ids because its corpus format pre-encodes them; the
+mapping is recorded in the dumped parameters either way).
+
+Run this file directly for the local contract check.
+"""
+
+import math
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+from rafiki_tpu.sdk import BaseModel, dataset_utils
+
+_START, _UNK = "<s>", "<unk>"
+
+
+class BigramHmm(BaseModel):
+
+    dependencies = {}
+
+    @staticmethod
+    def get_knob_config():
+        # reference BigramHmm.py:22-23 — deliberately empty
+        return {}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._trans = {}   # prev_tag -> {tag: logp}
+        self._emiss = {}   # tag -> {word: logp}
+        self._tags = []
+
+    # -- training ----------------------------------------------------------
+
+    def train(self, dataset_uri):
+        ds = dataset_utils.load_dataset_of_corpus(dataset_uri)
+        trans_counts, emiss_counts = {}, {}
+        tags = set()
+        for tokens, tag_rows in ds:
+            prev = _START
+            for tok, row in zip(tokens, tag_rows):
+                tag = row[0]
+                tags.add(tag)
+                trans_counts.setdefault(prev, {}).setdefault(tag, 0)
+                trans_counts[prev][tag] += 1
+                emiss_counts.setdefault(tag, {}).setdefault(tok.lower(), 0)
+                emiss_counts[tag][tok.lower()] += 1
+                prev = tag
+        self._tags = sorted(tags)
+        # add-one smoothing over the tag/word vocab (reference smooths by
+        # assigning unseen events a floor probability)
+        self._trans = self._normalize(trans_counts, self._tags)
+        self._emiss = self._normalize(emiss_counts, None)
+        self.logger.log(f"No. of tags: {len(self._tags)}")
+
+    @staticmethod
+    def _normalize(counts, support):
+        out = {}
+        for ctx, dist in counts.items():
+            total = sum(dist.values())
+            n_events = len(support) if support else len(dist) + 1
+            out[ctx] = {k: math.log((v + 1) / (total + n_events))
+                        for k, v in dist.items()}
+            out[ctx][_UNK] = math.log(1.0 / (total + n_events))
+        return out
+
+    # -- decoding ----------------------------------------------------------
+
+    def _logp(self, table, ctx, key):
+        dist = table.get(ctx)
+        if dist is None:
+            return math.log(1e-8)
+        return dist.get(key, dist[_UNK])
+
+    def _viterbi(self, tokens):
+        if not tokens:
+            return []
+        scores = {t: self._logp(self._trans, _START, t)
+                  + self._logp(self._emiss, t, tokens[0].lower())
+                  for t in self._tags}
+        back = []
+        for tok in tokens[1:]:
+            nxt, ptr = {}, {}
+            for t in self._tags:
+                best_prev = max(
+                    scores,
+                    key=lambda p: scores[p] + self._logp(self._trans, p, t))
+                nxt[t] = (scores[best_prev]
+                          + self._logp(self._trans, best_prev, t)
+                          + self._logp(self._emiss, t, tok.lower()))
+                ptr[t] = best_prev
+            scores = nxt
+            back.append(ptr)
+        tag = max(scores, key=scores.get)
+        path = [tag]
+        for ptr in reversed(back):
+            tag = ptr[tag]
+            path.append(tag)
+        return path[::-1]
+
+    # -- BaseModel contract --------------------------------------------------
+
+    def evaluate(self, dataset_uri):
+        ds = dataset_utils.load_dataset_of_corpus(dataset_uri)
+        correct = total = 0
+        for tokens, tag_rows in ds:
+            pred = self._viterbi(list(tokens))
+            for p, row in zip(pred, tag_rows):
+                correct += p == row[0]
+                total += 1
+        return correct / max(total, 1)
+
+    def predict(self, queries):
+        return [self._viterbi(list(tokens)) for tokens in queries]
+
+    def dump_parameters(self):
+        return {"trans": self._trans, "emiss": self._emiss, "tags": self._tags}
+
+    def load_parameters(self, params):
+        self._trans = params["trans"]
+        self._emiss = params["emiss"]
+        self._tags = params["tags"]
+
+
+if __name__ == "__main__":
+    import random
+    import tempfile
+
+    from rafiki_tpu.sdk import test_model_class
+    from rafiki_tpu.sdk.dataset import write_corpus_dataset
+
+    random.seed(0)
+    nouns = ["cat", "dog", "bird", "tree"]
+    verbs = ["runs", "sees", "eats"]
+    dets = ["the", "a"]
+    sents = []
+    for _ in range(80):
+        toks = [random.choice(dets), random.choice(nouns),
+                random.choice(verbs), random.choice(dets),
+                random.choice(nouns)]
+        tags = [["DT"], ["NN"], ["VB"], ["DT"], ["NN"]]
+        sents.append((toks, tags))
+    with tempfile.TemporaryDirectory() as d:
+        train_uri = write_corpus_dataset(sents, os.path.join(d, "train.zip"))
+        test_uri = write_corpus_dataset(sents[:20], os.path.join(d, "test.zip"))
+        test_model_class(
+            clazz=BigramHmm,
+            task="POS_TAGGING",
+            train_dataset_uri=train_uri,
+            test_dataset_uri=test_uri,
+            queries=[["the", "cat", "runs"]],
+        )

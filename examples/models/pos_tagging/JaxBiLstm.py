@@ -1,0 +1,211 @@
+"""JaxBiLstm — BiLSTM POS tagger model template.
+
+Parity with the reference's PyBiLstm (reference
+examples/models/pos_tagging/PyBiLstm.py:19-291: a PyTorch BiLSTM with
+word-embedding/hidden-size/dropout/lr/batch knobs, reference :24-32). The
+recurrence comes from rafiki_tpu.models.bilstm — a lax.scan LSTM with fused
+gates — trained through DataParallelTrainer with a masked per-token
+cross-entropy. Word dropout is applied host-side by replacing input ids
+with <unk> at the knob's rate (the same regularizer the reference applies
+inside the torch module).
+
+Run this file directly for the local contract check.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rafiki_tpu.models import bilstm
+from rafiki_tpu.sdk import (
+    BaseModel,
+    CategoricalKnob,
+    DataParallelTrainer,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    dataset_utils,
+)
+
+_PAD, _UNK = 0, 1
+
+
+class JaxBiLstm(BaseModel):
+
+    dependencies = {"jax": None, "optax": None}
+
+    @staticmethod
+    def get_knob_config():
+        # reference PyBiLstm.py:24-32
+        return {
+            "epochs": FixedKnob(10),
+            "word_embed_dims": IntegerKnob(16, 128),
+            "word_rnn_hidden_size": IntegerKnob(16, 128),
+            "word_dropout": FloatKnob(1e-3, 2e-1, is_exp=True),
+            "learning_rate": FloatKnob(1e-2, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([16, 32, 64, 128]),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+        self._trainer = None
+        self._cfg = None
+        self._word_vocab = None  # word -> id (0=pad, 1=unk)
+        self._tag_vocab = None   # list of tag strings
+
+    # -- data --------------------------------------------------------------
+
+    def _encode(self, sentences, max_len):
+        ids = np.full((len(sentences), max_len), _PAD, np.int32)
+        mask = np.zeros((len(sentences), max_len), np.float32)
+        tags = np.zeros((len(sentences), max_len), np.int32)
+        tag_index = {t: i for i, t in enumerate(self._tag_vocab)}
+        for i, (tokens, tag_rows) in enumerate(sentences):
+            for j, tok in enumerate(tokens[:max_len]):
+                ids[i, j] = self._word_vocab.get(tok.lower(), _UNK)
+                mask[i, j] = 1.0
+                if tag_rows is not None:
+                    tags[i, j] = tag_index.get(tag_rows[j][0], 0)
+        return ids, mask, tags
+
+    def _load(self, dataset_uri, fit_vocab=False):
+        ds = dataset_utils.load_dataset_of_corpus(dataset_uri)
+        sentences = list(ds)
+        if fit_vocab:
+            words = sorted({t.lower() for toks, _ in sentences for t in toks})
+            self._word_vocab = {w: i + 2 for i, w in enumerate(words)}
+            self._tag_vocab = ds.tag_vocabs[0]
+            self._max_len = max(ds.max_len, 1)
+        return self._encode(sentences, self._max_len)
+
+    # -- model -------------------------------------------------------------
+
+    def _build_trainer(self):
+        cfg = self._cfg
+
+        def loss_fn(params, batch, rng):
+            ids, mask, tags = batch
+            logits = bilstm.apply(params, ids, mask, cfg)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tags[..., None], axis=-1)[..., 0]
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            return loss, {}
+
+        def predict_fn(params, batch):
+            ids, mask = batch[..., 0], batch[..., 1].astype(jnp.float32)
+            return jnp.argmax(bilstm.apply(params, ids, mask, cfg), axis=-1)
+
+        return DataParallelTrainer(
+            loss_fn,
+            optax.adam(self._knobs["learning_rate"]),
+            predict_fn=predict_fn,
+        )
+
+    def train(self, dataset_uri):
+        ids, mask, tags = self._load(dataset_uri, fit_vocab=True)
+        self._cfg = bilstm.BiLstmConfig(
+            vocab=len(self._word_vocab) + 2,
+            n_tags=len(self._tag_vocab),
+            embed_dim=self._knobs["word_embed_dims"],
+            hidden=self._knobs["word_rnn_hidden_size"],
+            max_len=self._max_len,
+        )
+        # host-side word dropout: replace ids with <unk> at the knob rate
+        drop = np.random.default_rng(0).uniform(size=ids.shape)
+        ids_train = np.where(
+            (drop < self._knobs["word_dropout"]) & (ids != _PAD), _UNK, ids)
+        self._trainer = self._build_trainer()
+        params, opt_state = self._trainer.init(
+            lambda rng: bilstm.init(rng, self._cfg))
+        self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        self._params, _ = self._trainer.fit(
+            params, opt_state, (ids_train, mask, tags),
+            epochs=self._knobs["epochs"],
+            batch_size=self._knobs["batch_size"],
+            log=self.logger.log,
+        )
+
+    def evaluate(self, dataset_uri):
+        ids, mask, tags = self._load(dataset_uri)
+        pred = self._predict_ids(ids, mask)
+        correct = ((pred == tags) * mask).sum()
+        return float(correct / np.maximum(mask.sum(), 1.0))
+
+    def _predict_ids(self, ids, mask):
+        packed = np.stack([ids, mask.astype(np.int32)], axis=-1)
+        return self._trainer.predict_batched(self._params, packed)
+
+    def predict(self, queries):
+        sentences = [(list(toks), None) for toks in queries]
+        ids, mask, _ = self._encode(sentences, self._max_len)
+        pred = self._predict_ids(ids, mask)
+        out = []
+        for i, toks in enumerate(queries):
+            n = min(len(toks), self._max_len)
+            out.append([self._tag_vocab[t] for t in pred[i, :n]])
+        return out
+
+    def dump_parameters(self):
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "word_vocab": self._word_vocab,
+            "tag_vocab": self._tag_vocab,
+            "max_len": self._max_len,
+            "embed_dim": self._cfg.embed_dim,
+            "hidden": self._cfg.hidden,
+        }
+
+    def load_parameters(self, params):
+        self._word_vocab = params["word_vocab"]
+        self._tag_vocab = params["tag_vocab"]
+        self._max_len = params["max_len"]
+        self._cfg = bilstm.BiLstmConfig(
+            vocab=len(self._word_vocab) + 2,
+            n_tags=len(self._tag_vocab),
+            embed_dim=params["embed_dim"],
+            hidden=params["hidden"],
+            max_len=self._max_len,
+        )
+        if self._trainer is None:
+            self._trainer = self._build_trainer()
+        self._params = self._trainer.device_put_params(params["params"])
+
+
+if __name__ == "__main__":
+    import random
+    import tempfile
+
+    from rafiki_tpu.sdk import test_model_class
+    from rafiki_tpu.sdk.dataset import write_corpus_dataset
+
+    random.seed(0)
+    nouns = ["cat", "dog", "bird", "tree"]
+    verbs = ["runs", "sees", "eats"]
+    dets = ["the", "a"]
+    sents = []
+    for _ in range(120):
+        toks = [random.choice(dets), random.choice(nouns),
+                random.choice(verbs), random.choice(dets),
+                random.choice(nouns)]
+        tags = [["DT"], ["NN"], ["VB"], ["DT"], ["NN"]]
+        sents.append((toks, tags))
+    with tempfile.TemporaryDirectory() as d:
+        train_uri = write_corpus_dataset(sents, os.path.join(d, "train.zip"))
+        test_uri = write_corpus_dataset(sents[:30], os.path.join(d, "test.zip"))
+        test_model_class(
+            clazz=JaxBiLstm,
+            task="POS_TAGGING",
+            train_dataset_uri=train_uri,
+            test_dataset_uri=test_uri,
+            queries=[["the", "cat", "runs"]],
+        )

@@ -126,8 +126,13 @@ class ServicesManager:
             # doesn't leave a phantom STARTED service behind
             self._db.mark_service_as_stopped(service["id"])
             raise
-        # record the chip indices actually granted by the allocator
-        self._db.update_service_chips(service["id"], ctx.chips)
+        try:
+            # record the chip indices actually granted by the allocator
+            self._db.update_service_chips(service["id"], ctx.chips)
+        except Exception:
+            # placement DID start the worker: tear it down, not just the row
+            self._destroy_service(service["id"], wait=False)
+            raise
         return service["id"]
 
     def stop_sub_train_job_services(self, sub_train_job_id: str) -> None:
@@ -209,8 +214,11 @@ class ServicesManager:
                         # only iterates sids in `created`
                         self._db.mark_service_as_stopped(service["id"])
                         raise
-                    self._db.update_service_chips(service["id"], ctx.chips)
+                    # in `created` from the moment it is placed, so the
+                    # outer rollback tears it down even if the chip-index
+                    # bookkeeping below fails
                     created.append(service["id"])
+                    self._db.update_service_chips(service["id"], ctx.chips)
             predictor_service = self._db.create_service(ServiceType.PREDICT)
             self._db.update_inference_job_predictor(
                 inference_job_id, predictor_service["id"]

@@ -123,7 +123,8 @@ def minibatch_stddev(x: jax.Array, group_size: int) -> jax.Array:
     """Append one channel of batch-group stddev (mode-collapse detector)."""
     n, h, w, c = x.shape
     g = min(group_size, n)
-    g = n // (n // g) if n % g else g          # ensure divisibility
+    while n % g:                               # largest divisor of n <= g
+        g -= 1
     y = x.reshape(g, n // g, h, w, c).astype(jnp.float32)
     y = y - jnp.mean(y, axis=0, keepdims=True)
     y = jnp.sqrt(jnp.mean(jnp.square(y), axis=0) + 1e-8)
@@ -389,6 +390,12 @@ class PgganTrainer:
             return jax.tree.map(lambda a, c: a * b + c * (1.0 - b), gs, g)
 
         self._ema = jax.jit(ema)
+        self._generate = jax.jit(g_apply, static_argnums=(4, 5))
+        # the lod training last ran at — generate() samples here by default,
+        # so a partially-grown model renders at its trained resolution
+        # (the reference's Network keeps lod as a graph variable with the
+        # same effect, pg_gans.py:301-303)
+        self.last_lod: float = 0.0
 
     def _data_sharding(self):
         if self.mesh is None:
@@ -504,6 +511,7 @@ class PgganTrainer:
                 # G update is always folded into Gs)
                 self.gs_params = self._ema(self.gs_params, self.g_params)
 
+            self.last_lod = sched.lod
             metrics = {"d_loss": float(d_loss), "g_loss": float(g_loss),
                        "wdist": float(aux["wdist"]), "lod": sched.lod,
                        "kimg": cur_nimg / 1000.0}
@@ -512,14 +520,17 @@ class PgganTrainer:
         return metrics
 
     def generate(self, n: int, labels: Optional[np.ndarray] = None,
-                 seed: int = 0, use_ema: bool = True) -> np.ndarray:
-        """Sample n images in [-1, 1] from Gs (the EMA generator)."""
+                 seed: int = 0, use_ema: bool = True,
+                 lod: Optional[float] = None) -> np.ndarray:
+        """Sample n images in [-1, 1] from Gs (the EMA generator) at the
+        lod training last reached (or an explicit override)."""
         params = self.gs_params if use_ema else self.g_params
         key = jax.random.PRNGKey(seed)
         latents = jax.random.normal(key, (n, self.cfg.latent_size))
         lbls = jnp.asarray(labels) if labels is not None else None
-        imgs = jax.jit(g_apply, static_argnums=(4,))(
-            params, latents, lbls, jnp.float32(0.0), self.cfg)
+        lod_val = self.last_lod if lod is None else lod
+        imgs = self._generate(params, latents, lbls, jnp.float32(lod_val),
+                              self.cfg, None)
         return np.asarray(imgs)
 
 

@@ -1,0 +1,113 @@
+"""Example model templates: contract conformance through test_model_class
+(the reference runs each example's __main__ by hand, reference
+TfFeedForward.py:168 — here the cheap ones run in CI; the JAX-heavy ones
+are covered by their own __main__ and the stack tests)."""
+
+import importlib.util
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.sdk import test_model_class as check_model_class
+from rafiki_tpu.sdk.dataset import write_corpus_dataset, write_numpy_dataset
+from rafiki_tpu.sdk.model import BaseModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples", "models")
+
+
+def _load(rel):
+    path = os.path.join(EXAMPLES, rel)
+    name = os.path.splitext(os.path.basename(rel))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return getattr(mod, name)
+
+
+ALL_TEMPLATES = [
+    "image_classification/JaxCnn.py",
+    "image_classification/JaxFeedForward.py",
+    "image_classification/JaxVgg16.py",
+    "image_classification/NpDecisionTree.py",
+    "image_classification/NpLinearSvm.py",
+    "image_generation/JaxProGan.py",
+    "pos_tagging/BigramHmm.py",
+    "pos_tagging/JaxBiLstm.py",
+]
+
+
+@pytest.mark.parametrize("rel", ALL_TEMPLATES)
+def test_template_declares_model(rel):
+    clazz = _load(rel)
+    assert issubclass(clazz, BaseModel)
+    cfg = clazz.get_knob_config()
+    assert isinstance(cfg, dict)
+
+
+def _blob_dataset(tmp_path):
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, size=240).astype(np.int32)
+    x = (rng.normal(size=(240, 8, 8, 1)) + y[:, None, None, None] * 2.0
+         ).astype(np.float32)
+    train = write_numpy_dataset(x, y, str(tmp_path / "train.npz"))
+    test = write_numpy_dataset(x[:60], y[:60], str(tmp_path / "test.npz"))
+    return train, test, x
+
+
+@pytest.mark.parametrize("rel,knobs,min_score", [
+    ("image_classification/NpDecisionTree.py",
+     {"max_depth": 8, "criterion": "gini"}, 0.9),
+    ("image_classification/NpLinearSvm.py",
+     {"max_iter": 20, "kernel": "rbf", "gamma": "scale", "C": 1.0}, 0.9),
+    ("image_classification/NpLinearSvm.py",
+     {"max_iter": 20, "kernel": "linear", "gamma": "auto", "C": 1.0}, 0.8),
+])
+def test_classical_models_learn_blobs(rel, knobs, min_score, tmp_path):
+    clazz = _load(rel)
+    train, test, x = _blob_dataset(tmp_path)
+    # contract conformance (advisor-proposed knobs)
+    check_model_class(
+        clazz=clazz,
+        task="IMAGE_CLASSIFICATION",
+        train_dataset_uri=train,
+        test_dataset_uri=test,
+        queries=[x[0].tolist()],
+    )
+    # learning quality with pinned knobs
+    model = clazz(**knobs)
+    model.train(train)
+    assert model.evaluate(test) >= min_score
+
+
+def _toy_corpus(tmp_path):
+    random.seed(0)
+    nouns, verbs, dets = ["cat", "dog", "tree"], ["runs", "sees"], ["the", "a"]
+    sents = []
+    for _ in range(60):
+        toks = [random.choice(dets), random.choice(nouns),
+                random.choice(verbs)]
+        sents.append((toks, [["DT"], ["NN"], ["VB"]]))
+    train = write_corpus_dataset(sents, str(tmp_path / "train.zip"))
+    test = write_corpus_dataset(sents[:20], str(tmp_path / "test.zip"))
+    return train, test
+
+
+def test_bigram_hmm_learns_toy_grammar(tmp_path):
+    clazz = _load("pos_tagging/BigramHmm.py")
+    train, test = _toy_corpus(tmp_path)
+    check_model_class(
+        clazz=clazz,
+        task="POS_TAGGING",
+        train_dataset_uri=train,
+        test_dataset_uri=test,
+        queries=[["the", "cat", "runs"]],
+    )
+    model = clazz()
+    model.train(train)
+    assert model.evaluate(test) == 1.0
+    assert model.predict([["a", "dog", "sees"]]) == [["DT", "NN", "VB"]]
