@@ -1,0 +1,122 @@
+"""Convert an MNIST-format dataset (idx-ubyte files) into the IMAGE_FILES
+zip this framework's dataset loader consumes.
+
+Analogue of the reference converter (reference
+examples/datasets/image_classification/load_mnist_format.py:15-96), with
+one deliberate difference: inputs are local file paths (optionally
+gzipped), not download URLs — the build environment has no egress, and the
+reference's URL path was only a fetch in front of the same idx parsing.
+
+Usage:
+    python load_mnist_format.py \
+        --train-images train-images-idx3-ubyte.gz \
+        --train-labels train-labels-idx1-ubyte.gz \
+        --test-images  t10k-images-idx3-ubyte.gz \
+        --test-labels  t10k-labels-idx1-ubyte.gz \
+        --out-train train.zip --out-test test.zip [--limit N]
+
+Run with --selftest to exercise the converter on synthetic idx files.
+"""
+
+import argparse
+import gzip
+import os
+import struct
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+import numpy as np
+
+from rafiki_tpu.sdk.dataset import write_image_files_dataset
+
+
+def _open(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def read_idx_images(path, limit=None):
+    """Parse an idx3-ubyte image file -> (N, H, W) uint8."""
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 0x803:
+            raise ValueError(f"{path}: bad idx3 magic {magic:#x}")
+        if limit is not None:
+            n = min(n, limit)
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def read_idx_labels(path, limit=None):
+    """Parse an idx1-ubyte label file -> (N,) uint8."""
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 0x801:
+            raise ValueError(f"{path}: bad idx1 magic {magic:#x}")
+        if limit is not None:
+            n = min(n, limit)
+        return np.frombuffer(f.read(n), np.uint8).copy()
+
+
+def load(train_images, train_labels, test_images, test_labels,
+         out_train_dataset_path, out_test_dataset_path, limit=None):
+    x = read_idx_images(train_images, limit)
+    y = read_idx_labels(train_labels, limit)
+    write_image_files_dataset(x, y, out_train_dataset_path)
+    x = read_idx_images(test_images, limit)
+    y = read_idx_labels(test_labels, limit)
+    write_image_files_dataset(x, y, out_test_dataset_path)
+    print(f"Wrote {out_train_dataset_path} and {out_test_dataset_path}")
+
+
+def _write_idx(tmpdir, images, labels):
+    ip = os.path.join(tmpdir, "imgs.idx3-ubyte")
+    lp = os.path.join(tmpdir, "lbls.idx1-ubyte")
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, *images.shape))
+        f.write(images.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 0x801, len(labels)))
+        f.write(labels.tobytes())
+    return ip, lp
+
+
+def _selftest():
+    import tempfile
+
+    from rafiki_tpu.sdk.dataset import dataset_utils
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        images = rng.integers(0, 256, size=(20, 28, 28), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=20, dtype=np.uint8)
+        ip, lp = _write_idx(d, images, labels)
+        out_train = os.path.join(d, "train.zip")
+        out_test = os.path.join(d, "test.zip")
+        load(ip, lp, ip, lp, out_train, out_test, limit=10)
+        ds = dataset_utils.load_dataset_of_image_files(out_train)
+        x, y = ds.load_as_arrays()
+        assert x.shape[0] == 10 and list(y) == list(labels[:10])
+        np.testing.assert_array_equal(
+            (x[0, ..., 0] * 255).round().astype(np.uint8), images[0])
+    print("selftest OK")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--selftest", action="store_true")
+    p.add_argument("--train-images")
+    p.add_argument("--train-labels")
+    p.add_argument("--test-images")
+    p.add_argument("--test-labels")
+    p.add_argument("--out-train", default="train.zip")
+    p.add_argument("--out-test", default="test.zip")
+    p.add_argument("--limit", type=int, default=None)
+    args = p.parse_args()
+    if args.selftest:
+        _selftest()
+    else:
+        load(args.train_images, args.train_labels, args.test_images,
+             args.test_labels, args.out_train, args.out_test, args.limit)

@@ -1,0 +1,105 @@
+"""Convert an image set into the array-record (.npz) dataset the GAN
+templates consume, padded to a square power-of-2 resolution and normalized
+to the [-1, 1] network range.
+
+Analogue of the reference's GAN dataset pipeline (reference
+examples/datasets/image_generation/load_mnist.py / load_cifar10.py +
+TFRecordExporter.py, which write multi-LoD TFRecords). The multi-LoD
+pre-materialization is deliberately dropped: the reference stored one
+downscaled copy per resolution because its TF1 input pipe could not resize
+on the fly without stalling the GPU (reference pg_gans.py:380-487); on TPU
+the discriminator builds its image pyramid in-graph from full-resolution
+reals (rafiki_tpu/models/pggan.py d_apply), so the dataset holds each image
+exactly once.
+
+Inputs: an IMAGE_FILES zip (see sdk/dataset.py), a directory of
+PNG/JPEG files, or a .npy array file.
+
+Usage:
+    python load_image_records.py --input images_dir_or_zip --out gan.npz
+
+Run with --selftest to exercise the converter.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+import numpy as np
+
+from rafiki_tpu.sdk.dataset import dataset_utils, write_numpy_dataset
+
+
+def _to_gan_range(x):
+    x = np.asarray(x, np.float32)
+    if x.max() > 1.5:
+        x = x / 127.5 - 1.0
+    elif x.min() >= 0.0:
+        x = x * 2.0 - 1.0
+    return x
+
+
+def _pad_square_pow2(x):
+    side = max(x.shape[1], x.shape[2])
+    res = 1 << (side - 1).bit_length()
+    if res != x.shape[1] or res != x.shape[2]:
+        x = np.pad(x, ((0, 0), (0, res - x.shape[1]),
+                       (0, res - x.shape[2]), (0, 0)),
+                   constant_values=-1.0)
+    return x
+
+
+def load(input_path, out_path, limit=None):
+    if os.path.isdir(input_path):
+        from PIL import Image
+        files = sorted(
+            f for f in os.listdir(input_path)
+            if f.lower().endswith((".png", ".jpg", ".jpeg")))[:limit]
+        imgs = [np.asarray(Image.open(os.path.join(input_path, f)))
+                for f in files]
+        x = np.stack(imgs)
+        y = np.zeros(len(x), np.int32)
+    elif input_path.endswith(".npy"):
+        x = np.load(input_path)[:limit]
+        y = np.zeros(len(x), np.int32)
+    else:
+        ds = dataset_utils.load_dataset_of_image_files(input_path)
+        x, y = ds.load_as_arrays()
+        x, y = x[:limit], y[:limit]
+    if x.ndim == 3:
+        x = x[..., None]
+    x = _pad_square_pow2(_to_gan_range(x))
+    write_numpy_dataset(x.astype(np.float32), np.asarray(y, np.int32), out_path)
+    print(f"Wrote {len(x)} images at {x.shape[1]}x{x.shape[2]} -> {out_path}")
+
+
+def _selftest():
+    import tempfile
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "raw.npy")
+        np.save(src, rng.integers(0, 256, size=(12, 28, 28), dtype=np.uint8))
+        out = os.path.join(d, "gan.npz")
+        load(src, out, limit=10)
+        ds = dataset_utils.load_dataset_of_arrays(out)
+        assert ds.x.shape == (10, 32, 32, 1)
+        assert -1.0 <= ds.x.min() and ds.x.max() <= 1.0
+    print("selftest OK")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--selftest", action="store_true")
+    p.add_argument("--input")
+    p.add_argument("--out", default="gan.npz")
+    p.add_argument("--limit", type=int, default=None)
+    args = p.parse_args()
+    if args.selftest:
+        _selftest()
+    else:
+        load(args.input, args.out, args.limit)

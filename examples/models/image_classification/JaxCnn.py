@@ -106,15 +106,8 @@ class JaxCnn(BaseModel):
 
     def _load(self, dataset_uri):
         size = self._knobs["image_size"]
-        if dataset_uri.endswith(".npz"):
-            ds = dataset_utils.load_dataset_of_arrays(dataset_uri)
-            x, y = ds.x.astype(np.float32), ds.y.astype(np.int32)
-        else:
-            ds = dataset_utils.load_dataset_of_image_files(
-                dataset_uri, image_size=(size, size)
-            )
-            x, y = ds.load_as_arrays()
-        return x, y
+        return dataset_utils.load_image_arrays(dataset_uri,
+                                               image_size=(size, size))
 
     # -- BaseModel contract ------------------------------------------------
 

@@ -72,13 +72,8 @@ class JaxVgg16(BaseModel):
 
     def _load(self, dataset_uri):
         size = self._knobs["image_size"]
-        if dataset_uri.endswith(".npz"):
-            ds = dataset_utils.load_dataset_of_arrays(dataset_uri)
-            return ds.x.astype(np.float32), ds.y.astype(np.int32)
-        ds = dataset_utils.load_dataset_of_image_files(
-            dataset_uri, image_size=(size, size))
-        x, y = ds.load_as_arrays()
-        return x.astype(np.float32), y.astype(np.int32)
+        return dataset_utils.load_image_arrays(dataset_uri,
+                                               image_size=(size, size))
 
     def train(self, dataset_uri):
         x, y = self._load(dataset_uri)

@@ -115,14 +115,8 @@ class NpDecisionTree(BaseModel):
         self._n_classes = None
 
     def _load(self, dataset_uri):
-        if dataset_uri.endswith(".npz"):
-            ds = dataset_utils.load_dataset_of_arrays(dataset_uri)
-            x, y = ds.x, ds.y
-        else:
-            ds = dataset_utils.load_dataset_of_image_files(dataset_uri)
-            x, y = ds.load_as_arrays()
-        x = np.asarray(x, np.float32).reshape(len(x), -1)
-        return x, np.asarray(y, np.int64)
+        x, y = dataset_utils.load_image_arrays(dataset_uri)
+        return x.reshape(len(x), -1), y.astype(np.int64)
 
     def train(self, dataset_uri):
         x, y = self._load(dataset_uri)

@@ -58,26 +58,33 @@ class NpLinearSvm(BaseModel):
 
     # -- featurization -----------------------------------------------------
 
-    def _gamma_value(self, x):
-        d = x.shape[1]
+    def _gamma_value(self, x_raw):
+        """sklearn semantics: 'scale' uses the *raw* input variance (on the
+        standardized features var ~= 1 and the two options would collapse)."""
+        d = x_raw.shape[1]
         if self._knobs["gamma"] == "scale":
-            v = x.var()
+            v = x_raw.var()
             return 1.0 / (d * v) if v > 0 else 1.0 / d
         return 1.0 / d  # 'auto'
 
     def _featurize(self, x, fit=False):
-        if fit:
-            self._mean = x.mean(axis=0)
-            self._std = x.std(axis=0) + 1e-8
-        x = (x - self._mean) / self._std
         if self._knobs["kernel"] == "linear":
-            return x
+            if fit:
+                self._mean = x.mean(axis=0)
+                self._std = x.std(axis=0) + 1e-8
+            return (x - self._mean) / self._std
+        # rbf: gamma acts on the raw inputs, as in sklearn's SVC (which does
+        # not standardize internally) — standardizing first would make
+        # 'scale' and 'auto' coincide
         if fit:
+            self._gamma = self._gamma_value(x)
             rng = np.random.default_rng(0)
-            g = self._gamma_value(x)
-            self._rff = rng.normal(scale=np.sqrt(2 * g),
+            self._rff = rng.normal(scale=np.sqrt(2 * self._gamma),
                                    size=(x.shape[1], N_RFF))
             self._rff_phase = rng.uniform(0, 2 * np.pi, N_RFF)
+            # identity standardization so param dump/load stays uniform
+            self._mean = np.zeros(x.shape[1])
+            self._std = np.ones(x.shape[1])
         return np.sqrt(2.0 / N_RFF) * np.cos(x @ self._rff + self._rff_phase)
 
     # -- solver ------------------------------------------------------------
@@ -122,14 +129,8 @@ class NpLinearSvm(BaseModel):
     # -- BaseModel contract --------------------------------------------------
 
     def _load(self, dataset_uri):
-        if dataset_uri.endswith(".npz"):
-            ds = dataset_utils.load_dataset_of_arrays(dataset_uri)
-            x, y = ds.x, ds.y
-        else:
-            ds = dataset_utils.load_dataset_of_image_files(dataset_uri)
-            x, y = ds.load_as_arrays()
-        return (np.asarray(x, np.float64).reshape(len(x), -1),
-                np.asarray(y, np.int64))
+        x, y = dataset_utils.load_image_arrays(dataset_uri)
+        return x.astype(np.float64).reshape(len(x), -1), y.astype(np.int64)
 
     def train(self, dataset_uri):
         x, y = self._load(dataset_uri)

@@ -90,12 +90,13 @@ class BigramHmm(BaseModel):
         for tok in tokens[1:]:
             nxt, ptr = {}, {}
             for t in self._tags:
-                best_prev = max(
-                    scores,
-                    key=lambda p: scores[p] + self._logp(self._trans, p, t))
-                nxt[t] = (scores[best_prev]
-                          + self._logp(self._trans, best_prev, t)
-                          + self._logp(self._emiss, t, tok.lower()))
+                # one transition-logp lookup per (prev, t) — this is the
+                # O(n*T^2) hot loop
+                cand = {p: scores[p] + self._logp(self._trans, p, t)
+                        for p in scores}
+                best_prev = max(cand, key=cand.get)
+                nxt[t] = cand[best_prev] + self._logp(
+                    self._emiss, t, tok.lower())
                 ptr[t] = best_prev
             scores = nxt
             back.append(ptr)

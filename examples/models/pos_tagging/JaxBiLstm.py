@@ -75,7 +75,10 @@ class JaxBiLstm(BaseModel):
                 ids[i, j] = self._word_vocab.get(tok.lower(), _UNK)
                 mask[i, j] = 1.0
                 if tag_rows is not None:
-                    tags[i, j] = tag_index.get(tag_rows[j][0], 0)
+                    # gold tags unseen in training encode as -1: evaluate()
+                    # counts them as unavoidable misses rather than silently
+                    # scoring against tag 0
+                    tags[i, j] = tag_index.get(tag_rows[j][0], -1)
         return ids, mask, tags
 
     def _load(self, dataset_uri, fit_vocab=False):
@@ -120,25 +123,34 @@ class JaxBiLstm(BaseModel):
             hidden=self._knobs["word_rnn_hidden_size"],
             max_len=self._max_len,
         )
-        # host-side word dropout: replace ids with <unk> at the knob rate
-        drop = np.random.default_rng(0).uniform(size=ids.shape)
-        ids_train = np.where(
-            (drop < self._knobs["word_dropout"]) & (ids != _PAD), _UNK, ids)
         self._trainer = self._build_trainer()
         params, opt_state = self._trainer.init(
             lambda rng: bilstm.init(rng, self._cfg))
         self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
-        self._params, _ = self._trainer.fit(
-            params, opt_state, (ids_train, mask, tags),
-            epochs=self._knobs["epochs"],
-            batch_size=self._knobs["batch_size"],
-            log=self.logger.log,
-        )
+        drop_rng = np.random.default_rng(0)
+        for epoch in range(self._knobs["epochs"]):
+            # host-side word dropout, resampled every epoch so it acts as a
+            # stochastic regularizer (like the reference's in-module
+            # dropout), not a fixed corruption of the dataset
+            drop = drop_rng.uniform(size=ids.shape)
+            ids_train = np.where(
+                (drop < self._knobs["word_dropout"]) & (ids != _PAD),
+                _UNK, ids)
+            params, opt_state = self._trainer.fit(
+                params, opt_state, (ids_train, mask, tags),
+                epochs=1,
+                batch_size=self._knobs["batch_size"],
+                seed=epoch,
+                log=self.logger.log,
+            )
+        self._params = params
 
     def evaluate(self, dataset_uri):
         ids, mask, tags = self._load(dataset_uri)
         pred = self._predict_ids(ids, mask)
-        correct = ((pred == tags) * mask).sum()
+        # tags == -1 (unseen in training) stay in the denominator but can
+        # never match — an honest miss
+        correct = ((pred == tags) & (tags >= 0) & (mask > 0)).sum()
         return float(correct / np.maximum(mask.sum(), 1.0))
 
     def _predict_ids(self, ids, mask):

@@ -1,0 +1,147 @@
+"""End-to-end quickstart: the full AutoML cycle on one machine.
+
+The analogue of the reference quickstart (reference
+examples/scripts/quickstart.py:66-140): upload two model templates, run a
+train job with parallel HPO trials, deploy the best trials as an inference
+job, and query the predictor — except there is no Docker swarm to stand up
+first: the control plane boots in-process and workers are placed as
+threads with chip affinity by the placement layer.
+
+Usage:
+    python examples/scripts/quickstart.py [--trials N] [--chips N]
+        [--train-dataset path.zip|.npz --test-dataset path.zip|.npz]
+
+With no dataset arguments a small synthetic separable dataset is generated
+(the environment has no egress; the reference pulled Fashion-MNIST from
+GitHub).
+"""
+
+import argparse
+import os
+import pprint
+import sys
+import tempfile
+import time
+import uuid
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+sys.path.insert(0, os.path.abspath(REPO))
+
+import numpy as np
+
+
+def ensure_workdir():
+    workdir = os.environ.setdefault(
+        "RAFIKI_WORKDIR", os.path.join(tempfile.gettempdir(), "rafiki_quickstart"))
+    for sub in ("data", "params", "logs"):
+        os.makedirs(os.path.join(workdir, sub), exist_ok=True)
+    return workdir
+
+
+def make_synthetic_dataset(workdir):
+    from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, size=2048).astype(np.int32)
+    x = (rng.normal(size=(2048, 32, 32, 3)) * 0.5
+         + y[:, None, None, None] * 0.3).astype(np.float32)
+    train = write_numpy_dataset(
+        x[:1536], y[:1536], os.path.join(workdir, "data", "quickstart_train.npz"))
+    test = write_numpy_dataset(
+        x[1536:], y[1536:], os.path.join(workdir, "data", "quickstart_test.npz"))
+    return train, test, x[1536].tolist()
+
+
+def wait_until_train_job_has_stopped(client, app, timeout_s=1800):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        job = client.get_train_job(app=app)
+        if job["status"] in ("STOPPED", "ERRORED"):
+            return job["status"]
+        time.sleep(3)
+    raise TimeoutError(f"train job for {app} still running after {timeout_s}s")
+
+
+def quickstart(args):
+    workdir = ensure_workdir()
+
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import Client
+    from rafiki_tpu.config import SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD
+    from rafiki_tpu.db.database import Database
+
+    admin = Admin(db=Database(os.path.join(workdir, "quickstart.sqlite")))
+    server = AdminServer(admin).start()
+    print(f"Admin HTTP API on 127.0.0.1:{server.port}")
+    client = Client(admin_host="127.0.0.1", admin_port=server.port)
+    client.login(SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD)
+
+    if args.train_dataset:
+        train_uri, test_uri = args.train_dataset, args.test_dataset
+        query = None
+    else:
+        train_uri, test_uri, query = make_synthetic_dataset(workdir)
+
+    app_id = uuid.uuid4().hex[:8]
+    app = f"image_classification_app_{app_id}"
+    models = []
+    for name, rel, clazz in [
+        (f"JaxCnn_{app_id}", "image_classification/JaxCnn.py", "JaxCnn"),
+        (f"NpDt_{app_id}", "image_classification/NpDecisionTree.py",
+         "NpDecisionTree"),
+    ]:
+        path = os.path.abspath(os.path.join(
+            REPO, "examples", "models", rel))
+        print(f'Adding model "{name}"...')
+        m = client.create_model(name=name, task="IMAGE_CLASSIFICATION",
+                                model_file_path=path, model_class=clazz)
+        models.append(m["name"] if "name" in m else name)
+
+    print(f'Creating train job for app "{app}"...')
+    job = client.create_train_job(
+        app=app,
+        task="IMAGE_CLASSIFICATION",
+        train_dataset_uri=train_uri,
+        test_dataset_uri=test_uri,
+        budget={"MODEL_TRIAL_COUNT": args.trials, "CHIP_COUNT": args.chips},
+        models=models,
+    )
+    pprint.pprint(job)
+
+    print("Waiting for train job to complete (this might take a few minutes)...")
+    status = wait_until_train_job_has_stopped(client, app)
+    print(f"Train job {status}")
+
+    print("Best trials:")
+    pprint.pprint(client.get_best_trials_of_train_job(app=app))
+
+    print("Creating inference job...")
+    pprint.pprint(client.create_inference_job(app=app))
+
+    if query is None:
+        ds_query = np.zeros((32, 32, 3), np.float32).tolist()
+    else:
+        ds_query = query
+    print("Predicting...")
+    predictions = client.predict(app=app, queries=[ds_query])
+    print("Predictions are:")
+    print([np.argmax(p) for p in predictions])
+
+    client.stop_inference_job(app=app)
+    client.stop_all_jobs()
+    server.stop()
+    admin.shutdown()
+    print("Quickstart complete.")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--trials", type=int, default=4)
+    parser.add_argument("--chips", type=int, default=1)
+    parser.add_argument("--train-dataset", default=None)
+    parser.add_argument("--test-dataset", default=None)
+    args = parser.parse_args()
+    if bool(args.train_dataset) != bool(args.test_dataset):
+        parser.error("--train-dataset and --test-dataset go together")
+    quickstart(args)

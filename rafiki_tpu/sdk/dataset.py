@@ -74,6 +74,18 @@ class DatasetUtils:
     ) -> "ImageFilesDataset":
         return ImageFilesDataset(self.download_dataset_from_uri(uri), image_size)
 
+    def load_image_arrays(
+        self, uri: str, image_size: Optional[Tuple[int, int]] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Either dataset format -> (x float32, y int32) dense arrays — the
+        branch every image-classification template needs."""
+        if uri.endswith(".npz"):
+            ds = self.load_dataset_of_arrays(uri)
+            return ds.x.astype(np.float32), ds.y.astype(np.int32)
+        img_ds = self.load_dataset_of_image_files(uri, image_size=image_size)
+        x, y = img_ds.load_as_arrays()
+        return x.astype(np.float32), y.astype(np.int32)
+
     def load_dataset_of_arrays(self, uri: str) -> "NumpyDataset":
         return NumpyDataset(self.download_dataset_from_uri(uri))
 

@@ -1,0 +1,30 @@
+"""Dataset converters: run each converter's selftest (reference keeps its
+converters untested; here they are part of the suite)."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_module(rel):
+    path = os.path.join(REPO, "examples", "datasets", rel)
+    name = "conv_" + os.path.splitext(os.path.basename(rel))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mnist_format_converter():
+    _load_module("image_classification/load_mnist_format.py")._selftest()
+
+
+def test_ptb_format_converter():
+    _load_module("pos_tagging/load_ptb_format.py")._selftest()
+
+
+def test_image_records_converter():
+    _load_module("image_generation/load_image_records.py")._selftest()
