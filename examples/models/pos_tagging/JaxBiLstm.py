@@ -136,12 +136,18 @@ class JaxBiLstm(BaseModel):
             ids_train = np.where(
                 (drop < self._knobs["word_dropout"]) & (ids != _PAD),
                 _UNK, ids)
+            def log_with_epoch(_e=epoch, **kw):
+                # inner fit always reports epoch=0; restore the outer index
+                # so the 'Loss over epochs' plot stays a curve
+                kw["epoch"] = float(_e)
+                self.logger.log(**kw)
+
             params, opt_state = self._trainer.fit(
                 params, opt_state, (ids_train, mask, tags),
                 epochs=1,
                 batch_size=self._knobs["batch_size"],
                 seed=epoch,
-                log=self.logger.log,
+                log=log_with_epoch,
             )
         self._params = params
 

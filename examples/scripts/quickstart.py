@@ -112,6 +112,12 @@ def quickstart(args):
     print("Waiting for train job to complete (this might take a few minutes)...")
     status = wait_until_train_job_has_stopped(client, app)
     print(f"Train job {status}")
+    if status != "STOPPED":
+        print("Train job errored — check worker logs under "
+              f"{os.path.join(workdir, 'logs')}")
+        server.stop()
+        admin.shutdown()
+        sys.exit(1)
 
     print("Best trials:")
     pprint.pprint(client.get_best_trials_of_train_job(app=app))

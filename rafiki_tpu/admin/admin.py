@@ -374,6 +374,15 @@ class Admin:
             raise InvalidRequestError(f"No such trial {trial_id}")
         return parse_logs(self.db.get_trial_logs(trial_id))
 
+    def get_trial_trace(self, trial_id: str) -> List[Dict]:
+        """Per-phase span breakdown recorded by the train worker (the
+        tracing subsystem the reference lacks, SURVEY.md §5.1)."""
+        if self.db.get_trial(trial_id) is None:
+            raise InvalidRequestError(f"No such trial {trial_id}")
+        from rafiki_tpu.utils.trace import load_trace
+
+        return load_trace(trial_id)
+
     def get_trial_params(self, trial_id: str) -> bytes:
         trial = self.db.get_trial(trial_id)
         if trial is None or not trial.get("params_file_path"):

@@ -152,6 +152,8 @@ class AdminServer:
             # trials
             r("GET", r"/trials/(?P<tid>[^/]+)/logs", _ANY, lambda au, m, b, q:
                 A.get_trial_logs(m["tid"])),
+            r("GET", r"/trials/(?P<tid>[^/]+)/trace", _ANY, lambda au, m, b, q:
+                A.get_trial_trace(m["tid"])),
             r("GET", r"/trials/(?P<tid>[^/]+)/parameters", _ANY,
                 lambda au, m, b, q: {"params_base64": base64.b64encode(
                     A.get_trial_params(m["tid"])).decode()}),

@@ -171,6 +171,11 @@ class Client:
     def get_trial_logs(self, trial_id: str) -> Dict:
         return self._call("GET", f"/trials/{trial_id}/logs")
 
+    def get_trial_trace(self, trial_id: str) -> List[Dict]:
+        """Per-phase span breakdown of a trial (propose/train/evaluate/
+        persist wall-clock) — no reference analogue (SURVEY.md §5.1)."""
+        return self._call("GET", f"/trials/{trial_id}/trace")
+
     def download_trial_params(self, trial_id: str) -> bytes:
         data = self._call("GET", f"/trials/{trial_id}/parameters")
         return base64.b64decode(data["params_base64"])

@@ -1,0 +1,151 @@
+"""Tracing and profiling — spans for the control plane, XLA profiles for
+the compute plane.
+
+The reference has no tracing subsystem at all (SURVEY.md §5.1: no timers,
+spans, or profiler hooks anywhere); its nearest artifact is the per-trial
+metric stream. This module is the first-class upgrade:
+
+- **Spans**: lightweight wall-clock spans with nesting (thread-local
+  stack), collected per trial/service by a `Tracer` and persisted as JSON
+  lines under LOGS_DIR. The train worker wraps each trial phase (propose /
+  train / evaluate / persist) so every trial ships a breakdown of where its
+  time went; the REST layer serves it back (`GET /trials/<id>/trace`).
+- **XLA profiles**: `jax_profile(dir)` wraps `jax.profiler.trace` to
+  capture a TensorBoard-loadable xplane trace of the device — opt-in via
+  the RAFIKI_PROFILE env var because capture is not free. This is the
+  TPU-side story the reference could never have (its compute was opaque
+  inside user TF1 graphs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+from rafiki_tpu import config
+
+logger = logging.getLogger(__name__)
+
+_tls = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    depth: int = 0
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        return max(self.end - self.start, 0.0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "duration_s": round(self.duration_s, 6),
+            "depth": self.depth,
+            **({"attrs": self.attrs} if self.attrs else {}),
+        }
+
+
+class Tracer:
+    """Collects spans for one unit of work (a trial, a predict call...).
+
+    Thread-safe for concurrent span entry from worker threads; nesting depth
+    is tracked per thread.
+    """
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.spans: List[Span] = []
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        depth = getattr(_tls, "depth", 0)
+        _tls.depth = depth + 1
+        s = Span(name=name, start=time.time(), depth=depth, attrs=attrs)
+        try:
+            yield s
+        finally:
+            _tls.depth = depth
+            s.end = time.time()
+            with self._lock:
+                self.spans.append(s)
+
+    def summary(self) -> Dict[str, float]:
+        """name -> total seconds (top-level occurrences summed)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for s in self.spans:
+                out[s.name] = out.get(s.name, 0.0) + s.duration_s
+        return out
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or trace_path(self.trace_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with self._lock:
+            ordered = sorted(self.spans, key=lambda s: s.start)
+            with open(path, "w") as f:
+                for s in ordered:
+                    f.write(json.dumps(s.to_dict()) + "\n")
+        return path
+
+
+def trace_path(trace_id: str) -> str:
+    return os.path.join(config.LOGS_DIR, f"trace-{trace_id}.jsonl")
+
+
+def load_trace(trace_id: str) -> List[Dict[str, Any]]:
+    """Read back a saved trace; [] if none was recorded."""
+    path = trace_path(trace_id)
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# XLA / device profiling
+
+def profiling_enabled() -> bool:
+    return os.environ.get("RAFIKI_PROFILE", "") not in ("", "0", "false")
+
+
+@contextlib.contextmanager
+def jax_profile(out_dir: Optional[str] = None,
+                force: bool = False) -> Iterator[Optional[str]]:
+    """Capture an XLA device profile (xplane, TensorBoard-loadable) around
+    the body. No-op unless RAFIKI_PROFILE is set (or force=True) — capture
+    adds overhead and output is large."""
+    if not (force or profiling_enabled()):
+        yield None
+        return
+    out_dir = out_dir or os.path.join(config.LOGS_DIR, "profiles")
+    os.makedirs(out_dir, exist_ok=True)
+    import jax
+
+    try:
+        jax.profiler.start_trace(out_dir)
+        started = True
+    except Exception:  # already tracing, or backend without profiler support
+        logger.exception("jax profiler failed to start")
+        started = False
+    try:
+        yield out_dir if started else None
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.exception("jax profiler failed to stop")

@@ -35,6 +35,7 @@ from rafiki_tpu.placement.manager import ServiceContext
 from rafiki_tpu.sdk.log import ModelLogger
 from rafiki_tpu.sdk.model import load_model_class
 from rafiki_tpu.sdk.params import dump_params
+from rafiki_tpu.utils.trace import Tracer, jax_profile
 
 logger = logging.getLogger(__name__)
 
@@ -115,17 +116,20 @@ class TrainWorker:
                 )
                 return
 
-            knobs = self._advisors.propose(advisor_id)
+            tracer = Tracer("pending")
+            with tracer.span("propose"):
+                knobs = self._advisors.propose(advisor_id)
             trial = self._db.create_trial(
                 self._sub_id, model["id"], knobs, worker_id=ctx.service_id
             )
+            tracer.trace_id = trial["id"]
             trial_logger = ModelLogger()
             trial_logger.set_sink(
                 lambda line, _tid=trial["id"]: self._db.add_trial_log(_tid, line)
             )
             try:
                 score, params_path = self._run_trial(
-                    clazz, knobs, job, trial["id"], trial_logger
+                    clazz, knobs, job, trial["id"], trial_logger, tracer
                 )
                 if ctx.stopping:
                     self._db.mark_trial_as_terminated(trial["id"])
@@ -150,16 +154,29 @@ class TrainWorker:
         job: Dict[str, Any],
         trial_id: str,
         trial_logger: ModelLogger,
+        tracer: Optional[Tracer] = None,
     ) -> tuple:
+        tracer = tracer or Tracer(trial_id)
         model = clazz(**knobs)
         model.logger = trial_logger
         try:
-            model.train(job["train_dataset_uri"])
-            score = float(model.evaluate(job["test_dataset_uri"]))
-            os.makedirs(self._params_dir, exist_ok=True)
-            params_path = os.path.join(self._params_dir, f"{trial_id}.params")
-            with open(params_path, "wb") as f:
-                f.write(dump_params(model.dump_parameters()))
+            with jax_profile(), tracer.span("train"):
+                model.train(job["train_dataset_uri"])
+            with tracer.span("evaluate"):
+                score = float(model.evaluate(job["test_dataset_uri"]))
+            with tracer.span("persist_params"):
+                os.makedirs(self._params_dir, exist_ok=True)
+                params_path = os.path.join(
+                    self._params_dir, f"{trial_id}.params")
+                with open(params_path, "wb") as f:
+                    f.write(dump_params(model.dump_parameters()))
             return score, params_path
         finally:
             model.destroy()
+            tracer.save()
+            # the phase breakdown also lands in the trial's metric stream so
+            # the existing log/plot plumbing surfaces it (SURVEY.md §5.5)
+            trial_logger.log("trial phase breakdown", **{
+                f"trace_{k}_s": round(v, 4)
+                for k, v in tracer.summary().items()
+            })

@@ -1,0 +1,109 @@
+"""Tracing subsystem: span recording, persistence, summary, nesting, and
+the per-trial wiring through the full stack (SURVEY.md §5.1 names tracing
+as the first-class upgrade over the reference, which has none)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.utils.trace import (
+    Tracer,
+    jax_profile,
+    load_trace,
+    trace_path,
+)
+
+
+def test_span_timing_and_nesting(tmp_workdir):
+    t = Tracer("t1")
+    with t.span("outer"):
+        time.sleep(0.01)
+        with t.span("inner", detail="x"):
+            time.sleep(0.01)
+    names = {s.name: s for s in t.spans}
+    assert names["outer"].depth == 0 and names["inner"].depth == 1
+    assert names["inner"].attrs == {"detail": "x"}
+    assert names["outer"].duration_s >= names["inner"].duration_s > 0.0
+    # inner closes first (appended first) but save orders by start time
+    path = t.save()
+    rows = [json.loads(line) for line in open(path)]
+    assert rows[0]["name"] == "outer"
+
+
+def test_trace_roundtrip(tmp_workdir):
+    t = Tracer("trial-xyz")
+    with t.span("train"):
+        pass
+    t.save()
+    assert os.path.exists(trace_path("trial-xyz"))
+    rows = load_trace("trial-xyz")
+    assert len(rows) == 1 and rows[0]["name"] == "train"
+    assert load_trace("nonexistent") == []
+
+
+def test_summary_sums_by_name(tmp_workdir):
+    t = Tracer("t2")
+    for _ in range(3):
+        with t.span("step"):
+            time.sleep(0.005)
+    s = t.summary()
+    assert set(s) == {"step"} and s["step"] >= 0.015
+
+
+def test_jax_profile_noop_without_env(tmp_workdir, monkeypatch):
+    monkeypatch.delenv("RAFIKI_PROFILE", raising=False)
+    with jax_profile() as out:
+        assert out is None
+
+
+def test_trial_trace_through_stack(tmp_workdir):
+    """A train job records a trace per trial, served over REST."""
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import Client
+    from rafiki_tpu.config import SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+    admin = Admin(db=Database(str(tmp_workdir / "db.sqlite")))
+    server = AdminServer(admin).start()
+    try:
+        client = Client(admin_host="127.0.0.1", admin_port=server.port)
+        client.login(SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD)
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 3, size=120).astype(np.int32)
+        x = (rng.normal(size=(120, 8, 8, 1)) + y[:, None, None, None]
+             ).astype(np.float32)
+        train = write_numpy_dataset(x, y, str(tmp_workdir / "train.npz"))
+        test = write_numpy_dataset(x, y, str(tmp_workdir / "test.npz"))
+        client.create_model(
+            name="NpDt", task="IMAGE_CLASSIFICATION",
+            model_file_path=os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "examples", "models", "image_classification",
+                "NpDecisionTree.py"),
+            model_class="NpDecisionTree")
+        client.create_train_job(
+            app="trace_app", task="IMAGE_CLASSIFICATION",
+            train_dataset_uri=train, test_dataset_uri=test,
+            budget={"MODEL_TRIAL_COUNT": 1})
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            job = client.get_train_job(app="trace_app")
+            if job["status"] in ("STOPPED", "ERRORED"):
+                break
+            time.sleep(0.5)
+        assert job["status"] == "STOPPED"
+        trials = client.get_trials_of_train_job(app="trace_app")
+        trace = client.get_trial_trace(trials[0]["id"])
+        names = {s["name"] for s in trace}
+        assert {"propose", "train", "evaluate", "persist_params"} <= names
+        # the phase breakdown also lands in the metric stream
+        logs = client.get_trial_logs(trials[0]["id"])
+        assert any("trace_train_s" in m for m in logs.get("metrics", []))
+    finally:
+        server.stop()
+        admin.shutdown()
