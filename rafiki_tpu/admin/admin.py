@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional
 from rafiki_tpu import config
 from rafiki_tpu.advisor.advisor import AdvisorStore
 from rafiki_tpu.admin.services import ServicesManager
-from rafiki_tpu.cache.queue import InProcessBroker
+from rafiki_tpu.cache.shm_broker import make_broker
 from rafiki_tpu.constants import (
     InferenceJobStatus,
     ModelAccessRight,
@@ -62,7 +62,9 @@ class Admin:
     ):
         self.db = db or Database()
         self.advisor_store = AdvisorStore()
-        self.broker = InProcessBroker()
+        # RAFIKI_BROKER=shm selects the native cross-process data
+        # plane (cache/shm_broker.py); default is in-process
+        self.broker = make_broker()
         self.placement = placement or LocalPlacementManager(
             on_status=self._on_service_status
         )
@@ -530,3 +532,8 @@ class Admin:
         self.stop_all_jobs()
         if hasattr(self.placement, "stop_all"):
             self.placement.stop_all()
+        # the shm broker holds listener threads + /dev/shm segments; the
+        # in-process broker has no close()
+        close = getattr(self.broker, "close", None)
+        if close is not None:
+            close()

@@ -1,0 +1,273 @@
+"""Cross-process serving broker over the native shared-memory queue.
+
+`InProcessBroker` (cache/queue.py) hands queries between threads of one
+process. This broker carries the same traffic between *processes* on one
+host through rafiki_tpu.native.shm_queue — the native replacement for the
+reference's Redis data plane (reference rafiki/cache/cache.py: every query
+rpush'd over TCP to a Redis server and polled at 0.25 s). Queue names are
+deterministic in (prefix, job, worker), so a worker process can attach with
+`ShmWorkerQueue.attach(...)` knowing only its ids.
+
+Wire format: JSON messages {"id": ..., "query": ...} on the per-worker
+query queue; {"id": ..., "result": ...} | {"id": ..., "error": ...} on the
+per-job response queue. A listener thread on the predictor side resolves
+`QueryFuture`s by id.
+
+Select with RAFIKI_BROKER=shm (Admin falls back to the in-process broker if
+the native library can't be built).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from rafiki_tpu.cache.queue import Broker, QueryFuture
+from rafiki_tpu.native.shm_queue import (
+    ShmMessageQueue,
+    ShmQueueClosed,
+    available,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _qname(prefix: str, *parts: str) -> str:
+    digest = hashlib.sha256("|".join(parts).encode()).hexdigest()[:24]
+    return f"/{prefix}-{digest}"
+
+
+class ShmWorkerQueue:
+    """Worker-side view: drains query batches, pushes responses.
+
+    Duck-types cache.queue.WorkerQueue's `take_batch` but yields
+    (ResponseHandle, query) pairs — the handle writes the response message
+    instead of resolving an in-process future.
+    """
+
+    class ResponseHandle:
+        __slots__ = ("_rq", "_id")
+
+        def __init__(self, rq: ShmMessageQueue, qid: str):
+            self._rq = rq
+            self._id = qid
+
+        def set_result(self, value: Any) -> None:
+            self._rq.push(json.dumps({"id": self._id, "result": value}).encode())
+
+        def set_error(self, error: BaseException) -> None:
+            self._rq.push(json.dumps(
+                {"id": self._id, "error": str(error)}).encode())
+
+    def __init__(self, query_q: ShmMessageQueue, response_q: ShmMessageQueue):
+        self._qq = query_q
+        self._rq = response_q
+
+    @classmethod
+    def attach(cls, prefix: str, inference_job_id: str,
+               worker_id: str) -> "ShmWorkerQueue":
+        """Open the queues from another process by deterministic name."""
+        qq = ShmMessageQueue(
+            _qname(prefix, "q", inference_job_id, worker_id), create=False)
+        rq = ShmMessageQueue(
+            _qname(prefix, "r", inference_job_id), create=False)
+        return cls(qq, rq)
+
+    def take_batch(self, max_size: int, deadline_s: float,
+                   wait_timeout_s: float = 0.5
+                   ) -> List[Tuple["ShmWorkerQueue.ResponseHandle", Any]]:
+        try:
+            first = self._qq.pop(timeout_s=wait_timeout_s)
+        except ShmQueueClosed:
+            return []
+        if first is None:
+            return []
+        batch = [first]
+        t0 = time.monotonic()
+        while len(batch) < max_size:
+            remaining = deadline_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._qq.pop(timeout_s=max(remaining, 0.001))
+            except ShmQueueClosed:
+                break
+            if nxt is None:
+                break
+            batch.append(nxt)
+        out = []
+        for raw in batch:
+            msg = json.loads(raw)
+            out.append((self.ResponseHandle(self._rq, msg["id"]),
+                        msg["query"]))
+        return out
+
+    def close(self) -> None:
+        self._qq.close()
+
+
+class _SubmitProxy:
+    """Predictor-side view of one worker's query queue."""
+
+    def __init__(self, broker: "ShmBroker", job_id: str,
+                 query_q: ShmMessageQueue):
+        self._broker = broker
+        self._job_id = job_id
+        self._qq = query_q
+
+    def submit(self, query: Any) -> QueryFuture:
+        qid = uuid.uuid4().hex
+        fut = QueryFuture()
+        self._broker._register_pending(self._job_id, qid, fut)
+        try:
+            self._qq.push(json.dumps({"id": qid, "query": query}).encode())
+        except Exception as e:
+            self._broker._pop_pending(self._job_id, qid)
+            fut.set_error(e)
+        return fut
+
+
+class ShmBroker(Broker):
+    """Owner (predictor-process) side of the shm data plane."""
+
+    def __init__(self, prefix: Optional[str] = None,
+                 queue_capacity: int = 1 << 20):
+        if not available():
+            raise RuntimeError("native shmqueue unavailable")
+        self.prefix = prefix or f"rafiki{uuid.uuid4().hex[:8]}"
+        self._capacity = queue_capacity
+        self._lock = threading.Lock()
+        self._query_qs: Dict[str, Dict[str, ShmMessageQueue]] = {}
+        self._response_qs: Dict[str, ShmMessageQueue] = {}
+        self._pending: Dict[str, Dict[str, QueryFuture]] = {}
+        self._listeners: Dict[str, threading.Thread] = {}
+        self._graveyard: List[ShmMessageQueue] = []
+        self._closed = False
+
+    # -- Broker interface --------------------------------------------------
+
+    def register_worker(self, inference_job_id: str,
+                        worker_id: str) -> ShmWorkerQueue:
+        with self._lock:
+            rq = self._ensure_response_queue(inference_job_id)
+            qq = ShmMessageQueue(
+                _qname(self.prefix, "q", inference_job_id, worker_id),
+                capacity=self._capacity, create=True)
+            self._query_qs.setdefault(inference_job_id, {})[worker_id] = qq
+        # a same-process worker thread shares the owner's handles; a separate
+        # worker process uses ShmWorkerQueue.attach() instead
+        return ShmWorkerQueue(qq, rq)
+
+    def unregister_worker(self, inference_job_id: str, worker_id: str) -> None:
+        with self._lock:
+            qq = self._query_qs.get(inference_job_id, {}).pop(worker_id, None)
+            if qq is not None:
+                # close only — a _SubmitProxy snapshot taken before this call
+                # may still hold the handle, and destroy() munmaps under it
+                # (closed pushes fail cleanly; unmapped ones segfault).
+                # The segment is reclaimed at broker close().
+                qq.close()
+                self._graveyard.append(qq)
+
+    def get_worker_queues(self, inference_job_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                wid: _SubmitProxy(self, inference_job_id, qq)
+                for wid, qq in self._query_qs.get(inference_job_id, {}).items()
+            }
+
+    # -- response plumbing -------------------------------------------------
+
+    def _ensure_response_queue(self, job_id: str) -> ShmMessageQueue:
+        """Caller holds self._lock."""
+        if job_id not in self._response_qs:
+            rq = ShmMessageQueue(
+                _qname(self.prefix, "r", job_id),
+                capacity=self._capacity, create=True)
+            self._response_qs[job_id] = rq
+            self._pending[job_id] = {}
+            t = threading.Thread(
+                target=self._listen, args=(job_id, rq),
+                name=f"shm-listener-{job_id[:8]}", daemon=True)
+            self._listeners[job_id] = t
+            t.start()
+        return self._response_qs[job_id]
+
+    def _register_pending(self, job_id: str, qid: str, fut: QueryFuture) -> None:
+        with self._lock:
+            self._pending.setdefault(job_id, {})[qid] = fut
+
+    def _pop_pending(self, job_id: str, qid: str) -> Optional[QueryFuture]:
+        with self._lock:
+            return self._pending.get(job_id, {}).pop(qid, None)
+
+    def _listen(self, job_id: str, rq: ShmMessageQueue) -> None:
+        while not self._closed:
+            try:
+                raw = rq.pop(timeout_s=0.5)
+            except ShmQueueClosed:
+                break
+            except Exception:
+                logger.exception("response listener %s died", job_id)
+                break
+            if raw is None:
+                continue
+            try:
+                msg = json.loads(raw)
+            except json.JSONDecodeError:
+                logger.error("bad response message on %s", job_id)
+                continue
+            fut = self._pop_pending(job_id, msg.get("id", ""))
+            if fut is None:
+                continue
+            if "error" in msg:
+                fut.set_error(RuntimeError(msg["error"]))
+            else:
+                fut.set_result(msg.get("result"))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            jobs = list(self._query_qs)
+            for job_id in jobs:
+                for qq in self._query_qs[job_id].values():
+                    qq.close()
+                    qq.destroy()
+            self._query_qs.clear()
+            for qq in self._graveyard:
+                qq.destroy()
+            self._graveyard.clear()
+            for rq in self._response_qs.values():
+                rq.close()
+        for t in self._listeners.values():
+            t.join(timeout=2.0)
+        with self._lock:
+            for rq in self._response_qs.values():
+                rq.destroy()
+            self._response_qs.clear()
+            for pend in self._pending.values():
+                for fut in pend.values():
+                    fut.set_error(RuntimeError("broker closed"))
+            self._pending.clear()
+
+
+def make_broker() -> Broker:
+    """RAFIKI_BROKER=shm -> native cross-process broker (with fallback);
+    anything else -> in-process condition-variable broker."""
+    import os
+
+    from rafiki_tpu.cache.queue import InProcessBroker
+
+    if os.environ.get("RAFIKI_BROKER") == "shm":
+        try:
+            return ShmBroker()
+        except Exception:
+            logger.warning("shm broker unavailable; using in-process broker")
+    return InProcessBroker()

@@ -1,0 +1,84 @@
+"""On-demand native build: compile src/*.cpp into a cached shared library.
+
+No pybind11 in this environment, so bindings are plain `extern "C"` + ctypes
+(see shm_queue.py). The library is built once per source-hash into
+~/.cache/rafiki_tpu (or RAFIKI_NATIVE_CACHE) and memoized; if no compiler is
+available the callers fall back to pure-Python implementations, so the
+framework never *requires* the native path — it's the fast path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "RAFIKI_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "rafiki_tpu"),
+    )
+
+
+def _source_path(name: str) -> str:
+    return os.path.join(_SRC_DIR, f"{name}.cpp")
+
+
+def build_library(name: str) -> Optional[str]:
+    """Compile src/<name>.cpp -> cached .so; returns the path or None."""
+    src = _source_path(name)
+    if not os.path.exists(src):
+        logger.error("no native source %s", src)
+        return None
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out_dir = _cache_dir()
+    out = os.path.join(out_dir, f"lib{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(out_dir, exist_ok=True)
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+        src, "-o", out + ".tmp", "-lpthread", "-lrt",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except FileNotFoundError:
+        logger.warning("g++ not available; native %s disabled", name)
+        return None
+    except subprocess.CalledProcessError as e:
+        logger.error("native build of %s failed:\n%s", name,
+                     e.stderr.decode(errors="replace"))
+        return None
+    os.replace(out + ".tmp", out)
+    return out
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Build (if needed) and dlopen a native library; memoized; None if the
+    toolchain is unavailable."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        path = build_library(name)
+        lib = None
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                logger.exception("failed to load %s", path)
+        _cache[name] = lib
+        return lib

@@ -1,0 +1,181 @@
+"""ctypes binding for the native shared-memory message queue (shmqueue.cpp).
+
+`ShmMessageQueue` moves byte messages between processes on one host through
+a POSIX shm ring buffer — the native replacement for the reference's Redis
+transport (reference rafiki/cache/cache.py). `available()` reports whether
+the native library could be built; callers fall back to the in-process
+Python broker otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+import uuid
+from typing import Optional
+
+from rafiki_tpu.native.build import load_library
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_CAPACITY = 1 << 20  # 1 MiB ring
+
+
+def _lib():
+    lib = load_library("shmqueue")
+    if lib is None:
+        return None
+    if not getattr(lib, "_shmq_configured", False):
+        lib.shmq_create.restype = ctypes.c_void_p
+        lib.shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.shmq_open.restype = ctypes.c_void_p
+        lib.shmq_open.argtypes = [ctypes.c_char_p]
+        lib.shmq_push.restype = ctypes.c_int
+        lib.shmq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint32, ctypes.c_long]
+        lib.shmq_pop.restype = ctypes.c_int
+        lib.shmq_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint32, ctypes.c_long,
+                                 ctypes.POINTER(ctypes.c_uint32)]
+        lib.shmq_used.restype = ctypes.c_uint64
+        lib.shmq_used.argtypes = [ctypes.c_void_p]
+        lib.shmq_close.argtypes = [ctypes.c_void_p]
+        lib.shmq_destroy.argtypes = [ctypes.c_void_p]
+        lib._shmq_configured = True
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def make_queue_name(prefix: str = "rafiki") -> str:
+    """A fresh shm object name (must start with '/', one component)."""
+    return f"/{prefix}-{os.getpid()}-{uuid.uuid4().hex[:12]}"
+
+
+class ShmQueueClosed(Exception):
+    pass
+
+
+class ShmMessageQueue:
+    """One MPMC byte-message queue backed by POSIX shared memory."""
+
+    def __init__(self, name: str, capacity: int = _DEFAULT_CAPACITY,
+                 create: bool = True):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError("native shmqueue unavailable (no toolchain)")
+        self._lib = lib
+        self.name = name
+        self._create = create
+        if create:
+            self._h = lib.shmq_create(name.encode(), capacity)
+        else:
+            self._h = lib.shmq_open(name.encode())
+        if not self._h:
+            raise OSError(f"shmq_{'create' if create else 'open'}({name}) failed")
+        # receive buffers are per-thread: concurrent pop() calls must not
+        # share one buffer or a second pop overwrites it before .raw is read
+        self._tls = threading.local()
+        # in-flight native-call tracking: destroy() must not munmap the
+        # segment while another thread is blocked inside shmq_push/pop —
+        # that is a segfault, not an exception
+        self._cv = threading.Condition()
+        self._inflight = 0
+
+    def _enter_native(self) -> None:
+        with self._cv:
+            if not self._h:
+                raise ShmQueueClosed(self.name)
+            self._inflight += 1
+
+    def _exit_native(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._cv.notify_all()
+
+    def push(self, payload: bytes, timeout_s: float = 5.0) -> None:
+        self._enter_native()
+        try:
+            rc = self._lib.shmq_push(self._h, payload, len(payload),
+                                     int(timeout_s * 1000))
+        finally:
+            self._exit_native()
+        if rc == -1:
+            raise TimeoutError("shm queue full")
+        if rc == -2:
+            raise ShmQueueClosed(self.name)
+        if rc == -3:
+            raise ValueError(f"message of {len(payload)}B exceeds ring capacity")
+        assert rc == 0, rc
+
+    def pop(self, timeout_s: float = 0.5) -> Optional[bytes]:
+        """One message, or None on timeout. Raises ShmQueueClosed when the
+        queue is closed and drained."""
+        buf = getattr(self._tls, "buf", None)
+        if buf is None:
+            buf = self._tls.buf = ctypes.create_string_buffer(64 * 1024)
+        required = ctypes.c_uint32(0)
+        self._enter_native()
+        try:
+            rc = self._lib.shmq_pop(self._h, buf, len(buf),
+                                    int(timeout_s * 1000),
+                                    ctypes.byref(required))
+            while rc == -4:
+                # grow receive buffer and retry: with concurrent consumers a
+                # different (larger) message may be at head by the retry, so
+                # loop, not a single retry
+                buf = self._tls.buf = ctypes.create_string_buffer(
+                    int(required.value))
+                rc = self._lib.shmq_pop(self._h, buf, len(buf),
+                                        int(timeout_s * 1000),
+                                        ctypes.byref(required))
+        finally:
+            self._exit_native()
+        if rc == -1:
+            return None
+        if rc == -2:
+            raise ShmQueueClosed(self.name)
+        assert rc >= 0, rc
+        return buf.raw[:rc]
+
+    def used_bytes(self) -> int:
+        try:
+            self._enter_native()
+        except ShmQueueClosed:
+            return 0
+        try:
+            return int(self._lib.shmq_used(self._h))
+        finally:
+            self._exit_native()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.shmq_close(self._h)
+
+    def destroy(self) -> None:
+        """Unmap (and unlink, if this handle created the segment). Waits for
+        in-flight push/pop calls on this handle to return first — their
+        blocking waits are bounded by their own timeouts; call close() before
+        destroy() to wake them immediately."""
+        with self._cv:
+            if not self._h:
+                return
+            h, self._h = self._h, None  # new calls now raise ShmQueueClosed
+            while self._inflight:
+                if not self._cv.wait(timeout=10.0):
+                    logger.warning(
+                        "destroy(%s): %d native calls still in flight",
+                        self.name, self._inflight)
+                    break
+        self._lib.shmq_destroy(h)
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

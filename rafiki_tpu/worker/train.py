@@ -172,11 +172,19 @@ class TrainWorker:
                     f.write(dump_params(model.dump_parameters()))
             return score, params_path
         finally:
-            model.destroy()
-            tracer.save()
-            # the phase breakdown also lands in the trial's metric stream so
-            # the existing log/plot plumbing surfaces it (SURVEY.md §5.5)
-            trial_logger.log("trial phase breakdown", **{
-                f"trace_{k}_s": round(v, 4)
-                for k, v in tracer.summary().items()
-            })
+            try:
+                model.destroy()
+            finally:
+                # diagnostics only: a trace-persistence failure must never
+                # turn a successful trial into ERRORED (or mask the real
+                # exception of a failed one)
+                try:
+                    tracer.save()
+                    # the phase breakdown also lands in the trial's metric
+                    # stream so the existing log/plot plumbing surfaces it
+                    trial_logger.log("trial phase breakdown", **{
+                        f"trace_{k}_s": round(v, 4)
+                        for k, v in tracer.summary().items()
+                    })
+                except Exception:
+                    logger.exception("failed to persist trial trace")

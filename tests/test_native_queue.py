@@ -1,0 +1,246 @@
+"""Native shared-memory queue + broker: build, FIFO/wraparound semantics,
+thread concurrency, cross-process attach, and the full serving stack over
+RAFIKI_BROKER=shm."""
+
+import json
+import multiprocessing
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.native import shm_queue
+from rafiki_tpu.native.shm_queue import (
+    ShmMessageQueue,
+    ShmQueueClosed,
+    make_queue_name,
+)
+
+pytestmark = pytest.mark.skipif(
+    not shm_queue.available(), reason="no native toolchain")
+
+
+def test_fifo_and_timeout():
+    q = ShmMessageQueue(make_queue_name("t1"), capacity=1 << 14)
+    try:
+        for i in range(10):
+            q.push(f"msg{i}".encode())
+        for i in range(10):
+            assert q.pop() == f"msg{i}".encode()
+        assert q.pop(timeout_s=0.05) is None
+    finally:
+        q.destroy()
+
+
+def test_wraparound_and_large_messages():
+    q = ShmMessageQueue(make_queue_name("t2"), capacity=1 << 14)
+    try:
+        payload = os.urandom(5000)
+        for i in range(40):  # many times around the 16 KiB ring
+            q.push(payload + bytes([i]))
+            assert q.pop() == payload + bytes([i])
+        with pytest.raises(ValueError):
+            q.push(os.urandom(1 << 15))  # exceeds ring capacity
+    finally:
+        q.destroy()
+
+
+def test_receive_buffer_grows():
+    q = ShmMessageQueue(make_queue_name("t3"), capacity=1 << 18)
+    try:
+        big = os.urandom(100_000)  # > the initial 64 KiB receive buffer
+        q.push(big)
+        assert q.pop() == big
+    finally:
+        q.destroy()
+
+
+def test_close_semantics():
+    q = ShmMessageQueue(make_queue_name("t4"), capacity=1 << 14)
+    try:
+        q.push(b"pending")
+        q.close()
+        assert q.pop() == b"pending"  # drains
+        with pytest.raises(ShmQueueClosed):
+            q.pop()
+        with pytest.raises(ShmQueueClosed):
+            q.push(b"x")
+    finally:
+        q.destroy()
+
+
+def test_threaded_producers_consumers():
+    q = ShmMessageQueue(make_queue_name("t5"), capacity=1 << 16)
+    n_per, n_prod = 200, 4
+    seen = []
+    seen_lock = threading.Lock()
+
+    def produce(pid):
+        for i in range(n_per):
+            q.push(json.dumps({"p": pid, "i": i}).encode())
+
+    def consume():
+        while True:
+            try:
+                raw = q.pop(timeout_s=1.0)
+            except ShmQueueClosed:
+                return
+            if raw is None:
+                return
+            with seen_lock:
+                seen.append(json.loads(raw))
+
+    try:
+        prods = [threading.Thread(target=produce, args=(p,))
+                 for p in range(n_prod)]
+        cons = [threading.Thread(target=consume) for _ in range(3)]
+        for t in prods + cons:
+            t.start()
+        for t in prods:
+            t.join()
+        for t in cons:
+            t.join()
+        assert len(seen) == n_per * n_prod
+        # per-producer FIFO holds even with interleaving
+        for p in range(n_prod):
+            idxs = [m["i"] for m in seen if m["p"] == p]
+            assert sorted(idxs) == list(range(n_per))
+    finally:
+        q.destroy()
+
+
+def _child_echo(req_name, resp_name):
+    # re-open both queues by name in a fresh process; echo request->response
+    req = ShmMessageQueue(req_name, create=False)
+    resp = ShmMessageQueue(resp_name, create=False)
+    msg = req.pop(timeout_s=10.0)
+    resp.push(b"echo:" + (msg or b"<timeout>"))
+    req.destroy()   # non-owner: unmap only
+    resp.destroy()
+
+
+def test_cross_process_attach():
+    req = ShmMessageQueue(make_queue_name("xpq"), capacity=1 << 14)
+    resp = ShmMessageQueue(make_queue_name("xpr"), capacity=1 << 14)
+    try:
+        ctx = multiprocessing.get_context("spawn")
+        p = ctx.Process(target=_child_echo, args=(req.name, resp.name))
+        p.start()
+        req.push(b"ping")
+        got = resp.pop(timeout_s=15.0)
+        p.join(timeout=10)
+        assert got == b"echo:ping"
+        assert p.exitcode == 0
+    finally:
+        req.destroy()
+        resp.destroy()
+
+
+def test_shm_broker_roundtrip():
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("job1", "w1")
+
+        def worker():
+            for _ in range(50):
+                batch = wq.take_batch(max_size=8, deadline_s=0.002,
+                                      wait_timeout_s=0.2)
+                for handle, query in batch:
+                    handle.set_result({"echo": query})
+
+        t = threading.Thread(target=worker)
+        t.start()
+        proxies = broker.get_worker_queues("job1")
+        assert list(proxies) == ["w1"]
+        futs = [proxies["w1"].submit({"n": i}) for i in range(20)]
+        results = [f.result(timeout=10.0) for f in futs]
+        assert results == [{"echo": {"n": i}} for i in range(20)]
+        t.join(timeout=10)
+    finally:
+        broker.close()
+
+
+def test_full_stack_over_shm_broker(tmp_workdir, monkeypatch):
+    """The AutoML serving path with the native data plane selected."""
+    monkeypatch.setenv("RAFIKI_BROKER", "shm")
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+    from rafiki_tpu.client.client import Client
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.config import SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+    admin = Admin(db=Database(str(tmp_workdir / "db.sqlite")))
+    assert isinstance(admin.broker, ShmBroker)
+    server = AdminServer(admin).start()
+    try:
+        client = Client(admin_host="127.0.0.1", admin_port=server.port)
+        client.login(SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD)
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 3, size=120).astype(np.int32)
+        x = (rng.normal(size=(120, 8, 8, 1)) + y[:, None, None, None]
+             ).astype(np.float32)
+        train = write_numpy_dataset(x, y, str(tmp_workdir / "train.npz"))
+        test = write_numpy_dataset(x, y, str(tmp_workdir / "test.npz"))
+        client.create_model(
+            name="NpDt", task="IMAGE_CLASSIFICATION",
+            model_file_path=os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "examples", "models", "image_classification",
+                "NpDecisionTree.py"),
+            model_class="NpDecisionTree")
+        client.create_train_job(
+            app="shm_app", task="IMAGE_CLASSIFICATION",
+            train_dataset_uri=train, test_dataset_uri=test,
+            budget={"MODEL_TRIAL_COUNT": 1})
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            job = client.get_train_job(app="shm_app")
+            if job["status"] in ("STOPPED", "ERRORED"):
+                break
+            time.sleep(0.5)
+        assert job["status"] == "STOPPED"
+        client.create_inference_job(app="shm_app")
+        preds = client.predict(app="shm_app", queries=[x[0].tolist()])
+        assert len(preds) == 1 and len(preds[0]) == 3
+    finally:
+        server.stop()
+        admin.shutdown()
+
+
+def test_wrap_reservation_under_load():
+    """Regression: a wrapping push must account the skipped tail bytes in
+    its space requirement — with the old `4 spare bytes` accounting, a
+    producer/consumer pair with messages comparable to the ring size
+    silently corrupted payloads."""
+    q = ShmMessageQueue(make_queue_name("t6"), capacity=1000)
+    results = []
+
+    def consume(n):
+        for _ in range(n):
+            while True:
+                try:
+                    raw = q.pop(timeout_s=1.0)
+                except ShmQueueClosed:
+                    return
+                if raw is not None:
+                    results.append(raw)
+                    break
+
+    sizes = [100, 327, 250, 90, 411, 64, 199, 300] * 25
+    payloads = [bytes([i % 251]) * s for i, s in enumerate(sizes)]
+    t = threading.Thread(target=consume, args=(len(payloads),))
+    t.start()
+    try:
+        for p in payloads:
+            q.push(p, timeout_s=10.0)
+        t.join(timeout=30)
+        assert results == payloads
+        assert q.used_bytes() == 0
+    finally:
+        q.destroy()
