@@ -37,11 +37,14 @@ class LMConfig:
 
 
 def tiny(vocab: int = 256, max_len: int = 128, dim: int = 64, depth: int = 2,
-         heads: int = 4, moe_experts: int = 0) -> LMConfig:
+         heads: int = 4, moe_experts: int = 0, **encoder_kw) -> LMConfig:
+    """``encoder_kw`` passes through to TransformerConfig (seq_parallel,
+    pipeline, n_microbatches, ...)."""
     return LMConfig(vocab=vocab, max_len=max_len,
                     encoder=TransformerConfig(dim=dim, depth=depth,
                                               heads=heads, causal=True,
-                                              moe_experts=moe_experts))
+                                              moe_experts=moe_experts,
+                                              **encoder_kw))
 
 
 def init(rng: jax.Array, cfg: LMConfig) -> Params:

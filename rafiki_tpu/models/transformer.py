@@ -41,6 +41,17 @@ class TransformerConfig:
     use_flash: Optional[bool] = None  # None = auto by backend/seq-len
     moe_experts: int = 0  # >0 replaces the MLP with an expert-parallel MoE
     moe_capacity_factor: float = 1.25
+    # "ring" routes attention through parallel/ring.py when the current mesh
+    # has a seq axis > 1: exact attention with k/v shards rotating over ICI,
+    # sequence length scaling linearly in chips. None = GSPMD seq-sharding
+    # of activations only (all-gather on the attention matmuls).
+    seq_parallel: Optional[str] = None
+    # "gpipe" runs the depth stack through parallel/pipeline.py microbatch
+    # pipelining when the current mesh has a pipe axis > 1: each stage holds
+    # depth/n_stages layers, activations hop stage-to-stage over ICI. None =
+    # GSPMD weight-sharding of the scanned depth axis.
+    pipeline: Optional[str] = None
+    n_microbatches: int = 4
 
 
 def block_init(rng: jax.Array, cfg: TransformerConfig) -> Params:
@@ -69,14 +80,26 @@ def block_apply(params: Params, x: jax.Array, cfg: TransformerConfig,
     """Returns (x, aux_loss) — aux is the MoE load-balancing term (0 for
     dense blocks)."""
     from rafiki_tpu.parallel.moe import moe_apply
-    from rafiki_tpu.parallel.sharding import shard_activations
+    from rafiki_tpu.parallel.sharding import (
+        current_mesh,
+        mesh_axis_size,
+        shard_activations,
+    )
 
     x = shard_activations(x, ("data", "seq", None))
     r1 = r2 = None
     if rng is not None:
         r1, r2 = jax.random.split(rng)
+    attn_fn = None
+    if cfg.seq_parallel == "ring" and mesh_axis_size("seq") > 1:
+        from rafiki_tpu.parallel.ring import ring_attention
+
+        mesh = current_mesh()
+        attn_fn = lambda q, k, v, causal: ring_attention(  # noqa: E731
+            q, k, v, mesh, causal=causal)
     h = multi_head_attention(params["attn"], core.layernorm(params["ln1"], x),
-                             causal=cfg.causal, use_flash=cfg.use_flash)
+                             causal=cfg.causal, use_flash=cfg.use_flash,
+                             attn_fn=attn_fn)
     x = x + core.dropout(r1, h, cfg.dropout, deterministic)
     h = core.layernorm(params["ln2"], x)
     aux = jnp.zeros((), jnp.float32)
@@ -98,7 +121,61 @@ def stack_init(rng: jax.Array, cfg: TransformerConfig) -> Params:
 def stack_apply(stacked: Params, x: jax.Array, cfg: TransformerConfig,
                 rng: Optional[jax.Array] = None,
                 deterministic: bool = True) -> Tuple[jax.Array, jax.Array]:
-    """scan over the depth-stacked block params -> (x, summed aux loss)."""
+    """scan over the depth-stacked block params -> (x, summed aux loss).
+
+    With ``cfg.pipeline == 'gpipe'`` and a pipe axis > 1 on the current
+    mesh, the scan is replaced by microbatch pipelining over the stages
+    (parallel/pipeline.py) — each stage holds depth/n_stages layers and
+    activations hop over ICI. The gpipe path is deterministic (no dropout
+    rng threading across stages) and returns aux = 0.
+    """
+    from rafiki_tpu.parallel.sharding import (
+        activation_mesh,
+        current_mesh,
+        mesh_axis_size,
+    )
+
+    if cfg.pipeline == "gpipe" and mesh_axis_size("pipe") > 1:
+        from rafiki_tpu.parallel.pipeline import gpipe_apply
+
+        if cfg.moe_experts > 0:
+            raise ValueError(
+                "pipeline='gpipe' does not support MoE blocks (the stage "
+                "body drops the load-balancing aux loss); use GSPMD pipe "
+                "weight-sharding (pipeline=None) for MoE models")
+        if mesh_axis_size("model") > 1:
+            raise ValueError(
+                "pipeline='gpipe' cannot combine with a model (TP) axis "
+                "> 1: the pipeline shard_map claims stage weights whole, "
+                "which would silently all-gather TP-sharded kernels; use "
+                "GSPMD pipe weight-sharding (pipeline=None) with TP")
+        depth = jax.tree.leaves(stacked)[0].shape[0]
+        n_stages = mesh_axis_size("pipe")
+        if depth % n_stages != 0:
+            raise ValueError(
+                f"stack depth {depth} not divisible by {n_stages} pipeline "
+                "stages")
+        if cfg.dropout > 0 and not deterministic:
+            raise ValueError(
+                "pipeline='gpipe' is deterministic (no dropout-rng "
+                "threading across stages); set dropout=0 or pipeline=None")
+        if x.shape[0] % cfg.n_microbatches != 0:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by "
+                f"n_microbatches={cfg.n_microbatches}")
+
+        mesh = current_mesh()
+
+        def block_fn(layer, h):
+            # plain per-stage compute: no activation sharding constraints or
+            # nested shard_maps inside the pipeline's shard_map body
+            with activation_mesh(None):
+                y, _ = block_apply(layer, h, cfg, None, True)
+            return y
+
+        y = gpipe_apply(block_fn, stacked, x, mesh,
+                        n_microbatches=cfg.n_microbatches)
+        return y, jnp.zeros((), jnp.float32)
 
     def body(carry, layer):
         x, key = carry

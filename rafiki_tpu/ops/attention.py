@@ -55,10 +55,13 @@ def attention_init(rng: jax.Array, dim: int, heads: int) -> Params:
 
 def multi_head_attention(params: Params, x: jax.Array,
                          causal: bool = False,
-                         use_flash: Optional[bool] = None) -> jax.Array:
+                         use_flash: Optional[bool] = None,
+                         attn_fn=None) -> jax.Array:
     """Self-attention over (B, S, D). ``use_flash=None`` auto-selects the
     pallas kernel for sequences long enough that materializing (S, S) scores
-    would be HBM-bound."""
+    would be HBM-bound. ``attn_fn(q, k, v, causal)`` overrides the inner
+    attention entirely (the seam ring attention plugs into — see
+    models/transformer.py seq_parallel)."""
     from rafiki_tpu.ops.flash_attention import flash_attention
 
     b, s, d = x.shape
@@ -66,9 +69,10 @@ def multi_head_attention(params: Params, x: jax.Array,
     q = jnp.einsum("bsd,dhk->bhsk", x, params["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bhsk", x, params["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bhsk", x, params["wv"].astype(dt))
-    if use_flash is None:
-        use_flash = jax.default_backend() == "tpu" and s >= 1024
-    if use_flash:
+    if attn_fn is not None:
+        o = attn_fn(q, k, v, causal)
+    elif use_flash or (use_flash is None
+                       and jax.default_backend() == "tpu" and s >= 1024):
         o = flash_attention(q, k, v, causal=causal)
     else:
         o = mha_reference(q, k, v, causal=causal)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from rafiki_tpu.parallel.mesh import PIPELINE_AXIS
+from rafiki_tpu.parallel.mesh import DATA_AXIS, PIPELINE_AXIS
 
 
 def _stage_local(params_local: Any, x_mbs: jax.Array, *, block_fn,
@@ -66,22 +66,31 @@ def _stage_local(params_local: Any, x_mbs: jax.Array, *, block_fn,
 def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
                 stacked_params: Any, x: jax.Array, mesh: Mesh,
                 n_microbatches: int,
-                pipe_axis: str = PIPELINE_AXIS) -> jax.Array:
+                pipe_axis: str = PIPELINE_AXIS,
+                data_axis: str = DATA_AXIS) -> jax.Array:
     """Run ``block_fn`` over the pipe-sharded layer stack with microbatched
     pipelining. ``x``: (B, ...) with B divisible by n_microbatches; layer
-    stack depth divisible by the pipe axis size."""
+    stack depth divisible by the pipe axis size. If the mesh also has a
+    ``data`` axis, the microbatch dim stays data-sharded (DP x PP compose:
+    each data shard runs its own pipeline over the same stage weights)."""
     n_stages = mesh.shape[pipe_axis]
     b = x.shape[0]
     assert b % n_microbatches == 0, "batch must divide into microbatches"
     x_mbs = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
 
+    # keep the microbatch dim data-sharded only when it divides; otherwise
+    # fall back to replicated input (correct, just more ICI traffic)
+    dp = data_axis if data_axis in mesh.axis_names else None
+    if dp is not None and (b // n_microbatches) % mesh.shape[dp] != 0:
+        dp = None
+    x_spec = P(None, dp)
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     fn = jax.shard_map(
         partial(_stage_local, block_fn=block_fn, axis_name=pipe_axis,
                 n_microbatches=n_microbatches),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )
     y = fn(stacked_params, x_mbs)

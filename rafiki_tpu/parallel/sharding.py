@@ -80,6 +80,31 @@ def shard_activations(x: jax.Array, axes: Sequence[Any]) -> jax.Array:
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
+def current_mesh() -> Optional[Mesh]:
+    """The mesh installed by the innermost ``activation_mesh`` (None outside
+    a trainer). Models use this to route to mesh-aware paths — ring
+    attention over the ``seq`` axis, GPipe over ``pipe`` — without the mesh
+    appearing in their signatures.
+
+    Trace-time contract: this is read during jit TRACING, so the routing it
+    selects (and the mesh any shard_map binds) is baked into the compiled
+    function. A jitted function must therefore be traced and executed under
+    the same activation_mesh — keep one jitted closure per mesh, as
+    GspmdTrainer does (its ``step``/``predict`` always wrap the per-instance
+    jit in ``activation_mesh(self.mesh)``). Don't share one ``jax.jit``
+    across different mesh contexts: the first trace's routing wins silently.
+    """
+    return getattr(_act, "mesh", None)
+
+
+def mesh_axis_size(axis: str) -> int:
+    """Size of ``axis`` on the current mesh (1 if absent / no mesh)."""
+    mesh = current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
 class GspmdTrainer:
     """pjit-style trainer: params sharded per the model's spec tree, batch
     sharded per ``batch_specs``, one fused donated train step.
