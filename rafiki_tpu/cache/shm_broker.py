@@ -42,6 +42,23 @@ def _qname(prefix: str, *parts: str) -> str:
     return f"/{prefix}-{digest}"
 
 
+def _json_dumps(obj: Any) -> bytes:
+    """Shm wire format is JSON (it crosses process boundaries), which is
+    narrower than InProcessBroker's arbitrary-object handoff. Bridge the
+    common gap: numpy arrays/scalars a model predict() returns are converted
+    via tolist()/item(); anything else non-JSON raises TypeError."""
+
+    def default(o):
+        if hasattr(o, "tolist"):
+            return o.tolist()
+        if hasattr(o, "item"):
+            return o.item()
+        raise TypeError(
+            f"{type(o).__name__} is not JSON-serializable on the shm wire")
+
+    return json.dumps(obj, default=default).encode()
+
+
 class ShmWorkerQueue:
     """Worker-side view: drains query batches, pushes responses.
 
@@ -58,11 +75,20 @@ class ShmWorkerQueue:
             self._id = qid
 
         def set_result(self, value: Any) -> None:
-            self._rq.push(json.dumps({"id": self._id, "result": value}).encode())
+            # transport backpressure (full response ring, broker mid-close)
+            # must not crash the serving worker loop — the predictor's SLO
+            # timeout covers the dropped response
+            try:
+                self._rq.push(_json_dumps({"id": self._id, "result": value}))
+            except Exception:
+                logger.exception("dropping response %s", self._id)
 
         def set_error(self, error: BaseException) -> None:
-            self._rq.push(json.dumps(
-                {"id": self._id, "error": str(error)}).encode())
+            try:
+                self._rq.push(_json_dumps(
+                    {"id": self._id, "error": str(error)}))
+            except Exception:
+                logger.exception("dropping error response %s", self._id)
 
     def __init__(self, query_q: ShmMessageQueue, response_q: ShmMessageQueue):
         self._qq = query_q
@@ -125,7 +151,7 @@ class _SubmitProxy:
         fut = QueryFuture()
         self._broker._register_pending(self._job_id, qid, fut)
         try:
-            self._qq.push(json.dumps({"id": qid, "query": query}).encode())
+            self._qq.push(_json_dumps({"id": qid, "query": query}))
         except Exception as e:
             self._broker._pop_pending(self._job_id, qid)
             fut.set_error(e)

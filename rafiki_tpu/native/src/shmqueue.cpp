@@ -172,7 +172,9 @@ int shmq_push(void* hv, const uint8_t* buf, uint32_t len, long timeout_ms) {
   if (4ull + len > q->capacity) return -3;  // unfittable even when empty
   timespec ts;
   timeout_to_abs(timeout_ms, &ts);
-  if (robust_lock(&q->mutex) != 0) return -1;
+  // timed, so a stopped (e.g. SIGSTOP'd) lock holder can't block a push
+  // past its deadline — mirrors shmq_pop
+  if (robust_timedlock(&q->mutex, &ts) != 0) return -1;
   // The space requirement depends on where tail sits (a wrap skips the
   // remainder of the ring), and tail moves whenever another producer gets
   // in between our waits — so recompute it every iteration.
@@ -188,10 +190,13 @@ int shmq_push(void* hv, const uint8_t* buf, uint32_t len, long timeout_ms) {
     if (room_to_end < required) required += room_to_end;  // wrap skip bytes
     if (cap - q->used >= required) break;
     if (q->used == 0) {
-      // empty yet still insufficient: this tail alignment can never fit
-      // until a reader moves head, and there is nothing to read
-      pthread_mutex_unlock(&q->mutex);
-      return -3;
+      // ring empty (head == tail, nothing in flight) yet insufficient:
+      // only the wrap-skip remainder is in the way. Rebase both cursors
+      // to 0 — any message that fits an empty ring now fits (the entry
+      // check guarantees 4+len <= capacity), so the recompute breaks.
+      q->head = 0;
+      q->tail = 0;
+      continue;
     }
     int rc = pthread_cond_timedwait(&q->not_full, &q->mutex, &ts);
     if (rc == ETIMEDOUT) {

@@ -32,8 +32,6 @@ from rafiki_tpu import config
 
 logger = logging.getLogger(__name__)
 
-_tls = threading.local()
-
 
 @dataclass
 class Span:
@@ -69,18 +67,27 @@ class Tracer:
         self.trace_id = trace_id
         self.spans: List[Span] = []
         self._lock = threading.Lock()
+        # depth per (tracer, thread) — a module-global thread-local would
+        # interleave depths of two tracers active on one thread (e.g. a
+        # predict-call tracer inside a trial tracer)
+        self._depth: Dict[int, int] = {}
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[Span]:
-        depth = getattr(_tls, "depth", 0)
-        _tls.depth = depth + 1
+        tid = threading.get_ident()
+        with self._lock:
+            depth = self._depth.get(tid, 0)
+            self._depth[tid] = depth + 1
         s = Span(name=name, start=time.time(), depth=depth, attrs=attrs)
         try:
             yield s
         finally:
-            _tls.depth = depth
             s.end = time.time()
             with self._lock:
+                if depth == 0:
+                    self._depth.pop(tid, None)
+                else:
+                    self._depth[tid] = depth
                 self.spans.append(s)
 
     def summary(self) -> Dict[str, float]:

@@ -244,3 +244,26 @@ def test_wrap_reservation_under_load():
         assert q.used_bytes() == 0
     finally:
         q.destroy()
+
+
+def test_empty_ring_large_message_any_tail_position():
+    """Regression (ADVICE r1): a message needing more than the contiguous
+    room at the current tail must still fit an EMPTY ring — the push rebases
+    head/tail to 0 instead of returning 'message too large'. Walk the tail
+    through awkward alignments with small messages, then push a >half-ring
+    message at each position."""
+    cap = 1024
+    q = ShmMessageQueue(make_queue_name("t7"), capacity=cap)
+    big = os.urandom(cap - 4)  # the largest message that can ever fit
+    try:
+        for step in range(40):
+            # advance tail by an odd amount, ring returns to empty
+            filler = bytes([step % 251]) * (37 + 13 * step % 300)
+            q.push(filler, timeout_s=1.0)
+            assert q.pop(timeout_s=1.0) == filler
+            # ring is empty; the big push must succeed regardless of tail
+            q.push(big, timeout_s=1.0)
+            assert q.pop(timeout_s=1.0) == big
+        assert q.used_bytes() == 0
+    finally:
+        q.destroy()
