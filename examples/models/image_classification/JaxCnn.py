@@ -32,9 +32,11 @@ from rafiki_tpu.sdk import (
     FixedKnob,
     FloatKnob,
     IntegerKnob,
+    cached_trainer,
     classification_accuracy,
     dataset_utils,
     softmax_classifier_loss,
+    tunable_optimizer,
 )
 
 
@@ -96,11 +98,18 @@ class JaxCnn(BaseModel):
         return core.dense(params["head"], x).astype(jnp.float32)
 
     def _build_trainer(self):
-        return DataParallelTrainer(
+        # Cached by the knobs that change the compiled program; lr is a
+        # *dynamic* hyperparam (tunable_optimizer), so HPO trials that
+        # differ only in lr share one jitted step — zero recompiles after
+        # the first trial of each architecture bucket.
+        key = ("JaxCnn", self._knobs["num_stages"],
+               self._knobs["base_channels"], self._knobs["image_size"])
+        return cached_trainer(key, lambda: DataParallelTrainer(
             softmax_classifier_loss(self._apply),
-            optax.adamw(self._knobs["learning_rate"]),
+            tunable_optimizer(optax.adamw,
+                              learning_rate=self._knobs["learning_rate"]),
             predict_fn=lambda p, x: jax.nn.softmax(self._apply(p, x), axis=-1),
-        )
+        ))
 
     # -- data --------------------------------------------------------------
 
@@ -116,7 +125,9 @@ class JaxCnn(BaseModel):
         self._num_classes = int(y.max()) + 1
         self._trainer = self._build_trainer()
         init_fn = self._make_init(x.shape[-1], self._num_classes)
-        params, opt_state = self._trainer.init(init_fn)
+        params, opt_state = self._trainer.init(
+            init_fn,
+            hyperparams={"learning_rate": self._knobs["learning_rate"]})
         self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         params, _ = self._trainer.fit(
             params,

@@ -31,9 +31,11 @@ from rafiki_tpu.sdk import (
     FixedKnob,
     FloatKnob,
     IntegerKnob,
+    cached_trainer,
     classification_accuracy,
     dataset_utils,
     softmax_classifier_loss,
+    tunable_optimizer,
 )
 
 
@@ -61,12 +63,16 @@ class JaxFeedForward(BaseModel):
         self._cfg = None
 
     def _build_trainer(self):
-        apply_fn = lambda p, x: feedforward.apply(p, x, self._cfg)
-        return DataParallelTrainer(
+        # cached by the frozen config (covers every shape-affecting knob);
+        # lr is dynamic, so HPO trials share one compiled step
+        cfg = self._cfg
+        apply_fn = lambda p, x: feedforward.apply(p, x, cfg)
+        return cached_trainer(("JaxFeedForward", cfg), lambda: DataParallelTrainer(
             softmax_classifier_loss(apply_fn),
-            optax.adam(self._knobs["learning_rate"]),
+            tunable_optimizer(optax.adam,
+                              learning_rate=self._knobs["learning_rate"]),
             predict_fn=lambda p, x: jax.nn.softmax(apply_fn(p, x), axis=-1),
-        )
+        ))
 
     def _load(self, dataset_uri):
         size = self._knobs["image_size"]
@@ -84,7 +90,8 @@ class JaxFeedForward(BaseModel):
         )
         self._trainer = self._build_trainer()
         params, opt_state = self._trainer.init(
-            lambda rng: feedforward.init(rng, self._cfg))
+            lambda rng: feedforward.init(rng, self._cfg),
+            hyperparams={"learning_rate": self._knobs["learning_rate"]})
         self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         self._params, _ = self._trainer.fit(
             params, opt_state, (x, y),

@@ -28,9 +28,11 @@ from rafiki_tpu.sdk import (
     DataParallelTrainer,
     FixedKnob,
     FloatKnob,
+    cached_trainer,
     classification_accuracy,
     dataset_utils,
     softmax_classifier_loss,
+    tunable_optimizer,
 )
 
 
@@ -57,12 +59,15 @@ class JaxVgg16(BaseModel):
         self._cfg = None
 
     def _build_trainer(self):
-        apply_fn = lambda p, x: vgg.apply(p, x, self._cfg)
-        return DataParallelTrainer(
+        # cached by the frozen config; lr is dynamic (see JaxCnn)
+        cfg = self._cfg
+        apply_fn = lambda p, x: vgg.apply(p, x, cfg)
+        return cached_trainer(("JaxVgg16", cfg), lambda: DataParallelTrainer(
             softmax_classifier_loss(apply_fn),
-            optax.adam(self._knobs["learning_rate"]),
+            tunable_optimizer(optax.adam,
+                              learning_rate=self._knobs["learning_rate"]),
             predict_fn=lambda p, x: jax.nn.softmax(apply_fn(p, x), axis=-1),
-        )
+        ))
 
     def _make_cfg(self, channels, num_classes):
         plan = (vgg.VGG16_PLAN if self._knobs["depth"] == "vgg16"
@@ -80,7 +85,8 @@ class JaxVgg16(BaseModel):
         self._cfg = self._make_cfg(x.shape[-1], int(y.max()) + 1)
         self._trainer = self._build_trainer()
         params, opt_state = self._trainer.init(
-            lambda rng: vgg.init(rng, self._cfg))
+            lambda rng: vgg.init(rng, self._cfg),
+            hyperparams={"learning_rate": self._knobs["learning_rate"]})
         self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         self._params, _ = self._trainer.fit(
             params, opt_state, (x, y),

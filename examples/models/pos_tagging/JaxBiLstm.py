@@ -32,7 +32,9 @@ from rafiki_tpu.sdk import (
     FixedKnob,
     FloatKnob,
     IntegerKnob,
+    cached_trainer,
     dataset_utils,
+    tunable_optimizer,
 )
 
 _PAD, _UNK = 0, 1
@@ -108,11 +110,14 @@ class JaxBiLstm(BaseModel):
             ids, mask = batch[..., 0], batch[..., 1].astype(jnp.float32)
             return jnp.argmax(bilstm.apply(params, ids, mask, cfg), axis=-1)
 
-        return DataParallelTrainer(
+        # cached by the frozen config (vocab/tag sizes, dims, max_len);
+        # lr is dynamic (see JaxCnn)
+        return cached_trainer(("JaxBiLstm", cfg), lambda: DataParallelTrainer(
             loss_fn,
-            optax.adam(self._knobs["learning_rate"]),
+            tunable_optimizer(optax.adam,
+                              learning_rate=self._knobs["learning_rate"]),
             predict_fn=predict_fn,
-        )
+        ))
 
     def train(self, dataset_uri):
         ids, mask, tags = self._load(dataset_uri, fit_vocab=True)
@@ -125,7 +130,8 @@ class JaxBiLstm(BaseModel):
         )
         self._trainer = self._build_trainer()
         params, opt_state = self._trainer.init(
-            lambda rng: bilstm.init(rng, self._cfg))
+            lambda rng: bilstm.init(rng, self._cfg),
+            hyperparams={"learning_rate": self._knobs["learning_rate"]})
         self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         drop_rng = np.random.default_rng(0)
         for epoch in range(self._knobs["epochs"]):

@@ -7,8 +7,11 @@ Reference analogue: rafiki/model/ (SURVEY.md §2.1)."""
 from rafiki_tpu.sdk.dataset import dataset_utils  # noqa: F401
 from rafiki_tpu.sdk.jax_backend import (  # noqa: F401
     DataParallelTrainer,
+    cached_trainer,
     classification_accuracy,
+    enable_persistent_compile_cache,
     softmax_classifier_loss,
+    tunable_optimizer,
 )
 from rafiki_tpu.sdk.knob import (  # noqa: F401
     BaseKnob,

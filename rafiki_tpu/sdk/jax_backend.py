@@ -18,9 +18,13 @@ models here describe *pure functions* — ``init_fn(rng) -> params`` and
 
 from __future__ import annotations
 
+import collections
+import logging
+import os
+import threading
 import time
 from functools import partial
-from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,9 +32,117 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from rafiki_tpu.parallel.mesh import DATA_AXIS, get_default_mesh
+from rafiki_tpu.parallel.mesh import DATA_AXIS, get_default_mesh, visible_devices
 
 LossFn = Callable[[Any, Any, jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Cross-trial compile reuse (SURVEY.md §7.3's trials/hour lever).
+#
+# The reference paid a container boot + pip install per trial (reference
+# scripts/start_worker.py:6-9); the TPU-native equivalent of that tax is XLA
+# recompilation. Two layers kill it:
+#
+# 1. `cached_trainer`: a process-level cache of trainer objects keyed by
+#    (model-declared static signature, this thread's device grant). Trials
+#    whose knobs differ only in *dynamic* hyperparameters (lr via
+#    `tunable_optimizer`) reuse the same jitted train step — zero retrace.
+# 2. `enable_persistent_compile_cache`: JAX's on-disk executable cache, so
+#    even fresh executor *processes* (ProcessPlacementManager) skip
+#    compilation for programs any previous process already built.
+
+_trainer_cache: "collections.OrderedDict[Hashable, Any]" = collections.OrderedDict()
+_trainer_cache_lock = threading.Lock()
+_TRAINER_CACHE_CAP = int(os.environ.get("RAFIKI_TRAINER_CACHE_CAP", "8"))
+
+
+def cached_trainer(key: Hashable, build: Callable[[], Any]) -> Any:
+    """Return a cached trainer for `key` (scoped to this thread's device
+    grant), building it with `build()` on first use.
+
+    The key must cover every knob that changes the *compiled program*:
+    architecture knobs, batch/image sizes if they alter shapes the trainer
+    bakes in, and the model class identity. Dynamic knobs (lr through
+    `tunable_optimizer`) stay out of the key — that is the point. LRU-capped
+    (RAFIKI_TRAINER_CACHE_CAP, default 8): evicted trainers just free their
+    executables; params live outside the trainer so nothing else is lost.
+    """
+    grant = tuple(d.id for d in visible_devices())
+    full_key = (key, grant)
+    with _trainer_cache_lock:
+        if full_key in _trainer_cache:
+            _trainer_cache.move_to_end(full_key)
+            return _trainer_cache[full_key]
+    trainer = build()
+    with _trainer_cache_lock:
+        if full_key not in _trainer_cache:
+            _trainer_cache[full_key] = trainer
+            while len(_trainer_cache) > _TRAINER_CACHE_CAP:
+                _trainer_cache.popitem(last=False)
+        _trainer_cache.move_to_end(full_key)
+        return _trainer_cache[full_key]
+
+
+def trainer_cache_clear() -> None:
+    with _trainer_cache_lock:
+        _trainer_cache.clear()
+
+
+def tunable_optimizer(make: Callable[..., optax.GradientTransformation],
+                      **hyperparams: float) -> optax.GradientTransformation:
+    """Wrap an optax factory so its hyperparameters become *dynamic* state
+    (optax.inject_hyperparams): ``tunable_optimizer(optax.adamw,
+    learning_rate=3e-4)``. The jitted train step is then identical for every
+    value — trials differing only in these knobs share one executable; the
+    per-trial value is set at ``DataParallelTrainer.init(...,
+    hyperparams={...})`` time."""
+    return optax.inject_hyperparams(make)(**hyperparams)
+
+
+def set_opt_hyperparams(opt_state: Any, hyperparams: Dict[str, float]) -> Any:
+    """Override injected hyperparameter values in an opt_state produced by a
+    `tunable_optimizer` (no-op keys raise — a typo must not silently train
+    at the wrong lr)."""
+    hp = getattr(opt_state, "hyperparams", None)
+    if hp is None:
+        raise ValueError(
+            "opt_state has no injected hyperparams; build the optimizer "
+            "with tunable_optimizer(...) to tune it across cached trials")
+    for k, v in hyperparams.items():
+        if k not in hp:
+            raise KeyError(f"optimizer has no hyperparam {k!r}; has {list(hp)}")
+        hp[k] = jnp.asarray(v, dtype=jnp.asarray(hp[k]).dtype)
+    return opt_state
+
+
+_persistent_cache_enabled = False
+
+
+def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Turn on JAX's on-disk compilation cache (idempotent). Executables
+    persist across processes, so a fresh worker re-running a known program
+    skips XLA entirely. Returns the cache dir, or None if unavailable."""
+    global _persistent_cache_enabled
+    if _persistent_cache_enabled:
+        return jax.config.jax_compilation_cache_dir
+    from rafiki_tpu import config as rconfig
+
+    cache_dir = (cache_dir
+                 or os.environ.get("RAFIKI_COMPILE_CACHE_DIR")
+                 or os.path.join(rconfig.WORKDIR, "xla_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # default threshold skips small programs; trials are mostly small
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _persistent_cache_enabled = True
+        return cache_dir
+    except Exception:
+        logger.exception("persistent compile cache unavailable")
+        return None
 
 
 def shuffled_batches(
@@ -99,11 +211,18 @@ class DataParallelTrainer:
     def device_put_params(self, params: Any) -> Any:
         return jax.device_put(params, self._repl)
 
-    def init(self, init_fn: Callable[[jax.Array], Any], seed: int = 0) -> Tuple[Any, Any]:
-        """Initialize (params, opt_state), replicated over the mesh."""
+    def init(self, init_fn: Callable[[jax.Array], Any], seed: int = 0,
+             hyperparams: Optional[Dict[str, float]] = None) -> Tuple[Any, Any]:
+        """Initialize (params, opt_state), replicated over the mesh.
+
+        ``hyperparams`` overrides injected optimizer values (see
+        `tunable_optimizer`) — how a cached trainer gets this trial's lr."""
         params = init_fn(jax.random.key(seed))
         params = self.device_put_params(params)
-        opt_state = jax.device_put(self.optimizer.init(params), self._repl)
+        opt_state = self.optimizer.init(params)
+        if hyperparams:
+            opt_state = set_opt_hyperparams(opt_state, hyperparams)
+        opt_state = jax.device_put(opt_state, self._repl)
         return params, opt_state
 
     # -- training ---------------------------------------------------------

@@ -32,6 +32,7 @@ from rafiki_tpu.constants import BudgetType
 from rafiki_tpu.db.database import Database
 from rafiki_tpu.parallel.mesh import set_device_grant
 from rafiki_tpu.placement.manager import ServiceContext
+from rafiki_tpu.sdk.jax_backend import enable_persistent_compile_cache
 from rafiki_tpu.sdk.log import ModelLogger
 from rafiki_tpu.sdk.model import load_model_class
 from rafiki_tpu.sdk.params import dump_params
@@ -66,6 +67,10 @@ class TrainWorker:
     def start(self, ctx: ServiceContext) -> None:
         """The trial loop; returns when budget is reached or stop is set."""
         set_device_grant(ctx.chips)
+        # on-disk XLA executable reuse across trials AND worker processes —
+        # the TPU-native answer to the reference's per-trial container boot
+        # cost (reference scripts/start_worker.py:6-9)
+        enable_persistent_compile_cache()
         try:
             self._loop(ctx)
         finally:

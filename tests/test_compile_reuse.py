@@ -1,0 +1,126 @@
+"""Cross-trial compile reuse (SURVEY.md §7.3): trials that differ only in
+dynamic hyperparameters (lr) must share one jitted train step — the
+trials/hour lever the reference could never pull (it paid a container boot
++ pip install per trial, reference scripts/start_worker.py:6-9)."""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rafiki_tpu.sdk.jax_backend import (
+    DataParallelTrainer,
+    cached_trainer,
+    set_opt_hyperparams,
+    softmax_classifier_loss,
+    trainer_cache_clear,
+    tunable_optimizer,
+)
+
+
+def _apply(params, x):
+    return x @ params["w"]
+
+
+def _init(rng):
+    return {"w": jax.random.normal(rng, (8, 4)) * 0.1}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    trainer_cache_clear()
+    yield
+    trainer_cache_clear()
+
+
+def _build(trace_counter):
+    def loss(params, batch, rng):
+        trace_counter.append(1)  # runs at TRACE time only
+        return softmax_classifier_loss(_apply)(params, batch, rng)
+
+    return DataParallelTrainer(
+        loss, tunable_optimizer(optax.adamw, learning_rate=1e-3))
+
+
+def test_same_key_returns_same_trainer_and_no_retrace():
+    traces = []
+    builds = []
+
+    def build():
+        builds.append(1)
+        return _build(traces)
+
+    x = np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32)
+    y = np.zeros((16,), np.int32)
+
+    # trial 1: lr=1e-3
+    t1 = cached_trainer(("m", "arch-a"), build)
+    p, o = t1.init(_init, hyperparams={"learning_rate": 1e-3})
+    p, o = t1.fit(p, o, (x, y), epochs=1, batch_size=16)
+
+    # trial 2: identical static knobs, different lr -> same trainer object,
+    # no rebuild, and the step function must NOT retrace
+    n_traces = len(traces)
+    t2 = cached_trainer(("m", "arch-a"), build)
+    assert t2 is t1
+    assert builds == [1]
+    p2, o2 = t2.init(_init, seed=1, hyperparams={"learning_rate": 5e-2})
+    p2, o2 = t2.fit(p2, o2, (x, y), epochs=1, batch_size=16)
+    assert len(traces) == n_traces, "second trial retraced the train step"
+
+    # different static key -> a different trainer
+    t3 = cached_trainer(("m", "arch-b"), build)
+    assert t3 is not t1
+
+
+def test_injected_lr_actually_changes_training():
+    """The shared executable must still honor each trial's lr (lr rides in
+    opt_state, not in the compiled program)."""
+    traces = []
+    t = cached_trainer(("m2",), lambda: _build(traces))
+    x = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.int32)
+
+    p0, o0 = t.init(_init, hyperparams={"learning_rate": 1e-6})
+    w_before = np.asarray(p0["w"]).copy()
+    p1, _ = t.fit(p0, o0, (x, y), epochs=1, batch_size=32)
+    tiny_delta = np.abs(np.asarray(p1["w"]) - w_before).max()
+
+    pb, ob = t.init(_init, hyperparams={"learning_rate": 0.5})
+    w_before = np.asarray(pb["w"]).copy()
+    pb2, _ = t.fit(pb, ob, (x, y), epochs=1, batch_size=32)
+    big_delta = np.abs(np.asarray(pb2["w"]) - w_before).max()
+
+    assert big_delta > 100 * tiny_delta, (tiny_delta, big_delta)
+
+
+def test_set_opt_hyperparams_rejects_typos():
+    opt = tunable_optimizer(optax.adamw, learning_rate=1e-3)
+    state = opt.init({"w": np.zeros((2, 2), np.float32)})
+    with pytest.raises(KeyError):
+        set_opt_hyperparams(state, {"learning_rte": 1e-2})
+    plain = optax.adamw(1e-3).init({"w": np.zeros((2, 2), np.float32)})
+    with pytest.raises(ValueError):
+        set_opt_hyperparams(plain, {"learning_rate": 1e-2})
+
+
+def test_device_grant_scopes_the_cache():
+    """Executors with different chip grants must not share trainers (their
+    meshes differ)."""
+    from rafiki_tpu.parallel.mesh import set_device_grant
+
+    traces = []
+    try:
+        set_device_grant([0, 1])
+        ta = cached_trainer(("m3",), lambda: _build(traces))
+        set_device_grant([2, 3])
+        tb = cached_trainer(("m3",), lambda: _build(traces))
+        assert ta is not tb
+        assert ta.mesh.devices.tolist() != tb.mesh.devices.tolist()
+    finally:
+        set_device_grant(None)
