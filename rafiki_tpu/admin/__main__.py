@@ -1,0 +1,63 @@
+"""Standalone admin server: `python -m rafiki_tpu.admin`.
+
+The analogue of the reference's `scripts/start_admin.py` (seed superadmin,
+serve the REST API until signalled). Config via env:
+
+    RAFIKI_WORKDIR      data/params/logs/db root      (default: cwd)
+    RAFIKI_DB_PATH      store file                    (default: WORKDIR/rafiki.sqlite3)
+    RAFIKI_ADMIN_HOST   bind host                     (default: 127.0.0.1)
+    RAFIKI_ADMIN_PORT   bind port                     (default: 3000; 0 = ephemeral)
+    RAFIKI_PLACEMENT    local | process               (default: local)
+    RAFIKI_BROKER       shm for the native data plane (forced by process mode)
+
+With RAFIKI_PLACEMENT=process, train/inference workers run as child
+*processes* with chip grants, shared SQLite/WAL metadata, shm serving
+queues, and HPO coordination back through this server's REST API — the
+single-host deployment story the reference delivered with Docker Swarm
+(reference scripts/start.sh:1-25, docs/src/dev/architecture.rst:17-48).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=os.environ.get("RAFIKI_LOG_LEVEL", "INFO"),
+        format="%(levelname)s:%(asctime)s:%(name)s: %(message)s",
+    )
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.db.database import Database
+
+    for sub in ("data", "params", "logs"):
+        os.makedirs(os.path.join(config.WORKDIR, sub), exist_ok=True)
+
+    admin = Admin(db=Database(config.DB_PATH))
+    host = os.environ.get("RAFIKI_ADMIN_HOST", "127.0.0.1")
+    port = int(os.environ.get("RAFIKI_ADMIN_PORT", "3000"))
+    server = AdminServer(admin, host=host, port=port).start()
+    placement = type(admin.placement).__name__
+    print(f"rafiki_tpu admin on http://{host}:{server.port} "
+          f"(db={admin.db.path}, placement={placement})", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        print("shutting down...", flush=True)
+        server.stop()
+        admin.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

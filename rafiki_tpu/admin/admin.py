@@ -16,6 +16,7 @@ REST use are the same code path.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -63,11 +64,33 @@ class Admin:
         self.db = db or Database()
         self.advisor_store = AdvisorStore()
         # RAFIKI_BROKER=shm selects the native cross-process data
-        # plane (cache/shm_broker.py); default is in-process
-        self.broker = make_broker()
-        self.placement = placement or LocalPlacementManager(
-            on_status=self._on_service_status
+        # plane (cache/shm_broker.py); default is in-process.
+        # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to
+        # the shm segments), so process mode forces the shm broker.
+        process_mode = (
+            placement is None
+            and os.environ.get("RAFIKI_PLACEMENT") == "process"
         )
+        if process_mode:
+            from rafiki_tpu.cache.shm_broker import ShmBroker
+
+            self.broker = ShmBroker()
+        else:
+            self.broker = make_broker()
+        if placement is not None:
+            self.placement = placement
+        elif process_mode:
+            from rafiki_tpu.placement.process import ProcessPlacementManager
+
+            self.placement = ProcessPlacementManager(
+                db=self.db,
+                broker=self.broker,
+                on_status=self._on_service_status,
+            )
+        else:
+            self.placement = LocalPlacementManager(
+                on_status=self._on_service_status
+            )
         if self.placement.on_status is None:
             self.placement.on_status = self._on_service_status
         self.services = ServicesManager(

@@ -85,6 +85,10 @@ class AdminServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        # worker *processes* coordinate HPO + events through this API;
+        # tell the placement layer where it lives (placement/process.py)
+        if hasattr(self.admin.placement, "admin_addr"):
+            self.admin.placement.admin_addr = (self.host, self.port)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )

@@ -117,7 +117,11 @@ class ServicesManager:
         )
         try:
             ctx = self._placement.create_service(
-                service["id"], ServiceType.TRAIN, worker.start, n_chips=n_chips
+                service["id"], ServiceType.TRAIN, worker.start,
+                n_chips=n_chips,
+                # declarative payload so process/remote placements can
+                # launch the worker without the closure
+                extra={"sub_train_job_id": sub_train_job_id},
             )
         except Exception:
             # the DB rows exist but placement never started the service
@@ -208,6 +212,8 @@ class ServicesManager:
                             worker.start,
                             n_chips=1,
                             best_effort_chips=True,
+                            extra={"inference_job_id": inference_job_id,
+                                   "trial_id": trial["id"]},
                         )
                     except Exception:
                         # close the row: it was never placed, and rollback

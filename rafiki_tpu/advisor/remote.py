@@ -1,0 +1,55 @@
+"""Advisor sessions over HTTP — the out-of-process worker's view.
+
+The reference's train workers talked to a separate advisor Flask service
+over HTTP (reference rafiki/worker/train.py:207-215, advisor/app.py:17-50).
+Here the advisor store lives inside the Admin process and is exposed on the
+admin REST API (`/advisors/*`, admin/http.py); `RemoteAdvisorStore` adapts
+that API to the in-process `AdvisorStore` interface the TrainWorker consumes
+— so parallel worker *processes* of one sub-train-job still coordinate
+through the single shared GP (the fix for reference train.py:213's
+uncoordinated parallel HPO carries over to multi-process placement).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from rafiki_tpu.client.client import Client
+from rafiki_tpu.sdk.knob import serialize_knob_config
+
+
+class _RemoteAdvisor:
+    """Duck-types BaseAdvisor for the one call TrainWorker makes on it."""
+
+    def __init__(self, client: Client, advisor_id: str):
+        self._client = client
+        self._id = advisor_id
+
+    def feedback(self, knobs: Dict[str, Any], score: float) -> None:
+        self._client.feedback_knobs(self._id, knobs, float(score))
+
+
+class RemoteAdvisorStore:
+    """AdvisorStore facade over the admin REST API (duck-typed; the
+    TrainWorker never imports the concrete class)."""
+
+    def __init__(self, client: Client):
+        self._client = client
+
+    def create_advisor(self, knob_config: Dict[str, Any],
+                       advisor_id: Optional[str] = None) -> str:
+        return self._client.create_advisor(
+            serialize_knob_config(knob_config), advisor_id=advisor_id)
+
+    def propose(self, advisor_id: str) -> Dict[str, Any]:
+        return self._client.propose_knobs(advisor_id)
+
+    def feedback(self, advisor_id: str, knobs: Dict[str, Any],
+                 score: float) -> Dict[str, Any]:
+        return self._client.feedback_knobs(advisor_id, knobs, float(score))
+
+    def get(self, advisor_id: str) -> _RemoteAdvisor:
+        return _RemoteAdvisor(self._client, advisor_id)
+
+    def delete_advisor(self, advisor_id: str) -> None:
+        self._client.delete_advisor(advisor_id)

@@ -284,6 +284,55 @@ class ShmBroker(Broker):
             self._pending.clear()
 
 
+class ShmBrokerClient:
+    """Worker-process side of the shm data plane.
+
+    The owner (`ShmBroker`, in the admin/predictor process) creates the
+    segments when a serving service is placed; a worker process built by
+    ProcessPlacementManager attaches to them by deterministic name —
+    the analogue of the reference's workers connecting to the Redis address
+    passed in their container env (reference rafiki/cache/cache.py:21,
+    services_manager env plumbing). `register_worker` therefore *attaches*
+    (with retry, the owner may still be creating) and `unregister_worker`
+    detaches without closing: segment lifecycle belongs to the owner, so a
+    crashed-and-restarted worker can re-attach and resume serving.
+    """
+
+    def __init__(self, prefix: str, attach_timeout_s: float = 10.0):
+        self.prefix = prefix
+        self._attach_timeout_s = attach_timeout_s
+        self._queues: Dict[Tuple[str, str], ShmWorkerQueue] = {}
+
+    def register_worker(self, inference_job_id: str,
+                        worker_id: str) -> ShmWorkerQueue:
+        deadline = time.monotonic() + self._attach_timeout_s
+        while True:
+            try:
+                wq = ShmWorkerQueue.attach(
+                    self.prefix, inference_job_id, worker_id)
+                break
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        self._queues[(inference_job_id, worker_id)] = wq
+        return wq
+
+    def unregister_worker(self, inference_job_id: str, worker_id: str) -> None:
+        wq = self._queues.pop((inference_job_id, worker_id), None)
+        if wq is not None:
+            # detach only (munmap, no shm_unlink — we are not the owner);
+            # do NOT close: the shared closed flag would kill the segment
+            # for the owner and for any restarted worker
+            wq._qq.destroy()
+            wq._rq.destroy()
+
+    def get_worker_queues(self, inference_job_id: str) -> Dict[str, Any]:
+        raise NotImplementedError(
+            "worker-side broker client cannot enumerate queues; the "
+            "predictor runs in the owner process")
+
+
 def make_broker() -> Broker:
     """RAFIKI_BROKER=shm -> native cross-process broker (with fallback);
     anything else -> in-process condition-variable broker."""

@@ -141,7 +141,17 @@ class Database:
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute("PRAGMA foreign_keys=ON")
+            # Cross-process story (ProcessPlacementManager): every worker
+            # process opens its own Database on the same WAL file; concurrent
+            # writers serialize on the file lock, waiting up to this budget
+            # instead of failing with 'database is locked'.
+            self._conn.execute("PRAGMA busy_timeout=15000")
             self._conn.executescript(_SCHEMA)
+
+    @property
+    def path(self) -> str:
+        """The backing file path (':memory:' for the in-memory store)."""
+        return self._path
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,296 @@
+"""Process-level service placement: workers as child processes.
+
+The reference deployed every dynamic worker as a Docker Swarm *container*
+with env-var plumbing and a restart-on-failure policy (reference
+rafiki/container/docker_swarm.py:122-148, scripts/start_worker.py:15-25).
+`ProcessPlacementManager` is the TPU-host analogue: each service is a child
+**process** launched on `python -m rafiki_tpu.worker.bootstrap` with
+
+- its chip grant in ``RAFIKI_CHIP_GRANT`` (indices into jax.devices() — the
+  analogue of ``CUDA_VISIBLE_DEVICES``, reference docker_swarm.py:122-126),
+- its payload ids (`sub_train_job_id` / `inference_job_id`+`trial_id`) in
+  env, the way the reference forwarded ``RAFIKI_SERVICE_ID`` etc.
+  (reference services_manager.py:307-318),
+- the metadata store reached by every process through the same SQLite/WAL
+  file, and the serving data plane through the native shm queues
+  (cache/shm_broker.py) — created owner-side here at placement time, so the
+  child only ever attaches,
+- HPO coordination through the admin REST API (advisor/remote.py), keeping
+  the shared-GP semantics across *processes*.
+
+Restart-on-failure parity: a child exiting non-zero while not being stopped
+is relaunched up to ``max_restarts`` times (reference
+container_manager.py:23-25); chips are released only when the child is
+actually gone.
+
+Status protocol: the child itself marks its service RUNNING (on ready) /
+STOPPED / ERRORED in the store, like the reference's in-container bootstrap
+(reference utils/service.py:10-46, 94-105). The monitor thread here is the
+backstop for children that die without writing (SIGKILL, interpreter
+crash).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.constants import ServiceStatus, ServiceType
+from rafiki_tpu.placement.manager import (
+    ChipAllocator,
+    InsufficientChipsError,
+    PlacementManager,
+    ServiceContext,
+    StatusFn,
+)
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class _ProcRunner:
+    def __init__(self, manager: "ProcessPlacementManager", ctx: ServiceContext,
+                 env: Dict[str, str], log_path: str):
+        self.manager = manager
+        self.ctx = ctx
+        self.env = env
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._proc_lock = threading.Lock()
+        self.thread = threading.Thread(
+            target=self._run, name=f"proc-svc-{ctx.service_id[:8]}",
+            daemon=True)
+
+    def _spawn(self) -> subprocess.Popen:
+        os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        logf = open(self.log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "rafiki_tpu.worker.bootstrap"],
+                env=self.env, cwd=_REPO_ROOT,
+                stdout=logf, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        finally:
+            logf.close()  # the child holds its own fd now
+        return proc
+
+    def _run(self) -> None:
+        mgr = self.manager
+        try:
+            restarts = 0
+            rc: Optional[int] = None
+            while not self.ctx.stop_event.is_set():
+                with self._proc_lock:
+                    self.proc = self._spawn()
+                rc = self._wait_current()
+                if self.ctx.stop_event.is_set() or rc == 0:
+                    break
+                logger.error(
+                    "service %s process exited rc=%s (log: %s)",
+                    self.ctx.service_id, rc, self.log_path)
+                restarts += 1
+                if restarts > mgr.max_restarts:
+                    self._report_final(ServiceStatus.ERRORED)
+                    return
+            self._report_final(
+                ServiceStatus.STOPPED if (rc == 0 or rc is None)
+                else ServiceStatus.ERRORED)
+        finally:
+            self.manager._on_runner_exit(self.ctx)
+
+    def _wait_current(self) -> Optional[int]:
+        with self._proc_lock:
+            proc = self.proc
+        if proc is None:
+            return None
+        while True:
+            try:
+                return proc.wait(timeout=0.5)
+            except subprocess.TimeoutExpired:
+                if self.ctx.stop_event.is_set():
+                    return self._terminate(proc)
+
+    def _terminate(self, proc: subprocess.Popen) -> Optional[int]:
+        """SIGTERM -> child marks its own status and exits; SIGKILL after
+        the grace period."""
+        try:
+            proc.terminate()
+        except ProcessLookupError:
+            return proc.poll()
+        try:
+            return proc.wait(timeout=self.manager.stop_grace_s)
+        except subprocess.TimeoutExpired:
+            logger.warning("service %s ignored SIGTERM; killing",
+                           self.ctx.service_id)
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            return proc.wait(timeout=5)
+
+    def _report_final(self, status_from_rc: str) -> None:
+        """Report the service's terminal status through on_status — ALWAYS,
+        even when the child already wrote its own row: the orchestration
+        side-effects (refresh_train_job_status etc.) live behind the
+        callback, and in process mode nobody else fires them after the last
+        worker exits. The child's self-written status wins over the
+        rc-derived one (it knows stop-vs-crash better than the exit code)."""
+        mgr = self.manager
+        final = status_from_rc
+        try:
+            if mgr.db is not None:
+                svc = mgr.db.get_service(self.ctx.service_id)
+                if svc is not None and svc["status"] in (
+                        ServiceStatus.STOPPED, ServiceStatus.ERRORED):
+                    final = svc["status"]
+            if mgr.on_status:
+                mgr.on_status(self.ctx.service_id, final)
+        except Exception:
+            logger.exception("final status report failed for %s",
+                             self.ctx.service_id)
+
+
+class ProcessPlacementManager(PlacementManager):
+    """Places services as child processes on this host.
+
+    Requirements: a file-backed store (``db.path`` != ':memory:') shared via
+    SQLite WAL, and for serving, a `ShmBroker` whose segments the children
+    attach to. ``admin_addr`` (host, port) of a running AdminServer enables
+    cross-process HPO coordination; without it train workers fall back to a
+    process-local advisor (the reference's uncoordinated-parallel-HPO
+    behavior) with a warning.
+    """
+
+    def __init__(
+        self,
+        db=None,
+        broker=None,
+        admin_addr: Optional[tuple] = None,
+        allocator: Optional[ChipAllocator] = None,
+        on_status: Optional[StatusFn] = None,
+        max_restarts: int = 3,
+        stop_grace_s: float = 15.0,
+    ):
+        self.db = db
+        self.broker = broker
+        self.admin_addr = admin_addr
+        self.allocator = allocator or ChipAllocator()
+        self.on_status = on_status
+        self.max_restarts = max_restarts
+        self.stop_grace_s = stop_grace_s
+        self._lock = threading.Lock()
+        self._runners: Dict[str, _ProcRunner] = {}
+
+    # -- PlacementManager --------------------------------------------------
+
+    def create_service(
+        self,
+        service_id: str,
+        service_type: str,
+        run_fn=None,  # declarative launch: the payload travels in `extra`
+        n_chips: int = 0,
+        extra: Optional[Dict[str, Any]] = None,
+        best_effort_chips: bool = False,
+    ) -> ServiceContext:
+        if self.db is None or self.db.path == ":memory:":
+            raise RuntimeError(
+                "ProcessPlacementManager needs a file-backed Database "
+                "(children open the same SQLite/WAL file)")
+        extra = dict(extra or {})
+        try:
+            chips = self.allocator.allocate(n_chips) if n_chips > 0 else []
+        except InsufficientChipsError:
+            if not best_effort_chips:
+                raise
+            chips = []
+        ctx = ServiceContext(
+            service_id=service_id,
+            service_type=service_type,
+            chips=chips,
+            stop_event=threading.Event(),
+            extra=extra,
+        )
+        try:
+            env = self._child_env(ctx)
+        except Exception:
+            self.allocator.release(chips)
+            raise
+        if service_type == ServiceType.INFERENCE and self.broker is not None:
+            # owner-side data-plane provisioning: create the query segment
+            # now so the child (and the predictor fan-out) can attach
+            self.broker.register_worker(extra["inference_job_id"], service_id)
+        log_path = os.path.join(
+            config.LOGS_DIR, f"service-{service_id}.log")
+        runner = _ProcRunner(self, ctx, env, log_path)
+        with self._lock:
+            self._runners[service_id] = runner
+        runner.thread.start()
+        return ctx
+
+    def destroy_service(self, service_id: str, wait: bool = True) -> None:
+        with self._lock:
+            runner = self._runners.pop(service_id, None)
+        if runner is None:
+            return  # tolerate concurrent deletion
+        runner.ctx.stop_event.set()
+        if wait:
+            runner.thread.join(timeout=self.stop_grace_s + 10)
+        if (self.broker is not None
+                and runner.ctx.service_type == ServiceType.INFERENCE):
+            job_id = runner.ctx.extra.get("inference_job_id")
+            if job_id:
+                try:
+                    self.broker.unregister_worker(job_id, service_id)
+                except Exception:
+                    logger.exception("broker unregister failed for %s",
+                                     service_id)
+
+    def stop_all(self) -> None:
+        with self._lock:
+            ids = list(self._runners)
+        for sid in ids:
+            self.destroy_service(sid)
+
+    # -- internals ---------------------------------------------------------
+
+    def _on_runner_exit(self, ctx: ServiceContext) -> None:
+        self.allocator.release(ctx.chips)
+
+    def _child_env(self, ctx: ServiceContext) -> Dict[str, str]:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (_REPO_ROOT, env.get("PYTHONPATH")) if p)
+        env["RAFIKI_SERVICE_ID"] = ctx.service_id
+        env["RAFIKI_SERVICE_TYPE"] = ctx.service_type
+        env["RAFIKI_DB_PATH"] = os.path.abspath(self.db.path)
+        env["RAFIKI_WORKDIR"] = config.WORKDIR
+        env["RAFIKI_CHIP_GRANT"] = ",".join(str(c) for c in ctx.chips)
+        # the process-wide fallback must not fight the explicit grant
+        env.pop("RAFIKI_VISIBLE_DEVICES", None)
+        if self.admin_addr is not None:
+            env["RAFIKI_ADMIN_ADDR"] = f"{self.admin_addr[0]}:{self.admin_addr[1]}"
+        if ctx.service_type == ServiceType.TRAIN:
+            env["RAFIKI_SUB_TRAIN_JOB_ID"] = ctx.extra["sub_train_job_id"]
+        elif ctx.service_type == ServiceType.INFERENCE:
+            env["RAFIKI_INFERENCE_JOB_ID"] = ctx.extra["inference_job_id"]
+            env["RAFIKI_TRIAL_ID"] = ctx.extra["trial_id"]
+            if self.broker is None or not hasattr(self.broker, "prefix"):
+                raise RuntimeError(
+                    "process-mode inference needs the shm broker "
+                    "(RAFIKI_BROKER=shm) so worker processes can attach "
+                    "to the serving data plane")
+            env["RAFIKI_BROKER_PREFIX"] = self.broker.prefix
+        else:
+            raise ValueError(
+                f"unsupported process service type {ctx.service_type!r}")
+        return env

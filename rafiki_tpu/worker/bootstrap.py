@@ -1,0 +1,147 @@
+"""Worker-process bootstrap: `python -m rafiki_tpu.worker.bootstrap`.
+
+The analogue of the reference's in-container entrypoint (reference
+scripts/start_worker.py:15-25 dispatching on RAFIKI_SERVICE_TYPE, and
+rafiki/utils/service.py:10-46 installing signal handlers and marking the
+service RUNNING/ERRORED in the store). Launched by ProcessPlacementManager
+with everything it needs in env:
+
+    RAFIKI_SERVICE_ID / RAFIKI_SERVICE_TYPE   identity + dispatch
+    RAFIKI_CHIP_GRANT                         comma-sep jax.devices() indices
+    RAFIKI_DB_PATH                            shared SQLite/WAL file
+    RAFIKI_SUB_TRAIN_JOB_ID                   (TRAIN)
+    RAFIKI_INFERENCE_JOB_ID, RAFIKI_TRIAL_ID  (INFERENCE)
+    RAFIKI_ADMIN_ADDR                         host:port for advisor/events
+    RAFIKI_BROKER_PREFIX                      shm data-plane namespace
+
+Status protocol: RUNNING is written on ctx.ready() (startup really
+succeeded), STOPPED on clean exit/SIGTERM, ERRORED on crash — rc mirrors it
+so the parent's monitor can backstop a silent death.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+import traceback
+
+logger = logging.getLogger(__name__)
+
+
+def _require(name: str) -> str:
+    v = os.environ.get(name)
+    if not v:
+        raise RuntimeError(f"bootstrap: missing env {name}")
+    return v
+
+
+def main() -> int:
+    from rafiki_tpu import config
+    from rafiki_tpu.constants import ServiceType
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import ServiceContext
+
+    service_id = _require("RAFIKI_SERVICE_ID")
+    service_type = _require("RAFIKI_SERVICE_TYPE")
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(levelname)s:%(asctime)s:{service_id[:8]}:%(name)s: "
+               "%(message)s",
+    )
+
+    chips = [int(c) for c in os.environ.get("RAFIKI_CHIP_GRANT", "").split(",")
+             if c.strip()]
+    db = Database(_require("RAFIKI_DB_PATH"))
+
+    stop_event = threading.Event()
+
+    def on_signal(signum, frame):
+        logger.info("signal %s: stopping", signum)
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    ctx = ServiceContext(
+        service_id=service_id,
+        service_type=service_type,
+        chips=chips,
+        stop_event=stop_event,
+        on_ready=lambda: db.mark_service_as_running(service_id),
+    )
+
+    admin_client = None
+    addr = os.environ.get("RAFIKI_ADMIN_ADDR")
+    if addr:
+        from rafiki_tpu.client.client import Client
+
+        host, port = addr.rsplit(":", 1)
+        admin_client = Client(admin_host=host, admin_port=int(port))
+        admin_client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+
+    try:
+        if service_type == ServiceType.TRAIN:
+            _run_train(ctx, db, admin_client)
+        elif service_type == ServiceType.INFERENCE:
+            _run_inference(ctx, db)
+        else:
+            raise RuntimeError(f"bootstrap: unsupported type {service_type}")
+    except Exception:
+        logger.error("service crashed:\n%s", traceback.format_exc())
+        try:
+            db.mark_service_as_errored(service_id)
+        except Exception:
+            logger.exception("could not mark errored")
+        return 1
+    db.mark_service_as_stopped(service_id)
+    return 0
+
+
+def _run_train(ctx, db, admin_client) -> None:
+    from rafiki_tpu.worker.train import TrainWorker
+
+    if admin_client is not None:
+        from rafiki_tpu.advisor.remote import RemoteAdvisorStore
+
+        advisors = RemoteAdvisorStore(admin_client)
+
+        def send_event(name, payload):
+            admin_client.send_event(name, **payload)
+    else:
+        # no admin API reachable: process-local advisor (the reference's
+        # uncoordinated-parallel-HPO behavior, reference train.py:213)
+        from rafiki_tpu.advisor.advisor import AdvisorStore
+
+        logger.warning("no RAFIKI_ADMIN_ADDR; HPO is process-local")
+        advisors = AdvisorStore()
+        send_event = lambda name, payload: None  # noqa: E731
+
+    worker = TrainWorker(
+        _require("RAFIKI_SUB_TRAIN_JOB_ID"),
+        db,
+        advisors,
+        send_event=send_event,
+    )
+    worker.start(ctx)
+
+
+def _run_inference(ctx, db) -> None:
+    from rafiki_tpu.cache.shm_broker import ShmBrokerClient
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    broker = ShmBrokerClient(_require("RAFIKI_BROKER_PREFIX"))
+    worker = InferenceWorker(
+        _require("RAFIKI_INFERENCE_JOB_ID"),
+        _require("RAFIKI_TRIAL_ID"),
+        db,
+        broker,
+    )
+    worker.start(ctx)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Full AutoML cycle with out-of-process workers.
+
+The multi-process deployment story (reference analogue: workers as swarm
+containers, reference rafiki/container/docker_swarm.py:14-181 +
+scripts/start_worker.py:15-25): train and inference workers run as child
+processes sharing the SQLite/WAL store, coordinating HPO through the admin
+REST API, and serving through the native shm data plane.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.admin.http import AdminServer
+from rafiki_tpu.constants import TrainJobStatus, TrialStatus
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.native.shm_queue import available as shm_available
+from rafiki_tpu.placement.process import ProcessPlacementManager
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fake_model.py")
+
+pytestmark = pytest.mark.skipif(
+    not shm_available(), reason="native shm queue unavailable")
+
+
+@pytest.fixture()
+def proc_admin(tmp_workdir, monkeypatch):
+    monkeypatch.setenv("RAFIKI_PLACEMENT", "process")
+    admin = Admin(
+        db=Database(str(tmp_workdir / "rafiki.sqlite3")),
+        params_dir=str(tmp_workdir / "params"),
+    )
+    assert isinstance(admin.placement, ProcessPlacementManager)
+    server = AdminServer(admin).start()
+    yield admin
+    server.stop()
+    admin.shutdown()
+
+
+def _login(admin):
+    from rafiki_tpu import config
+
+    return admin.authenticate_user(
+        config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+
+
+@pytest.mark.slow
+def test_full_cycle_with_process_workers(proc_admin):
+    admin = proc_admin
+    uid = _login(admin)["user_id"]
+    with open(FIXTURE, "rb") as f:
+        admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION", f.read(),
+                           "FakeModel")
+    admin.create_train_job(
+        uid, "procapp", "IMAGE_CLASSIFICATION", "uri://train", "uri://test",
+        budget={"MODEL_TRIAL_COUNT": 3, "CHIP_COUNT": 2},
+    )
+    job = admin.wait_until_train_job_stopped(uid, "procapp", timeout_s=120)
+    assert job["status"] == TrainJobStatus.STOPPED
+
+    trials = admin.get_trials_of_train_job(uid, "procapp")
+    completed = [t for t in trials if t["status"] == TrialStatus.COMPLETED]
+    assert len(completed) >= 3
+    # trial rows were written by the worker processes; logs flowed through
+    # the shared store
+    logs = admin.get_trial_logs(completed[0]["id"])
+    assert any(m["message"] == "train done" for m in logs["messages"])
+
+    # parallel worker processes shared one advisor session through the REST
+    # API: the GP proposed distinct knob points across processes
+    knob_sets = {str(sorted(t["knobs"].items())) for t in completed}
+    assert len(knob_sets) >= 2
+
+    # serving: worker process attaches to the shm data plane
+    admin.create_inference_job(uid, "procapp")
+    preds = admin.predict(uid, "procapp", [[0.0], [1.0]])
+    assert preds[0] == [0.5, 0.5] and len(preds) == 2
+
+    t0 = time.monotonic()
+    admin.predict(uid, "procapp", [[0.5]])
+    assert time.monotonic() - t0 < 0.25, "cross-process serving beat the poll floor"
+
+    admin.stop_all_jobs()
+
+
+@pytest.mark.slow
+def test_errored_child_is_restarted_then_marked(proc_admin):
+    """Restart-on-failure parity (reference container_manager.py:23-25): a
+    child that keeps dying is relaunched max_restarts times, then ERRORED."""
+    admin = proc_admin
+    admin.placement.max_restarts = 1
+    svc = admin.db.create_service("TRAIN", replicas=1)
+    ctx = admin.placement.create_service(
+        svc["id"], "TRAIN", None, n_chips=0,
+        extra={"sub_train_job_id": "no-such-sub-job"})
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        row = admin.db.get_service(svc["id"])
+        if row["status"] == "ERRORED":
+            break
+        time.sleep(0.5)
+    assert admin.db.get_service(svc["id"])["status"] == "ERRORED"
+    log = os.path.join(
+        os.environ["RAFIKI_WORKDIR"], "logs", f"service-{svc['id']}.log")
+    assert os.path.exists(log)
+    admin.placement.destroy_service(svc["id"])
