@@ -1,0 +1,171 @@
+"""Flagship-model benchmarks: step time, throughput, and MFU on the live
+backend.
+
+Fills the BASELINE.md "Measured TPU baselines" rows the AutoML bench can't:
+ViT-B/16 (the BASELINE.json north-star config) and the progressive GAN (the
+reference fork's marquee model, reference pg_gans.py). FLOPs come from
+XLA's own cost analysis of the compiled step (falling back to an analytic
+transformer estimate), so
+
+    MFU = program_flops / (step_time * peak_flops)
+
+is the compiler's count, not a hand-wave. Peak chip flops defaults to the
+v5e bf16 number and is overridable with RAFIKI_PEAK_TFLOPS.
+
+Run standalone (`python bench_models.py`) for a JSON report, or let
+bench.py embed the numbers in its one-line summary (RAFIKI_BENCH_MODELS=0
+skips).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+# v5e: 197 TFLOP/s bf16 per chip (public spec); override for other parts
+PEAK_TFLOPS = float(os.environ.get("RAFIKI_PEAK_TFLOPS", "197"))
+
+
+def _compiled_flops(jitted, *args) -> Optional[float]:
+    """XLA's own FLOP estimate for the compiled program (None if the
+    backend doesn't report one)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):  # per-device list on some backends
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _time_steps(run_step, n_steps: int) -> float:
+    """Median wall-clock seconds per step (run_step must block on device)."""
+    times = []
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        run_step()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_vit(batch_size: int = 64, image_size: int = 224,
+              n_steps: int = 20) -> Dict[str, Any]:
+    """ViT-B/16 fused train step (fwd+bwd+adamw), bf16 activations."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from rafiki_tpu.models import vit
+
+    cfg = vit.vit_b16(num_classes=1000, image_size=image_size)
+    params = jax.jit(lambda r: vit.init(r, cfg))(jax.random.key(0))
+    opt = optax.adamw(1e-3)
+    opt_state = jax.jit(opt.init)(params)
+
+    def loss_fn(p, batch, rng):
+        x, y = batch
+        logits = vit.apply(p, x, cfg, rng, deterministic=False)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    @jax.jit
+    def train_step(p, s, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch, rng)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    x = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
+    y = jnp.zeros((batch_size,), jnp.int32)
+    rng = jax.random.key(1)
+
+    flops = _compiled_flops(train_step, params, opt_state, (x, y), rng)
+    # warmup (compile + first dispatch)
+    params, opt_state, loss = train_step(params, opt_state, (x, y), rng)
+    jax.block_until_ready(loss)
+
+    state = {"p": params, "s": opt_state}
+
+    def one():
+        state["p"], state["s"], loss = train_step(
+            state["p"], state["s"], (x, y), rng)
+        jax.block_until_ready(loss)
+
+    step_s = _time_steps(one, n_steps)
+    out = {
+        "model": "ViT-B/16",
+        "batch_size": batch_size,
+        "step_time_ms": round(step_s * 1000, 2),
+        "steps_per_s": round(1.0 / step_s, 3),
+        "images_per_s": round(batch_size / step_s, 1),
+        "backend": jax.default_backend(),
+    }
+    if flops is not None:
+        out["step_tflops"] = round(flops / 1e12, 3)
+        out["mfu"] = round(flops / (step_s * PEAK_TFLOPS * 1e12), 4)
+    return out
+
+
+def bench_pggan(resolution: int = 64, minibatch: int = 64,
+                n_steps: int = 20) -> Dict[str, Any]:
+    """Progressive-GAN D+G step at full resolution (the steady-state cost
+    once growth completes — the reference's headline img/s regime)."""
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models import pggan
+
+    cfg = pggan.PgganConfig(resolution=resolution)
+    trainer = pggan.PgganTrainer(cfg)
+    trainer.init_optimizers(1e-3, 1e-3)
+    max_stage = cfg.num_stages - 1
+    d_step, g_step = trainer._get_steps(max_stage, minibatch)
+    reals = jnp.zeros((minibatch, resolution, resolution, 3), jnp.float32)
+    lod = jnp.float32(0.0)
+    state = {"rng": jax.random.PRNGKey(0)}
+
+    def one():
+        state["rng"], kd, kg = jax.random.split(state["rng"], 3)
+        trainer.d_params, trainer._opt_state["d"], d_loss, _ = d_step(
+            trainer.d_params, trainer.g_params, trainer._opt_state["d"],
+            reals, None, lod, kd)
+        trainer.g_params, trainer._opt_state["g"], g_loss = g_step(
+            trainer.g_params, trainer.d_params, trainer._opt_state["g"],
+            None, lod, kg)
+        jax.block_until_ready(g_loss)
+
+    one()  # warmup: compiles both D and G directions
+    step_s = _time_steps(one, n_steps)
+    return {
+        "model": f"PGGAN-{resolution}",
+        "minibatch": minibatch,
+        "step_time_ms": round(step_s * 1000, 2),
+        "images_per_s": round(minibatch / step_s, 1),
+        "kimg_per_hour": round(minibatch / step_s * 3.6, 1),
+        "backend": jax.default_backend(),
+    }
+
+
+def run_all(small: bool = False) -> Dict[str, Any]:
+    """All flagship benches; ``small`` shrinks shapes for CPU smoke."""
+    if small:
+        return {
+            "vit": bench_vit(batch_size=4, image_size=64, n_steps=3),
+            "pggan": bench_pggan(resolution=16, minibatch=8, n_steps=3),
+        }
+    return {
+        "vit": bench_vit(),
+        "pggan": bench_pggan(),
+    }
+
+
+if __name__ == "__main__":
+    import jax
+
+    small = jax.default_backend() == "cpu" or bool(
+        os.environ.get("RAFIKI_BENCH_SMALL"))
+    print(json.dumps(run_all(small=small), indent=2))

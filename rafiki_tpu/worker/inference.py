@@ -15,8 +15,9 @@ load without adding idle latency.
 from __future__ import annotations
 
 import logging
+import threading
 import traceback
-from typing import Optional
+from typing import Dict, Optional
 
 from rafiki_tpu import config
 from rafiki_tpu.cache.queue import Broker
@@ -27,6 +28,26 @@ from rafiki_tpu.sdk.model import load_model_class
 from rafiki_tpu.sdk.params import load_params
 
 logger = logging.getLogger(__name__)
+
+# Per-service serving counters (batches served, queries served), updated by
+# the worker loop so benchmarks and ops can compute *batch occupancy* —
+# mean queries/batch, the signal that continuous batching actually
+# coalesces under concurrent load instead of serving singletons.
+_stats_lock = threading.Lock()
+SERVING_STATS: Dict[str, Dict[str, int]] = {}
+
+
+def serving_stats() -> Dict[str, Dict[str, int]]:
+    """Snapshot of {service_id: {batches, queries}} for this process."""
+    with _stats_lock:
+        return {k: dict(v) for k, v in SERVING_STATS.items()}
+
+
+def _record_batch(service_id: str, n_queries: int) -> None:
+    with _stats_lock:
+        s = SERVING_STATS.setdefault(service_id, {"batches": 0, "queries": 0})
+        s["batches"] += 1
+        s["queries"] += n_queries
 
 
 class InferenceWorker:
@@ -69,6 +90,7 @@ class InferenceWorker:
                 )
                 if not batch:
                     continue
+                _record_batch(ctx.service_id, len(batch))
                 futures = [f for f, _ in batch]
                 queries = [q for _, q in batch]
                 try:
