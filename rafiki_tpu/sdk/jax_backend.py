@@ -128,6 +128,13 @@ def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> Optional
     global _persistent_cache_enabled
     if _persistent_cache_enabled:
         return jax.config.jax_compilation_cache_dir
+    # CPU AOT cache entries are tied to exact machine-feature sets and can
+    # fail to load (or SIGILL) when the detected features differ between
+    # compile and load; the cache pays off on TPU where compiles are slow,
+    # so restrict it there unless explicitly forced.
+    if (jax.default_backend() == "cpu"
+            and not os.environ.get("RAFIKI_COMPILE_CACHE_CPU")):
+        return None
     from rafiki_tpu import config as rconfig
 
     cache_dir = (cache_dir

@@ -28,3 +28,24 @@ def test_ptb_format_converter():
 
 def test_image_records_converter():
     _load_module("image_generation/load_image_records.py")._selftest()
+
+
+def test_cifar10_converter():
+    _load_module("image_classification/load_cifar10.py")._selftest()
+
+
+def test_cifar10_synthetic_is_learnable():
+    """The no-egress surrogate must be structured enough that a linear probe
+    clears chance by a wide margin (scores on it are meaningful)."""
+    import numpy as np
+
+    mod = _load_module("image_classification/load_cifar10.py")
+    (xtr, ytr), (xte, yte) = mod.synthetic_cifar(2000, 500)
+    xtr = xtr.reshape(len(xtr), -1).astype(np.float32) / 255.0
+    xte = xte.reshape(len(xte), -1).astype(np.float32) / 255.0
+    # one-step ridge classifier (closed form)
+    onehot = np.eye(10)[ytr]
+    w = np.linalg.solve(
+        xtr.T @ xtr + 10.0 * np.eye(xtr.shape[1]), xtr.T @ onehot)
+    acc = float((np.argmax(xte @ w, axis=1) == yte).mean())
+    assert acc > 0.5, f"surrogate barely learnable: linear acc {acc}"
