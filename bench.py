@@ -203,12 +203,19 @@ def main():
             admin.shutdown()
 
     result = {
-        "metric": "AutoML trials/hour/chip (CIFAR-10 CNN, 1-epoch trials)",
+        "metric": ("AutoML trials/hour/chip (CIFAR-10-surrogate CNN, 1-epoch "
+                   "trials) vs reference 12/hr structural bound"),
         "value": round(trials_per_hour_chip, 2),
         "unit": "trials/hour/chip",
         "vs_baseline": round(trials_per_hour_chip / REFERENCE_TRIALS_PER_HOUR, 2),
+        "vs_baseline_note": ("denominator is the reference's structural bound "
+                             "of 12 no-op trials/hour implied by its 5-min "
+                             "test budget (test/test_train_jobs.py:11), not a "
+                             "measured run"),
         "trials_completed": n_done,
-        "best_trial_accuracy": round(best_score, 4) if best_score else None,
+        # accuracy is on the deterministic CIFAR-10-shaped surrogate (zero
+        # egress in this env), not real CIFAR-10 — hence the explicit name
+        "best_trial_accuracy_surrogate": round(best_score, 4) if best_score else None,
         "train_wall_s": round(train_wall, 1),
         "reference_p50_floor_ms": REFERENCE_P50_FLOOR_MS,
         "n_chips_visible": n_chips,

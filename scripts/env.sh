@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Single source of deployment config, sourced by every script in this dir.
+# The analogue of the reference's .env.sh (reference .env.sh:1-60): secrets,
+# host/port, paths, mode — but for a TPU-VM process deployment instead of a
+# Docker Swarm one.
+
+export RAFIKI_WORKDIR="${RAFIKI_WORKDIR:-$(pwd)/rafiki_workdir}"
+export RAFIKI_DB_PATH="${RAFIKI_DB_PATH:-$RAFIKI_WORKDIR/rafiki.sqlite3}"
+export RAFIKI_ADMIN_HOST="${RAFIKI_ADMIN_HOST:-127.0.0.1}"
+export RAFIKI_ADMIN_PORT="${RAFIKI_ADMIN_PORT:-3000}"
+
+# local   = workers as threads inside the admin process (dev)
+# process = workers as child processes with chip grants + shm data plane (prod)
+export RAFIKI_PLACEMENT="${RAFIKI_PLACEMENT:-process}"
+
+export SUPERADMIN_EMAIL="${SUPERADMIN_EMAIL:-superadmin@rafiki}"
+export SUPERADMIN_PASSWORD="${SUPERADMIN_PASSWORD:-rafiki}"
+export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
+
+# Persistent XLA compile cache shared across trials/restarts
+# (replaces the reference's per-boot `pip install` warmup cost,
+# reference scripts/start_worker.py:6-9).
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$RAFIKI_WORKDIR/xla_cache}"
+
+RAFIKI_PID_FILE="$RAFIKI_WORKDIR/admin.pid"
+RAFIKI_ADMIN_LOG="$RAFIKI_WORKDIR/logs/admin.log"

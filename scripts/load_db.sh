@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Restore the metadata store from a SQL dump (analogue of reference
+# scripts/load_db.sh). Refuses to clobber an existing db unless -f is given.
+# Usage: scripts/load_db.sh [-f] [in.sql]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source scripts/env.sh
+
+FORCE=0
+if [ "${1:-}" = "-f" ]; then FORCE=1; shift; fi
+IN="${1:-$RAFIKI_WORKDIR/db.dump.sql}"
+
+if [ -f "$RAFIKI_DB_PATH" ] && [ "$FORCE" != "1" ]; then
+    echo "refusing to overwrite $RAFIKI_DB_PATH (use -f to force)" >&2
+    exit 1
+fi
+mkdir -p "$(dirname "$RAFIKI_DB_PATH")"
+python - "$IN" "$RAFIKI_DB_PATH" <<'EOF'
+import os, sqlite3, sys
+src, dst = sys.argv[1], sys.argv[2]
+if os.path.exists(dst):
+    os.remove(dst)
+conn = sqlite3.connect(dst)
+with open(src) as f:
+    conn.executescript(f.read())
+conn.close()
+print(f"loaded {src} -> {dst}")
+EOF

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Dump the metadata store to SQL text (analogue of reference scripts/save_db.sh).
+# Usage: scripts/save_db.sh [out.sql]   (default: db.dump.sql next to the db)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source scripts/env.sh
+
+OUT="${1:-$RAFIKI_WORKDIR/db.dump.sql}"
+python - "$RAFIKI_DB_PATH" "$OUT" <<'EOF'
+import sqlite3, sys
+src, out = sys.argv[1], sys.argv[2]
+conn = sqlite3.connect(f"file:{src}?mode=ro", uri=True)
+with open(out, "w") as f:
+    for line in conn.iterdump():
+        f.write(line + "\n")
+conn.close()
+print(f"dumped {src} -> {out}")
+EOF

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# One-command stack boot (analogue of reference scripts/start.sh:1-25).
+# Starts the admin server; with RAFIKI_PLACEMENT=process (the default here)
+# that single entrypoint owns the whole stack: train/inference workers are
+# spawned as chip-affine child processes on demand, metadata is SQLite/WAL,
+# the serving data plane is the native shm queue. There is no separate db /
+# cache / advisor container to boot — those are in-process subsystems.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source scripts/env.sh
+
+mkdir -p "$RAFIKI_WORKDIR/logs"
+
+if [ -f "$RAFIKI_PID_FILE" ] && kill -0 "$(cat "$RAFIKI_PID_FILE")" 2>/dev/null; then
+    echo "admin already running (pid $(cat "$RAFIKI_PID_FILE"))"
+    exit 0
+fi
+
+nohup python -m rafiki_tpu.admin >"$RAFIKI_ADMIN_LOG" 2>&1 &
+echo $! > "$RAFIKI_PID_FILE"
+
+# Liveness gate (analogue of reference scripts/utils.sh ensure_stable):
+# wait for the server banner, fail if the process died.
+for _ in $(seq 1 60); do
+    if ! kill -0 "$(cat "$RAFIKI_PID_FILE")" 2>/dev/null; then
+        echo "admin failed to start; log tail:" >&2
+        tail -20 "$RAFIKI_ADMIN_LOG" >&2
+        rm -f "$RAFIKI_PID_FILE"
+        exit 1
+    fi
+    if grep -q "rafiki_tpu admin on" "$RAFIKI_ADMIN_LOG" 2>/dev/null; then
+        grep "rafiki_tpu admin on" "$RAFIKI_ADMIN_LOG"
+        echo "started (pid $(cat "$RAFIKI_PID_FILE"), log $RAFIKI_ADMIN_LOG)"
+        exit 0
+    fi
+    sleep 0.5
+done
+echo "admin did not report ready within 30s; see $RAFIKI_ADMIN_LOG" >&2
+exit 1

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Stop the stack (analogue of reference scripts/stop.sh). SIGTERM lets the
+# admin's shutdown path stop jobs and reap worker child processes gracefully.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source scripts/env.sh
+
+if [ ! -f "$RAFIKI_PID_FILE" ]; then
+    echo "not running (no pid file at $RAFIKI_PID_FILE)"
+    exit 0
+fi
+PID="$(cat "$RAFIKI_PID_FILE")"
+if kill -0 "$PID" 2>/dev/null; then
+    kill -TERM "$PID"
+    for _ in $(seq 1 40); do
+        kill -0 "$PID" 2>/dev/null || break
+        sleep 0.5
+    done
+    if kill -0 "$PID" 2>/dev/null; then
+        echo "graceful stop timed out; sending SIGKILL" >&2
+        kill -KILL "$PID"
+    fi
+fi
+rm -f "$RAFIKI_PID_FILE"
+echo "stopped"
