@@ -33,7 +33,7 @@ sys.path.insert(0, REPO)
 N_TRIALS = int(os.environ.get("RAFIKI_BENCH_TRIALS", 5))
 N_TRAIN = int(os.environ.get("RAFIKI_BENCH_TRAIN_N", 8192))
 N_TEST = int(os.environ.get("RAFIKI_BENCH_TEST_N", 2048))
-N_CLIENTS = int(os.environ.get("RAFIKI_BENCH_CLIENTS", 8))
+N_CLIENTS = int(os.environ.get("RAFIKI_BENCH_CLIENTS", 32))
 N_REQS_PER_CLIENT = int(os.environ.get("RAFIKI_BENCH_REQS", 40))
 BENCH_MODELS = os.environ.get("RAFIKI_BENCH_MODELS", "1") not in ("0", "false")
 REFERENCE_TRIALS_PER_HOUR = 12.0  # see module docstring
@@ -215,7 +215,8 @@ def main():
         "trials_completed": n_done,
         # accuracy is on the deterministic CIFAR-10-shaped surrogate (zero
         # egress in this env), not real CIFAR-10 — hence the explicit name
-        "best_trial_accuracy_surrogate": round(best_score, 4) if best_score else None,
+        "best_trial_accuracy_surrogate": (
+            round(best_score, 4) if best_score is not None else None),
         "train_wall_s": round(train_wall, 1),
         "reference_p50_floor_ms": REFERENCE_P50_FLOOR_MS,
         "n_chips_visible": n_chips,

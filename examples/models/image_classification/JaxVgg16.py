@@ -100,9 +100,22 @@ class JaxVgg16(BaseModel):
         return classification_accuracy(self._trainer, self._params, x, y)
 
     def predict(self, queries):
+        from rafiki_tpu import config as rconfig
+
         x = np.asarray(queries, dtype=np.float32)
-        probs = self._trainer.predict_batched(self._params, x)
+        # same cap as warm_up, so serving sizes stay on the warmed ladder
+        probs = self._trainer.predict_batched(
+            self._params, x, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
         return [p.tolist() for p in probs]
+
+    def warm_up(self):
+        # compile all serving batch buckets pre-traffic (see BaseModel.warm_up)
+        from rafiki_tpu import config as rconfig
+
+        size = self._knobs["image_size"]
+        example = np.zeros((size, size, self._cfg.channels), np.float32)
+        self._trainer.warm_predict(self._params, example,
+                                   batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
 
     def dump_parameters(self):
         return {

@@ -166,8 +166,20 @@ class JaxBiLstm(BaseModel):
         return float(correct / np.maximum(mask.sum(), 1.0))
 
     def _predict_ids(self, ids, mask):
+        from rafiki_tpu import config as rconfig
+
         packed = np.stack([ids, mask.astype(np.int32)], axis=-1)
-        return self._trainer.predict_batched(self._params, packed)
+        # same cap as warm_up, so serving sizes stay on the warmed ladder
+        return self._trainer.predict_batched(
+            self._params, packed, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
+
+    def warm_up(self):
+        # compile all serving batch buckets pre-traffic (see BaseModel.warm_up)
+        from rafiki_tpu import config as rconfig
+
+        example = np.zeros((self._max_len, 2), np.int32)
+        self._trainer.warm_predict(self._params, example,
+                                   batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
 
     def predict(self, queries):
         sentences = [(list(toks), None) for toks in queries]

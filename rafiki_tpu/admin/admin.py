@@ -338,6 +338,23 @@ class Admin:
             ],
         }
 
+    def get_train_jobs_of_user(self, user_id: str) -> List[Dict]:
+        """Light listing for dashboards: one row per train job, no worker
+        fan-out (the web UI's landing view)."""
+        return [
+            {
+                "id": j["id"],
+                "app": j["app"],
+                "app_version": j["app_version"],
+                "task": j["task"],
+                "status": j["status"],
+                "budget": j["budget"],
+                "datetime_started": j["datetime_started"],
+                "datetime_stopped": j["datetime_stopped"],
+            }
+            for j in self.db.get_train_jobs_of_user(user_id)
+        ]
+
     def get_train_jobs_of_app(self, user_id: str, app: str) -> List[Dict]:
         return [
             self.get_train_job(user_id, app, j["app_version"])

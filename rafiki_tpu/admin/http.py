@@ -15,6 +15,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import os
 import re
 import threading
 import traceback
@@ -138,6 +139,8 @@ class AdminServer:
                 A.create_train_job(
                     au["user_id"], b["app"], b["task"], b["train_dataset_uri"],
                     b["test_dataset_uri"], b.get("budget"), b.get("models"))),
+            r("GET", "/train_jobs", _ANY, lambda au, m, b, q:
+                A.get_train_jobs_of_user(au["user_id"])),
             r("GET", r"/train_jobs/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:
                 A.get_train_jobs_of_app(au["user_id"], m["app"])),
             r("GET", r"/train_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)", _ANY,
@@ -203,10 +206,33 @@ class AdminServer:
                 A.handle_event(m["name"], b) or {}),
         ]
 
+    # -- static web admin --------------------------------------------------
+
+    _WEB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "web")
+
+    def _serve_web(self, handler: BaseHTTPRequestHandler) -> None:
+        """Serve the single-file dashboard SPA (the analogue of the
+        reference's React/Express web admin, reference web/app.js:12-17 —
+        here one static HTML file against the same-origin REST API)."""
+        try:
+            with open(os.path.join(self._WEB_DIR, "index.html"), "rb") as f:
+                data = f.read()
+        except OSError:
+            self._respond(handler, 404, {"error": "web UI assets missing"})
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/html; charset=utf-8")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
         try:
             parsed = urlparse(handler.path)
             path = parsed.path.rstrip("/") or "/"
+            if method == "GET" and path == "/web":
+                self._serve_web(handler)
+                return
             query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             body: Dict[str, Any] = {}
             try:

@@ -86,14 +86,39 @@ class ServicesManager:
             # a running job.
             total_chips = min(total_chips, avail.total_chips)
         chips_per_sub = total_chips // len(sub_jobs) if sub_jobs else 0
+        # CHIPS_PER_TRIAL > 1 gives each trial executor its own multi-chip
+        # mesh (the executor's device grant IS its mesh — see
+        # worker/train.py set_device_grant -> parallel.get_default_mesh), so
+        # a single trial trains data/tensor/sequence-parallel across chips.
+        # The reference could never do this: 1 GPU per worker, hard-wired
+        # (reference services_manager.py:117-126).
+        chips_per_trial = max(int(budget.get(BudgetType.CHIPS_PER_TRIAL, 1)), 1)
 
         created: List[str] = []
         try:
             for sub in sub_jobs:
-                # one executor per chip; 0-chip fallback executor otherwise
-                n_workers = max(chips_per_sub, 1)
-                n_chips_each = 1 if chips_per_sub > 0 else 0
-                for _ in range(n_workers):
+                if chips_per_sub == 0:
+                    # 0-chip fallback executor (shared devices)
+                    workers = [0]
+                elif chips_per_sub < chips_per_trial:
+                    # downsized grant, like the chip-count clamp above —
+                    # still one multi-chip executor rather than failing
+                    workers = [chips_per_sub]
+                else:
+                    workers = [chips_per_trial] * (
+                        chips_per_sub // chips_per_trial
+                    )
+                    stranded = chips_per_sub % chips_per_trial
+                    if stranded:
+                        # uniform grants on purpose: a smaller leftover
+                        # executor would compile its own program instead of
+                        # sharing the cached step — but say so
+                        logger.info(
+                            "sub_train_job %s: %d of %d chips idle "
+                            "(CHIPS_PER_TRIAL=%d does not divide the "
+                            "per-model share)", sub["id"], stranded,
+                            chips_per_sub, chips_per_trial)
+                for n_chips_each in workers:
                     sid = self._create_train_worker(sub["id"], n_chips_each)
                     created.append(sid)
             self._wait_until_services_running(created)
@@ -193,6 +218,7 @@ class ServicesManager:
                 f"Train job {train_job['id']} has no completed trials"
             )
         created: List[str] = []
+        worker_trials: Dict[str, str] = {}
         try:
             for trial in best_trials:
                 for _ in range(config.INFERENCE_WORKER_REPLICAS_PER_TRIAL):
@@ -200,6 +226,7 @@ class ServicesManager:
                     self._db.create_inference_job_worker(
                         service["id"], inference_job_id, trial["id"]
                     )
+                    worker_trials[service["id"]] = trial["id"]
                     worker = InferenceWorker(
                         inference_job_id, trial["id"], self._db, self._broker
                     )
@@ -230,7 +257,8 @@ class ServicesManager:
                 inference_job_id, predictor_service["id"]
             )
             predictor = Predictor(
-                inference_job_id, self._broker, train_job["task"]
+                inference_job_id, self._broker, train_job["task"],
+                worker_trials=worker_trials,
             )
             with self._lock:
                 self._predictors[inference_job_id] = predictor

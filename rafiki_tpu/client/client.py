@@ -142,6 +142,11 @@ class Client:
             },
         )
 
+    def get_train_jobs(self) -> List[Dict]:
+        """All of this user's train jobs, newest first (the dashboard's
+        landing view)."""
+        return self._call("GET", "/train_jobs")
+
     def get_train_jobs_of_app(self, app: str) -> List[Dict]:
         return self._call("GET", f"/train_jobs/{app}")
 

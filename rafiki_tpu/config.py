@@ -24,10 +24,12 @@ SUPERADMIN_PASSWORD = os.environ.get("SUPERADMIN_PASSWORD", "rafiki")
 APP_SECRET = os.environ.get("APP_SECRET", "rafiki-tpu-dev-secret")
 TOKEN_TTL_HOURS = _env_int("TOKEN_TTL_HOURS", 24)
 
-# Serving fleet shape per inference job (reference rafiki/config.py:10-11).
+# Serving fleet shape per inference job — reference parity: 2 best trials
+# x 2 replicas each (reference rafiki/config.py:10-11). The predictor
+# load-balances within a trial's replicas and ensembles across trials.
 INFERENCE_MAX_BEST_TRIALS = _env_int("INFERENCE_MAX_BEST_TRIALS", 2)
 INFERENCE_WORKER_REPLICAS_PER_TRIAL = _env_int(
-    "INFERENCE_WORKER_REPLICAS_PER_TRIAL", 1
+    "INFERENCE_WORKER_REPLICAS_PER_TRIAL", 2
 )
 
 # Continuous-batching predictor knobs. The reference's serving pipeline had a

@@ -23,6 +23,11 @@ class BudgetType:
     GPU_COUNT = "GPU_COUNT"
     # Wall-clock budget in hours (new capability; the reference has none).
     TIME_HOURS = "TIME_HOURS"
+    # Chips granted to EACH trial executor (new capability): >1 gives every
+    # trial a multi-chip mesh — data/tensor/sequence-parallel training inside
+    # a trial, not just trial-parallelism. The reference was hard-wired to
+    # 1 GPU per worker (reference services_manager.py:117-126).
+    CHIPS_PER_TRIAL = "CHIPS_PER_TRIAL"
 
 
 class TaskType:

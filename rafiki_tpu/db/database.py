@@ -291,6 +291,16 @@ class Database:
             j["budget"] = json.loads(j["budget"])
         return j
 
+    def get_train_jobs_of_user(self, user_id: str) -> List[Dict]:
+        rows = self._all(
+            "SELECT * FROM train_job WHERE user_id=?"
+            " ORDER BY datetime_started DESC",
+            (user_id,),
+        )
+        for j in rows:
+            j["budget"] = json.loads(j["budget"])
+        return rows
+
     def get_train_jobs_of_app(self, user_id: str, app: str) -> List[Dict]:
         rows = self._all(
             "SELECT * FROM train_job WHERE user_id=? AND app=?"
@@ -442,6 +452,57 @@ class Database:
             ),
         )
         return self.get_trial(tid)  # type: ignore[return-value]
+
+    def reserve_trial(
+        self,
+        sub_train_job_id: str,
+        model_id: str,
+        knobs: Dict[str, Any],
+        worker_id: Optional[str] = None,
+        max_trials: Optional[int] = None,
+    ) -> Optional[Dict]:
+        """Atomically create a trial iff the sub-train-job's budget allows it.
+
+        Count-then-insert runs in ONE IMMEDIATE transaction, so N parallel
+        workers — threads sharing this handle or processes sharing the WAL
+        file — can never overshoot ``max_trials`` (the reference's
+        check-then-create raced the same way this repo's round-2
+        worker/train.py did). Returns the trial row, or None when the budget
+        is already spent."""
+        tid = uuid.uuid4().hex
+        with self._lock:
+            # IMMEDIATE takes the write lock up front: the count below can't
+            # be invalidated by another process between read and insert
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                if max_trials is not None:
+                    row = self._conn.execute(
+                        "SELECT COUNT(*) AS c FROM trial"
+                        " WHERE sub_train_job_id=? AND status != ?",
+                        (sub_train_job_id, TrialStatus.TERMINATED),
+                    ).fetchone()
+                    if row["c"] >= max_trials:
+                        self._conn.execute("ROLLBACK")
+                        return None
+                self._conn.execute(
+                    "INSERT INTO trial (id, sub_train_job_id, model_id,"
+                    " worker_id, knobs, status, datetime_started)"
+                    " VALUES (?,?,?,?,?,?,?)",
+                    (
+                        tid,
+                        sub_train_job_id,
+                        model_id,
+                        worker_id,
+                        json.dumps(knobs),
+                        TrialStatus.RUNNING,
+                        time.time(),
+                    ),
+                )
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+        return self.get_trial(tid)
 
     def get_trial(self, trial_id: str) -> Optional[Dict]:
         t = self._one("SELECT * FROM trial WHERE id=?", (trial_id,))

@@ -1,23 +1,29 @@
-"""Predictor: fan-out queries to per-trial inference workers, gather, and
-ensemble.
+"""Predictor: route queries to the serving fleet, gather, and ensemble.
 
 Parity with the reference's Predictor (reference
-rafiki/predictor/predictor.py:14-87): queries go to every registered worker of
-the inference job and the responses are ensembled per task. Differences:
+rafiki/predictor/predictor.py:14-87) — with the reference's two serving
+defects fixed by design:
 
-- futures + condition variables replace the 0.25 s Redis poll (the reference's
-  p50 floor, reference predictor.py:46-59);
-- a real timeout/SLO exists (`PREDICT_TIMEOUT_S`; the reference had a TODO at
-  predictor.py:45 and would wait forever on a dead worker) — workers that miss
-  the deadline are dropped from the ensemble rather than stalling the request;
-- ``predict_batch`` is implemented (the reference left it as a TODO at
-  predictor.py:85-87).
+- the reference fanned every query to *every* registered worker, including
+  replicas of the same trial (reference predictor.py:39-41), so replicas
+  multiplied work instead of capacity. Here workers are grouped by trial:
+  each request is ENSEMBLED across trials but LOAD-BALANCED (round-robin,
+  with failover to sibling replicas) within a trial's replicas;
+- futures + condition variables replace the 0.25 s Redis poll (the
+  reference's p50 floor, reference predictor.py:46-59), and a real
+  timeout/SLO exists (`PREDICT_TIMEOUT_S`; the reference had a TODO at
+  predictor.py:45 and would wait forever on a dead worker) — trials whose
+  replicas all miss the deadline are dropped from the ensemble rather than
+  stalling the request;
+- ``predict_batch`` is implemented (a reference TODO at predictor.py:85-87).
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
-from typing import Any, List, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import config
 from rafiki_tpu.cache.queue import Broker, QueryFuture
@@ -27,10 +33,18 @@ logger = logging.getLogger(__name__)
 
 
 class Predictor:
-    def __init__(self, inference_job_id: str, broker: Broker, task: Optional[str]):
+    def __init__(self, inference_job_id: str, broker: Broker,
+                 task: Optional[str],
+                 worker_trials: Optional[Dict[str, str]] = None):
+        """``worker_trials`` maps worker service_id -> trial_id (built by the
+        deploy path from the inference_job_worker rows). Workers absent from
+        the map are treated as single-replica trials of their own — the
+        fan-out-to-all behavior degrades gracefully, never silently drops."""
         self._job_id = inference_job_id
         self._broker = broker
         self._task = task
+        self._worker_trials = dict(worker_trials or {})
+        self._rr = itertools.count()
 
     def predict(self, query: Any, timeout_s: Optional[float] = None) -> Any:
         return self.predict_batch([query], timeout_s)[0]
@@ -38,39 +52,111 @@ class Predictor:
     def predict_batch(
         self, queries: List[Any], timeout_s: Optional[float] = None
     ) -> List[Any]:
-        """Fan each query out to every worker, gather with a deadline,
-        ensemble across the workers that answered."""
-        import time as _time
-
+        """One replica per trial answers each request (round-robin with
+        failover); the ensemble is across trials."""
         timeout_s = timeout_s if timeout_s is not None else config.PREDICT_TIMEOUT_S
-        deadline = _time.monotonic() + timeout_s
+        deadline = time.monotonic() + timeout_s
         queues = self._broker.get_worker_queues(self._job_id)
         if not queues:
             raise RuntimeError(
                 f"No inference workers registered for job {self._job_id}"
             )
-        futures: List[List[QueryFuture]] = [
-            [q.submit(query) for query in queries] for q in queues.values()
-        ]
-        worker_predictions: List[Optional[List[Any]]] = []
-        for worker_futs in futures:
-            preds: Optional[List[Any]] = []
-            for fut in worker_futs:
-                try:
-                    # one deadline shared by the whole request, not a fresh
-                    # timeout per future — a dead worker costs at most the SLO
-                    remaining = max(deadline - _time.monotonic(), 0.0)
-                    preds.append(fut.result(remaining))
-                except Exception as e:
-                    logger.warning("worker dropped from ensemble: %r", e)
-                    preds = None
-                    break
-            worker_predictions.append(preds)
-        answered = [p for p in worker_predictions if p is not None]
+        # group live workers by trial; unknown workers stand alone
+        groups: Dict[str, List[str]] = {}
+        for wid in queues:
+            groups.setdefault(self._worker_trials.get(wid, wid), []).append(wid)
+        rr = next(self._rr)
+        trial_predictions: List[Optional[List[Any]]] = []
+        # submit the first attempt for every trial up front so replicas of
+        # different trials run concurrently, then gather per trial
+        orders = {
+            trial: wids[rr % len(wids):] + wids[:rr % len(wids)]
+            for trial, wids in groups.items()
+        }
+        inflight = {
+            trial: [queues[order[0]].submit(q) for q in queries]
+            for trial, order in orders.items()
+        }
+        for trial, order in orders.items():
+            preds = self._gather_with_failover(
+                trial, order, queues, queries, inflight[trial], deadline)
+            trial_predictions.append(preds)
+        answered = [p for p in trial_predictions if p is not None]
         if not answered:
             raise TimeoutError("No inference worker answered within the SLO")
-        # transpose: ensemble expects [worker][query]
+        # transpose: ensemble expects [trial][query]
         return [
             ensemble_predictions([w[i] for w in answered], self._task)
             for i in range(len(queries))
         ]
+
+    def _gather_with_failover(self, trial, order, queues, queries,
+                              first_futs, deadline) -> Optional[List[Any]]:
+        """Gather one trial's predictions, hedging across its replicas.
+
+        The request deadline is split across the remaining replicas
+        (remaining/k) so a *silently* dead replica — no error, just no
+        answer — still leaves budget to try a sibling. Hedged batches are
+        never abandoned: once more than one batch is in flight, a poll loop
+        sweeps ALL of them, so a healthy-but-slow first replica that
+        answers after its hedge fired still serves the request within the
+        SLO."""
+        issued: List[List[QueryFuture]] = [list(first_futs)]
+        attempt = 0
+        while True:
+            attempts_left = len(order) - attempt
+            if attempts_left <= 0:
+                break
+            attempt_deadline = min(
+                deadline,
+                time.monotonic()
+                + max(deadline - time.monotonic(), 0.0) / attempts_left)
+            if len(issued) == 1:
+                # common case: one batch in flight — block directly, no
+                # polling overhead on the fast path
+                try:
+                    return [
+                        f.result(max(attempt_deadline - time.monotonic(), 0.0))
+                        for f in issued[0]
+                    ]
+                except Exception as e:
+                    logger.info("replica %s failed (%r); failing over",
+                                order[attempt], e)
+                    if isinstance(e, TimeoutError):
+                        # silent replica: keep its futures in the sweep pool
+                        pass
+                    else:
+                        issued.pop()
+            else:
+                preds = self._sweep(issued, attempt_deadline)
+                if preds is not None:
+                    return preds
+            attempt += 1
+            if attempt < len(order) and time.monotonic() < deadline:
+                issued.append(
+                    [queues[order[attempt]].submit(q) for q in queries])
+        # final sweep: any in-flight batch may still land before the SLO
+        preds = self._sweep(issued, deadline) if issued else None
+        if preds is None:
+            logger.warning("trial %s dropped from ensemble: no replica of %s "
+                           "answered within the SLO", trial, order)
+        return preds
+
+    @staticmethod
+    def _sweep(issued: List[List[QueryFuture]],
+               until: float) -> Optional[List[Any]]:
+        """Poll every in-flight batch until one completes or `until`.
+
+        20 ms granularity — only reached on the failover path, where a
+        replica already blew its share of the SLO."""
+        while True:
+            for futs in list(issued):
+                try:
+                    return [f.result(0.0) for f in futs]
+                except TimeoutError:
+                    continue  # not ready yet — keep it in the pool
+                except Exception:
+                    issued.remove(futs)  # replica answered with an error
+            if not issued or time.monotonic() >= until:
+                return None
+            time.sleep(min(0.02, max(until - time.monotonic(), 0.0)))

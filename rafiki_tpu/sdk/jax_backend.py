@@ -215,6 +215,30 @@ class DataParallelTrainer:
         r = -(-batch_size // self.n_data)
         return r * self.n_data
 
+    # Smallest predict bucket: padding 1 query to 8 wastes negligible
+    # compute, while halving the number of distinct compiled shapes.
+    MIN_PREDICT_BUCKET = 8
+
+    def predict_buckets(self, cap: int) -> list:
+        """The fixed ladder of compiled predict batch shapes: powers of two
+        from MIN_PREDICT_BUCKET up to ``cap`` (each rounded to a multiple of
+        the data-axis size) — at most log2(cap) executables ever exist, no
+        matter what batch sizes arrive at serving time."""
+        buckets = []
+        b = self.MIN_PREDICT_BUCKET
+        while b < cap:
+            buckets.append(self.round_batch(b))
+            b *= 2
+        buckets.append(self.round_batch(cap))
+        # rounding can collapse adjacent powers of two on wide meshes
+        return sorted(set(buckets))
+
+    def _bucket_for(self, n: int, cap: int) -> int:
+        for b in self.predict_buckets(cap):
+            if b >= n:
+                return b
+        return self.predict_buckets(cap)[-1]
+
     def device_put_params(self, params: Any) -> Any:
         return jax.device_put(params, self._repl)
 
@@ -283,23 +307,46 @@ class DataParallelTrainer:
     def predict_batched(
         self, params: Any, x: np.ndarray, batch_size: int = 256
     ) -> np.ndarray:
-        """Run ``predict_fn`` over `x` in fixed-size padded batches (static
-        shapes; at most log2 distinct compiled sizes)."""
+        """Run ``predict_fn`` over `x` in power-of-two padded buckets.
+
+        Serving batch sizes vary with load (the continuous batcher drains
+        1..cap queries per tick); compiling a shape per distinct size would
+        recompile mid-traffic and blow the tail latency. Instead every chunk
+        is padded up to the fixed bucket ladder (`predict_buckets`), so the
+        set of compiled shapes is small, static, and warmable at deploy.
+        """
         assert self.predict_fn is not None, "no predict_fn configured"
         n = len(x)
-        batch_size = self.round_batch(min(batch_size, max(n, 1)))
+        cap = self.round_batch(max(batch_size, 1))
         outs = []
         i = 0
         while i < n:
-            chunk = x[i : i + batch_size]
-            pad = batch_size - len(chunk)
+            chunk = x[i : i + cap]
+            bucket = self._bucket_for(len(chunk), cap)
+            pad = bucket - len(chunk)
             if pad:
                 chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
             out = self._predict(params, jax.device_put(chunk, self._data))
             out = np.asarray(out)
             outs.append(out[: len(out) - pad] if pad else out)
-            i += batch_size
+            i += bucket - pad
         return np.concatenate(outs) if outs else np.zeros((0,))
+
+    def warm_predict(self, params: Any, example: np.ndarray,
+                     batch_size: int = 256) -> int:
+        """Compile every predict bucket up front by running ``predict_fn``
+        on copies of ``example`` (one query's worth of input) at each bucket
+        size. Called at serving deploy so no real request ever pays a
+        compile. Returns the number of buckets warmed."""
+        assert self.predict_fn is not None, "no predict_fn configured"
+        example = np.asarray(example)
+        cap = self.round_batch(max(batch_size, 1))
+        buckets = self.predict_buckets(cap)
+        for b in buckets:
+            chunk = np.broadcast_to(example[None], (b,) + example.shape)
+            self._predict(params, jax.device_put(np.ascontiguousarray(chunk),
+                                                 self._data))
+        return len(buckets)
 
 
 def softmax_classifier_loss(apply_fn: Callable[..., jax.Array]) -> LossFn:

@@ -82,6 +82,16 @@ class BaseModel(abc.ABC):
     def load_parameters(self, params: Any) -> None:
         """Restore trained parameters produced by ``dump_parameters``."""
 
+    def warm_up(self) -> None:
+        """Optional serving warm-up, called once by the inference worker
+        after ``load_parameters`` and before the service reports ready.
+
+        Implementations should run ``predict`` on representative synthetic
+        queries at the batch sizes serving will use (e.g.
+        ``DataParallelTrainer.warm_predict``) so every compiled shape exists
+        before real traffic arrives — no request ever pays an XLA compile.
+        Default: no-op (non-JAX templates have nothing to warm)."""
+
     def destroy(self) -> None:
         """Release resources (default: no-op)."""
 

@@ -39,6 +39,18 @@ def _require(name: str) -> str:
 
 
 def main() -> int:
+    # Honor JAX_PLATFORMS in the child explicitly: site hooks that register
+    # a remote-TPU plugin can initialize it from backends() regardless of
+    # the env var, and a worker meant for CPU (tests, CPU-fallback
+    # services) must never block on a TPU tunnel. The config update wins
+    # as long as no computation has run yet (same trick as
+    # tests/conftest.py).
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+
     from rafiki_tpu import config
     from rafiki_tpu.constants import ServiceType
     from rafiki_tpu.db.database import Database

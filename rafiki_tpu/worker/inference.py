@@ -82,6 +82,16 @@ class InferenceWorker:
         queue = self._broker.register_worker(self._job_id, ctx.service_id)
         try:
             model = self._load_model()
+            try:
+                # compile every serving batch bucket before accepting
+                # traffic — a mid-traffic XLA compile is a multi-second
+                # p99 spike (the reference never compiled anything, but
+                # paid 0.25 s polls instead)
+                model.warm_up()
+            except Exception:
+                logger.warning(
+                    "warm_up failed in worker %s (serving anyway):\n%s",
+                    ctx.service_id, traceback.format_exc())
             ctx.ready()  # model + params loaded: startup succeeded
             while not ctx.stopping:
                 batch = queue.take_batch(

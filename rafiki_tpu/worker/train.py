@@ -107,11 +107,21 @@ class TrainWorker:
 
         while not ctx.stopping:
             # shared budget accounting through the DB (reference
-            # train.py:227-232)
+            # train.py:227-232) — but the reserve is ATOMIC (count + insert
+            # in one transaction, db.reserve_trial): the reference's
+            # check-then-create let N parallel workers overshoot the trial
+            # budget by up to N-1
             over_time = deadline is not None and time.time() >= deadline
-            if over_time or (
-                self._db.count_trials_of_sub_train_job(self._sub_id) >= max_trials
-            ):
+            trial = None
+            tracer = Tracer("pending")
+            if not over_time:
+                with tracer.span("propose"):
+                    knobs = self._advisors.propose(advisor_id)
+                trial = self._db.reserve_trial(
+                    self._sub_id, model["id"], knobs,
+                    worker_id=ctx.service_id, max_trials=max_trials,
+                )
+            if trial is None:
                 self._send_event(
                     EVENT_BUDGET_REACHED,
                     {
@@ -120,13 +130,6 @@ class TrainWorker:
                     },
                 )
                 return
-
-            tracer = Tracer("pending")
-            with tracer.span("propose"):
-                knobs = self._advisors.propose(advisor_id)
-            trial = self._db.create_trial(
-                self._sub_id, model["id"], knobs, worker_id=ctx.service_id
-            )
             tracer.trace_id = trial["id"]
             trial_logger = ModelLogger()
             trial_logger.set_sink(

@@ -1,0 +1,79 @@
+"""A model template that really trains data-parallel on whatever mesh its
+executor's chip grant provides, and reports the mesh size as its score —
+the fixture for multi-chip-trial stack tests (CHIPS_PER_TRIAL)."""
+
+import numpy as np
+
+from rafiki_tpu.sdk import (
+    BaseModel,
+    DataParallelTrainer,
+    FixedKnob,
+    FloatKnob,
+    softmax_classifier_loss,
+)
+
+
+class MeshProbeModel(BaseModel):
+    dependencies = {"jax": None, "optax": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "learning_rate": FloatKnob(1e-3, 1e-1, is_exp=True),
+            "dim": FixedKnob(4),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+        self._mesh_devices = None
+
+    def _build_trainer(self):
+        import jax.numpy as jnp
+        import optax
+
+        def apply_fn(params, x):
+            return x @ params["w"]
+
+        # a fresh trainer every time on purpose: the *test* is that the mesh
+        # comes from this executor's chip grant
+        return DataParallelTrainer(
+            softmax_classifier_loss(apply_fn),
+            optax.sgd(self._knobs["learning_rate"]),
+            predict_fn=apply_fn,
+        )
+
+    def train(self, dataset_uri):
+        d = self._knobs["dim"]
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, d)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        trainer = self._build_trainer()
+        self._mesh_devices = int(trainer.mesh.devices.size)
+        import jax.numpy as jnp
+
+        params, opt_state = trainer.init(
+            lambda k: {"w": jnp.zeros((d, 2), jnp.float32)})
+        params, _ = trainer.fit(params, opt_state, (x, y),
+                                epochs=2, batch_size=16)
+        self._params = params
+
+    def evaluate(self, dataset_uri):
+        # score == the number of devices this trial actually trained over
+        return float(self._mesh_devices)
+
+    def predict(self, queries):
+        trainer = self._build_trainer()
+        x = np.asarray(queries, dtype=np.float32)
+        return trainer.predict_batched(self._params, x).tolist()
+
+    def dump_parameters(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self._params),
+                "mesh_devices": self._mesh_devices}
+
+    def load_parameters(self, params):
+        self._params = params["params"]
+        self._mesh_devices = params["mesh_devices"]

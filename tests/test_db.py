@@ -94,3 +94,52 @@ def test_inference_job_queries(db):
     assert db.get_running_inference_job_of_train_job(job["id"])["id"] == inf["id"]
     db.mark_inference_job_as_stopped(inf["id"])
     assert db.get_running_inference_job_of_train_job(job["id"]) is None
+
+
+def test_reserve_trial_atomic_under_parallel_workers(tmp_path):
+    # N workers hammering reserve_trial — threads on a shared handle AND
+    # separate handles on the same WAL file (the process-placement shape) —
+    # must create EXACTLY max_trials trials (VERDICT r2 item 6)
+    import threading
+
+    path = str(tmp_path / "race.sqlite3")
+    db0 = Database(path)
+    user, model, job, sub = _seed(db0)
+    max_trials = 7
+    n_workers = 6
+    created = []
+    created_lock = threading.Lock()
+
+    def worker(own_handle):
+        d = Database(path) if own_handle else db0
+        try:
+            while True:
+                t = d.reserve_trial(sub["id"], model["id"], {"lr": 0.1},
+                                    worker_id=f"w", max_trials=max_trials)
+                if t is None:
+                    return
+                with created_lock:
+                    created.append(t["id"])
+        finally:
+            if own_handle:
+                d.close()
+
+    threads = [threading.Thread(target=worker, args=(i % 2 == 0,))
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(created) == max_trials
+    assert db0.count_trials_of_sub_train_job(sub["id"]) == max_trials
+    db0.close()
+
+
+def test_reserve_trial_ignores_terminated_trials(db):
+    user, model, job, sub = _seed(db)
+    t1 = db.reserve_trial(sub["id"], model["id"], {}, max_trials=1)
+    assert t1 is not None
+    assert db.reserve_trial(sub["id"], model["id"], {}, max_trials=1) is None
+    # terminated trials release their budget slot (they never produced work)
+    db.mark_trial_as_terminated(t1["id"])
+    assert db.reserve_trial(sub["id"], model["id"], {}, max_trials=1) is not None

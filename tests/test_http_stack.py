@@ -143,3 +143,34 @@ def test_unauthenticated_request_rejected(server):
     c = Client("127.0.0.1", server.port)
     with pytest.raises(RafikiError):
         c.get_models()
+
+
+def test_web_dashboard_served_and_jobs_listing(server, superadmin):
+    # the SPA must serve without auth (login happens in-page), and the
+    # listing endpoint it lands on must work through the client SDK
+    import requests
+
+    resp = requests.get(f"http://127.0.0.1:{server.port}/web")
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/html")
+    body = resp.text
+    # structural markers the SPA needs to function
+    for marker in ("rafiki_tpu", "viewJobs", "renderPlot", "/tokens"):
+        assert marker in body
+
+    assert superadmin.get_train_jobs() == []
+    superadmin.create_model("fake", "IMAGE_CLASSIFICATION", FIXTURE,
+                            "FakeModel")
+    superadmin.create_train_job(
+        "webapp", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 1})
+    import time as _time
+
+    deadline = _time.monotonic() + 30
+    while superadmin.get_train_job("webapp")["status"] not in (
+            "STOPPED", "ERRORED"):
+        assert _time.monotonic() < deadline, "train job did not stop"
+        _time.sleep(0.1)
+    jobs = superadmin.get_train_jobs()
+    assert len(jobs) == 1 and jobs[0]["app"] == "webapp"
+    assert jobs[0]["status"] == "STOPPED"

@@ -103,6 +103,52 @@ def test_predict_batched_handles_padding():
     np.testing.assert_allclose(out, x * 2)
 
 
+def test_predict_batched_uses_pow2_buckets():
+    # serving batch sizes vary per tick; the compiled-shape set must stay on
+    # the fixed pow-2 ladder regardless of the sizes that arrive
+    seen_shapes = []
+
+    def apply_fn(params, xb):
+        seen_shapes.append(xb.shape[0])
+        return xb * params["s"]
+
+    trainer = DataParallelTrainer(
+        loss_fn=lambda p, b, r: (jnp.zeros(()), {}),
+        optimizer=optax.sgd(0.1),
+        predict_fn=apply_fn,
+    )
+    params = {"s": jnp.float32(3.0)}
+    buckets = set(trainer.predict_buckets(trainer.round_batch(64)))
+    for n in (1, 3, 5, 9, 17, 33, 64, 100):
+        x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        out = trainer.predict_batched(params, x, batch_size=64)
+        np.testing.assert_allclose(out, x * 3)
+    # every traced shape is on the ladder (tracing happens once per shape)
+    assert set(seen_shapes) <= buckets
+
+
+def test_warm_predict_compiles_every_bucket():
+    traced = []
+
+    def apply_fn(params, xb):
+        traced.append(xb.shape[0])
+        return xb * params["s"]
+
+    trainer = DataParallelTrainer(
+        loss_fn=lambda p, b, r: (jnp.zeros(()), {}),
+        optimizer=optax.sgd(0.1),
+        predict_fn=apply_fn,
+    )
+    params = {"s": jnp.float32(1.0)}
+    n = trainer.warm_predict(params, np.zeros((1,), np.float32), batch_size=64)
+    assert n == len(trainer.predict_buckets(trainer.round_batch(64)))
+    assert sorted(traced) == trainer.predict_buckets(trainer.round_batch(64))
+    # serving after warm-up must not trace any new shape
+    traced.clear()
+    trainer.predict_batched(params, np.zeros((13, 1), np.float32), batch_size=64)
+    assert traced == []
+
+
 def test_round_batch():
     trainer = DataParallelTrainer(
         loss_fn=lambda p, b, r: (jnp.zeros(()), {}),

@@ -68,7 +68,9 @@ def test_full_train_inference_cycle(admin, model_bytes):
 
     trials = admin.get_trials_of_train_job(uid, "myapp")
     completed = [t for t in trials if t["status"] == TrialStatus.COMPLETED]
-    assert len(completed) >= 4  # budget is a lower bound with parallel workers
+    # EXACTLY the budget: reserve_trial is atomic, so parallel workers can
+    # no longer overshoot (VERDICT r2 item 6)
+    assert len(completed) == 4
     for t in completed:
         assert t["score"] is not None
         assert t["knobs"]["fixed_knob"] == "fixed"
@@ -224,3 +226,52 @@ def test_time_budget_enforced(admin, model_bytes):
     job = admin.wait_until_train_job_stopped(uid, "tapp", timeout_s=30)
     assert job["status"] == TrainJobStatus.STOPPED
     assert admin.get_trials_of_train_job(uid, "tapp") == []
+
+
+def test_chips_per_trial_grants_multichip_mesh(admin, tmp_path):
+    # CHIPS_PER_TRIAL=4 on a 4-chip budget: ONE executor whose trial trains
+    # on a real 4-device mesh (VERDICT r2 item 2 — the reference was
+    # hard-wired to 1 GPU/worker, reference services_manager.py:117-126)
+    probe = os.path.join(os.path.dirname(__file__), "fixtures",
+                         "mesh_probe_model.py")
+    with open(probe, "rb") as f:
+        probe_bytes = f.read()
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "meshprobe", "IMAGE_CLASSIFICATION", probe_bytes,
+        "MeshProbeModel", access_right=ModelAccessRight.PUBLIC,
+    )
+    job = admin.create_train_job(
+        uid, "meshapp", "IMAGE_CLASSIFICATION", "uri://train", "uri://test",
+        budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 4,
+                "CHIPS_PER_TRIAL": 4},
+    )
+    assert len(job["workers"]) == 1  # 4 chips / 4 per trial = 1 executor
+    job = admin.wait_until_train_job_stopped(uid, "meshapp", timeout_s=60)
+    assert job["status"] == TrainJobStatus.STOPPED
+    trials = admin.get_trials_of_train_job(uid, "meshapp")
+    completed = [t for t in trials if t["status"] == TrialStatus.COMPLETED]
+    assert len(completed) == 2
+    # the score IS the mesh size the trial trained over
+    assert all(t["score"] == 4.0 for t in completed)
+
+
+def test_chips_per_trial_splits_workers(admin, model_bytes):
+    # 4-chip budget, 2 chips per trial -> 2 executors of 2 chips each
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(
+        uid, "fake2", "IMAGE_CLASSIFICATION", model_bytes, "FakeModel",
+        access_right=ModelAccessRight.PUBLIC,
+    )
+    job = admin.create_train_job(
+        uid, "splitapp", "IMAGE_CLASSIFICATION", "uri://train", "uri://test",
+        budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 4,
+                "CHIPS_PER_TRIAL": 2},
+    )
+    assert len(job["workers"]) == 2
+    chips = [w["chips"] for w in job["workers"]]
+    assert all(len(c) == 2 for c in chips)
+    assert len({i for c in chips for i in c}) == 4  # disjoint grants
+    admin.wait_until_train_job_stopped(uid, "splitapp", timeout_s=30)
