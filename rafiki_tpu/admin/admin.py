@@ -67,9 +67,9 @@ class Admin:
         # plane (cache/shm_broker.py); default is in-process.
         # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to
         # the shm segments), so process mode forces the shm broker.
+        placement_mode = os.environ.get("RAFIKI_PLACEMENT")
         process_mode = (
-            placement is None
-            and os.environ.get("RAFIKI_PLACEMENT") == "process"
+            placement is None and placement_mode in ("process", "hosts")
         )
         if process_mode:
             from rafiki_tpu.cache.shm_broker import ShmBroker
@@ -82,11 +82,29 @@ class Admin:
         elif process_mode:
             from rafiki_tpu.placement.process import ProcessPlacementManager
 
-            self.placement = ProcessPlacementManager(
+            local = ProcessPlacementManager(
                 db=self.db,
                 broker=self.broker,
                 on_status=self._on_service_status,
             )
+            if placement_mode == "hosts":
+                # multi-host: train goes to per-host agents
+                # (RAFIKI_AGENTS=host:port,host:port), serving stays on
+                # this host's engine next to the shm data plane
+                from rafiki_tpu.placement.hosts import HostAgentPlacementManager
+
+                agents = [a.strip() for a in
+                          os.environ.get("RAFIKI_AGENTS", "").split(",")
+                          if a.strip()]
+                self.placement = HostAgentPlacementManager(
+                    agents,
+                    local=local,
+                    key=os.environ.get("RAFIKI_AGENT_KEY"),
+                    on_status=self._on_service_status,
+                    db=self.db,
+                )
+            else:
+                self.placement = local
         else:
             self.placement = LocalPlacementManager(
                 on_status=self._on_service_status
@@ -551,6 +569,10 @@ class Admin:
                 self.services.refresh_train_job_status(payload["train_job_id"])
             elif name in ("train_job_worker_started", "train_job_worker_stopped"):
                 self.services.refresh_train_job_status(payload["train_job_id"])
+            elif name == "service_status":
+                # forwarded by per-host placement agents (placement/agent.py)
+                # so job-level refresh fires even for remotely-placed workers
+                self._on_service_status(payload["service_id"], payload["status"])
         except Exception:
             logger.exception("event %s failed", name)
 

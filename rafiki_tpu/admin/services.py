@@ -93,6 +93,19 @@ class ServicesManager:
         # The reference could never do this: 1 GPU per worker, hard-wired
         # (reference services_manager.py:117-126).
         chips_per_trial = max(int(budget.get(BudgetType.CHIPS_PER_TRIAL, 1)), 1)
+        if avail is not None:
+            # one executor's grant can never span hosts: clamp the per-trial
+            # mesh to the largest single-host inventory (downsize, don't
+            # fail — same policy as the CHIP_COUNT clamp above). Single-host
+            # allocators report their whole inventory.
+            max_per_service = getattr(
+                avail, "max_chips_per_service", avail.total_chips)
+            if chips_per_trial > max_per_service > 0:
+                logger.info(
+                    "CHIPS_PER_TRIAL=%d exceeds the largest host (%d chips); "
+                    "downsizing the per-trial mesh", chips_per_trial,
+                    max_per_service)
+                chips_per_trial = max_per_service
 
         created: List[str] = []
         try:

@@ -1,0 +1,246 @@
+"""Per-host placement agent: `python -m rafiki_tpu.placement.agent`.
+
+The multi-host analogue of the reference's per-node Docker Engine: the
+reference's admin drove a Swarm that placed containers onto nodes by their
+``available_gpus``/``num_services`` labels (reference
+rafiki/container/docker_swarm.py:53-90, 99-172). Here each TPU-VM host runs
+ONE agent process that owns the host's chip inventory and launches worker
+*processes* with chip grants through the local ProcessPlacementManager
+(placement/process.py) — the same engine the single-host deployment uses,
+now standing behind a small HTTP API the admin's
+HostAgentPlacementManager (placement/hosts.py) drives:
+
+    GET  /healthz              liveness
+    GET  /inventory            {host, total_chips, free_chips, n_services}
+    POST /services             {service_id, service_type, n_chips,
+                                best_effort_chips, extra} -> {chips}
+    POST /services/<id>/stop   {wait} -> {}
+
+Config via env:
+
+    RAFIKI_AGENT_HOST / RAFIKI_AGENT_PORT   bind address (default 127.0.0.1:0)
+    RAFIKI_AGENT_CHIPS                      comma-sep device indices this
+                                            host contributes (default: all)
+    RAFIKI_AGENT_KEY                        shared secret; when set, requests
+                                            must carry X-Rafiki-Agent-Key
+    RAFIKI_DB_PATH                          the shared metadata store (the
+                                            reference assumed a shared FS /
+                                            NFS the same way,
+                                            docs architecture.rst:60-64)
+    RAFIKI_WORKDIR                          data/params/logs root
+    RAFIKI_ADMIN_ADDR                       host:port of the AdminServer for
+                                            HPO coordination + status events
+
+Serving executors are NOT placed through agents: the serving data plane
+(shm queues) must be co-located with the predictor process, so inference
+stays on the admin host's local engine (see HostAgentPlacementManager).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import signal
+import socket
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from rafiki_tpu.constants import ServiceType
+from rafiki_tpu.placement.manager import ChipAllocator, InsufficientChipsError
+from rafiki_tpu.placement.process import ProcessPlacementManager
+
+logger = logging.getLogger(__name__)
+
+_SERVICE_STOP = re.compile(r"^/services/(?P<sid>[^/]+)/stop$")
+
+
+class AgentServer:
+    """HTTP facade over a host-local ProcessPlacementManager."""
+
+    def __init__(self, engine: ProcessPlacementManager,
+                 host: str = "127.0.0.1", port: int = 0,
+                 key: Optional[str] = None):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.key = key
+        self.hostname = socket.gethostname()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "AgentServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                server._dispatch(self, "GET")
+
+            def do_POST(self):
+                server._dispatch(self, "POST")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.engine.stop_all()
+
+    # -- request handling --------------------------------------------------
+
+    def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            if self.key and handler.headers.get("X-Rafiki-Agent-Key") != self.key:
+                return self._respond(handler, 401, {"error": "bad agent key"})
+            path = handler.path.split("?", 1)[0].rstrip("/")
+            body: Dict[str, Any] = {}
+            length = int(handler.headers.get("Content-Length") or 0)
+            if length:
+                body = json.loads(handler.rfile.read(length) or b"{}")
+
+            if method == "GET" and path == "/healthz":
+                return self._respond(handler, 200, {
+                    "host": self.hostname, "status": "ok"})
+            if method == "GET" and path == "/inventory":
+                alloc = self.engine.allocator
+                return self._respond(handler, 200, {
+                    "host": self.hostname,
+                    "total_chips": alloc.total_chips,
+                    "free_chips": alloc.free_chips,
+                    "n_services": len(self.engine._runners),
+                })
+            if method == "POST" and path == "/services":
+                if body.get("service_type") != ServiceType.TRAIN:
+                    return self._respond(handler, 400, {
+                        "error": "agents place TRAIN services only (the "
+                                 "serving data plane lives with the "
+                                 "predictor on the admin host)"})
+                try:
+                    ctx = self.engine.create_service(
+                        body["service_id"], body["service_type"],
+                        n_chips=int(body.get("n_chips", 0)),
+                        best_effort_chips=bool(body.get("best_effort_chips")),
+                        extra=body.get("extra") or {},
+                    )
+                except InsufficientChipsError as e:
+                    return self._respond(handler, 503, {"error": str(e)})
+                return self._respond(handler, 200, {"chips": ctx.chips})
+            m = _SERVICE_STOP.match(path) if method == "POST" else None
+            if m:
+                self.engine.destroy_service(
+                    m.group("sid"), wait=bool(body.get("wait", False)))
+                return self._respond(handler, 200, {})
+            self._respond(handler, 404, {"error": f"no route {method} {path}"})
+        except Exception as e:
+            logger.exception("agent request failed")
+            self._respond(handler, 500, {"error": f"{type(e).__name__}: {e}"})
+
+    @staticmethod
+    def _respond(handler, code: int, payload: Dict[str, Any]) -> None:
+        data = json.dumps(payload).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+
+def _admin_status_forwarder(db, admin_addr: Optional[str]):
+    """Terminal service statuses must reach the Admin (its orchestration
+    side-effects — job refresh — live behind the status callback, see
+    admin._on_service_status). Mark the shared store locally, then forward
+    the event best-effort over the admin REST API."""
+    client_box: Dict[str, Any] = {}
+
+    def on_status(service_id: str, status: str) -> None:
+        try:
+            if status == "RUNNING":
+                db.mark_service_as_running(service_id)
+            elif status == "STOPPED":
+                db.mark_service_as_stopped(service_id)
+            elif status == "ERRORED":
+                db.mark_service_as_errored(service_id)
+        except Exception:
+            logger.exception("status write failed for %s", service_id)
+        if not admin_addr:
+            return
+        try:
+            if "client" not in client_box:
+                from rafiki_tpu import config
+                from rafiki_tpu.client.client import Client
+
+                host, port = admin_addr.rsplit(":", 1)
+                c = Client(admin_host=host, admin_port=int(port))
+                c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+                client_box["client"] = c
+            client_box["client"].send_event(
+                "service_status", service_id=service_id, status=status)
+        except Exception:
+            client_box.pop("client", None)  # re-login next time
+            logger.warning("could not forward status of %s to admin",
+                           service_id)
+
+    return on_status
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=os.environ.get("RAFIKI_LOG_LEVEL", "INFO"),
+        format="%(levelname)s:%(asctime)s:agent:%(name)s: %(message)s",
+    )
+    from rafiki_tpu.db.database import Database
+
+    db_path = os.environ.get("RAFIKI_DB_PATH")
+    if not db_path:
+        print("RAFIKI_DB_PATH required (the shared metadata store)",
+              file=sys.stderr)
+        return 2
+    chips_env = os.environ.get("RAFIKI_AGENT_CHIPS", "")
+    chips = [int(c) for c in chips_env.split(",") if c.strip()] or None
+    db = Database(db_path)
+    admin_addr = os.environ.get("RAFIKI_ADMIN_ADDR")
+    addr_tuple = None
+    if admin_addr:
+        host, _, port = admin_addr.rpartition(":")
+        addr_tuple = (host, int(port))
+    engine = ProcessPlacementManager(
+        db=db,
+        admin_addr=addr_tuple,
+        allocator=ChipAllocator(chips),
+        on_status=_admin_status_forwarder(db, admin_addr),
+    )
+    server = AgentServer(
+        engine,
+        host=os.environ.get("RAFIKI_AGENT_HOST", "127.0.0.1"),
+        port=int(os.environ.get("RAFIKI_AGENT_PORT", "0")),
+        key=os.environ.get("RAFIKI_AGENT_KEY"),
+    ).start()
+    print(f"rafiki_tpu agent on http://{server.host}:{server.port} "
+          f"(chips={engine.allocator.total_chips}, db={db_path})", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

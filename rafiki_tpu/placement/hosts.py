@@ -1,0 +1,311 @@
+"""Multi-host placement: the admin-side manager driving per-host agents.
+
+The reference placed dynamic workers across a multi-node Docker Swarm with
+per-node GPU bookkeeping and a least-loaded node choice (reference
+rafiki/container/docker_swarm.py:53-90, 99-172). `HostAgentPlacementManager`
+is the TPU-VM analogue behind the same `PlacementManager` seam
+(placement/manager.py:122): every host runs a placement agent
+(placement/agent.py) owning that host's chips; train executors are placed
+on the agent with the lightest load that can satisfy the chip grant.
+
+Division of labor:
+
+- TRAIN services  -> remote agents (pure processes; coordination runs over
+  the shared store + admin REST, so host boundaries don't matter);
+- INFERENCE/PREDICT -> the ``local`` engine on the admin host, because the
+  serving data plane (cache/shm_broker.py) is shared memory co-located
+  with the predictor. Scaling serving across hosts means scaling admin
+  replicas, not scattering shm segments.
+
+Status flow: worker processes write their own service rows to the shared
+store (worker/bootstrap.py); each agent backstops crashes and forwards
+terminal statuses to the admin's ``service_status`` event so job-level
+refresh still fires (admin._on_service_status).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from rafiki_tpu.constants import ServiceType
+from rafiki_tpu.placement.manager import (
+    InsufficientChipsError,
+    PlacementManager,
+    ServiceContext,
+    StatusFn,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class AgentUnreachableError(Exception):
+    pass
+
+
+class _AgentHandle:
+    """Client for one host agent."""
+
+    def __init__(self, addr: str, key: Optional[str] = None,
+                 timeout_s: float = 10.0):
+        self.addr = addr  # "host:port"
+        self.key = key
+        self.timeout_s = timeout_s
+
+    def _call(self, method: str, path: str,
+              body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        url = f"http://{self.addr}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.key:
+            req.add_header("X-Rafiki-Agent-Key", self.key)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except (ValueError, TypeError):
+                pass
+            msg = payload.get("error", str(e))
+            if e.code == 503:
+                raise InsufficientChipsError(msg)
+            raise AgentUnreachableError(f"{self.addr}: {msg}")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise AgentUnreachableError(f"{self.addr}: {e}")
+
+    def inventory(self) -> Dict[str, Any]:
+        return self._call("GET", "/inventory")
+
+    def create_service(self, service_id: str, service_type: str,
+                       n_chips: int, best_effort_chips: bool,
+                       extra: Dict[str, Any]) -> List[int]:
+        out = self._call("POST", "/services", {
+            "service_id": service_id,
+            "service_type": service_type,
+            "n_chips": n_chips,
+            "best_effort_chips": best_effort_chips,
+            "extra": extra,
+        })
+        return list(out.get("chips", []))
+
+    def stop_service(self, service_id: str, wait: bool) -> None:
+        self._call("POST", f"/services/{service_id}/stop", {"wait": wait})
+
+
+class _FleetInventory:
+    """The budget-clamping shape admin/services.py expects from
+    `placement.allocator`: `total_chips` across all reachable agents, and
+    `max_chips_per_service` — the largest single-host inventory, since one
+    executor's grant can never span hosts."""
+
+    def __init__(self, manager: "HostAgentPlacementManager"):
+        self._manager = manager
+
+    @property
+    def total_chips(self) -> int:
+        return sum(
+            inv.get("total_chips", 0)
+            for _, inv in self._manager._inventories()
+        )
+
+    @property
+    def max_chips_per_service(self) -> int:
+        return max(
+            (inv.get("total_chips", 0)
+             for _, inv in self._manager._inventories()),
+            default=0,
+        )
+
+
+class HostAgentPlacementManager(PlacementManager):
+    """Places train executors across per-host agents; serving stays on the
+    admin host's local engine."""
+
+    def __init__(
+        self,
+        agents: List[str],
+        local: Optional[PlacementManager] = None,
+        key: Optional[str] = None,
+        on_status: Optional[StatusFn] = None,
+        db=None,
+        inventory_ttl_s: float = 1.0,
+        monitor_interval_s: float = 0.5,
+    ):
+        if not agents:
+            raise ValueError("at least one agent address required")
+        self.agents: Dict[str, _AgentHandle] = {
+            a: _AgentHandle(a, key=key) for a in agents
+        }
+        self.local = local
+        self.on_status = on_status
+        # The shared metadata store. When provided, a monitor thread polls
+        # the rows of remotely-placed services and fires `on_status` on
+        # terminal transitions — the admin's job-refresh side effects then
+        # never depend on agents being able to log in and forward events
+        # (that path, placement/agent.py _admin_status_forwarder, remains as
+        # a faster best-effort signal).
+        self.db = db
+        self.allocator = _FleetInventory(self)
+        self._inventory_ttl_s = inventory_ttl_s
+        self._monitor_interval_s = monitor_interval_s
+        self._inventory_cache: List[Tuple[str, Dict[str, Any]]] = []
+        self._inventory_at = 0.0
+        self._lock = threading.Lock()
+        self._placed: Dict[str, str] = {}  # service_id -> agent addr
+        self._reported: set = set()
+        self._monitor: Optional[threading.Thread] = None
+        self._closed = threading.Event()
+
+    # -- inventories -------------------------------------------------------
+
+    def _inventories(self) -> List[Tuple[str, Dict[str, Any]]]:
+        with self._lock:
+            if time.monotonic() - self._inventory_at < self._inventory_ttl_s:
+                return list(self._inventory_cache)
+        out: List[Tuple[str, Dict[str, Any]]] = []
+        for addr, handle in self.agents.items():
+            try:
+                out.append((addr, handle.inventory()))
+            except AgentUnreachableError:
+                logger.warning("agent %s unreachable; skipping", addr)
+        with self._lock:
+            self._inventory_cache = out
+            self._inventory_at = time.monotonic()
+        return list(out)
+
+    def _choose_agent(self, n_chips: int) -> Optional[str]:
+        """Least-loaded host with enough free chips (the reference's node
+        choice: filter by free GPUs, then fewest services, reference
+        docker_swarm.py:53-70)."""
+        candidates = [
+            (inv.get("n_services", 0), -inv.get("free_chips", 0), addr)
+            for addr, inv in self._inventories()
+            if inv.get("free_chips", 0) >= n_chips
+        ]
+        if not candidates:
+            return None
+        candidates.sort()
+        return candidates[0][2]
+
+    # -- PlacementManager --------------------------------------------------
+
+    def create_service(
+        self,
+        service_id: str,
+        service_type: str,
+        run_fn=None,
+        n_chips: int = 0,
+        extra: Optional[Dict[str, Any]] = None,
+        best_effort_chips: bool = False,
+    ) -> ServiceContext:
+        if service_type != ServiceType.TRAIN:
+            if self.local is None:
+                raise RuntimeError(
+                    "HostAgentPlacementManager needs a `local` engine for "
+                    "serving executors (the shm data plane is co-located "
+                    "with the predictor)")
+            return self.local.create_service(
+                service_id, service_type, run_fn, n_chips=n_chips,
+                extra=extra, best_effort_chips=best_effort_chips)
+
+        addr = self._choose_agent(n_chips)
+        if addr is None:
+            if not best_effort_chips and n_chips > 0:
+                raise InsufficientChipsError(
+                    f"No agent has {n_chips} free chips "
+                    f"(fleet: {[i for _, i in self._inventories()]})")
+            addr = self._choose_agent(0)
+            if addr is None:
+                raise AgentUnreachableError("no reachable agents")
+            n_chips = 0
+        chips = self.agents[addr].create_service(
+            service_id, service_type, n_chips, best_effort_chips,
+            dict(extra or {}))
+        with self._lock:
+            self._placed[service_id] = addr
+            self._inventory_at = 0.0  # free-chip counts changed
+            if (self.db is not None and self._monitor is None
+                    and not self._closed.is_set()):
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop, name="hosts-status-monitor",
+                    daemon=True)
+                self._monitor.start()
+        logger.info("placed %s on agent %s (chips=%s)",
+                    service_id[:8], addr, chips)
+        return ServiceContext(
+            service_id=service_id,
+            service_type=service_type,
+            chips=chips,
+            stop_event=threading.Event(),
+            extra=dict(extra or {}),
+        )
+
+    def destroy_service(self, service_id: str, wait: bool = True) -> None:
+        with self._lock:
+            addr = self._placed.pop(service_id, None)
+        if addr is None:
+            if self.local is not None:
+                self.local.destroy_service(service_id, wait=wait)
+            return
+        try:
+            self.agents[addr].stop_service(service_id, wait)
+        except AgentUnreachableError:
+            logger.warning("agent %s unreachable destroying %s",
+                           addr, service_id)
+        with self._lock:
+            self._inventory_at = 0.0
+
+    def _monitor_loop(self) -> None:
+        """Poll the shared store for terminal statuses of remotely-placed
+        services and fire on_status once per service — the authoritative
+        path for the admin's job-refresh side effects."""
+        from rafiki_tpu.constants import ServiceStatus
+
+        while not self._closed.wait(self._monitor_interval_s):
+            with self._lock:
+                pending = [sid for sid in self._placed
+                           if sid not in self._reported]
+            for sid in pending:
+                try:
+                    svc = self.db.get_service(sid)
+                except Exception:
+                    logger.exception("status poll failed for %s", sid)
+                    continue
+                if svc is None:
+                    continue
+                if svc["status"] in (ServiceStatus.STOPPED,
+                                     ServiceStatus.ERRORED):
+                    with self._lock:
+                        self._reported.add(sid)
+                    if self.on_status:
+                        try:
+                            self.on_status(sid, svc["status"])
+                        except Exception:
+                            logger.exception("status callback failed")
+
+    def stop_all(self) -> None:
+        self._closed.set()
+        with self._lock:
+            placed = dict(self._placed)
+            self._placed.clear()
+        for sid, addr in placed.items():
+            try:
+                self.agents[addr].stop_service(sid, wait=False)
+            except AgentUnreachableError:
+                pass
+        if self.local is not None and hasattr(self.local, "stop_all"):
+            self.local.stop_all()
+
+    # -- introspection (tests / ops) --------------------------------------
+
+    def placements(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._placed)

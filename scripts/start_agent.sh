@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Start a per-host placement agent (multi-host deployments). Run one per
+# TPU-VM host; point the admin at them with RAFIKI_PLACEMENT=hosts and
+# RAFIKI_AGENTS=host1:7070,host2:7070. The analogue of joining a node to
+# the reference's swarm (reference scripts/create_docker_swarm.sh).
+#
+# Env (beyond scripts/env.sh):
+#   RAFIKI_AGENT_HOST   bind address (default 0.0.0.0 — a remote admin must
+#                       be able to reach the agent; set 127.0.0.1 for
+#                       single-machine setups)
+#   RAFIKI_AGENT_PORT   bind port (default 7070)
+#   RAFIKI_AGENT_CHIPS  comma-sep chip indices this host contributes
+#                       (default: all visible devices)
+#   RAFIKI_AGENT_KEY    shared secret (set it when binding non-loopback)
+#   RAFIKI_ADMIN_ADDR   host:port of the admin server
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source scripts/env.sh
+
+export RAFIKI_AGENT_HOST="${RAFIKI_AGENT_HOST:-0.0.0.0}"
+export RAFIKI_AGENT_PORT="${RAFIKI_AGENT_PORT:-7070}"
+mkdir -p "$RAFIKI_WORKDIR/logs"
+AGENT_LOG="$RAFIKI_WORKDIR/logs/agent.log"
+AGENT_PID="$RAFIKI_WORKDIR/agent.pid"
+
+if [ -f "$AGENT_PID" ] && kill -0 "$(cat "$AGENT_PID")" 2>/dev/null; then
+    echo "agent already running (pid $(cat "$AGENT_PID"))"
+    exit 0
+fi
+
+nohup python -m rafiki_tpu.placement.agent >"$AGENT_LOG" 2>&1 &
+echo $! > "$AGENT_PID"
+for _ in $(seq 1 40); do
+    if ! kill -0 "$(cat "$AGENT_PID")" 2>/dev/null; then
+        echo "agent failed to start; log tail:" >&2
+        tail -20 "$AGENT_LOG" >&2
+        rm -f "$AGENT_PID"
+        exit 1
+    fi
+    if grep -q "rafiki_tpu agent on" "$AGENT_LOG" 2>/dev/null; then
+        grep "rafiki_tpu agent on" "$AGENT_LOG"
+        exit 0
+    fi
+    sleep 0.5
+done
+echo "agent did not report ready; see $AGENT_LOG" >&2
+exit 1
