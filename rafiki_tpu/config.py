@@ -66,8 +66,13 @@ _DYNAMIC_PATHS = {
     "LOGS_DIR": lambda: os.environ.get(
         "RAFIKI_LOGS_DIR", os.path.join(workdir(), "logs")
     ),
-    "DB_PATH": lambda: os.environ.get(
-        "RAFIKI_DB_PATH", os.path.join(workdir(), "rafiki.sqlite3")
+    # connection string: RAFIKI_DB_URL (e.g. postgresql://...) wins over the
+    # sqlite file path, so EVERY call site that passes config.DB_PATH honors
+    # the URL
+    "DB_PATH": lambda: (
+        os.environ.get("RAFIKI_DB_URL")
+        or os.environ.get("RAFIKI_DB_PATH")
+        or os.path.join(workdir(), "rafiki.sqlite3")
     ),
 }
 

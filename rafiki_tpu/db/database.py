@@ -1,4 +1,4 @@
-"""Data-access layer over SQLite.
+"""Data-access layer over SQLite or PostgreSQL.
 
 Same relational shape as the reference's PostgreSQL schema (reference
 rafiki/db/schema.py:18-133 — user, model, train_job, sub_train_job,
@@ -6,9 +6,15 @@ train_job_worker, inference_job, inference_job_worker, trial, trial_log,
 service) and the same DAL surface style as reference rafiki/db/database.py
 (~50 query/mutation methods, status-transition helpers).
 
-SQLite (WAL mode) replaces the external Postgres server: the control plane
-here is an in-process library usable from every worker thread, with the same
-DAL seam so a Postgres backend can slot in for multi-host deployments.
+Backend selection is by connection string (the reference's seam, reference
+db/database.py:20-34): a filesystem path (or ``:memory:``) selects the
+embedded SQLite/WAL backend — the dev and single-host default, usable
+in-process from every worker thread/process on one machine — while a
+``postgresql://`` URL selects an external PostgreSQL server for multi-host
+control planes (requires ``psycopg2``; driven by ``RAFIKI_DB_URL``). The
+SQL in this module is written once in the portable subset and translated
+per backend (placeholders, reserved words, DDL types).
+
 Thread-safe via a single serialized connection guarded by an RLock.
 """
 
@@ -30,8 +36,11 @@ from rafiki_tpu.constants import (
     TrialStatus,
 )
 
+# NOTE: tables are ordered so every REFERENCES target exists before its
+# referrer — PostgreSQL validates foreign keys at CREATE TABLE time
+# (SQLite only at DML time).
 _SCHEMA = """
-CREATE TABLE IF NOT EXISTS user (
+CREATE TABLE IF NOT EXISTS "user" (
     id TEXT PRIMARY KEY,
     email TEXT NOT NULL UNIQUE,
     password_hash TEXT NOT NULL,
@@ -41,7 +50,7 @@ CREATE TABLE IF NOT EXISTS user (
 );
 CREATE TABLE IF NOT EXISTS model (
     id TEXT PRIMARY KEY,
-    user_id TEXT NOT NULL REFERENCES user(id),
+    user_id TEXT NOT NULL REFERENCES "user"(id),
     name TEXT NOT NULL,
     task TEXT NOT NULL,
     model_file_bytes BLOB NOT NULL,
@@ -53,7 +62,7 @@ CREATE TABLE IF NOT EXISTS model (
 );
 CREATE TABLE IF NOT EXISTS train_job (
     id TEXT PRIMARY KEY,
-    user_id TEXT NOT NULL REFERENCES user(id),
+    user_id TEXT NOT NULL REFERENCES "user"(id),
     app TEXT NOT NULL,
     app_version INTEGER NOT NULL,
     task TEXT NOT NULL,
@@ -71,23 +80,16 @@ CREATE TABLE IF NOT EXISTS sub_train_job (
     model_id TEXT NOT NULL REFERENCES model(id),
     advisor_id TEXT
 );
-CREATE TABLE IF NOT EXISTS train_job_worker (
-    service_id TEXT PRIMARY KEY REFERENCES service(id),
-    sub_train_job_id TEXT NOT NULL REFERENCES sub_train_job(id)
-);
-CREATE TABLE IF NOT EXISTS inference_job (
+CREATE TABLE IF NOT EXISTS service (
     id TEXT PRIMARY KEY,
-    user_id TEXT NOT NULL REFERENCES user(id),
-    train_job_id TEXT NOT NULL REFERENCES train_job(id),
+    service_type TEXT NOT NULL,
     status TEXT NOT NULL,
-    predictor_service_id TEXT,
+    replicas INTEGER NOT NULL DEFAULT 1,
+    chips TEXT NOT NULL DEFAULT '[]',
+    host TEXT,
+    port INTEGER,
     datetime_started REAL NOT NULL,
     datetime_stopped REAL
-);
-CREATE TABLE IF NOT EXISTS inference_job_worker (
-    service_id TEXT PRIMARY KEY REFERENCES service(id),
-    inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
-    trial_id TEXT NOT NULL REFERENCES trial(id)
 );
 CREATE TABLE IF NOT EXISTS trial (
     id TEXT PRIMARY KEY,
@@ -101,6 +103,24 @@ CREATE TABLE IF NOT EXISTS trial (
     datetime_started REAL NOT NULL,
     datetime_stopped REAL
 );
+CREATE TABLE IF NOT EXISTS train_job_worker (
+    service_id TEXT PRIMARY KEY REFERENCES service(id),
+    sub_train_job_id TEXT NOT NULL REFERENCES sub_train_job(id)
+);
+CREATE TABLE IF NOT EXISTS inference_job (
+    id TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL REFERENCES "user"(id),
+    train_job_id TEXT NOT NULL REFERENCES train_job(id),
+    status TEXT NOT NULL,
+    predictor_service_id TEXT,
+    datetime_started REAL NOT NULL,
+    datetime_stopped REAL
+);
+CREATE TABLE IF NOT EXISTS inference_job_worker (
+    service_id TEXT PRIMARY KEY REFERENCES service(id),
+    inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
+    trial_id TEXT NOT NULL REFERENCES trial(id)
+);
 CREATE TABLE IF NOT EXISTS trial_log (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     trial_id TEXT NOT NULL REFERENCES trial(id),
@@ -108,93 +128,193 @@ CREATE TABLE IF NOT EXISTS trial_log (
     datetime REAL NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_trial_log_trial ON trial_log(trial_id);
-CREATE TABLE IF NOT EXISTS service (
-    id TEXT PRIMARY KEY,
-    service_type TEXT NOT NULL,
-    status TEXT NOT NULL,
-    replicas INTEGER NOT NULL DEFAULT 1,
-    chips TEXT NOT NULL DEFAULT '[]',
-    host TEXT,
-    port INTEGER,
-    datetime_started REAL NOT NULL,
-    datetime_stopped REAL
-);
 """
 
 
-def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
-    return dict(row)
+class _SqliteBackend:
+    """Embedded backend: SQLite in WAL mode, single serialized connection."""
+
+    kind = "sqlite"
+
+    def __init__(self, path: str):
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None
+        )
+        self.conn.row_factory = sqlite3.Row
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA foreign_keys=ON")
+        # Cross-process story (ProcessPlacementManager): every worker
+        # process opens its own Database on the same WAL file; concurrent
+        # writers serialize on the file lock, waiting up to this budget
+        # instead of failing with 'database is locked'.
+        self.conn.execute("PRAGMA busy_timeout=15000")
+        self.conn.executescript(_SCHEMA)
+
+    def execute(self, sql: str, args: tuple = ()):
+        return self.conn.execute(sql, args)
+
+    @staticmethod
+    def to_dict(row) -> Dict[str, Any]:
+        return dict(row)
+
+    def begin_exclusive(self, key: str) -> None:
+        """Open a transaction that serializes concurrent writers. IMMEDIATE
+        takes the database write lock up front, so a read inside the
+        transaction can't be invalidated before a following write."""
+        self.conn.execute("BEGIN IMMEDIATE")
+
+    def commit(self) -> None:
+        self.conn.execute("COMMIT")
+
+    def rollback(self) -> None:
+        self.conn.execute("ROLLBACK")
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+class _PostgresBackend:
+    """External-server backend for multi-host control planes (the
+    reference's default, reference db/database.py:20-34). Translates the
+    module's portable SQL: ``?`` placeholders -> ``%s`` and DDL types."""
+
+    kind = "postgres"
+
+    def __init__(self, url: str):
+        try:
+            import psycopg2
+            import psycopg2.extras
+        except ImportError as e:  # pragma: no cover - env without the driver
+            raise RuntimeError(
+                "postgresql:// store requires the psycopg2 driver "
+                "(pip install psycopg2-binary)") from e
+        self.path = url
+        self._dict_cursor = psycopg2.extras.RealDictCursor
+        self.conn = psycopg2.connect(url)
+        # autocommit parity with the sqlite backend: statements stand alone
+        # unless an explicit BEGIN opens a transaction block
+        self.conn.autocommit = True
+        cur = self.conn.cursor()
+        cur.execute(
+            _SCHEMA
+            .replace("BLOB", "BYTEA")
+            .replace("INTEGER PRIMARY KEY AUTOINCREMENT",
+                     "BIGSERIAL PRIMARY KEY")
+            .replace("REAL", "DOUBLE PRECISION")
+        )
+
+    def execute(self, sql: str, args: tuple = ()):
+        cur = self.conn.cursor(cursor_factory=self._dict_cursor)
+        cur.execute(sql.replace("?", "%s"), args)
+        return cur
+
+    @staticmethod
+    def to_dict(row) -> Dict[str, Any]:
+        # BYTEA arrives as memoryview; the DAL contract is bytes
+        return {
+            k: bytes(v) if isinstance(v, memoryview) else v
+            for k, v in dict(row).items()
+        }
+
+    def begin_exclusive(self, key: str) -> None:
+        """Transaction-scoped advisory lock on the key: concurrent
+        reserve-style writers for the same key serialize, unrelated keys
+        proceed in parallel."""
+        cur = self.conn.cursor()
+        cur.execute("BEGIN")
+        try:
+            cur.execute("SELECT pg_advisory_xact_lock(hashtext(%s))", (key,))
+        except Exception:
+            # never leave the shared connection inside an aborted
+            # transaction block — every later statement would fail
+            self.rollback()
+            raise
+
+    def commit(self) -> None:
+        self.conn.cursor().execute("COMMIT")
+
+    def rollback(self) -> None:
+        self.conn.cursor().execute("ROLLBACK")
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+def _make_backend(conn_str: str):
+    if conn_str.startswith(("postgresql://", "postgres://")):
+        return _PostgresBackend(conn_str)
+    return _SqliteBackend(conn_str)
 
 
 class Database:
-    """DAL facade. One instance may be shared across threads."""
+    """DAL facade. One instance may be shared across threads.
+
+    ``db_path`` is a connection string: a filesystem path / ``:memory:``
+    (SQLite) or a ``postgresql://`` URL. Default:
+    ``RAFIKI_DB_URL`` env if set, else the workdir SQLite file."""
 
     def __init__(self, db_path: Optional[str] = None):
-        self._path = db_path or config.DB_PATH
-        if self._path != ":memory:":
-            os.makedirs(os.path.dirname(os.path.abspath(self._path)), exist_ok=True)
+        conn_str = (db_path
+                    or os.environ.get("RAFIKI_DB_URL")
+                    or config.DB_PATH)
         self._lock = threading.RLock()
-        self._conn = sqlite3.connect(
-            self._path, check_same_thread=False, isolation_level=None
-        )
-        self._conn.row_factory = sqlite3.Row
-        with self._lock:
-            self._conn.execute("PRAGMA journal_mode=WAL")
-            self._conn.execute("PRAGMA foreign_keys=ON")
-            # Cross-process story (ProcessPlacementManager): every worker
-            # process opens its own Database on the same WAL file; concurrent
-            # writers serialize on the file lock, waiting up to this budget
-            # instead of failing with 'database is locked'.
-            self._conn.execute("PRAGMA busy_timeout=15000")
-            self._conn.executescript(_SCHEMA)
+        self._b = _make_backend(conn_str)
 
     @property
     def path(self) -> str:
-        """The backing file path (':memory:' for the in-memory store)."""
-        return self._path
+        """The backing connection string (':memory:' for the in-memory
+        store; a postgresql:// URL for the server backend)."""
+        return self._b.path
+
+    @property
+    def backend(self) -> str:
+        return self._b.kind
 
     def close(self) -> None:
         with self._lock:
-            self._conn.close()
+            self._b.close()
 
     # -- low-level helpers -------------------------------------------------
 
     def _exec(self, sql: str, args: tuple = ()) -> None:
         with self._lock:
-            self._conn.execute(sql, args)
+            self._b.execute(sql, args)
 
     def _one(self, sql: str, args: tuple = ()) -> Optional[Dict[str, Any]]:
         with self._lock:
-            row = self._conn.execute(sql, args).fetchone()
-        return _row_to_dict(row) if row else None
+            row = self._b.execute(sql, args).fetchone()
+        return self._b.to_dict(row) if row else None
 
     def _all(self, sql: str, args: tuple = ()) -> List[Dict[str, Any]]:
         with self._lock:
-            rows = self._conn.execute(sql, args).fetchall()
-        return [_row_to_dict(r) for r in rows]
+            rows = self._b.execute(sql, args).fetchall()
+        return [self._b.to_dict(r) for r in rows]
 
     # -- users -------------------------------------------------------------
 
     def create_user(self, email: str, password_hash: str, user_type: str) -> Dict:
         uid = uuid.uuid4().hex
         self._exec(
-            "INSERT INTO user (id, email, password_hash, user_type, banned,"
+            'INSERT INTO "user" (id, email, password_hash, user_type, banned,'
             " datetime_created) VALUES (?,?,?,?,0,?)",
             (uid, email, password_hash, user_type, time.time()),
         )
         return self.get_user(uid)  # type: ignore[return-value]
 
     def get_user(self, user_id: str) -> Optional[Dict]:
-        return self._one("SELECT * FROM user WHERE id=?", (user_id,))
+        return self._one('SELECT * FROM "user" WHERE id=?', (user_id,))
 
     def get_user_by_email(self, email: str) -> Optional[Dict]:
-        return self._one("SELECT * FROM user WHERE email=?", (email,))
+        return self._one('SELECT * FROM "user" WHERE email=?', (email,))
 
     def get_users(self) -> List[Dict]:
-        return self._all("SELECT * FROM user ORDER BY datetime_created")
+        return self._all('SELECT * FROM "user" ORDER BY datetime_created')
 
     def ban_user(self, user_id: str) -> None:
-        self._exec("UPDATE user SET banned=1 WHERE id=?", (user_id,))
+        self._exec('UPDATE "user" SET banned=1 WHERE id=?', (user_id,))
 
     # -- models ------------------------------------------------------------
 
@@ -471,20 +591,24 @@ class Database:
         is already spent."""
         tid = uuid.uuid4().hex
         with self._lock:
-            # IMMEDIATE takes the write lock up front: the count below can't
-            # be invalidated by another process between read and insert
-            self._conn.execute("BEGIN IMMEDIATE")
+            # the backend's exclusive transaction (IMMEDIATE write lock on
+            # sqlite, advisory xact lock on postgres) guarantees the count
+            # below can't be invalidated by another worker between read and
+            # insert
+            self._b.begin_exclusive(sub_train_job_id)
             try:
                 if max_trials is not None:
-                    row = self._conn.execute(
+                    row = self._b.execute(
                         "SELECT COUNT(*) AS c FROM trial"
                         " WHERE sub_train_job_id=? AND status != ?",
                         (sub_train_job_id, TrialStatus.TERMINATED),
                     ).fetchone()
+                    # plain key access is portable: sqlite3.Row and
+                    # psycopg2's RealDictRow both support it
                     if row["c"] >= max_trials:
-                        self._conn.execute("ROLLBACK")
+                        self._b.rollback()
                         return None
-                self._conn.execute(
+                self._b.execute(
                     "INSERT INTO trial (id, sub_train_job_id, model_id,"
                     " worker_id, knobs, status, datetime_started)"
                     " VALUES (?,?,?,?,?,?,?)",
@@ -498,9 +622,9 @@ class Database:
                         time.time(),
                     ),
                 )
-                self._conn.execute("COMMIT")
+                self._b.commit()
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                self._b.rollback()
                 raise
         return self.get_trial(tid)
 

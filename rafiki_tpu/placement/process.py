@@ -272,7 +272,11 @@ class ProcessPlacementManager(PlacementManager):
             p for p in (_REPO_ROOT, env.get("PYTHONPATH")) if p)
         env["RAFIKI_SERVICE_ID"] = ctx.service_id
         env["RAFIKI_SERVICE_TYPE"] = ctx.service_type
-        env["RAFIKI_DB_PATH"] = os.path.abspath(self.db.path)
+        # the store may be a postgresql:// URL (multi-host control plane);
+        # only filesystem paths get absolutized
+        db_ref = self.db.path
+        env["RAFIKI_DB_PATH"] = (
+            db_ref if "://" in db_ref else os.path.abspath(db_ref))
         env["RAFIKI_WORKDIR"] = config.WORKDIR
         env["RAFIKI_CHIP_GRANT"] = ",".join(str(c) for c in ctx.chips)
         # the process-wide fallback must not fight the explicit grant

@@ -6,6 +6,9 @@
 
 export RAFIKI_WORKDIR="${RAFIKI_WORKDIR:-$(pwd)/rafiki_workdir}"
 export RAFIKI_DB_PATH="${RAFIKI_DB_PATH:-$RAFIKI_WORKDIR/rafiki.sqlite3}"
+# Multi-host control planes: point every host at one PostgreSQL server
+# instead of the embedded SQLite file, e.g.
+#   export RAFIKI_DB_URL=postgresql://rafiki:pw@dbhost:5432/rafiki
 export RAFIKI_ADMIN_HOST="${RAFIKI_ADMIN_HOST:-127.0.0.1}"
 export RAFIKI_ADMIN_PORT="${RAFIKI_ADMIN_PORT:-3000}"
 

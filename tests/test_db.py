@@ -1,3 +1,5 @@
+import os
+
 import pytest
 
 from rafiki_tpu.constants import (
@@ -8,10 +10,32 @@ from rafiki_tpu.constants import (
 )
 from rafiki_tpu.db.database import Database
 
+# FK-safe deletion order for wiping a shared Postgres test database
+_WIPE_ORDER = ("trial_log", "inference_job_worker", "train_job_worker",
+               "trial", "sub_train_job", "inference_job", "train_job",
+               "model", "service", '"user"')
 
-@pytest.fixture()
-def db():
-    d = Database(":memory:")
+
+def _pg_database():
+    """The same DAL against a real PostgreSQL server — exercised whenever
+    the environment provides one (RAFIKI_TEST_PG_URL); skipped with an
+    explicit reason otherwise (this image ships neither a server nor the
+    psycopg2 driver)."""
+    url = os.environ.get("RAFIKI_TEST_PG_URL")
+    if not url:
+        pytest.skip("no PostgreSQL server in this environment; set "
+                    "RAFIKI_TEST_PG_URL=postgresql://user:pw@host/db to "
+                    "run the DAL suite against the postgres backend")
+    pytest.importorskip("psycopg2", reason="psycopg2 driver not installed")
+    d = Database(url)
+    for table in _WIPE_ORDER:
+        d._exec(f"DELETE FROM {table}")
+    return d
+
+
+@pytest.fixture(params=["sqlite", "postgres"])
+def db(request):
+    d = Database(":memory:") if request.param == "sqlite" else _pg_database()
     yield d
     d.close()
 
@@ -41,7 +65,12 @@ def test_model_unique_per_user(db):
     db.create_model(u["id"], "m", "T", b"x", "M", {}, "PRIVATE")
     import sqlite3
 
-    with pytest.raises(sqlite3.IntegrityError):
+    errors = (sqlite3.IntegrityError,)
+    if db.backend == "postgres":
+        import psycopg2
+
+        errors += (psycopg2.IntegrityError,)
+    with pytest.raises(errors):
         db.create_model(u["id"], "m", "T", b"x", "M", {}, "PRIVATE")
 
 
@@ -143,3 +172,39 @@ def test_reserve_trial_ignores_terminated_trials(db):
     # terminated trials release their budget slot (they never produced work)
     db.mark_trial_as_terminated(t1["id"])
     assert db.reserve_trial(sub["id"], model["id"], {}, max_trials=1) is not None
+
+
+def test_reserve_trial_atomic_postgres_connections():
+    # the postgres analogue of the WAL race test: N workers on SEPARATE
+    # server connections must create exactly max_trials (advisory-lock
+    # serialized reserve). Skips when the env has no server.
+    import threading
+
+    db0 = _pg_database()
+    try:
+        user, model, job, sub = _seed(db0)
+        max_trials = 5
+        created = []
+        lock = threading.Lock()
+
+        def worker():
+            d = Database(db0.path)
+            try:
+                while True:
+                    t = d.reserve_trial(sub["id"], model["id"], {},
+                                        max_trials=max_trials)
+                    if t is None:
+                        return
+                    with lock:
+                        created.append(t["id"])
+            finally:
+                d.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(created) == max_trials
+    finally:
+        db0.close()
