@@ -198,13 +198,21 @@ class _PostgresBackend:
         # unless an explicit BEGIN opens a transaction block
         self.conn.autocommit = True
         cur = self.conn.cursor()
-        cur.execute(
-            _SCHEMA
-            .replace("BLOB", "BYTEA")
-            .replace("INTEGER PRIMARY KEY AUTOINCREMENT",
-                     "BIGSERIAL PRIMARY KEY")
-            .replace("REAL", "DOUBLE PRECISION")
-        )
+        # serialize DDL across simultaneous boots: PG's CREATE TABLE IF NOT
+        # EXISTS is not concurrency-safe (two sessions can race into a
+        # duplicate-key error on pg_type), so take a session advisory lock
+        # for the schema pass
+        cur.execute("SELECT pg_advisory_lock(hashtext('rafiki_schema'))")
+        try:
+            cur.execute(
+                _SCHEMA
+                .replace("BLOB", "BYTEA")
+                .replace("INTEGER PRIMARY KEY AUTOINCREMENT",
+                         "BIGSERIAL PRIMARY KEY")
+                .replace("REAL", "DOUBLE PRECISION")
+            )
+        finally:
+            cur.execute("SELECT pg_advisory_unlock(hashtext('rafiki_schema'))")
 
     def execute(self, sql: str, args: tuple = ()):
         cur = self.conn.cursor(cursor_factory=self._dict_cursor)
@@ -257,9 +265,8 @@ class Database:
     ``RAFIKI_DB_URL`` env if set, else the workdir SQLite file."""
 
     def __init__(self, db_path: Optional[str] = None):
-        conn_str = (db_path
-                    or os.environ.get("RAFIKI_DB_URL")
-                    or config.DB_PATH)
+        # config.DB_PATH already resolves RAFIKI_DB_URL over RAFIKI_DB_PATH
+        conn_str = db_path or config.DB_PATH
         self._lock = threading.RLock()
         self._b = _make_backend(conn_str)
 

@@ -24,24 +24,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 from rafiki_tpu.parallel.mesh import DATA_AXIS, PIPELINE_AXIS
 
 
-def _stage_local(params_local: Any, x_mbs: jax.Array, *, block_fn,
-                 axis_name: str, n_microbatches: int) -> jax.Array:
-    """Per-stage body (inside shard_map).
-
-    params_local: this stage's layer stack (L_local, ...).
-    x_mbs: (M, mb, ...) full input microbatches (replicated; only stage 0
-    reads them).
-    """
-    n = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
-    m = n_microbatches
-
+def _make_stage_apply(params_local: Any, block_fn):
     def apply_stage(x):
         def body(h, layer):
             return block_fn(layer, h), None
         h, _ = jax.lax.scan(body, x, params_local)
         return h
+    return apply_stage
 
+
+def _stage_local(params_local: Any, x_mbs: jax.Array, *, block_fn,
+                 axis_name: str, n_microbatches: int) -> jax.Array:
+    """Per-stage body, replicated-input fallback (inside shard_map).
+
+    params_local: this stage's layer stack (L_local, ...).
+    x_mbs: (M, mb, ...) full input microbatches (replicated; only stage 0
+    reads them). Costs O(B) input HBM per stage — the streamed body below
+    is preferred whenever M divides by the stage count.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    m = n_microbatches
+
+    apply_stage = _make_stage_apply(params_local, block_fn)
     fwd_perm = [(r, (r + 1) % n) for r in range(n)]
     mb_shape = x_mbs.shape[1:]
 
@@ -60,6 +65,52 @@ def _stage_local(params_local: Any, x_mbs: jax.Array, *, block_fn,
     y = outs[n - 1:]                      # (M, mb, ...)
     y = jnp.where(my == n - 1, y, 0.0)
     # broadcast the final activations to every stage
+    return jax.lax.psum(y, axis_name)
+
+
+def _stage_local_streamed(params_local: Any, x_local: jax.Array, *, block_fn,
+                          axis_name: str, n_microbatches: int) -> jax.Array:
+    """Per-stage body with the input microbatches SHARDED over stages.
+
+    x_local: (M/n, mb, ...) — stage s starts holding microbatches
+    [s*M/n, (s+1)*M/n). The shards form one distributed queue in
+    stage-major order; every tick it rotates one slot toward stage 0
+    (a backward ``ppermute`` of each stage's head), so stage 0's local
+    head is always the next microbatch to feed. Input HBM per stage is
+    O(B/n) instead of the fallback's O(B) — activation memory now scales
+    with pipeline depth like the weights do.
+
+    Ticks past M feed wrapped (stale) queue entries into stage 0; their
+    outputs can never reach the last stage before the schedule ends, so
+    they are never observed (same argument as the fallback's clamped
+    feed).
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    m = n_microbatches
+
+    apply_stage = _make_stage_apply(params_local, block_fn)
+    fwd_perm = [(r, (r + 1) % n) for r in range(n)]
+    bwd_perm = [(r, (r - 1) % n) for r in range(n)]
+    mb_shape = x_local.shape[1:]
+
+    def tick(carry, _t):
+        buf, queue = carry
+        inp = jnp.where(my == 0, queue[0], buf)
+        out = apply_stage(inp)
+        nxt = jax.lax.ppermute(out, axis_name, fwd_perm)
+        # rotate the distributed queue: my head goes to the previous
+        # stage's tail; the next stage's head becomes my tail
+        incoming = jax.lax.ppermute(queue[0], axis_name, bwd_perm)
+        queue = jnp.concatenate([queue[1:], incoming[None]], axis=0)
+        return (nxt, queue), out
+
+    t_total = m + n - 1
+    (_, _), outs = jax.lax.scan(
+        tick, (jnp.zeros(mb_shape, x_local.dtype), x_local),
+        jnp.arange(t_total))
+    y = outs[n - 1:]                      # (M, mb, ...)
+    y = jnp.where(my == n - 1, y, 0.0)
     return jax.lax.psum(y, axis_name)
 
 
@@ -83,14 +134,19 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
     dp = data_axis if data_axis in mesh.axis_names else None
     if dp is not None and (b // n_microbatches) % mesh.shape[dp] != 0:
         dp = None
-    x_spec = P(None, dp)
+    if n_microbatches % n_stages == 0:
+        # preferred: input microbatches sharded over stages and streamed
+        # toward stage 0 tick by tick — O(B/n) input HBM per stage
+        body, x_in_spec = _stage_local_streamed, P(pipe_axis, dp)
+    else:
+        body, x_in_spec = _stage_local, P(None, dp)
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     fn = jax.shard_map(
-        partial(_stage_local, block_fn=block_fn, axis_name=pipe_axis,
+        partial(body, block_fn=block_fn, axis_name=pipe_axis,
                 n_microbatches=n_microbatches),
         mesh=mesh,
-        in_specs=(param_specs, x_spec),
-        out_specs=x_spec,
+        in_specs=(param_specs, x_in_spec),
+        out_specs=P(None, dp),
         check_vma=False,
     )
     y = fn(stacked_params, x_mbs)

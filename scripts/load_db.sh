@@ -6,6 +6,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 source scripts/env.sh
 
+if [ -n "${RAFIKI_DB_URL:-}" ]; then
+    echo "RAFIKI_DB_URL is set (postgres backend): use pg_dump/pg_restore" >&2
+    echo "against $RAFIKI_DB_URL instead of this sqlite-file script" >&2
+    exit 1
+fi
+
 FORCE=0
 if [ "${1:-}" = "-f" ]; then FORCE=1; shift; fi
 IN="${1:-$RAFIKI_WORKDIR/db.dump.sql}"

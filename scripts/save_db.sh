@@ -5,6 +5,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 source scripts/env.sh
 
+if [ -n "${RAFIKI_DB_URL:-}" ]; then
+    echo "RAFIKI_DB_URL is set (postgres backend): use pg_dump/pg_restore" >&2
+    echo "against $RAFIKI_DB_URL instead of this sqlite-file script" >&2
+    exit 1
+fi
+
 OUT="${1:-$RAFIKI_WORKDIR/db.dump.sql}"
 python - "$RAFIKI_DB_PATH" "$OUT" <<'EOF'
 import sqlite3, sys
