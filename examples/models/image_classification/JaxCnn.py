@@ -136,6 +136,9 @@ class JaxCnn(BaseModel):
             epochs=self._knobs["epochs"],
             batch_size=self._knobs["batch_size"],
             log=self.logger.log,
+            # mid-trial checkpointing: a crashed-and-restarted trial resumes
+            # from its last finished epoch (see BaseModel.checkpoint_path)
+            checkpoint_path=self.checkpoint_path,
         )
         self._params = params
 

@@ -1,0 +1,209 @@
+"""JaxBert — transformer text classifier with ARCHITECTURE SEARCH knobs.
+
+The BASELINE.json "BERT + search" north-star config as a model template:
+depth / heads / width are knobs, so the shared GP advisor performs neural
+architecture search over the BERT family — each sampled architecture is a
+trial, scores feed the same Bayesian optimizer as any hyperparameter (the
+reference had no NAS story at all; its nearest analogue is knob search over
+layer counts in TfFeedForward, reference
+examples/models/image_classification/TfFeedForward.py:20-28).
+
+TPU notes: one jitted fused step per architecture (cached_trainer keyed by
+the frozen config — repeat proposals of an architecture recompile nothing);
+tokens are hashed into a fixed vocab (dependency-free tokenizer), sequences
+padded to a static max_len so every trial shares batch shapes.
+
+Run this file directly for the local contract check.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+import jax
+import numpy as np
+import optax
+
+from rafiki_tpu.models import bert
+from rafiki_tpu.sdk import (
+    BaseModel,
+    CategoricalKnob,
+    DataParallelTrainer,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    cached_trainer,
+    dataset_utils,
+    softmax_classifier_loss,
+    tunable_optimizer,
+)
+
+
+def _hash_ids(tokens, vocab: int, max_len: int) -> np.ndarray:
+    """Dependency-free tokenizer: stable token hash into [2, vocab); 0 is
+    padding, 1 is the [CLS]-style pooling slot."""
+    import zlib
+
+    ids = np.zeros((max_len,), np.int32)
+    ids[0] = 1
+    for i, tok in enumerate(tokens[: max_len - 1]):
+        ids[i + 1] = 2 + zlib.crc32(tok.lower().encode()) % (vocab - 2)
+    return ids
+
+
+class JaxBert(BaseModel):
+    """Hashed-token BERT encoder; class = argmax over pooled logits."""
+
+    dependencies = {"jax": None, "optax": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            # the ARCHITECTURE search space (NAS via the shared GP advisor)
+            "depth": IntegerKnob(2, 4),
+            "heads": CategoricalKnob([2, 4]),
+            "dim": CategoricalKnob([64, 128]),
+            # ordinary hyperparameters
+            "learning_rate": FloatKnob(1e-4, 5e-3, is_exp=True),
+            "epochs": IntegerKnob(1, 3),
+            "batch_size": CategoricalKnob([16, 32, 64]),
+            "max_len": FixedKnob(64),
+            "vocab": FixedKnob(4096),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+        self._trainer = None
+        self._cfg = None
+        self._label_vocab = None
+
+    def _make_cfg(self, num_classes):
+        k = self._knobs
+        return bert.tiny(vocab=k["vocab"], max_len=k["max_len"],
+                         num_classes=num_classes, dim=k["dim"],
+                         depth=k["depth"], heads=k["heads"])
+
+    def _build_trainer(self):
+        cfg = self._cfg
+        apply_fn = lambda p, ids: bert.apply(p, ids, cfg)
+        # cached by the frozen config: every shape-affecting knob (the whole
+        # architecture) is in the key; lr stays dynamic
+        return cached_trainer(("JaxBert", cfg), lambda: DataParallelTrainer(
+            softmax_classifier_loss(apply_fn),
+            tunable_optimizer(optax.adamw,
+                              learning_rate=self._knobs["learning_rate"]),
+            predict_fn=lambda p, ids: jax.nn.softmax(apply_fn(p, ids), -1),
+        ))
+
+    # -- data --------------------------------------------------------------
+
+    def _load(self, dataset_uri):
+        """Corpus zip; each sentence's first tag column is its class label
+        (docs/tasks.md TEXT_CLASSIFICATION)."""
+        ds = dataset_utils.load_dataset_of_corpus(dataset_uri)
+        texts, labels = [], []
+        for tokens, tags in ds:
+            texts.append(tokens)
+            labels.append(tags[0][0] if tags and tags[0] else "")
+        if self._label_vocab is None:
+            self._label_vocab = sorted(set(labels))
+        lut = {t: i for i, t in enumerate(self._label_vocab)}
+        k = self._knobs
+        x = np.stack([_hash_ids(t, k["vocab"], k["max_len"]) for t in texts])
+        y = np.array([lut.get(l, 0) for l in labels], np.int32)
+        return x, y
+
+    # -- BaseModel contract ------------------------------------------------
+
+    def train(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        self._cfg = self._make_cfg(len(self._label_vocab))
+        self._trainer = self._build_trainer()
+        params, opt_state = self._trainer.init(
+            lambda rng: bert.init(rng, self._cfg),
+            hyperparams={"learning_rate": self._knobs["learning_rate"]})
+        self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        self._params, _ = self._trainer.fit(
+            params, opt_state, (x, y),
+            epochs=self._knobs["epochs"],
+            batch_size=self._knobs["batch_size"],
+            log=self.logger.log,
+            checkpoint_path=self.checkpoint_path,
+        )
+
+    def evaluate(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        from rafiki_tpu.sdk import classification_accuracy
+
+        return classification_accuracy(self._trainer, self._params, x, y)
+
+    def predict(self, queries):
+        from rafiki_tpu import config as rconfig
+
+        k = self._knobs
+        ids = np.stack([
+            _hash_ids(q.split() if isinstance(q, str) else list(q),
+                      k["vocab"], k["max_len"])
+            for q in queries
+        ])
+        probs = self._trainer.predict_batched(
+            self._params, ids, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
+        return [p.tolist() for p in probs]
+
+    def warm_up(self):
+        from rafiki_tpu import config as rconfig
+
+        example = np.zeros((self._knobs["max_len"],), np.int32)
+        self._trainer.warm_predict(self._params, example,
+                                   batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
+
+    def dump_parameters(self):
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "label_vocab": self._label_vocab,
+            "arch": {k: self._knobs[k] for k in
+                     ("depth", "heads", "dim", "max_len", "vocab")},
+        }
+
+    def load_parameters(self, params):
+        self._label_vocab = params["label_vocab"]
+        self._knobs.update(params["arch"])
+        self._cfg = self._make_cfg(len(self._label_vocab))
+        if self._trainer is None:
+            self._trainer = self._build_trainer()
+        self._params = self._trainer.device_put_params(params["params"])
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    from rafiki_tpu.sdk import test_model_class
+    from rafiki_tpu.sdk.dataset import write_corpus_dataset
+
+    rng = np.random.default_rng(0)
+    # two separable synthetic "languages": class A sentences draw from one
+    # token pool, class B from another
+    pools = (["alpha", "beta", "gamma", "delta"],
+             ["omega", "sigma", "lambda", "kappa"])
+    sentences = []
+    for i in range(200):
+        cls = i % 2
+        toks = list(rng.choice(pools[cls], size=rng.integers(3, 10)))
+        sentences.append((toks, [[f"class{cls}"]] * len(toks)))
+    with tempfile.TemporaryDirectory() as d:
+        train_uri = write_corpus_dataset(
+            sentences[:160], os.path.join(d, "train.zip"))
+        test_uri = write_corpus_dataset(
+            sentences[160:], os.path.join(d, "test.zip"))
+        test_model_class(
+            clazz=JaxBert,
+            task="TEXT_CLASSIFICATION",
+            train_dataset_uri=train_uri,
+            test_dataset_uri=test_uri,
+            queries=["alpha beta gamma", "omega sigma kappa"],
+        )

@@ -74,15 +74,18 @@ class WorkerQueue:
         max_size: int,
         deadline_s: float,
         wait_timeout_s: float = 0.5,
-    ) -> List[Tuple[QueryFuture, Any]]:
+    ) -> Optional[List[Tuple[QueryFuture, Any]]]:
         """Block until work arrives (or `wait_timeout_s` elapses), then keep
         draining until the batch fills or `deadline_s` passes since the first
-        item. Returns [] on timeout/closure so callers can check stop flags."""
+        item. Returns [] on timeout so callers can check stop flags, and
+        None once the queue is CLOSED and drained — a closed queue answers
+        instantly, so treating it like a timeout would turn the caller's
+        poll loop into a busy spin."""
         with self._cond:
             if not self._items and not self._closed:
                 self._cond.wait(wait_timeout_s)
             if not self._items:
-                return []
+                return None if self._closed else []
             first_t = time.monotonic()
             batch = self._items[:max_size]
             del self._items[: len(batch)]

@@ -106,11 +106,16 @@ class ShmWorkerQueue:
 
     def take_batch(self, max_size: int, deadline_s: float,
                    wait_timeout_s: float = 0.5
-                   ) -> List[Tuple["ShmWorkerQueue.ResponseHandle", Any]]:
+                   ) -> Optional[List[Tuple["ShmWorkerQueue.ResponseHandle",
+                                            Any]]]:
+        """[] on timeout; None once the queue is closed-and-drained (same
+        contract as cache.queue.WorkerQueue.take_batch — a closed ring
+        answers instantly, and callers polling it as if it were a timeout
+        would spin hot)."""
         try:
             first = self._qq.pop(timeout_s=wait_timeout_s)
         except ShmQueueClosed:
-            return []
+            return None
         if first is None:
             return []
         batch = [first]

@@ -1,10 +1,12 @@
 """BERT-style encoder for text classification.
 
-Backs the BASELINE.json "BERT-base text classification with ENAS search"
+Backs the BASELINE.json "BERT-base text classification with search"
 config: a bidirectional transformer encoder (models/transformer.py stack,
-non-causal) with token/position embeddings and first-token pooling. The
-ENAS advisor (advisor/enas.py) searches over depth/heads/dim knobs of this
-family.
+non-causal) with token/position embeddings and first-token pooling.
+Architecture search runs through the standard advisor machinery: the
+JaxBert template (examples/models/text_classification/JaxBert.py) exposes
+depth/heads/dim as knobs, so the shared GP advisor samples architectures
+of this family as trials.
 """
 
 from __future__ import annotations

@@ -56,6 +56,13 @@ class BaseModel(abc.ABC):
     def __init__(self, **knobs: Any):
         self._knobs = knobs
         self.logger: ModelLogger = _module_logger
+        #: set by the train worker before ``train()``: a per-trial file path
+        #: templates MAY hand to ``DataParallelTrainer.fit(checkpoint_path=
+        #: ...)`` for mid-trial checkpointing — a crashed-and-restarted trial
+        #: then resumes from its last epoch instead of from scratch (the
+        #: reference always restarted from scratch, reference
+        #: worker/train.py:122-132). None when run outside a worker.
+        self.checkpoint_path: Optional[str] = None
 
     @staticmethod
     @abc.abstractmethod

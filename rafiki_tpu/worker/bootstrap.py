@@ -78,6 +78,23 @@ def main() -> int:
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
 
+    # Orphan watchdog: if the parent placement manager dies without managing
+    # to SIGTERM us (hard kill mid-teardown, agent crash), this process must
+    # not linger — an orphaned serving worker with a torn-down data plane
+    # spins forever and, on a small host, starves everything else. Detected
+    # by reparenting (PPID becomes init).
+    parent0 = os.getppid()
+
+    def watch_parent():
+        while not stop_event.wait(2.0):
+            if os.getppid() != parent0:
+                logger.warning("parent %d died; stopping", parent0)
+                stop_event.set()
+                return
+
+    threading.Thread(target=watch_parent, name="orphan-watchdog",
+                     daemon=True).start()
+
     ctx = ServiceContext(
         service_id=service_id,
         service_type=service_type,

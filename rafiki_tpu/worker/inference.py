@@ -98,6 +98,13 @@ class InferenceWorker:
                     max_size=config.PREDICT_MAX_BATCH_SIZE,
                     deadline_s=config.PREDICT_BATCH_DEADLINE_MS / 1000.0,
                 )
+                if batch is None:
+                    # the data plane was closed under us (broker teardown,
+                    # owner gone): serving is over — exit instead of
+                    # spinning on a queue that answers instantly
+                    logger.info("query queue closed; worker %s exiting",
+                                ctx.service_id)
+                    break
                 if not batch:
                     continue
                 _record_batch(ctx.service_id, len(batch))

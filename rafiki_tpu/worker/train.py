@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, Optional
 
 from rafiki_tpu import config
 from rafiki_tpu.advisor.advisor import AdvisorStore
-from rafiki_tpu.constants import BudgetType
+from rafiki_tpu.constants import BudgetType, TrialStatus
 from rafiki_tpu.db.database import Database
 from rafiki_tpu.parallel.mesh import set_device_grant
 from rafiki_tpu.placement.manager import ServiceContext
@@ -105,6 +105,56 @@ class TrainWorker:
         self._db.update_sub_train_job_advisor(self._sub_id, advisor_id)
         ctx.ready()  # job info read + model class loaded: startup succeeded
 
+        # Crash recovery: trials left RUNNING by a killed predecessor of
+        # this service (a restarted worker keeps its service id) are re-run
+        # under the SAME trial id and knobs — a template that feeds
+        # ``checkpoint_path`` to fit() resumes from its last epoch rather
+        # than from scratch (the reference discarded all progress,
+        # reference worker/train.py:122-132).
+        for stale in self._db.get_trials_of_sub_train_job(self._sub_id):
+            if ctx.stopping:
+                return
+            if (stale["status"] != TrialStatus.RUNNING
+                    or stale["worker_id"] != ctx.service_id):
+                continue
+            if deadline is not None and time.time() >= deadline:
+                # the time budget expired while this trial was down: it
+                # will never run — release its budget slot (the main loop
+                # reports budget-reached right after)
+                logger.info("time budget spent; terminating stale trial %s",
+                            stale["id"])
+                self._db.mark_trial_as_terminated(stale["id"])
+                self._cleanup_ckpt(stale["id"])
+                continue
+            logger.info("resuming stale trial %s after worker restart",
+                        stale["id"])
+            trial_logger = ModelLogger()
+            trial_logger.set_sink(
+                lambda line, _tid=stale["id"]: self._db.add_trial_log(
+                    _tid, line))
+            tracer = Tracer(stale["id"])
+            try:
+                score, params_path = self._run_trial(
+                    clazz, stale["knobs"], job, stale["id"], trial_logger,
+                    tracer)
+                if ctx.stopping:
+                    self._db.mark_trial_as_terminated(stale["id"])
+                    self._cleanup_ckpt(stale["id"])
+                    return
+                self._db.mark_trial_as_complete(stale["id"], score,
+                                                params_path)
+                self._advisors.get(advisor_id).feedback(
+                    stale["knobs"], score)
+            except Exception:
+                if ctx.stopping:
+                    self._db.mark_trial_as_terminated(stale["id"])
+                    self._cleanup_ckpt(stale["id"])
+                    return
+                logger.error("resumed trial %s errored:\n%s", stale["id"],
+                             traceback.format_exc())
+                self._db.mark_trial_as_errored(stale["id"])
+                self._cleanup_ckpt(stale["id"])
+
         while not ctx.stopping:
             # shared budget accounting through the DB (reference
             # train.py:227-232) — but the reserve is ATOMIC (count + insert
@@ -141,19 +191,32 @@ class TrainWorker:
                 )
                 if ctx.stopping:
                     self._db.mark_trial_as_terminated(trial["id"])
+                    self._cleanup_ckpt(trial["id"])
                     return
                 self._db.mark_trial_as_complete(trial["id"], score, params_path)
                 self._advisors.get(advisor_id).feedback(knobs, score)
             except Exception:
                 if ctx.stopping:
                     self._db.mark_trial_as_terminated(trial["id"])
+                    self._cleanup_ckpt(trial["id"])
                     return
                 logger.error(
                     "trial %s errored:\n%s", trial["id"], traceback.format_exc()
                 )
                 self._db.mark_trial_as_errored(trial["id"])
+                self._cleanup_ckpt(trial["id"])
                 # errored trials count toward budget (reference train.py:231);
                 # keep looping — the executor survives a bad knob combination
+
+    def _cleanup_ckpt(self, trial_id: str) -> None:
+        """Drop a trial's mid-trial checkpoint once the trial reached a
+        terminal state it will never resume from (ERRORED/TERMINATED —
+        only RUNNING trials are ever re-run). Success-path cleanup lives in
+        _run_trial."""
+        try:
+            os.remove(os.path.join(self._params_dir, f"{trial_id}.ckpt"))
+        except OSError:
+            pass
 
     def _run_trial(
         self,
@@ -167,17 +230,26 @@ class TrainWorker:
         tracer = tracer or Tracer(trial_id)
         model = clazz(**knobs)
         model.logger = trial_logger
+        # per-trial checkpoint slot: templates that pass it to fit() get
+        # resume-from-last-epoch when a crashed worker re-runs this trial
+        os.makedirs(self._params_dir, exist_ok=True)
+        model.checkpoint_path = os.path.join(
+            self._params_dir, f"{trial_id}.ckpt")
         try:
             with jax_profile(), tracer.span("train"):
                 model.train(job["train_dataset_uri"])
             with tracer.span("evaluate"):
                 score = float(model.evaluate(job["test_dataset_uri"]))
             with tracer.span("persist_params"):
-                os.makedirs(self._params_dir, exist_ok=True)
                 params_path = os.path.join(
                     self._params_dir, f"{trial_id}.params")
                 with open(params_path, "wb") as f:
                     f.write(dump_params(model.dump_parameters()))
+            # the trial is complete: its mid-trial checkpoint is dead weight
+            try:
+                os.remove(model.checkpoint_path)
+            except OSError:
+                pass
             return score, params_path
         finally:
             try:

@@ -12,7 +12,9 @@ fi
 PID="$(cat "$RAFIKI_PID_FILE")"
 if kill -0 "$PID" 2>/dev/null; then
     kill -TERM "$PID"
-    for _ in $(seq 1 40); do
+    # generous grace: the admin SIGTERMs every worker child and waits for
+    # them; cutting this short orphans children mid-teardown
+    for _ in $(seq 1 180); do
         kill -0 "$PID" 2>/dev/null || break
         sleep 0.5
     done

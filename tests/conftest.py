@@ -9,6 +9,12 @@ import os
 
 if not os.environ.get("RAFIKI_TEST_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Child processes spawned by tests (process placement, host agents,
+    # multiprocessing) must never touch a remote-TPU tunnel: dropping the
+    # pool var disables any sitecustomize TPU-plugin registration in
+    # children, which otherwise adds ~10 s to EVERY interpreter start when
+    # the tunnel is slow/wedged (and can hang workers outright).
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (

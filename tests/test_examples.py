@@ -38,6 +38,7 @@ ALL_TEMPLATES = [
     "image_generation/JaxProGan.py",
     "pos_tagging/BigramHmm.py",
     "pos_tagging/JaxBiLstm.py",
+    "text_classification/JaxBert.py",
 ]
 
 
@@ -111,3 +112,36 @@ def test_bigram_hmm_learns_toy_grammar(tmp_path):
     model.train(train)
     assert model.evaluate(test) == 1.0
     assert model.predict([["a", "dog", "sees"]]) == [["DT", "NN", "VB"]]
+
+
+def test_jaxbert_architecture_search_template(tmp_path):
+    # the "BERT + search" template: architecture knobs (depth/heads/dim)
+    # sampled per trial; a tiny sampled config must learn a separable
+    # two-pool token task end to end
+    from rafiki_tpu.sdk.dataset import write_corpus_dataset
+
+    clazz = _load("text_classification/JaxBert.py")
+    rng = np.random.default_rng(0)
+    pools = (["alpha", "beta", "gamma"], ["omega", "sigma", "kappa"])
+    sentences = []
+    for i in range(120):
+        cls = i % 2
+        toks = list(rng.choice(pools[cls], size=rng.integers(3, 8)))
+        sentences.append((toks, [[f"class{cls}"]] * len(toks)))
+    train = write_corpus_dataset(sentences[:96], str(tmp_path / "tr.zip"))
+    test = write_corpus_dataset(sentences[96:], str(tmp_path / "te.zip"))
+
+    model = clazz(depth=2, heads=2, dim=64, learning_rate=3e-3, epochs=2,
+                  batch_size=16, max_len=32, vocab=512)
+    model.train(train)
+    score = model.evaluate(test)
+    assert score > 0.9
+    preds = model.predict(["alpha beta gamma", "omega sigma kappa"])
+    assert np.argmax(preds[0]) != np.argmax(preds[1])
+    # dump/restore roundtrip preserves the sampled architecture
+    blob = model.dump_parameters()
+    fresh = clazz(depth=4, heads=4, dim=128, learning_rate=1e-3, epochs=1,
+                  batch_size=16, max_len=32, vocab=512)
+    fresh.load_parameters(blob)
+    preds2 = fresh.predict(["alpha beta gamma"])
+    np.testing.assert_allclose(preds2[0], preds[0], atol=1e-5)

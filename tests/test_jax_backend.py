@@ -1,3 +1,4 @@
+import os
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -228,3 +229,60 @@ def test_thread_device_grant_precedence_and_isolation(monkeypatch):
         assert get_default_mesh().devices.size == 3  # ours unchanged
     finally:
         set_device_grant(None)
+
+
+def test_fit_checkpoint_resume_matches_uninterrupted(tmp_path):
+    # a fit interrupted after 2 of 4 epochs and resumed from its checkpoint
+    # must land on EXACTLY the params of an uninterrupted 4-epoch run (the
+    # rng schedule is a pure function of (seed, epoch))
+    x, y = _linear_data(n=256)
+
+    def apply_fn(params, xb):
+        return xb @ params["w"] + params["b"]
+
+    def init_fn(key):
+        return {"w": 0.01 * jax.random.normal(key, (8, 3)),
+                "b": jnp.zeros((3,))}
+
+    def make():
+        t = DataParallelTrainer(
+            loss_fn=softmax_classifier_loss(apply_fn),
+            optimizer=optax.adam(1e-2), predict_fn=apply_fn)
+        return t, *t.init(init_fn, seed=3)
+
+    ckpt = str(tmp_path / "trial.ckpt")
+    # straight 4-epoch run, no checkpointing
+    t0, p0, s0 = make()
+    ref, _ = t0.fit(p0, s0, (x, y), epochs=4, batch_size=64, seed=7)
+    # 2 epochs with checkpoint (simulated crash: fresh trainer + state after)
+    t1, p1, s1 = make()
+    t1.fit(p1, s1, (x, y), epochs=2, batch_size=64, seed=7,
+           checkpoint_path=ckpt)
+    assert os.path.exists(ckpt)
+    t2, p2, s2 = make()  # "restart": fresh params, resumes from the file
+    resumed, _ = t2.fit(p2, s2, (x, y), epochs=4, batch_size=64, seed=7,
+                        checkpoint_path=ckpt)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(resumed)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_fit_checkpoint_interrupted_epoch_boundary(tmp_path):
+    # resume respects checkpoint_every_epochs: only epochs 0..k-1 replay
+    x, y = _linear_data(n=128)
+
+    def apply_fn(params, xb):
+        return xb @ params["w"]
+
+    trainer = DataParallelTrainer(
+        loss_fn=softmax_classifier_loss(apply_fn),
+        optimizer=optax.sgd(1e-2))
+    params, opt = trainer.init(lambda k: {"w": jnp.zeros((8, 3))})
+    ckpt = str(tmp_path / "c.ckpt")
+    trainer.fit(params, opt, (x, y), epochs=3, batch_size=64,
+                checkpoint_path=ckpt, checkpoint_every_epochs=2)
+    from flax import serialization
+
+    with open(ckpt, "rb") as f:
+        blob = serialization.msgpack_restore(f.read())
+    assert blob["epoch"] == 3  # final epoch always checkpointed

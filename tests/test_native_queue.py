@@ -115,7 +115,7 @@ def _child_echo(req_name, resp_name):
     # re-open both queues by name in a fresh process; echo request->response
     req = ShmMessageQueue(req_name, create=False)
     resp = ShmMessageQueue(resp_name, create=False)
-    msg = req.pop(timeout_s=10.0)
+    msg = req.pop(timeout_s=30.0)
     resp.push(b"echo:" + (msg or b"<timeout>"))
     req.destroy()   # non-owner: unmap only
     resp.destroy()
@@ -129,7 +129,7 @@ def test_cross_process_attach():
         p = ctx.Process(target=_child_echo, args=(req.name, resp.name))
         p.start()
         req.push(b"ping")
-        got = resp.pop(timeout_s=15.0)
+        got = resp.pop(timeout_s=60.0)
         p.join(timeout=10)
         assert got == b"echo:ping"
         assert p.exitcode == 0
@@ -149,6 +149,8 @@ def test_shm_broker_roundtrip():
             for _ in range(50):
                 batch = wq.take_batch(max_size=8, deadline_s=0.002,
                                       wait_timeout_s=0.2)
+                if batch is None:
+                    return  # queue closed
                 for handle, query in batch:
                     handle.set_result({"echo": query})
 

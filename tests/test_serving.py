@@ -26,6 +26,8 @@ class EchoWorker:
         while not self._stop.is_set():
             batch = self.queue.take_batch(max_size=16, deadline_s=0.001,
                                           wait_timeout_s=0.05)
+            if batch is None:
+                return  # queue closed
             for fut, _query in batch:
                 if self.delay_s:
                     time.sleep(self.delay_s)
@@ -111,3 +113,17 @@ def test_slow_replica_still_answers_after_hedge(broker):
     assert p.predict([0.0], timeout_s=1.2) == [1.0, 0.0]
     assert time.monotonic() - t0 < 1.1  # answered at ~0.6s, not the SLO
     slow.stop()
+
+
+def test_take_batch_distinguishes_closed_from_timeout(broker):
+    # a closed queue must return None (terminal), never [] in a tight loop —
+    # regression for orphaned serving workers spinning on a torn-down data
+    # plane
+    q = broker.register_worker("job", "w")
+    assert q.take_batch(max_size=4, deadline_s=0.001, wait_timeout_s=0.01) == []
+    broker.unregister_worker("job", "w")
+    t0 = time.monotonic()
+    for _ in range(3):
+        assert q.take_batch(max_size=4, deadline_s=0.001,
+                            wait_timeout_s=5.0) is None
+    assert time.monotonic() - t0 < 1.0  # closed answers instantly, as None

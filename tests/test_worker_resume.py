@@ -1,0 +1,84 @@
+"""Crash recovery at the trial level: a restarted worker re-runs trials its
+predecessor left RUNNING (same id, same knobs), so templates using
+``checkpoint_path`` resume mid-trial — the reference restarted trials from
+scratch and left SIGKILLed ones RUNNING forever (reference
+worker/train.py:122-132)."""
+
+import os
+import threading
+
+from rafiki_tpu.advisor.advisor import AdvisorStore
+from rafiki_tpu.constants import ServiceType, TrialStatus, UserType
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.manager import ServiceContext
+from rafiki_tpu.worker.train import TrainWorker
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fake_model.py")
+
+
+def test_worker_resumes_stale_running_trial(tmp_path):
+    db = Database(":memory:")
+    user = db.create_user("u@x", "h", UserType.APP_DEVELOPER)
+    with open(FIXTURE, "rb") as f:
+        model = db.create_model(
+            user["id"], "fake", "IMAGE_CLASSIFICATION", f.read(),
+            "FakeModel", {"numpy": None}, "PUBLIC")
+    job = db.create_train_job(
+        user["id"], "app", 1, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        {"MODEL_TRIAL_COUNT": 3})
+    sub = db.create_sub_train_job(job["id"], model["id"])
+
+    # simulate a predecessor that died mid-trial: a RUNNING row owned by
+    # the service id this worker will come up with
+    knobs = {"int_knob": 4, "float_knob": 0.01, "cat_knob": "b",
+             "fixed_knob": "fixed"}
+    stale = db.create_trial(sub["id"], model["id"], knobs,
+                            worker_id="svc-resume")
+
+    worker = TrainWorker(sub["id"], db, AdvisorStore(),
+                         params_dir=str(tmp_path / "params"))
+    ctx = ServiceContext(service_id="svc-resume", service_type=ServiceType.TRAIN,
+                         chips=[], stop_event=threading.Event())
+    worker.start(ctx)  # sweeps the stale trial, then runs the budget out
+
+    trials = db.get_trials_of_sub_train_job(sub["id"])
+    by_id = {t["id"]: t for t in trials}
+    resumed = by_id[stale["id"]]
+    assert resumed["status"] == TrialStatus.COMPLETED
+    assert resumed["score"] is not None
+    assert resumed["params_file_path"] and os.path.exists(
+        resumed["params_file_path"])
+    # same knobs, not re-proposed
+    assert resumed["knobs"] == knobs
+    # the resumed trial consumed one budget slot: exactly 3 trials total
+    assert len(trials) == 3
+    assert all(t["status"] == TrialStatus.COMPLETED for t in trials)
+    db.close()
+
+
+def test_worker_ignores_other_workers_running_trials(tmp_path):
+    db = Database(":memory:")
+    user = db.create_user("u@x", "h", UserType.APP_DEVELOPER)
+    with open(FIXTURE, "rb") as f:
+        model = db.create_model(
+            user["id"], "fake", "IMAGE_CLASSIFICATION", f.read(),
+            "FakeModel", {"numpy": None}, "PUBLIC")
+    job = db.create_train_job(
+        user["id"], "app", 1, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        {"MODEL_TRIAL_COUNT": 2})
+    sub = db.create_sub_train_job(job["id"], model["id"])
+    other = db.create_trial(sub["id"], model["id"], {"fixed_knob": "fixed"},
+                            worker_id="someone-else")
+
+    worker = TrainWorker(sub["id"], db, AdvisorStore(),
+                         params_dir=str(tmp_path / "params"))
+    ctx = ServiceContext(service_id="svc-b", service_type=ServiceType.TRAIN,
+                         chips=[], stop_event=threading.Event())
+    worker.start(ctx)
+
+    # the foreign RUNNING trial was left alone (it still counts toward the
+    # budget, so only one more trial was reserved)
+    trials = db.get_trials_of_sub_train_job(sub["id"])
+    assert db.get_trial(other["id"])["status"] == TrialStatus.RUNNING
+    assert len(trials) == 2
+    db.close()
