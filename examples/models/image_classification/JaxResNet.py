@@ -1,0 +1,176 @@
+"""JaxResNet — residual convnet image classifier with BatchNorm.
+
+The BASELINE.json "CIFAR-10 ResNet + Bayesian HPO" config as a model
+template: a `depth` knob picks the ResNet-18 or ResNet-50 plan
+(rafiki_tpu.models.resnet) and the usual lr/epochs/batch knobs feed the GP
+advisor. BatchNorm's running statistics ride the trainer's *stateful* path
+(DataParallelTrainer(stateful=True)): they are threaded through the jitted
+step, checkpointed next to the params, and excluded from the optimizer —
+inference uses the accumulated running stats, so single-query serving is
+exact (no batch-stats dependence).
+
+Run this file directly for the local contract check.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rafiki_tpu.models import resnet
+from rafiki_tpu.sdk import (
+    BaseModel,
+    CategoricalKnob,
+    DataParallelTrainer,
+    FixedKnob,
+    FloatKnob,
+    IntegerKnob,
+    cached_trainer,
+    dataset_utils,
+    tunable_optimizer,
+)
+
+
+class JaxResNet(BaseModel):
+
+    dependencies = {"jax": None, "optax": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "depth": CategoricalKnob(["resnet18", "resnet50"]),
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "epochs": IntegerKnob(1, 4),
+            "batch_size": CategoricalKnob([64, 128, 256]),
+            "image_size": FixedKnob(32),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+        self._state = None  # BatchNorm running statistics
+        self._cfg = None
+
+    def _make_cfg(self, num_classes):
+        make = (resnet.resnet50 if self._knobs["depth"] == "resnet50"
+                else resnet.resnet18)
+        return make(num_classes=num_classes, small_inputs=True)
+
+    def _build_trainer(self):
+        cfg = self._cfg
+
+        def loss_fn(params, state, batch, rng):
+            x, y = batch
+            logits, new_state = resnet.apply(params, state, x, cfg,
+                                             train=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            acc = (jnp.argmax(logits, -1) == y).mean()
+            return loss, ({"acc": acc}, new_state)
+
+        def predict_fn(params, state, x):
+            logits, _ = resnet.apply(params, state, x, cfg, train=False)
+            return jax.nn.softmax(logits, axis=-1)
+
+        return cached_trainer(("JaxResNet", cfg), lambda: DataParallelTrainer(
+            loss_fn,
+            tunable_optimizer(optax.adamw,
+                              learning_rate=self._knobs["learning_rate"]),
+            predict_fn=predict_fn,
+            stateful=True,
+        ))
+
+    def _load(self, dataset_uri):
+        size = self._knobs["image_size"]
+        return dataset_utils.load_image_arrays(dataset_uri,
+                                               image_size=(size, size))
+
+    # -- BaseModel contract ------------------------------------------------
+
+    def train(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        self._cfg = self._make_cfg(int(y.max()) + 1)
+        trainer = self._build_trainer()
+        params, opt_state, state = trainer.init(
+            lambda rng: resnet.init(rng, self._cfg),
+            hyperparams={"learning_rate": self._knobs["learning_rate"]})
+        self.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        self._params, _, self._state = trainer.fit(
+            params, opt_state, (x, y),
+            epochs=self._knobs["epochs"],
+            batch_size=self._knobs["batch_size"],
+            log=self.logger.log,
+            checkpoint_path=self.checkpoint_path,
+            state=state,
+        )
+
+    def evaluate(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        trainer = self._build_trainer()
+        probs = trainer.predict_batched(self._params, x, state=self._state)
+        return float((np.argmax(probs, -1) == np.asarray(y)).mean())
+
+    def predict(self, queries):
+        from rafiki_tpu import config as rconfig
+
+        trainer = self._build_trainer()
+        x = np.asarray(queries, dtype=np.float32)
+        probs = trainer.predict_batched(
+            self._params, x, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE,
+            state=self._state)
+        return [p.tolist() for p in probs]
+
+    def warm_up(self):
+        from rafiki_tpu import config as rconfig
+
+        size = self._knobs["image_size"]
+        channels = int(self._params["stem"]["kernel"].shape[2])
+        example = np.zeros((size, size, channels), np.float32)
+        self._build_trainer().warm_predict(
+            self._params, example,
+            batch_size=rconfig.PREDICT_MAX_BATCH_SIZE, state=self._state)
+
+    def dump_parameters(self):
+        return {
+            "params": jax.tree.map(np.asarray, self._params),
+            "state": jax.tree.map(np.asarray, self._state),
+            "num_classes": self._cfg.num_classes,
+            "depth": self._knobs["depth"],
+        }
+
+    def load_parameters(self, blob):
+        self._knobs["depth"] = blob["depth"]
+        self._cfg = self._make_cfg(blob["num_classes"])
+        trainer = self._build_trainer()
+        self._params = trainer.device_put_params(blob["params"])
+        self._state = trainer.device_put_params(blob["state"])
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    from rafiki_tpu.sdk import test_model_class
+    from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        y = rng.integers(0, 10, size=256).astype(np.int32)
+        x = (rng.normal(size=(256, 32, 32, 3))
+             + y[:, None, None, None] * 0.5).astype(np.float32)
+        train_uri = write_numpy_dataset(x, y, os.path.join(d, "train.npz"))
+        test_uri = write_numpy_dataset(x[:64], y[:64], os.path.join(d, "test.npz"))
+        test_model_class(
+            clazz=JaxResNet,
+            task="IMAGE_CLASSIFICATION",
+            train_dataset_uri=train_uri,
+            test_dataset_uri=test_uri,
+            queries=[x[0].tolist()],
+        )

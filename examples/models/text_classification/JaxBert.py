@@ -174,8 +174,9 @@ class JaxBert(BaseModel):
         self._label_vocab = params["label_vocab"]
         self._knobs.update(params["arch"])
         self._cfg = self._make_cfg(len(self._label_vocab))
-        if self._trainer is None:
-            self._trainer = self._build_trainer()
+        # rebuild unconditionally: an existing trainer closed over the OLD
+        # architecture's cfg; cached_trainer makes the rebuild free
+        self._trainer = self._build_trainer()
         self._params = self._trainer.device_put_params(params["params"])
 
 

@@ -193,6 +193,10 @@ class AdminServer:
             r("POST", r"/advisors/(?P<aid>[^/]+)/feedback", _ANY,
                 lambda au, m, b, q: {"knobs": A.advisor_store.feedback(
                     m["aid"], b["knobs"], b["score"])}),
+            r("POST", r"/advisors/(?P<aid>[^/]+)/replay", _ANY,
+                lambda au, m, b, q: {"replayed": A.advisor_store.replay_feedback(
+                    m["aid"],
+                    [(i["knobs"], i["score"]) for i in b["items"]])}),
             r("DELETE", r"/advisors/(?P<aid>[^/]+)", _ANY, lambda au, m, b, q:
                 A.advisor_store.delete_advisor(m["aid"]) or {}),
             # admin actions (reference scripts/stop_all_jobs.py via client)

@@ -140,6 +140,25 @@ class AdvisorStore:
         advisor.feedback(knobs, score)
         return advisor.propose()
 
+    def replay_feedback(
+        self, advisor_id: str, items: List[Tuple[Dict[str, Any], float]]
+    ) -> bool:
+        """Seed a FRESH advisor session with already-scored (knobs, score)
+        pairs — how a restarted worker rebuilds the GP from the completed
+        trials already in the store. Atomic and empty-only: if the session
+        has any observations (it survived, or a sibling already replayed),
+        this is a no-op returning False, so concurrent restarts can't
+        double-feed the optimizer."""
+        with self._lock:
+            advisor = self._advisors.get(advisor_id)
+            if advisor is None:
+                raise KeyError(f"No such advisor: {advisor_id}")
+            if len(getattr(advisor, "history", ())) > 0:
+                return False
+            for knobs, score in items:
+                advisor.feedback(knobs, float(score))
+            return True
+
     def delete_advisor(self, advisor_id: str) -> None:
         with self._lock:
             self._advisors.pop(advisor_id, None)

@@ -51,5 +51,8 @@ class RemoteAdvisorStore:
     def get(self, advisor_id: str) -> _RemoteAdvisor:
         return _RemoteAdvisor(self._client, advisor_id)
 
+    def replay_feedback(self, advisor_id: str, items) -> bool:
+        return self._client.replay_advisor_feedback(advisor_id, items)
+
     def delete_advisor(self, advisor_id: str) -> None:
         self._client.delete_advisor(advisor_id)

@@ -235,6 +235,16 @@ class Client:
     def propose_knobs(self, advisor_id: str) -> Dict[str, Any]:
         return self._call("POST", f"/advisors/{advisor_id}/propose")["knobs"]
 
+    def replay_advisor_feedback(self, advisor_id: str, items) -> bool:
+        """Seed a fresh advisor session with already-scored (knobs, score)
+        pairs; no-op (False) if the session already has observations."""
+        out = self._call(
+            "POST",
+            f"/advisors/{advisor_id}/replay",
+            {"items": [{"knobs": k, "score": s} for k, s in items]},
+        )
+        return bool(out["replayed"])
+
     def feedback_knobs(
         self, advisor_id: str, knobs: Dict[str, Any], score: float
     ) -> Dict[str, Any]:

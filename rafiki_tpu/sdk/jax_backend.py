@@ -176,37 +176,77 @@ class DataParallelTrainer:
         self,
         loss_fn: LossFn,
         optimizer: optax.GradientTransformation,
-        predict_fn: Optional[Callable[[Any, Any], jax.Array]] = None,
+        predict_fn: Optional[Callable[..., jax.Array]] = None,
         mesh: Optional[Mesh] = None,
+        stateful: bool = False,
     ):
+        """``stateful=True`` threads a non-trained model state pytree
+        (BatchNorm running statistics, EMA copies, ...) through training:
+
+        - ``loss_fn(params, state, batch, rng) -> (loss, (aux, new_state))``
+        - ``init_fn(rng) -> (params, state)``; ``init`` returns
+          ``(params, opt_state, state)``
+        - ``fit(..., state=state)`` returns ``(params, opt_state, state)``
+        - ``predict_fn(params, state, x)``; predict/warm take ``state=``
+
+        The state is replicated like params, carried by value through the
+        jitted step (donated, so it never leaves HBM), checkpointed next to
+        params, and explicitly NOT touched by the optimizer — the trap of
+        stuffing it into the params pytree (zero gradients, but weight
+        decay would still corrupt it)."""
         self.mesh = mesh or get_default_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.predict_fn = predict_fn
+        self.stateful = stateful
         self._repl = NamedSharding(self.mesh, P())
         self._data = NamedSharding(self.mesh, P(DATA_AXIS))
         self.n_data = self.mesh.shape[DATA_AXIS]
 
-        def train_step(params, opt_state, batch, rng):
-            (loss, aux), grads = jax.value_and_grad(self.loss_fn, has_aux=True)(
-                params, batch, rng
-            )
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, aux
+        if stateful:
+            def train_step(params, opt_state, state, batch, rng):
+                (loss, (aux, state)), grads = jax.value_and_grad(
+                    self.loss_fn, has_aux=True)(params, state, batch, rng)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, state, loss, aux
 
-        self._train_step = jax.jit(
-            train_step,
-            donate_argnums=(0, 1),
-            in_shardings=(self._repl, self._repl, self._data, self._repl),
-            out_shardings=(self._repl, self._repl, self._repl, self._repl),
-        )
-        if predict_fn is not None:
-            self._predict = jax.jit(
-                predict_fn,
-                in_shardings=(self._repl, self._data),
-                out_shardings=self._data,
+            self._train_step = jax.jit(
+                train_step,
+                donate_argnums=(0, 1, 2),
+                in_shardings=(self._repl, self._repl, self._repl,
+                              self._data, self._repl),
+                out_shardings=(self._repl,) * 4 + (self._repl,),
             )
+        else:
+            def train_step(params, opt_state, batch, rng):
+                (loss, aux), grads = jax.value_and_grad(
+                    self.loss_fn, has_aux=True)(params, batch, rng)
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss, aux
+
+            self._train_step = jax.jit(
+                train_step,
+                donate_argnums=(0, 1),
+                in_shardings=(self._repl, self._repl, self._data, self._repl),
+                out_shardings=(self._repl, self._repl, self._repl, self._repl),
+            )
+        if predict_fn is not None:
+            if stateful:
+                self._predict = jax.jit(
+                    predict_fn,
+                    in_shardings=(self._repl, self._repl, self._data),
+                    out_shardings=self._data,
+                )
+            else:
+                self._predict = jax.jit(
+                    predict_fn,
+                    in_shardings=(self._repl, self._data),
+                    out_shardings=self._data,
+                )
 
     # -- helpers ----------------------------------------------------------
 
@@ -243,17 +283,27 @@ class DataParallelTrainer:
         return jax.device_put(params, self._repl)
 
     def init(self, init_fn: Callable[[jax.Array], Any], seed: int = 0,
-             hyperparams: Optional[Dict[str, float]] = None) -> Tuple[Any, Any]:
-        """Initialize (params, opt_state), replicated over the mesh.
+             hyperparams: Optional[Dict[str, float]] = None):
+        """Initialize (params, opt_state[, state]), replicated over the
+        mesh (state only for stateful trainers, whose ``init_fn`` returns
+        ``(params, state)``).
 
         ``hyperparams`` overrides injected optimizer values (see
         `tunable_optimizer`) — how a cached trainer gets this trial's lr."""
-        params = init_fn(jax.random.key(seed))
+        out = init_fn(jax.random.key(seed))
+        state = None
+        if self.stateful:
+            params, state = out
+            state = jax.device_put(state, self._repl)
+        else:
+            params = out
         params = self.device_put_params(params)
         opt_state = self.optimizer.init(params)
         if hyperparams:
             opt_state = set_opt_hyperparams(opt_state, hyperparams)
         opt_state = jax.device_put(opt_state, self._repl)
+        if self.stateful:
+            return params, opt_state, state
         return params, opt_state
 
     # -- training ---------------------------------------------------------
@@ -269,8 +319,11 @@ class DataParallelTrainer:
         log: Optional[Callable[..., None]] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every_epochs: int = 1,
-    ) -> Tuple[Any, Any]:
-        """Run the epoch loop over in-memory arrays.
+        state: Any = None,
+    ):
+        """Run the epoch loop over in-memory arrays. Returns
+        ``(params, opt_state)``, or ``(params, opt_state, state)`` for
+        stateful trainers (pass the initial ``state=`` in).
 
         ``data`` is a tuple of arrays with equal leading dim; each step gets
         the corresponding tuple slice as ``batch``.
@@ -292,8 +345,8 @@ class DataParallelTrainer:
         batch_size = min(self.round_batch(batch_size), fit_cap or self.n_data)
         start_epoch = 0
         if checkpoint_path and os.path.exists(checkpoint_path):
-            params, opt_state, start_epoch = self._restore_checkpoint(
-                checkpoint_path, params, opt_state)
+            params, opt_state, state, start_epoch = self._restore_checkpoint(
+                checkpoint_path, params, opt_state, state)
             logger.info("resuming fit from %s at epoch %d",
                         checkpoint_path, start_epoch)
         base_key = jax.random.key(seed + 1)
@@ -308,9 +361,13 @@ class DataParallelTrainer:
                 batches = shuffled_batches(n, batch_size, epoch_rng)
             for i, idx in enumerate(batches):
                 batch = tuple(jax.device_put(d[idx], self._data) for d in data)
-                params, opt_state, loss, _ = self._train_step(
-                    params, opt_state, batch, jax.random.fold_in(epoch_key, i)
-                )
+                step_rng = jax.random.fold_in(epoch_key, i)
+                if self.stateful:
+                    params, opt_state, state, loss, _ = self._train_step(
+                        params, opt_state, state, batch, step_rng)
+                else:
+                    params, opt_state, loss, _ = self._train_step(
+                        params, opt_state, batch, step_rng)
                 losses.append(loss)
             if losses and log is not None:
                 mean_loss = float(jnp.mean(jnp.stack(losses)))
@@ -319,12 +376,14 @@ class DataParallelTrainer:
                     (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
                     or epoch + 1 == epochs):
                 self._save_checkpoint(checkpoint_path, params, opt_state,
-                                      epoch + 1)
+                                      epoch + 1, state)
+        if self.stateful:
+            return params, opt_state, state
         return params, opt_state
 
     @staticmethod
     def _save_checkpoint(path: str, params: Any, opt_state: Any,
-                         next_epoch: int) -> None:
+                         next_epoch: int, state: Any = None) -> None:
         from flax import serialization
 
         from rafiki_tpu.sdk.params import _to_host
@@ -335,6 +394,7 @@ class DataParallelTrainer:
         blob = serialization.to_bytes({
             "params": _to_host(params),
             "opt_state": _to_host(opt_state),
+            "state": _to_host(state) if state is not None else {},
             "epoch": next_epoch,
         })
         tmp = f"{path}.tmp"
@@ -342,25 +402,36 @@ class DataParallelTrainer:
             f.write(blob)
         os.replace(tmp, path)  # atomic: readers never see a torn file
 
-    def _restore_checkpoint(self, path: str, params: Any,
-                            opt_state: Any) -> Tuple[Any, Any, int]:
+    def _restore_checkpoint(self, path: str, params: Any, opt_state: Any,
+                            state: Any = None) -> Tuple[Any, Any, Any, int]:
         """Restore into the shapes of freshly-initialized (params,
-        opt_state) — flax's from-target restore keeps optax's NamedTuple
-        state structure intact."""
+        opt_state[, state]) — flax's from-target restore keeps optax's
+        NamedTuple state structure intact."""
         from flax import serialization
 
         with open(path, "rb") as f:
             blob = f.read()
-        target = {"params": params, "opt_state": opt_state, "epoch": 0}
+        target = {"params": params, "opt_state": opt_state,
+                  "state": state if state is not None else {}, "epoch": 0}
         restored = serialization.from_bytes(target, blob)
         params = self.device_put_params(restored["params"])
         opt_state = jax.device_put(restored["opt_state"], self._repl)
-        return params, opt_state, int(restored["epoch"])
+        if state is not None:
+            state = jax.device_put(restored["state"], self._repl)
+        return params, opt_state, state, int(restored["epoch"])
 
     # -- inference --------------------------------------------------------
 
+    def _run_predict(self, params: Any, chunk: np.ndarray,
+                     state: Any) -> jax.Array:
+        dev = jax.device_put(chunk, self._data)
+        if self.stateful:
+            return self._predict(params, state, dev)
+        return self._predict(params, dev)
+
     def predict_batched(
-        self, params: Any, x: np.ndarray, batch_size: int = 256
+        self, params: Any, x: np.ndarray, batch_size: int = 256,
+        state: Any = None,
     ) -> np.ndarray:
         """Run ``predict_fn`` over `x` in power-of-two padded buckets.
 
@@ -381,14 +452,13 @@ class DataParallelTrainer:
             pad = bucket - len(chunk)
             if pad:
                 chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
-            out = self._predict(params, jax.device_put(chunk, self._data))
-            out = np.asarray(out)
+            out = np.asarray(self._run_predict(params, chunk, state))
             outs.append(out[: len(out) - pad] if pad else out)
             i += bucket - pad
         return np.concatenate(outs) if outs else np.zeros((0,))
 
     def warm_predict(self, params: Any, example: np.ndarray,
-                     batch_size: int = 256) -> int:
+                     batch_size: int = 256, state: Any = None) -> int:
         """Compile every predict bucket up front by running ``predict_fn``
         on copies of ``example`` (one query's worth of input) at each bucket
         size. Called at serving deploy so no real request ever pays a
@@ -399,8 +469,7 @@ class DataParallelTrainer:
         buckets = self.predict_buckets(cap)
         for b in buckets:
             chunk = np.broadcast_to(example[None], (b,) + example.shape)
-            self._predict(params, jax.device_put(np.ascontiguousarray(chunk),
-                                                 self._data))
+            self._run_predict(params, np.ascontiguousarray(chunk), state)
         return len(buckets)
 
 

@@ -105,13 +105,33 @@ class TrainWorker:
         self._db.update_sub_train_job_advisor(self._sub_id, advisor_id)
         ctx.ready()  # job info read + model class loaded: startup succeeded
 
-        # Crash recovery: trials left RUNNING by a killed predecessor of
-        # this service (a restarted worker keeps its service id) are re-run
-        # under the SAME trial id and knobs — a template that feeds
-        # ``checkpoint_path`` to fit() resumes from its last epoch rather
-        # than from scratch (the reference discarded all progress,
+        all_trials = self._db.get_trials_of_sub_train_job(self._sub_id)
+
+        # Crash recovery, part 1: if the advisor session is fresh (its
+        # process died too — in-process store, or an admin restart), rebuild
+        # the GP from the completed trials already in the store; otherwise
+        # the remaining budget would be proposed from the prior as if no
+        # trial had ever run. Atomic + empty-only on the store side, so
+        # concurrently restarted siblings can't double-feed.
+        scored = [(t["knobs"], t["score"]) for t in all_trials
+                  if t["status"] == TrialStatus.COMPLETED
+                  and t["score"] is not None]
+        if scored:
+            try:
+                if self._advisors.replay_feedback(advisor_id, scored):
+                    logger.info("replayed %d completed trials into advisor %s",
+                                len(scored), advisor_id)
+            except Exception:
+                logger.warning("advisor replay failed; proposals start from "
+                               "the prior", exc_info=True)
+
+        # Crash recovery, part 2: trials left RUNNING by a killed
+        # predecessor of this service (a restarted worker keeps its service
+        # id) are re-run under the SAME trial id and knobs — a template that
+        # feeds ``checkpoint_path`` to fit() resumes from its last epoch
+        # rather than from scratch (the reference discarded all progress,
         # reference worker/train.py:122-132).
-        for stale in self._db.get_trials_of_sub_train_job(self._sub_id):
+        for stale in all_trials:
             if ctx.stopping:
                 return
             if (stale["status"] != TrialStatus.RUNNING
@@ -213,10 +233,12 @@ class TrainWorker:
         terminal state it will never resume from (ERRORED/TERMINATED —
         only RUNNING trials are ever re-run). Success-path cleanup lives in
         _run_trial."""
-        try:
-            os.remove(os.path.join(self._params_dir, f"{trial_id}.ckpt"))
-        except OSError:
-            pass
+        for suffix in (".ckpt", ".ckpt.tmp"):
+            try:
+                os.remove(os.path.join(self._params_dir,
+                                       f"{trial_id}{suffix}"))
+            except OSError:
+                pass
 
     def _run_trial(
         self,
@@ -246,10 +268,7 @@ class TrainWorker:
                 with open(params_path, "wb") as f:
                     f.write(dump_params(model.dump_parameters()))
             # the trial is complete: its mid-trial checkpoint is dead weight
-            try:
-                os.remove(model.checkpoint_path)
-            except OSError:
-                pass
+            self._cleanup_ckpt(trial_id)
             return score, params_path
         finally:
             try:

@@ -286,3 +286,46 @@ def test_fit_checkpoint_interrupted_epoch_boundary(tmp_path):
     with open(ckpt, "rb") as f:
         blob = serialization.msgpack_restore(f.read())
     assert blob["epoch"] == 3  # final epoch always checkpointed
+
+
+def test_stateful_trainer_threads_batchnorm_like_state(tmp_path):
+    # stateful=True: non-trained state (here a running mean, batchnorm-
+    # style) is threaded through the step, used by predict, checkpointed,
+    # and NEVER touched by the optimizer (weight decay would corrupt it)
+    def loss_fn(params, state, batch, rng):
+        x, y = batch
+        mean = x.mean()
+        new_state = {"running": 0.9 * state["running"] + 0.1 * mean}
+        logits = (x - state["running"]) @ params["w"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        return loss, ({}, new_state)
+
+    def predict_fn(params, state, x):
+        return (x - state["running"]) @ params["w"]
+
+    trainer = DataParallelTrainer(
+        loss_fn, optax.adamw(1e-2, weight_decay=0.5),
+        predict_fn=predict_fn, stateful=True)
+    x, y = _linear_data(n=256)
+    x = x + 5.0  # offset the running stat must learn
+    params, opt_state, state = trainer.init(
+        lambda k: ({"w": 0.01 * jax.random.normal(k, (8, 3))},
+                   {"running": jnp.float32(0.0)}))
+    ckpt = str(tmp_path / "s.ckpt")
+    params, opt_state, state = trainer.fit(
+        params, opt_state, (x, y), epochs=3, batch_size=64,
+        checkpoint_path=ckpt, state=state)
+    # the running stat converged toward the data mean — and was NOT decayed
+    # to zero by adamw's weight decay
+    assert 3.0 < float(state["running"]) < 7.0
+    out = trainer.predict_batched(params, x[:8], state=state)
+    assert out.shape == (8, 3)
+    # resume path restores the state too
+    p2, o2, s2 = trainer.init(
+        lambda k: ({"w": 0.01 * jax.random.normal(k, (8, 3))},
+                   {"running": jnp.float32(0.0)}))
+    p2, o2, s2 = trainer.fit(p2, o2, (x, y), epochs=3, batch_size=64,
+                             checkpoint_path=ckpt, state=s2)
+    np.testing.assert_allclose(float(s2["running"]), float(state["running"]),
+                               rtol=1e-6)

@@ -82,3 +82,38 @@ def test_worker_ignores_other_workers_running_trials(tmp_path):
     assert db.get_trial(other["id"])["status"] == TrialStatus.RUNNING
     assert len(trials) == 2
     db.close()
+
+
+def test_restarted_worker_replays_completed_scores_into_fresh_advisor(tmp_path):
+    # an advisor session that died with its process must be rebuilt from
+    # the completed trials in the store before new proposals happen
+    db = Database(":memory:")
+    user = db.create_user("u@x", "h", UserType.APP_DEVELOPER)
+    with open(FIXTURE, "rb") as f:
+        model = db.create_model(
+            user["id"], "fake", "IMAGE_CLASSIFICATION", f.read(),
+            "FakeModel", {"numpy": None}, "PUBLIC")
+    job = db.create_train_job(
+        user["id"], "app", 1, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        {"MODEL_TRIAL_COUNT": 4})
+    sub = db.create_sub_train_job(job["id"], model["id"])
+    # two completed trials from "before the crash"
+    for score in (0.3, 0.8):
+        t = db.create_trial(sub["id"], model["id"],
+                            {"int_knob": 4, "float_knob": 0.01,
+                             "cat_knob": "a", "fixed_knob": "fixed"})
+        db.mark_trial_as_complete(t["id"], score, None)
+
+    store = AdvisorStore()  # fresh, like a restarted process
+    worker = TrainWorker(sub["id"], db, store,
+                         params_dir=str(tmp_path / "params"))
+    ctx = ServiceContext(service_id="svc-r2", service_type=ServiceType.TRAIN,
+                         chips=[], stop_event=threading.Event())
+    worker.start(ctx)
+    advisor = store.get(sub["id"])
+    # 2 replayed + 2 newly run = 4 observations in the GP
+    assert len(advisor.history) == 4
+    # double-replay protection: a second restart must not re-feed
+    assert store.replay_feedback(
+        sub["id"], [({"int_knob": 1, "float_knob": 0.01, "cat_knob": "a",
+                      "fixed_knob": "fixed"}, 0.5)]) is False
