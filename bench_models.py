@@ -69,9 +69,13 @@ def _xla_flops(jitted, *args) -> Optional[float]:
 
 
 def bench_vit(batch_size: int = 128, image_size: int = 224,
-              n_steps: int = 32, steps_per_call: int = 8) -> Dict[str, Any]:
+              n_steps: int = 32, steps_per_call: int = 8,
+              remat: Optional[str] = "dots") -> Dict[str, Any]:
     """ViT-B/16 fused train step (fwd+bwd+adamw), bf16 activations, donated
-    buffers, multi-step scan per dispatch."""
+    buffers, multi-step scan per dispatch, dots-saveable remat (batch 128
+    does not fit 16 GB HBM with full activation stashing)."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -79,6 +83,9 @@ def bench_vit(batch_size: int = 128, image_size: int = 224,
     from rafiki_tpu.models import vit
 
     cfg = vit.vit_b16(num_classes=1000, image_size=image_size)
+    if remat is not None:
+        cfg = dataclasses.replace(
+            cfg, encoder=dataclasses.replace(cfg.encoder, remat=remat))
     params = jax.jit(lambda r: vit.init(r, cfg))(jax.random.key(0))
     opt = optax.adamw(1e-3)
     opt_state = jax.jit(opt.init)(params)

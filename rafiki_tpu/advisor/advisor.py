@@ -41,7 +41,11 @@ def _jsonify(value: Any) -> Any:
 
 
 class BaseAdvisor:
-    """Contract: propose a knob assignment; feed back its achieved score."""
+    """Contract: propose a knob assignment; feed back its achieved score.
+
+    ``observation_count`` is part of the contract: the store's
+    ``replay_feedback`` empty-only guard depends on every advisor type
+    reporting how many observations it holds."""
 
     def __init__(self, knob_config: KnobConfig):
         self.knob_config = knob_config
@@ -50,6 +54,10 @@ class BaseAdvisor:
         raise NotImplementedError
 
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
+        raise NotImplementedError
+
+    @property
+    def observation_count(self) -> int:
         raise NotImplementedError
 
 
@@ -84,6 +92,10 @@ class Advisor(BaseAdvisor):
     def history(self) -> List[Tuple[np.ndarray, float]]:
         return list(zip(self._opt.observed_X, self._opt.observed_y))
 
+    @property
+    def observation_count(self) -> int:
+        return len(self._opt.observed_y)
+
 
 class RandomAdvisor(BaseAdvisor):
     """Uniform random search baseline."""
@@ -92,12 +104,17 @@ class RandomAdvisor(BaseAdvisor):
         super().__init__(knob_config)
         self._rng = np.random.default_rng(seed)
         self._dims = knob_config_dims(knob_config)
+        self._n_observed = 0
 
     def propose(self) -> Dict[str, Any]:
         return _jsonify(knobs_from_unit(self.knob_config, self._rng.random(self._dims)))
 
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
-        pass
+        self._n_observed += 1
+
+    @property
+    def observation_count(self) -> int:
+        return self._n_observed
 
 
 class AdvisorStore:
@@ -148,12 +165,15 @@ class AdvisorStore:
         trials already in the store. Atomic and empty-only: if the session
         has any observations (it survived, or a sibling already replayed),
         this is a no-op returning False, so concurrent restarts can't
-        double-feed the optimizer."""
+        double-feed the optimizer. (Workers also feed back BEFORE marking a
+        trial COMPLETED, so a trial visible as COMPLETED implies its score
+        is already in a surviving session — the guard and that ordering
+        together close the double-feed window.)"""
         with self._lock:
             advisor = self._advisors.get(advisor_id)
             if advisor is None:
                 raise KeyError(f"No such advisor: {advisor_id}")
-            if len(getattr(advisor, "history", ())) > 0:
+            if advisor.observation_count > 0:
                 return False
             for knobs, score in items:
                 advisor.feedback(knobs, float(score))

@@ -203,50 +203,35 @@ class DataParallelTrainer:
         self._data = NamedSharding(self.mesh, P(DATA_AXIS))
         self.n_data = self.mesh.shape[DATA_AXIS]
 
-        if stateful:
-            def train_step(params, opt_state, state, batch, rng):
+        # one step body for both modes: `state` is an empty tuple when
+        # stateless, so grads/updates/donation logic can't diverge between
+        # the two variants
+        n_state = 1 if stateful else 0
+
+        def train_step(params, opt_state, state, batch, rng):
+            if stateful:
                 (loss, (aux, state)), grads = jax.value_and_grad(
                     self.loss_fn, has_aux=True)(params, state, batch, rng)
-                updates, opt_state = self.optimizer.update(
-                    grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return params, opt_state, state, loss, aux
-
-            self._train_step = jax.jit(
-                train_step,
-                donate_argnums=(0, 1, 2),
-                in_shardings=(self._repl, self._repl, self._repl,
-                              self._data, self._repl),
-                out_shardings=(self._repl,) * 4 + (self._repl,),
-            )
-        else:
-            def train_step(params, opt_state, batch, rng):
+            else:
                 (loss, aux), grads = jax.value_and_grad(
                     self.loss_fn, has_aux=True)(params, batch, rng)
-                updates, opt_state = self.optimizer.update(
-                    grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return params, opt_state, loss, aux
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, state, loss, aux
 
-            self._train_step = jax.jit(
-                train_step,
-                donate_argnums=(0, 1),
-                in_shardings=(self._repl, self._repl, self._data, self._repl),
-                out_shardings=(self._repl, self._repl, self._repl, self._repl),
-            )
+        self._train_step = jax.jit(
+            train_step,
+            donate_argnums=(0, 1, 2),
+            in_shardings=(self._repl,) * 3 + (self._data, self._repl),
+            out_shardings=(self._repl,) * 5,
+        )
         if predict_fn is not None:
-            if stateful:
-                self._predict = jax.jit(
-                    predict_fn,
-                    in_shardings=(self._repl, self._repl, self._data),
-                    out_shardings=self._data,
-                )
-            else:
-                self._predict = jax.jit(
-                    predict_fn,
-                    in_shardings=(self._repl, self._data),
-                    out_shardings=self._data,
-                )
+            self._predict = jax.jit(
+                predict_fn,
+                in_shardings=(self._repl,) * (1 + n_state) + (self._data,),
+                out_shardings=self._data,
+            )
 
     # -- helpers ----------------------------------------------------------
 
@@ -362,12 +347,8 @@ class DataParallelTrainer:
             for i, idx in enumerate(batches):
                 batch = tuple(jax.device_put(d[idx], self._data) for d in data)
                 step_rng = jax.random.fold_in(epoch_key, i)
-                if self.stateful:
-                    params, opt_state, state, loss, _ = self._train_step(
-                        params, opt_state, state, batch, step_rng)
-                else:
-                    params, opt_state, loss, _ = self._train_step(
-                        params, opt_state, batch, step_rng)
+                params, opt_state, state, loss, _ = self._train_step(
+                    params, opt_state, state, batch, step_rng)
                 losses.append(loss)
             if losses and log is not None:
                 mean_loss = float(jnp.mean(jnp.stack(losses)))
@@ -413,7 +394,18 @@ class DataParallelTrainer:
             blob = f.read()
         target = {"params": params, "opt_state": opt_state,
                   "state": state if state is not None else {}, "epoch": 0}
-        restored = serialization.from_bytes(target, blob)
+        # checkpoints written before the stateful-trainer change carry no
+        # "state" entry; from_bytes rejects extra target keys, so fall back
+        # to a matching stateless target (resume must survive a worker
+        # upgrade mid-trial). try/except rather than pre-parsing: a second
+        # full msgpack parse would double restore time and host memory.
+        try:
+            restored = serialization.from_bytes(target, blob)
+        except ValueError:
+            target = dict(target)
+            target.pop("state")
+            restored = dict(serialization.from_bytes(target, blob))
+            restored["state"] = state if state is not None else {}
         params = self.device_put_params(restored["params"])
         opt_state = jax.device_put(restored["opt_state"], self._repl)
         if state is not None:

@@ -161,10 +161,15 @@ class TrainWorker:
                     self._db.mark_trial_as_terminated(stale["id"])
                     self._cleanup_ckpt(stale["id"])
                     return
-                self._db.mark_trial_as_complete(stale["id"], score,
-                                                params_path)
+                # feedback BEFORE mark-complete: a sibling restarting in
+                # between sees COMPLETED only once the observation is in the
+                # GP, so its empty-only replay can't double-feed (the
+                # reverse window re-runs the trial at worst — a duplicate
+                # noisy observation, which the GP tolerates)
                 self._advisors.get(advisor_id).feedback(
                     stale["knobs"], score)
+                self._db.mark_trial_as_complete(stale["id"], score,
+                                                params_path)
             except Exception:
                 if ctx.stopping:
                     self._db.mark_trial_as_terminated(stale["id"])
@@ -213,8 +218,9 @@ class TrainWorker:
                     self._db.mark_trial_as_terminated(trial["id"])
                     self._cleanup_ckpt(trial["id"])
                     return
-                self._db.mark_trial_as_complete(trial["id"], score, params_path)
+                # feedback first — see the stale-trial path above for why
                 self._advisors.get(advisor_id).feedback(knobs, score)
+                self._db.mark_trial_as_complete(trial["id"], score, params_path)
             except Exception:
                 if ctx.stopping:
                     self._db.mark_trial_as_terminated(trial["id"])

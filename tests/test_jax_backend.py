@@ -329,3 +329,37 @@ def test_stateful_trainer_threads_batchnorm_like_state(tmp_path):
                              checkpoint_path=ckpt, state=s2)
     np.testing.assert_allclose(float(s2["running"]), float(state["running"]),
                                rtol=1e-6)
+
+
+def test_restore_pre_state_key_checkpoint(tmp_path):
+    # checkpoints written before the stateful-trainer change have no "state"
+    # entry; a worker upgraded mid-trial must still resume them, not ERROR
+    from flax import serialization
+
+    from rafiki_tpu.sdk.params import _to_host
+
+    x, y = _linear_data(n=128)
+
+    def apply_fn(params, xb):
+        return xb @ params["w"]
+
+    trainer = DataParallelTrainer(
+        loss_fn=softmax_classifier_loss(apply_fn),
+        optimizer=optax.sgd(1e-2))
+    params, opt = trainer.init(lambda k: {"w": jnp.zeros((8, 3))})
+    ckpt = str(tmp_path / "legacy.ckpt")
+    # write the pre-upgrade format: no "state" key
+    with open(ckpt, "wb") as f:
+        f.write(serialization.to_bytes({
+            "params": _to_host(params),
+            "opt_state": _to_host(opt),
+            "epoch": 2,
+        }))
+    p, o, s, epoch = trainer._restore_checkpoint(ckpt, params, opt)
+    assert epoch == 2
+    assert jax.tree.structure(p) == jax.tree.structure(params)
+    # and fit() resumes from it end-to-end (epochs 0-1 skipped)
+    out, _ = trainer.fit(p, o, (x, y), epochs=3, batch_size=64,
+                         checkpoint_path=ckpt)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(out))

@@ -100,3 +100,39 @@ def test_lm_tiny_loss(moe_experts):
         assert float(aux["moe_aux"]) > 0
     else:
         assert float(aux["moe_aux"]) == 0
+
+
+@pytest.mark.parametrize("remat", ["dots", "full"])
+def test_vit_remat_matches_no_remat(remat):
+    """remat is a memory knob only: loss and grads must be bit-identical
+    (same ops, same order) to the no-remat scan."""
+    import dataclasses
+
+    cfg = vit.tiny()
+    cfg_r = dataclasses.replace(
+        cfg, encoder=dataclasses.replace(cfg.encoder, remat=remat))
+    params = vit.init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    y = jnp.zeros((2,), jnp.int32)
+
+    def loss(p, c):
+        lg = vit.apply(p, x, c)
+        return optax.softmax_cross_entropy_with_integer_labels(lg, y).mean()
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(p, cfg))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(p, cfg_r))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_transformer_unknown_remat_rejected():
+    import dataclasses
+
+    cfg = vit.tiny()
+    cfg = dataclasses.replace(
+        cfg, encoder=dataclasses.replace(cfg.encoder, remat="bogus"))
+    params = vit.init(jax.random.key(0), cfg)
+    x = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="remat"):
+        vit.apply(params, x, cfg)
