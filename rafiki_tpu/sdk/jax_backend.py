@@ -57,6 +57,10 @@ logger = logging.getLogger(__name__)
 _trainer_cache: "collections.OrderedDict[Hashable, Any]" = collections.OrderedDict()
 _trainer_cache_lock = threading.Lock()
 _TRAINER_CACHE_CAP = int(os.environ.get("RAFIKI_TRAINER_CACHE_CAP", "8"))
+# datasets at or below this size are replicated on-device so fit() can run
+# each epoch as a single lax.scan dispatch (see DataParallelTrainer.fit)
+_SCAN_EPOCH_MAX_BYTES = int(
+    os.environ.get("RAFIKI_SCAN_EPOCH_MAX_BYTES", str(256 << 20)))
 
 
 def cached_trainer(key: Hashable, build: Callable[[], Any]) -> Any:
@@ -226,6 +230,39 @@ class DataParallelTrainer:
             in_shardings=(self._repl,) * 3 + (self._data, self._repl),
             out_shardings=(self._repl,) * 5,
         )
+
+        # Device-resident epoch scan: the whole epoch as ONE dispatch. The
+        # per-step loop pays a host->device put plus a dispatch per batch —
+        # ~15-20 ms each through a remote-chip tunnel, which for small
+        # AutoML datasets dwarfs the compute. Here the dataset is uploaded
+        # once (replicated), the shuffled index matrix ships as a single
+        # (n_steps, batch) array, and lax.scan runs the SAME train_step
+        # body per row — identical op order and rng schedule to the loop,
+        # so the two paths are numerically interchangeable.
+        def epoch_scan(params, opt_state, state, data_dev, idx_mat,
+                       epoch_key):
+            def body(carry, step):
+                p, o, s = carry
+                i, idx = step
+                batch = tuple(
+                    jax.lax.with_sharding_constraint(
+                        jnp.take(d, idx, axis=0), self._data)
+                    for d in data_dev)
+                p, o, s, loss, _ = train_step(
+                    p, o, s, batch, jax.random.fold_in(epoch_key, i))
+                return (p, o, s), loss
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state),
+                (jnp.arange(idx_mat.shape[0]), idx_mat))
+            return params, opt_state, state, losses
+
+        self._epoch_scan = jax.jit(
+            epoch_scan,
+            donate_argnums=(0, 1, 2),
+            in_shardings=(self._repl,) * 6,
+            out_shardings=(self._repl,) * 4,
+        )
         if predict_fn is not None:
             self._predict = jax.jit(
                 predict_fn,
@@ -305,6 +342,7 @@ class DataParallelTrainer:
         checkpoint_path: Optional[str] = None,
         checkpoint_every_epochs: int = 1,
         state: Any = None,
+        scan_epoch: Optional[bool] = None,
     ):
         """Run the epoch loop over in-memory arrays. Returns
         ``(params, opt_state)``, or ``(params, opt_state, state)`` for
@@ -321,6 +359,12 @@ class DataParallelTrainer:
         the file resumes from the saved epoch. The rng schedule is a pure
         function of (seed, epoch), so a resumed run takes exactly the steps
         the uninterrupted run would have.
+
+        ``scan_epoch`` selects the device-resident epoch scan (one dispatch
+        per epoch; see ``epoch_scan`` in ``__init__``). Default ``None`` =
+        auto: on when the dataset fits the replication budget
+        (``RAFIKI_SCAN_EPOCH_MAX_BYTES``, 256 MB; ``RAFIKI_SCAN_EPOCH`` =
+        on/off/auto overrides). Both paths produce the same result.
         """
         n = len(data[0])
         # Largest multiple of the data-axis size that fits in the dataset;
@@ -334,25 +378,46 @@ class DataParallelTrainer:
                 checkpoint_path, params, opt_state, state)
             logger.info("resuming fit from %s at epoch %d",
                         checkpoint_path, start_epoch)
+        if scan_epoch is None:
+            env = os.environ.get("RAFIKI_SCAN_EPOCH", "auto").lower()
+            if env in ("0", "off", "false"):
+                scan_epoch = False
+            elif env in ("1", "on", "true"):
+                scan_epoch = True
+            else:
+                scan_epoch = (sum(int(d.nbytes) for d in data)
+                              <= _SCAN_EPOCH_MAX_BYTES)
+        data_dev = None  # uploaded lazily: a resume at epoch==epochs skips it
         base_key = jax.random.key(seed + 1)
         for epoch in range(start_epoch, epochs):
             t0 = time.time()
-            losses = []
             epoch_rng = np.random.default_rng([seed, epoch])
             epoch_key = jax.random.fold_in(base_key, epoch)
             if fit_cap == 0:
                 batches: Any = [epoch_rng.choice(n, self.n_data)]
             else:
                 batches = shuffled_batches(n, batch_size, epoch_rng)
-            for i, idx in enumerate(batches):
-                batch = tuple(jax.device_put(d[idx], self._data) for d in data)
-                step_rng = jax.random.fold_in(epoch_key, i)
-                params, opt_state, state, loss, _ = self._train_step(
-                    params, opt_state, state, batch, step_rng)
-                losses.append(loss)
-            if losses and log is not None:
-                mean_loss = float(jnp.mean(jnp.stack(losses)))
-                log(loss=mean_loss, epoch=float(epoch), epoch_time=time.time() - t0)
+            if scan_epoch:
+                if data_dev is None:
+                    data_dev = tuple(
+                        jax.device_put(np.asarray(d), self._repl)
+                        for d in data)
+                idx_mat = jnp.asarray(np.stack(list(batches)), jnp.int32)
+                params, opt_state, state, losses = self._epoch_scan(
+                    params, opt_state, state, data_dev, idx_mat, epoch_key)
+            else:
+                losses = []
+                for i, idx in enumerate(batches):
+                    batch = tuple(
+                        jax.device_put(d[idx], self._data) for d in data)
+                    step_rng = jax.random.fold_in(epoch_key, i)
+                    params, opt_state, state, loss, _ = self._train_step(
+                        params, opt_state, state, batch, step_rng)
+                    losses.append(loss)
+                losses = jnp.stack(losses) if losses else jnp.zeros((0,))
+            if len(losses) and log is not None:
+                log(loss=float(jnp.mean(losses)), epoch=float(epoch),
+                    epoch_time=time.time() - t0)
             if checkpoint_path and (
                     (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
                     or epoch + 1 == epochs):

@@ -165,9 +165,10 @@ class TrainWorker:
                 # between sees COMPLETED only once the observation is in the
                 # GP, so its empty-only replay can't double-feed (the
                 # reverse window re-runs the trial at worst — a duplicate
-                # noisy observation, which the GP tolerates)
-                self._advisors.get(advisor_id).feedback(
-                    stale["knobs"], score)
+                # noisy observation, which the GP tolerates). A feedback
+                # failure (e.g. remote advisor briefly down) must not cost
+                # the finished trial its result — warn and persist anyway.
+                self._feedback_best_effort(advisor_id, stale["knobs"], score)
                 self._db.mark_trial_as_complete(stale["id"], score,
                                                 params_path)
             except Exception:
@@ -219,7 +220,7 @@ class TrainWorker:
                     self._cleanup_ckpt(trial["id"])
                     return
                 # feedback first — see the stale-trial path above for why
-                self._advisors.get(advisor_id).feedback(knobs, score)
+                self._feedback_best_effort(advisor_id, knobs, score)
                 self._db.mark_trial_as_complete(trial["id"], score, params_path)
             except Exception:
                 if ctx.stopping:
@@ -233,6 +234,18 @@ class TrainWorker:
                 self._cleanup_ckpt(trial["id"])
                 # errored trials count toward budget (reference train.py:231);
                 # keep looping — the executor survives a bad knob combination
+
+    def _feedback_best_effort(self, advisor_id: str, knobs, score) -> None:
+        """Feed a trial score to the advisor, never letting an advisor
+        failure destroy the trial result: the caller marks the trial
+        COMPLETED right after, and a trained-and-scored trial beats a
+        slightly staler GP (the score is also recoverable later via
+        replay_feedback from the COMPLETED row)."""
+        try:
+            self._advisors.get(advisor_id).feedback(knobs, score)
+        except Exception:
+            logger.warning("advisor feedback failed for %s (continuing):\n%s",
+                           advisor_id, traceback.format_exc())
 
     def _cleanup_ckpt(self, trial_id: str) -> None:
         """Drop a trial's mid-trial checkpoint once the trial reached a
