@@ -65,25 +65,27 @@ class BenchCnn(JaxCnn):
     return src
 
 
-def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
-    """Drive POST /predict/<app> with N concurrent clients through the real
-    HTTP layer (the reference's serving numbers went through its Flask
-    predictor, reference predictor/app.py:23-31 — this is apples-to-apples,
-    plus concurrency the reference bench never had)."""
+def _serving_client_proc(server_port: int, app: str, query, n_threads: int,
+                         n_reqs: int, barrier, out_q) -> None:
+    """One client process: n_threads concurrent request loops. Runs in its
+    own interpreter so client-side JSON encode/decode and HTTP work never
+    contends with the server process's GIL — threads-in-the-server-process
+    clients understate what the serving stack actually sustains."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU tunnel in clients
+    os.environ["JAX_PLATFORMS"] = "cpu"
     from rafiki_tpu import config as rconfig
     from rafiki_tpu.client.client import Client
 
     lat_lock = threading.Lock()
     latencies = []
     errors = [0]
-    start_barrier = threading.Barrier(N_CLIENTS + 1)
 
-    def client_loop():
+    def loop():
         c = Client(admin_host="127.0.0.1", admin_port=server_port)
         c.login(rconfig.SUPERADMIN_EMAIL, rconfig.SUPERADMIN_PASSWORD)
-        c.predict(app, [query])  # per-client warmup/connection
-        start_barrier.wait()
-        for _ in range(N_REQS_PER_CLIENT):
+        c.predict(app, [query])  # warmup/connection
+        barrier.wait()
+        for _ in range(n_reqs):
             t0 = time.monotonic()
             try:
                 c.predict(app, [query])
@@ -94,21 +96,56 @@ def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
                 with lat_lock:
                     errors[0] += 1
 
-    threads = [threading.Thread(target=client_loop, daemon=True)
-               for _ in range(N_CLIENTS)]
+    threads = [threading.Thread(target=loop, daemon=True)
+               for _ in range(n_threads)]
     for t in threads:
         t.start()
-    start_barrier.wait()
-    t0 = time.monotonic()
     for t in threads:
         t.join(timeout=300)
+    out_q.put((latencies, errors[0]))
+
+
+def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
+    """Drive POST /predict/<app> with N concurrent clients through the real
+    HTTP layer (the reference's serving numbers went through its Flask
+    predictor, reference predictor/app.py:23-31 — this is apples-to-apples,
+    plus concurrency the reference bench never had). Clients run in
+    separate processes (see _serving_client_proc)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")  # never fork a TPU-connected process
+    n_procs = max(1, min(int(os.environ.get("RAFIKI_BENCH_CLIENT_PROCS", 8)),
+                         N_CLIENTS))
+    per_proc = N_CLIENTS // n_procs
+    extra = N_CLIENTS - per_proc * n_procs
+    barrier = ctx.Barrier(N_CLIENTS + 1)
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_serving_client_proc,
+            args=(server_port, app, query, per_proc + (1 if i < extra else 0),
+                  N_REQS_PER_CLIENT, barrier, out_q),
+            daemon=True)
+        for i in range(n_procs)
+    ]
+    for p in procs:
+        p.start()
+    barrier.wait()  # all client threads warmed up and connected
+    t0 = time.monotonic()
+    latencies, errors = [], 0
+    for _ in procs:
+        lat, err = out_q.get(timeout=600)
+        latencies.extend(lat)
+        errors += err
     wall = time.monotonic() - t0
+    for p in procs:
+        p.join(timeout=30)
 
     lat = np.array(sorted(latencies)) * 1000.0
     out = {
         "serving_clients": N_CLIENTS,
         "serving_requests": int(len(lat)),
-        "serving_errors": errors[0],
+        "serving_errors": errors,
         "serving_req_s": round(len(lat) / wall, 1) if wall > 0 else 0.0,
         "serving_p50_ms": round(float(np.percentile(lat, 50)), 2) if len(lat) else None,
         "serving_p99_ms": round(float(np.percentile(lat, 99)), 2) if len(lat) else None,

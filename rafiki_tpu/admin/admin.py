@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import config
@@ -63,6 +64,12 @@ class Admin:
     ):
         self.db = db or Database()
         self.advisor_store = AdvisorStore()
+        # predict hot path: (user, app, version) -> (ts, Predictor); the
+        # epoch counter lets stop-time invalidation win over in-flight
+        # resolutions (see predict/_drop_predict_routes)
+        self._predict_route_cache: Dict[Any, Any] = {}
+        self._predict_route_lock = threading.Lock()
+        self._predict_route_epoch = 0
         # RAFIKI_BROKER=shm selects the native cross-process data
         # plane (cache/shm_broker.py); default is in-process.
         # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to
@@ -520,12 +527,47 @@ class Admin:
         if inf is None:
             raise InvalidRequestError("No running inference job")
         self.services.stop_inference_services(inf["id"])
+        self._drop_predict_routes(inf["id"])
         return self.get_inference_job(user_id, app, job["app_version"])
+
+    def _drop_predict_routes(self, inference_job_id: str) -> None:
+        """Invalidate cached predict routes for a stopped inference job —
+        within the TTL its workers may still be draining, so predict must
+        go back to the control plane and correctly report the stop. Bumps
+        the route epoch so an in-flight predict() that resolved before this
+        stop cannot re-insert the dead route."""
+        with self._predict_route_lock:
+            self._predict_route_epoch += 1
+            for key, (_, predictor) in list(self._predict_route_cache.items()):
+                if predictor._job_id == inference_job_id:
+                    self._predict_route_cache.pop(key, None)
 
     def predict(
         self, user_id: str, app: str, queries: List[Any], app_version: int = -1
     ) -> List[Any]:
-        """Serving entrypoint: route queries to the app's running predictor."""
+        """Serving entrypoint: route queries to the app's running predictor.
+
+        The app->predictor resolution (two control-plane DB reads) is
+        cached for a short TTL: the serving hot path must not convoy on the
+        serialized metadata connection at high request rates, and a few
+        seconds of staleness only delays visibility of a *newly swapped*
+        inference job — a dead predictor raises and re-resolves
+        immediately."""
+        key = (user_id, app, app_version)
+        now = time.monotonic()
+        with self._predict_route_lock:
+            cached = self._predict_route_cache.get(key)
+        if cached is not None and now - cached[0] < config.PREDICT_ROUTE_TTL_S:
+            try:
+                return cached[1].predict_batch(queries)
+            except (RuntimeError, TimeoutError):
+                # workers gone (RuntimeError: job stopped/replaced) or
+                # registered-but-dead (TimeoutError): fall through and
+                # re-resolve against the control plane
+                with self._predict_route_lock:
+                    self._predict_route_cache.pop(key, None)
+        with self._predict_route_lock:
+            epoch = self._predict_route_epoch
         job = self.db.get_train_job_by_app_version(user_id, app, app_version)
         if job is None:
             raise InvalidRequestError(f"No such app {app}")
@@ -535,6 +577,12 @@ class Admin:
         predictor = self.services.get_predictor(inf["id"])
         if predictor is None:
             raise InvalidRequestError("Predictor not available")
+        with self._predict_route_lock:
+            # only cache if no invalidation ran while we resolved — a
+            # concurrent stop_inference_job must not have its route
+            # resurrected by this thread's stale resolution
+            if self._predict_route_epoch == epoch:
+                self._predict_route_cache[key] = (now, predictor)
         return predictor.predict_batch(queries)
 
     def stop_all_jobs(self) -> None:
@@ -545,6 +593,7 @@ class Admin:
             [InferenceJobStatus.STARTED, InferenceJobStatus.RUNNING]
         ):
             self.services.stop_inference_services(inf["id"])
+            self._drop_predict_routes(inf["id"])
         for job in self.db.get_train_jobs_by_statuses(
             [TrainJobStatus.STARTED, TrainJobStatus.RUNNING]
         ):

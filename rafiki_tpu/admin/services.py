@@ -232,9 +232,20 @@ class ServicesManager:
             )
         created: List[str] = []
         worker_trials: Dict[str, str] = {}
+        # Capacity-aware replica count. Replicas buy capacity only when they
+        # get their own chip, and redundancy only when they are separate
+        # processes; same-chip replicas in one process just split batches —
+        # halving batch occupancy and doubling per-query dispatches (the
+        # reference's 2 replicas each got their own GPU,
+        # reference services_manager.py:390-395 + config.py:10-11).
+        n_replicas = config.INFERENCE_WORKER_REPLICAS_PER_TRIAL
+        alloc = getattr(self._placement, "allocator", None)
+        if alloc is not None:
+            n_replicas = max(1, min(
+                n_replicas, alloc.total_chips // max(len(best_trials), 1)))
         try:
             for trial in best_trials:
-                for _ in range(config.INFERENCE_WORKER_REPLICAS_PER_TRIAL):
+                for _ in range(n_replicas):
                     service = self._db.create_service(ServiceType.INFERENCE)
                     self._db.create_inference_job_worker(
                         service["id"], inference_job_id, trial["id"]

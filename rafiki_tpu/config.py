@@ -81,3 +81,7 @@ def __getattr__(name: str) -> str:
     if name in _DYNAMIC_PATHS:
         return _DYNAMIC_PATHS[name]()
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+# How long Admin.predict may reuse a resolved app->predictor route without
+# re-reading the control-plane DB (serving hot path; see admin.predict).
+PREDICT_ROUTE_TTL_S = _env_float("PREDICT_ROUTE_TTL_S", 5.0)

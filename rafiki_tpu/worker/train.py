@@ -63,6 +63,8 @@ class TrainWorker:
         self._advisors = advisor_store
         self._send_event = send_event or (lambda name, payload: None)
         self._params_dir = params_dir or config.PARAMS_DIR
+        # observations whose advisor feedback failed, awaiting retry
+        self._pending_feedback: list = []
 
     def start(self, ctx: ServiceContext) -> None:
         """The trial loop; returns when budget is reached or stop is set."""
@@ -192,6 +194,11 @@ class TrainWorker:
             tracer = Tracer("pending")
             if not over_time:
                 with tracer.span("propose"):
+                    try:
+                        self._retry_pending_feedback(advisor_id)
+                    except Exception:
+                        logger.warning("pending feedback retry failed; "
+                                       "proposing without it", exc_info=True)
                     knobs = self._advisors.propose(advisor_id)
                 trial = self._db.reserve_trial(
                     self._sub_id, model["id"], knobs,
@@ -238,14 +245,26 @@ class TrainWorker:
     def _feedback_best_effort(self, advisor_id: str, knobs, score) -> None:
         """Feed a trial score to the advisor, never letting an advisor
         failure destroy the trial result: the caller marks the trial
-        COMPLETED right after, and a trained-and-scored trial beats a
-        slightly staler GP (the score is also recoverable later via
-        replay_feedback from the COMPLETED row)."""
+        COMPLETED right after. A failed observation is queued and retried
+        before each later proposal (_retry_pending_feedback) — it cannot be
+        recovered by replay_feedback, which only seeds *empty* sessions."""
         try:
+            self._retry_pending_feedback(advisor_id)
             self._advisors.get(advisor_id).feedback(knobs, score)
         except Exception:
-            logger.warning("advisor feedback failed for %s (continuing):\n%s",
-                           advisor_id, traceback.format_exc())
+            self._pending_feedback.append((knobs, score))
+            logger.warning(
+                "advisor feedback failed for %s (queued for retry):\n%s",
+                advisor_id, traceback.format_exc())
+
+    def _retry_pending_feedback(self, advisor_id: str) -> None:
+        """Flush observations whose original feedback failed (advisor
+        briefly unreachable). Called before proposing and before new
+        feedback so the GP sees every completed trial, in order."""
+        while self._pending_feedback:
+            knobs, score = self._pending_feedback[0]
+            self._advisors.get(advisor_id).feedback(knobs, score)
+            self._pending_feedback.pop(0)
 
     def _cleanup_ckpt(self, trial_id: str) -> None:
         """Drop a trial's mid-trial checkpoint once the trial reached a

@@ -367,8 +367,7 @@ def test_restore_pre_state_key_checkpoint(tmp_path):
 
 def test_scan_epoch_matches_per_step_loop(tmp_path):
     # the device-resident epoch scan must land on the params the per-step
-    # loop produces (same op order, same rng schedule), for both the
-    # stateless and the stateful trainer
+    # loop produces (same op order, same rng schedule)
     x, y = _linear_data(n=96)
 
     def apply_fn(params, xb):
@@ -391,6 +390,43 @@ def test_scan_epoch_matches_per_step_loop(tmp_path):
     scanned, _ = t1.fit(p1, s1, (x, y), epochs=3, batch_size=32, seed=11,
                         scan_epoch=True)
     for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(scanned)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_scan_epoch_matches_loop_stateful(tmp_path):
+    # same equivalence for the stateful trainer: the model state (here a
+    # running-mean, batchnorm-style) must thread through the scan carry
+    # exactly as it does through the per-step loop
+    x, y = _linear_data(n=96)
+
+    def loss_fn(params, state, batch, rng):
+        xb, yb = batch
+        logits = xb @ params["w"]
+        new_state = {"running": 0.9 * state["running"] + 0.1 * jnp.mean(xb)}
+        import optax as _optax
+
+        loss = _optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+        return loss, ({}, new_state)
+
+    def make():
+        t = DataParallelTrainer(loss_fn=loss_fn,
+                                optimizer=optax.adam(1e-2), stateful=True)
+        p, o, s = t.init(
+            lambda k: ({"w": 0.01 * jax.random.normal(k, (8, 3))},
+                       {"running": jnp.zeros(())}), seed=5)
+        return t, p, o, s
+
+    t0, p0, o0, s0 = make()
+    rp, ro, rs = t0.fit(p0, o0, (x, y), epochs=3, batch_size=32, seed=11,
+                        scan_epoch=False, state=s0)
+    t1, p1, o1, s1 = make()
+    sp, so, ss = t1.fit(p1, o1, (x, y), epochs=3, batch_size=32, seed=11,
+                        scan_epoch=True, state=s1)
+    np.testing.assert_allclose(float(rs["running"]), float(ss["running"]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(rp), jax.tree.leaves(sp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
 

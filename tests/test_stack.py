@@ -275,3 +275,33 @@ def test_chips_per_trial_splits_workers(admin, model_bytes):
     assert all(len(c) == 2 for c in chips)
     assert len({i for c in chips for i in c}) == 4  # disjoint grants
     admin.wait_until_train_job_stopped(uid, "splitapp", timeout_s=30)
+
+
+def test_single_chip_deploy_gets_one_replica_per_trial(tmp_path, model_bytes):
+    # replicas only buy capacity when chips back them: on a 1-chip host,
+    # same-chip replicas of the same trial just split batches, so the
+    # deploy caps at 1 replica/trial (config default stays 2 for hosts
+    # with capacity — reference parity, reference config.py:10-11)
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    try:
+        uid = _login(a)["user_id"]
+        a.create_model(uid, "fake", "IMAGE_CLASSIFICATION", model_bytes,
+                       "FakeModel")
+        a.create_train_job(
+            uid, "capapp", "IMAGE_CLASSIFICATION", "uri://train", "uri://test",
+            budget={"MODEL_TRIAL_COUNT": 3, "CHIP_COUNT": 1},
+        )
+        a.wait_until_train_job_stopped(uid, "capapp", timeout_s=30)
+        inf = a.create_inference_job(uid, "capapp")
+        workers = a.db.get_workers_of_inference_job(inf["id"])
+        trials = {w["trial_id"] for w in workers}
+        assert len(workers) == len(trials)  # exactly 1 replica per trial
+        # still serves
+        preds = a.predict(uid, "capapp", [[0.0]])
+        assert len(preds) == 1
+    finally:
+        a.shutdown()

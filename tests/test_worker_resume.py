@@ -117,3 +117,35 @@ def test_restarted_worker_replays_completed_scores_into_fresh_advisor(tmp_path):
     assert store.replay_feedback(
         sub["id"], [({"int_knob": 1, "float_knob": 0.01, "cat_knob": "a",
                       "fixed_knob": "fixed"}, 0.5)]) is False
+
+
+def test_feedback_failure_is_queued_and_retried():
+    # a transient advisor outage must not lose the observation: the score
+    # is queued and flushed before the next feedback/proposal (it is NOT
+    # recoverable via replay_feedback, which only seeds empty sessions)
+    from rafiki_tpu.worker.train import TrainWorker
+
+    class FlakyAdvisor:
+        def __init__(self):
+            self.fail = True
+            self.seen = []
+
+        def feedback(self, knobs, score):
+            if self.fail:
+                raise ConnectionError("advisor briefly down")
+            self.seen.append((knobs, score))
+
+    class Store:
+        def __init__(self):
+            self.advisor = FlakyAdvisor()
+
+        def get(self, advisor_id):
+            return self.advisor
+
+    w = TrainWorker("sub", db=None, advisor_store=Store())
+    w._feedback_best_effort("a", {"k": 1}, 0.5)   # fails -> queued
+    assert w._pending_feedback == [({"k": 1}, 0.5)]
+    w._advisors.advisor.fail = False
+    w._feedback_best_effort("a", {"k": 2}, 0.7)   # flushes queue first
+    assert w._pending_feedback == []
+    assert w._advisors.advisor.seen == [({"k": 1}, 0.5), ({"k": 2}, 0.7)]
