@@ -130,7 +130,14 @@ def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
     ]
     for p in procs:
         p.start()
-    barrier.wait()  # all client threads warmed up and connected
+    try:
+        # all client threads warmed up and connected; a dead client process
+        # would strand the barrier forever, so fail fast instead
+        barrier.wait(timeout=120)
+    except threading.BrokenBarrierError:
+        dead = [p.pid for p in procs if not p.is_alive()]
+        raise RuntimeError(
+            f"serving bench clients failed to warm up (dead procs: {dead})")
     t0 = time.monotonic()
     latencies, errors = [], 0
     for _ in procs:

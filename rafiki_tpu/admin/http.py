@@ -197,6 +197,14 @@ class AdminServer:
                 lambda au, m, b, q: {"replayed": A.advisor_store.replay_feedback(
                     m["aid"],
                     [(i["knobs"], i["score"]) for i in b["items"]])}),
+            # ASHA rung report (early stopping; advisor/asha.py)
+            r("POST", r"/advisors/(?P<aid>[^/]+)/report_rung", _ANY,
+                lambda au, m, b, q: {"keep": A.advisor_store.report_rung(
+                    m["aid"], b["trial_id"], int(b["resource"]),
+                    float(b["value"]),
+                    min_resource=int(b.get("min_resource", 1)),
+                    eta=int(b.get("eta", 3)),
+                    mode=b.get("mode", "min"))}),
             r("DELETE", r"/advisors/(?P<aid>[^/]+)", _ANY, lambda au, m, b, q:
                 A.advisor_store.delete_advisor(m["aid"]) or {}),
             # admin actions (reference scripts/stop_all_jobs.py via client)

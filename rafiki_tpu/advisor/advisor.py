@@ -9,6 +9,7 @@ single-threaded, reference scripts/start_advisor.py:10).
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
@@ -126,6 +127,7 @@ class AdvisorStore:
 
     def __init__(self) -> None:
         self._advisors: Dict[str, BaseAdvisor] = {}
+        self._schedulers: Dict[str, Any] = {}  # advisor_id -> AshaScheduler
         self._lock = threading.Lock()
 
     def create_advisor(
@@ -179,6 +181,38 @@ class AdvisorStore:
                 advisor.feedback(knobs, float(score))
             return True
 
+    def report_rung(self, advisor_id: str, trial_id: str, resource: int,
+                    value: float, min_resource: int = 1, eta: int = 3,
+                    mode: str = "min") -> bool:
+        """ASHA early-stop check: record an intermediate metric for a trial
+        and return whether it should continue (advisor/asha.py). The
+        scheduler shares the advisor session's lifecycle, so parallel
+        workers of one sub-train-job compete within one rung population —
+        like the shared GP."""
+        from rafiki_tpu.advisor.asha import AshaScheduler
+
+        with self._lock:
+            if advisor_id not in self._advisors:
+                raise KeyError(f"No such advisor: {advisor_id}")
+            sched = self._schedulers.get(advisor_id)
+            if sched is None:
+                sched = self._schedulers[advisor_id] = AshaScheduler(
+                    min_resource=min_resource, eta=eta, mode=mode)
+            elif (sched.min_resource, sched.eta, sched.mode) != (
+                    max(int(min_resource), 1), int(eta), mode):
+                # the scheduler is shared per session and configured by
+                # whoever reports first; a divergent caller (worker
+                # restarted with a changed budget against a live admin)
+                # competes under the existing ladder — say so, don't
+                # silently ignore the requested parameters
+                logging.getLogger(__name__).warning(
+                    "ASHA params (%s,%s,%s) differ from session %s's "
+                    "live scheduler (%s,%s,%s); using the existing one",
+                    min_resource, eta, mode, advisor_id,
+                    sched.min_resource, sched.eta, sched.mode)
+        return sched.report(trial_id, resource, value)
+
     def delete_advisor(self, advisor_id: str) -> None:
         with self._lock:
             self._advisors.pop(advisor_id, None)
+            self._schedulers.pop(advisor_id, None)

@@ -54,5 +54,12 @@ class RemoteAdvisorStore:
     def replay_feedback(self, advisor_id: str, items) -> bool:
         return self._client.replay_advisor_feedback(advisor_id, items)
 
+    def report_rung(self, advisor_id: str, trial_id: str, resource: int,
+                    value: float, min_resource: int = 1, eta: int = 3,
+                    mode: str = "min") -> bool:
+        return self._client.report_rung(
+            advisor_id, trial_id, resource, value,
+            min_resource=min_resource, eta=eta, mode=mode)
+
     def delete_advisor(self, advisor_id: str) -> None:
         self._client.delete_advisor(advisor_id)

@@ -254,6 +254,19 @@ class Client:
             {"knobs": knobs, "score": score},
         )["knobs"]
 
+    def report_rung(self, advisor_id: str, trial_id: str, resource: int,
+                    value: float, min_resource: int = 1, eta: int = 3,
+                    mode: str = "min") -> bool:
+        """ASHA early-stop rung report; returns whether the trial should
+        continue training."""
+        return bool(self._call(
+            "POST",
+            f"/advisors/{advisor_id}/report_rung",
+            {"trial_id": trial_id, "resource": int(resource),
+             "value": float(value), "min_resource": int(min_resource),
+             "eta": int(eta), "mode": mode},
+        )["keep"])
+
     def delete_advisor(self, advisor_id: str) -> None:
         self._call("DELETE", f"/advisors/{advisor_id}")
 

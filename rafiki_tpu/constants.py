@@ -28,6 +28,13 @@ class BudgetType:
     # a trial, not just trial-parallelism. The reference was hard-wired to
     # 1 GPU per worker (reference services_manager.py:117-126).
     CHIPS_PER_TRIAL = "CHIPS_PER_TRIAL"
+    # ASHA early stopping (new capability; reference trials always ran to
+    # their full epoch budget). Truthy enables rung-based stopping on the
+    # per-epoch "loss" metric templates already log; min-epochs/eta tune
+    # the rung ladder (advisor/asha.py).
+    EARLY_STOP = "EARLY_STOP"
+    ASHA_MIN_EPOCHS = "ASHA_MIN_EPOCHS"
+    ASHA_ETA = "ASHA_ETA"
 
 
 class TaskType:

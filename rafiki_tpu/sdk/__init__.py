@@ -22,7 +22,12 @@ from rafiki_tpu.sdk.knob import (  # noqa: F401
     deserialize_knob_config,
     serialize_knob_config,
 )
-from rafiki_tpu.sdk.log import ModelLogger, logger, parse_logs  # noqa: F401
+from rafiki_tpu.sdk.log import (  # noqa: F401
+    ModelLogger,
+    StopTrialEarly,
+    logger,
+    parse_logs,
+)
 from rafiki_tpu.sdk.model import (  # noqa: F401
     BaseModel,
     InvalidModelClassError,

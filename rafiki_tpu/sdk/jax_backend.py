@@ -33,6 +33,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rafiki_tpu.parallel.mesh import DATA_AXIS, get_default_mesh, visible_devices
+from rafiki_tpu.sdk.log import StopTrialEarly
 
 LossFn = Callable[[Any, Any, jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
 
@@ -415,14 +416,24 @@ class DataParallelTrainer:
                         params, opt_state, state, batch, step_rng)
                     losses.append(loss)
                 losses = jnp.stack(losses) if losses else jnp.zeros((0,))
+            stop_early = False
             if len(losses) and log is not None:
-                log(loss=float(jnp.mean(losses)), epoch=float(epoch),
-                    epoch_time=time.time() - t0)
+                try:
+                    log(loss=float(jnp.mean(losses)), epoch=float(epoch),
+                        epoch_time=time.time() - t0)
+                except StopTrialEarly:
+                    # scheduler verdict (ASHA): this trial is not
+                    # competitive — stop training here and return what it
+                    # learned; the caller evaluates and completes normally
+                    logger.info("early stop after epoch %d", epoch)
+                    stop_early = True
             if checkpoint_path and (
                     (epoch + 1) % max(checkpoint_every_epochs, 1) == 0
-                    or epoch + 1 == epochs):
+                    or epoch + 1 == epochs or stop_early):
                 self._save_checkpoint(checkpoint_path, params, opt_state,
                                       epoch + 1, state)
+            if stop_early:
+                break
         if self.stateful:
             return params, opt_state, state
         return params, opt_state

@@ -24,6 +24,15 @@ class LogType:
     PLOT = "PLOT"
 
 
+class StopTrialEarly(Exception):
+    """Raised out of ``ModelLogger.log`` when the trial's scheduler decided
+    this trial should stop (ASHA early stopping, advisor/asha.py). The
+    SDK trainer's fit() catches it and returns the current params; the
+    train worker also catches it around ``model.train`` for templates with
+    hand-rolled loops — either way the trial proceeds to evaluate() and
+    completes with the score its truncated training earned."""
+
+
 class ModelLogger:
     """Structured logger injected into models as ``self.logger`` / the module
     singleton ``logger``. Thread-safe enough for one trial per logger instance
@@ -33,10 +42,19 @@ class ModelLogger:
     def __init__(self) -> None:
         self._sink: Optional[Sink] = None
         self._echo = True
+        self._stop_check: Optional[Callable[[Dict[str, float]], bool]] = None
 
     def set_sink(self, sink: Optional[Sink], echo: bool = False) -> None:
         self._sink = sink
         self._echo = echo or sink is None
+
+    def set_stop_check(
+        self, check: Optional[Callable[[Dict[str, float]], bool]]
+    ) -> None:
+        """Install a per-metrics-report early-stop predicate (the worker
+        wires this to the sub-train-job's ASHA scheduler). ``check(metrics)
+        -> True`` makes the next ``log(**metrics)`` raise StopTrialEarly."""
+        self._stop_check = check
 
     def log(self, msg: str = "", **metrics: float) -> None:
         """Log a free-form message and/or named numeric metrics."""
@@ -45,6 +63,9 @@ class ModelLogger:
         if metrics:
             clean = {k: float(v) for k, v in metrics.items()}
             self._emit({"type": LogType.METRICS, "metrics": clean})
+            if self._stop_check is not None and self._stop_check(clean):
+                raise StopTrialEarly(
+                    f"scheduler stopped this trial at {clean}")
 
     def define_plot(
         self, title: str, metrics: List[str], x_axis: Optional[str] = None

@@ -33,7 +33,7 @@ from rafiki_tpu.db.database import Database
 from rafiki_tpu.parallel.mesh import set_device_grant
 from rafiki_tpu.placement.manager import ServiceContext
 from rafiki_tpu.sdk.jax_backend import enable_persistent_compile_cache
-from rafiki_tpu.sdk.log import ModelLogger
+from rafiki_tpu.sdk.log import ModelLogger, StopTrialEarly
 from rafiki_tpu.sdk.model import load_model_class
 from rafiki_tpu.sdk.params import dump_params
 from rafiki_tpu.utils.trace import Tracer, jax_profile
@@ -99,6 +99,11 @@ class TrainWorker:
             if time_budget_h is not None
             else None
         )
+        # ASHA early stopping (budget-opt-in): rung-check each trial's
+        # per-epoch "loss" report against the sub-job's shared scheduler
+        self._early_stop = bool(budget.get(BudgetType.EARLY_STOP, False))
+        self._asha_min = int(budget.get(BudgetType.ASHA_MIN_EPOCHS, 1))
+        self._asha_eta = int(budget.get(BudgetType.ASHA_ETA, 3))
         clazz = load_model_class(model["model_file_bytes"], model["model_class"])
         knob_config = clazz.get_knob_config()
         advisor_id = self._advisors.create_advisor(
@@ -154,6 +159,7 @@ class TrainWorker:
             trial_logger.set_sink(
                 lambda line, _tid=stale["id"]: self._db.add_trial_log(
                     _tid, line))
+            self._install_stop_check(trial_logger, advisor_id, stale["id"])
             tracer = Tracer(stale["id"])
             try:
                 score, params_path = self._run_trial(
@@ -218,6 +224,7 @@ class TrainWorker:
             trial_logger.set_sink(
                 lambda line, _tid=trial["id"]: self._db.add_trial_log(_tid, line)
             )
+            self._install_stop_check(trial_logger, advisor_id, trial["id"])
             try:
                 score, params_path = self._run_trial(
                     clazz, knobs, job, trial["id"], trial_logger, tracer
@@ -256,6 +263,37 @@ class TrainWorker:
             logger.warning(
                 "advisor feedback failed for %s (queued for retry):\n%s",
                 advisor_id, traceback.format_exc())
+
+    def _install_stop_check(self, trial_logger: ModelLogger,
+                            advisor_id: str, trial_id: str) -> None:
+        """Wire a trial's logger to the sub-job's ASHA scheduler: every
+        per-epoch METRICS report with a "loss" value becomes a rung check;
+        an uncompetitive trial's next log() raises StopTrialEarly, which
+        fit()/the trial runner treat as a normal (truncated) completion.
+        Advisor stores without report_rung (older remote admins) silently
+        disable early stopping — never fail a trial over it."""
+        if not getattr(self, "_early_stop", False):
+            return
+        report = getattr(self._advisors, "report_rung", None)
+        if report is None:
+            logger.warning("EARLY_STOP budget set but the advisor store "
+                           "has no report_rung; trials run full-length")
+            return
+
+        def check(metrics: Dict[str, Any]) -> bool:
+            if "loss" not in metrics or "epoch" not in metrics:
+                return False
+            try:
+                return not report(
+                    advisor_id, trial_id, int(metrics["epoch"]) + 1,
+                    metrics["loss"], min_resource=self._asha_min,
+                    eta=self._asha_eta)
+            except Exception:
+                logger.warning("ASHA rung report failed; continuing trial",
+                               exc_info=True)
+                return False
+
+        trial_logger.set_stop_check(check)
 
     def _retry_pending_feedback(self, advisor_id: str) -> None:
         """Flush observations whose original feedback failed (advisor
@@ -296,8 +334,17 @@ class TrainWorker:
         model.checkpoint_path = os.path.join(
             self._params_dir, f"{trial_id}.ckpt")
         try:
-            with jax_profile(), tracer.span("train"):
-                model.train(job["train_dataset_uri"])
+            try:
+                with jax_profile(), tracer.span("train"):
+                    model.train(job["train_dataset_uri"])
+            except StopTrialEarly:
+                # templates with hand-rolled train loops surface the ASHA
+                # verdict here (SDK-trainer templates never do — fit()
+                # absorbs it); the truncated model still gets evaluated
+                trial_logger.log("trial stopped early by scheduler")
+            # the verdict is delivered; trace/trace-metric logs after this
+            # must not re-raise
+            trial_logger.set_stop_check(None)
             with tracer.span("evaluate"):
                 score = float(model.evaluate(job["test_dataset_uri"]))
             with tracer.span("persist_params"):

@@ -1,0 +1,172 @@
+"""ASHA early stopping: scheduler unit behavior + the full-stack path
+(budget -> worker stop-check -> logger raise -> truncated trial completes)."""
+
+import pytest
+
+from rafiki_tpu.advisor.asha import AshaScheduler
+from rafiki_tpu.advisor.advisor import AdvisorStore
+from rafiki_tpu.sdk.knob import FloatKnob
+from rafiki_tpu.sdk.log import ModelLogger, StopTrialEarly
+
+
+def test_rung_ladder():
+    s = AshaScheduler(min_resource=1, eta=3)
+    assert s._rungs_reached(1) == [1]
+    assert s._rungs_reached(2) == [1]
+    assert s._rungs_reached(3) == [1, 3]
+    assert s._rungs_reached(9) == [1, 3, 9]
+
+
+def test_permissive_until_eta_values():
+    # with fewer than eta values at a rung there is no evidence: everyone
+    # continues, even a much worse second trial
+    s = AshaScheduler(min_resource=1, eta=3)
+    assert s.report("t1", 1, 0.1)
+    assert s.report("t2", 1, 99.0)
+
+
+def test_uncompetitive_trial_stops_at_rung():
+    s = AshaScheduler(min_resource=1, eta=3)
+    assert s.report("t1", 1, 0.1)
+    assert s.report("t2", 1, 0.2)
+    # third value completes the rung population; 9.0 is not in the top 1/3
+    assert not s.report("t3", 1, 9.0)
+    # the best-so-far keeps going at higher rungs
+    assert s.report("t1", 3, 0.05)
+
+
+def test_max_mode_and_nonfinite():
+    s = AshaScheduler(min_resource=1, eta=2, mode="max")
+    assert s.report("a", 1, 0.9)
+    assert s.report("b", 1, 0.95)
+    assert not s.report("c", 1, 0.1)   # worst of 3 in max mode
+    assert not s.report("d", 1, float("nan"))
+
+
+def test_each_rung_recorded_once_per_trial():
+    s = AshaScheduler(min_resource=1, eta=2)
+    assert s.report("t1", 1, 0.5)
+    assert s.report("t1", 1, 0.4)  # same rung again: no new record
+    assert len(s._rungs[1]) == 1
+
+
+def test_store_report_rung_shares_scheduler_and_deletes():
+    store = AdvisorStore()
+    aid = store.create_advisor({"lr": FloatKnob(0.1, 1.0)}, advisor_id="sub1")
+    assert store.report_rung(aid, "t1", 1, 0.3, eta=2)
+    assert store.report_rung(aid, "t2", 1, 0.2, eta=2)  # better: promoted
+    assert not store.report_rung(aid, "t3", 1, 5.0, eta=2)
+    store.delete_advisor(aid)
+    with pytest.raises(KeyError):
+        store.report_rung(aid, "t4", 1, 0.1)
+
+
+def test_logger_stop_check_raises():
+    lg = ModelLogger()
+    lg.set_sink(lambda line: None)
+    lg.set_stop_check(lambda m: m.get("loss", 0) > 1.0)
+    lg.log(loss=0.5, epoch=0)  # fine
+    with pytest.raises(StopTrialEarly):
+        lg.log(loss=2.0, epoch=1)
+    lg.set_stop_check(None)
+    lg.log(loss=2.0, epoch=2)  # cleared: no raise
+
+
+ASHA_PROBE_MODEL = b'''
+from rafiki_tpu.sdk import BaseModel, FixedKnob, FloatKnob
+
+_TRIAL_COUNTER = [0]
+
+
+class AshaProbe(BaseModel):
+    """Each successive trial logs a strictly worse per-epoch loss, so with
+    ASHA on, trial 2+ must be rung-stopped after its first report."""
+
+    dependencies = {"numpy": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {"epochs": FixedKnob(4), "lr": FloatKnob(0.001, 0.1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._params = None
+
+    def train(self, dataset_uri):
+        _TRIAL_COUNTER[0] += 1
+        loss = float(_TRIAL_COUNTER[0])
+        for epoch in range(4):
+            # params track progress BEFORE each report, like a real
+            # template whose fit() returns current params on early stop
+            self._params = {"w": [loss], "epochs_done": epoch + 1}
+            self.logger.log(loss=loss, epoch=float(epoch))
+
+    def evaluate(self, dataset_uri):
+        return 1.0 / self._params["w"][0]
+
+    def predict(self, queries):
+        return [[1.0] for _ in queries]
+
+    def dump_parameters(self):
+        return self._params
+
+    def load_parameters(self, params):
+        self._params = params
+'''
+
+
+def test_stack_early_stop_truncates_bad_trials(tmp_path):
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.constants import TrialStatus
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    try:
+        uid = a.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        a.create_model(uid, "probe", "IMAGE_CLASSIFICATION",
+                       ASHA_PROBE_MODEL, "AshaProbe")
+        a.create_train_job(
+            uid, "ashapp", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+            budget={"MODEL_TRIAL_COUNT": 3, "CHIP_COUNT": 1,
+                    "EARLY_STOP": 1, "ASHA_ETA": 2},
+        )
+        a.wait_until_train_job_stopped(uid, "ashapp", timeout_s=30)
+        trials = sorted(a.get_trials_of_train_job(uid, "ashapp"),
+                        key=lambda t: t["datetime_started"])
+        assert [t["status"] for t in trials] == [TrialStatus.COMPLETED] * 3
+        assert all(t["score"] is not None for t in trials)
+
+        def epochs_logged(trial):
+            logs = a.get_trial_logs(trial["id"])  # already parse_logs'd
+            return sum(1 for m in logs["metrics"] if "loss" in m)
+
+        counts = [epochs_logged(t) for t in trials]
+        # trial 1 sets the rung bar and runs its full 4 epochs; trials 2-3
+        # log strictly worse losses and must be stopped at the first rung
+        assert counts[0] == 4
+        assert counts[1] == 1 and counts[2] == 1
+    finally:
+        a.shutdown()
+
+
+def test_late_first_report_does_not_backfill_lower_rungs():
+    # a trial resuming from a late checkpoint (fresh scheduler) must not
+    # seed early rungs with its late-epoch loss — that would set an
+    # unbeatable bar for healthy fresh trials
+    s = AshaScheduler(min_resource=1, eta=3)
+    assert s.report("resumed", 9, 0.001)  # records ONLY at rung 9
+    assert s._rungs.get(1) is None or s._rungs[1] == []
+    assert s._rungs[9] == [0.001]
+    # fresh trials at rung 1 compete among themselves, not against 0.001
+    assert s.report("f1", 1, 0.5)
+    assert s.report("f2", 1, 0.6)
+    # population [0.5, 0.6, 0.55]: top_k=1 -> only 0.5 promotes; 0.55 stops
+    # — but crucially the bar is 0.5 (a real rung-1 loss), not 0.001
+    assert not s.report("f3", 1, 0.55)
