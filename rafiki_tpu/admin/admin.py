@@ -292,6 +292,7 @@ class Admin:
         model_names: Optional[List[str]] = None,
     ) -> Dict:
         budget = budget or {}
+        self._validate_budget(budget)
         # pick the models: named ones, or all visible models for the task
         # (reference admin.py:118-161)
         # public models first, then the caller's own — so a same-named PUBLIC
@@ -332,6 +333,45 @@ class Admin:
             self.db.create_sub_train_job(job["id"], m["id"])
         self.services.create_train_services(job["id"])
         return self.get_train_job(user_id, app, version)
+
+    @staticmethod
+    def _validate_budget(budget: Dict[str, Any]) -> None:
+        """Reject malformed budgets at job creation — a bad value silently
+        degrading the job later (e.g. ASHA_ETA=1 disabling early stopping
+        with a warning per epoch) is strictly worse than a 400 here."""
+        from rafiki_tpu.constants import BudgetType
+
+        def as_int(key, minimum):
+            raw = budget.get(key)
+            if raw is None:
+                return
+            try:
+                v = int(raw)
+            except (TypeError, ValueError):
+                raise InvalidRequestError(f"budget {key}={raw!r} is not an "
+                                          "integer")
+            if v < minimum:
+                raise InvalidRequestError(
+                    f"budget {key}={v} must be >= {minimum}")
+
+        as_int(BudgetType.MODEL_TRIAL_COUNT, 1)
+        as_int(BudgetType.CHIP_COUNT, 0)
+        as_int(BudgetType.GPU_COUNT, 0)
+        as_int(BudgetType.CHIPS_PER_TRIAL, 1)
+        as_int(BudgetType.ASHA_MIN_EPOCHS, 1)
+        as_int(BudgetType.ASHA_ETA, 2)
+        raw = budget.get(BudgetType.TIME_HOURS)
+        if raw is not None:
+            try:
+                hours = float(raw)
+            except (TypeError, ValueError):
+                raise InvalidRequestError(
+                    f"budget TIME_HOURS={raw!r} is not a number")
+            if hours < 0:
+                # 0 is legal: the deadline is already spent, so the job
+                # stops before running any trial (tested behavior)
+                raise InvalidRequestError(
+                    f"budget TIME_HOURS={hours} must be >= 0")
 
     def get_train_job(
         self, user_id: str, app: str, app_version: int = -1

@@ -72,6 +72,15 @@ class AdminServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: keep-alive, so a client session reuses one
+            # connection (and one server thread) across requests instead of
+            # paying connect + thread-spawn per call. Safe because every
+            # response path sends Content-Length. The idle timeout reaps
+            # the thread of a client that died without closing (SIGKILL'd
+            # worker) — otherwise dead-connection threads pile up forever.
+            protocol_version = "HTTP/1.1"
+            timeout = 300
+
             def log_message(self, fmt, *args):  # quiet
                 pass
 
@@ -281,7 +290,9 @@ class AdminServer:
         except (
             InvalidRequestError,
             InvalidModelClassError,
-            KeyError,  # missing body field
+            KeyError,    # missing body field
+            ValueError,  # malformed body field (bad int/float/enum value)
+            TypeError,   # wrong body field type
         ) as e:
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
         except InsufficientChipsError as e:

@@ -77,6 +77,12 @@ class AshaScheduler:
             if not new_rungs:
                 return True  # between rungs: no decision point
             rung = new_rungs[-1]
+            if int(resource) != rung:
+                # the measurement was taken past the rung's resource (sparse
+                # reporter, or a resume that overshot): recording it would
+                # bias the rung with a later-epoch value, so skip — a rung
+                # population holds only values measured AT its resource
+                return True
             values = self._rungs.setdefault(rung, [])
             values.append(value)
             if len(values) < self.eta:

@@ -11,6 +11,7 @@ client.py:487-506), inference job CRUD, predict, advisor endpoints,
 from __future__ import annotations
 
 import base64
+import threading
 from typing import Any, Dict, List, Optional
 
 import requests
@@ -28,6 +29,20 @@ class Client:
         self._base = f"http://{admin_host}:{admin_port}"
         self._token: Optional[str] = None
         self.user: Optional[Dict[str, Any]] = None
+        # pooled keep-alive connections: a fresh TCP connect per call would
+        # cost setup latency AND a new server-side handler thread each time
+        # (the admin server speaks HTTP/1.1 — admin/http.py). One Session
+        # PER THREAD: requests.Session is not documented thread-safe, and a
+        # Client is shared across threads (e.g. the placement agent's
+        # status forwarder reports from per-service threads).
+        self._tls = threading.local()
+
+    @property
+    def _http(self) -> requests.Session:
+        s = getattr(self._tls, "session", None)
+        if s is None:
+            s = self._tls.session = requests.Session()
+        return s
 
     # -- plumbing ----------------------------------------------------------
 
@@ -41,7 +56,7 @@ class Client:
         headers = {}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
-        resp = requests.request(
+        resp = self._http.request(
             method, self._base + path, json=body, params=params, headers=headers
         )
         try:

@@ -170,3 +170,43 @@ def test_late_first_report_does_not_backfill_lower_rungs():
     # population [0.5, 0.6, 0.55]: top_k=1 -> only 0.5 promotes; 0.55 stops
     # — but crucially the bar is 0.5 (a real rung-1 loss), not 0.001
     assert not s.report("f3", 1, 0.55)
+
+
+def test_sparse_reporter_never_pollutes_rungs():
+    # a template reporting every 2 epochs against ladder 1,3,9 never has a
+    # measurement AT a rung resource: it must be marked seen but recorded
+    # nowhere (no decision, no bias) rather than logging epoch-2 losses
+    # into the epoch-1 population
+    s = AshaScheduler(min_resource=1, eta=3)
+    assert s.report("sparse", 2, 0.01)
+    assert s.report("sparse", 4, 0.005)
+    assert 1 not in s._rungs or s._rungs[1] == []
+    assert 3 not in s._rungs or s._rungs[3] == []
+    # aligned reporters are unaffected by the sparse one
+    assert s.report("a", 1, 0.5)
+
+
+def test_bad_asha_budget_rejected_at_creation(tmp_path):
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    try:
+        uid = a.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        a.create_model(uid, "probe", "IMAGE_CLASSIFICATION",
+                       ASHA_PROBE_MODEL, "AshaProbe")
+        for bad in ({"ASHA_ETA": 1}, {"ASHA_MIN_EPOCHS": 0},
+                    {"MODEL_TRIAL_COUNT": "many"}, {"TIME_HOURS": -1},):
+            with pytest.raises(InvalidRequestError):
+                a.create_train_job(uid, "vapp", "IMAGE_CLASSIFICATION",
+                                   "uri://t", "uri://e",
+                                   budget={"MODEL_TRIAL_COUNT": 1, **bad})
+    finally:
+        a.shutdown()
