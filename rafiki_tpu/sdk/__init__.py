@@ -28,6 +28,7 @@ from rafiki_tpu.sdk.log import (  # noqa: F401
     logger,
     parse_logs,
 )
+from rafiki_tpu.sdk.population import PopulationTrainer  # noqa: F401
 from rafiki_tpu.sdk.model import (  # noqa: F401
     BaseModel,
     InvalidModelClassError,

@@ -31,6 +31,7 @@ def _load(rel):
 
 ALL_TEMPLATES = [
     "image_classification/JaxCnn.py",
+    "image_classification/JaxCnnPopulation.py",
     "image_classification/JaxResNet.py",
     "image_classification/JaxFeedForward.py",
     "image_classification/JaxVgg16.py",
