@@ -115,18 +115,27 @@ class JaxCnnPopulation(BaseModel):
         lo, hi = float(self._knobs["lr_min"]), float(self._knobs["lr_max"])
         lrs = np.geomspace(min(lo, hi), max(lo, hi), k).tolist()
 
+        self._trainer = self._build_trainer()
         # winner selection needs held-out data: carve a val split off a
         # SHUFFLED view of the train set (dataset zips often arrive
         # class-ordered — an unshuffled tail would be a one-class val set
         # and make best-of-K selection meaningless). Deterministic
-        # permutation so a resumed re-run sees the identical split.
-        perm = np.random.default_rng(0).permutation(len(x))
-        x, y = x[perm], y[perm]
-        n_val = max(len(x) // 8, 1)
-        x_tr, y_tr = x[:-n_val], y[:-n_val]
-        x_val, y_val = x[-n_val:], y[-n_val:]
-
-        self._trainer = self._build_trainer()
+        # permutation so a resumed re-run sees the identical split, and
+        # memoized on the (cached) trainer so successive trials pass the
+        # SAME split arrays — that identity is what fit()'s cross-trial
+        # device cache keys on.
+        split_key = (id(x), id(y))
+        cached_split = getattr(self._trainer, "_split_cache", None)
+        if cached_split is not None and cached_split[0] == split_key:
+            x_tr, y_tr, x_val, y_val = cached_split[1]
+        else:
+            perm = np.random.default_rng(0).permutation(len(x))
+            xs, ys = x[perm], y[perm]
+            n_val = max(len(xs) // 8, 1)
+            x_tr, y_tr = xs[:-n_val], ys[:-n_val]
+            x_val, y_val = xs[-n_val:], ys[-n_val:]
+            self._trainer._split_cache = (
+                split_key, (x_tr, y_tr, x_val, y_val))
         params, opt = self._trainer.init(
             self._make_init(x.shape[-1], num_classes),
             {"learning_rate": lrs})

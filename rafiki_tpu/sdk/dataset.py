@@ -19,6 +19,7 @@ re-decoding PNGs per epoch.
 
 from __future__ import annotations
 
+import collections
 import csv
 import hashlib
 import io
@@ -44,6 +45,14 @@ class DatasetUtils:
         self._cache_dir = cache_dir or os.path.join(
             tempfile.gettempdir(), "rafiki_tpu_datasets"
         )
+        # in-memory array cache for load_image_arrays: successive HPO
+        # trials of one job load the SAME dataset — re-parsing the file
+        # (and breaking downstream identity-keyed device caches, see
+        # DataParallelTrainer.fit) per trial is pure waste. Keyed by
+        # (resolved path, mtime, size, image_size); tiny LRU (a worker
+        # serves one job: train + test sets).
+        self._array_cache: "collections.OrderedDict" = collections.OrderedDict()
+        self._array_cache_cap = 4
 
     def download_dataset_from_uri(self, uri: str) -> str:
         """Resolve a dataset URI to a local file path, downloading through a
@@ -78,13 +87,38 @@ class DatasetUtils:
         self, uri: str, image_size: Optional[Tuple[int, int]] = None
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Either dataset format -> (x float32, y int32) dense arrays — the
-        branch every image-classification template needs."""
+        branch every image-classification template needs. Cached in memory
+        per (file identity, image_size): repeat loads return the SAME
+        array objects, which downstream device caches key on. Callers must
+        treat the arrays as read-only (templates already do — jit tracing
+        would not see an in-place mutation anyway)."""
+        path = self.download_dataset_from_uri(uri)
+        st = os.stat(path)
+        # st_ino catches the atomic write-then-rename pattern even when
+        # mtime granularity is coarse; an in-place same-size rewrite within
+        # one timestamp tick can still alias — callers that rewrite
+        # datasets in place should call invalidate_array_cache()
+        key = (path, st.st_mtime_ns, st.st_size, st.st_ino, image_size)
+        hit = self._array_cache.get(key)
+        if hit is not None:
+            self._array_cache.move_to_end(key)
+            return hit
         if uri.endswith(".npz"):
-            ds = self.load_dataset_of_arrays(uri)
-            return ds.x.astype(np.float32), ds.y.astype(np.int32)
-        img_ds = self.load_dataset_of_image_files(uri, image_size=image_size)
-        x, y = img_ds.load_as_arrays()
-        return x.astype(np.float32), y.astype(np.int32)
+            ds = NumpyDataset(path)
+            out = (ds.x.astype(np.float32), ds.y.astype(np.int32))
+        else:
+            img_ds = ImageFilesDataset(path, image_size)
+            x, y = img_ds.load_as_arrays()
+            out = (x.astype(np.float32), y.astype(np.int32))
+        self._array_cache[key] = out
+        while len(self._array_cache) > self._array_cache_cap:
+            self._array_cache.popitem(last=False)
+        return out
+
+    def invalidate_array_cache(self) -> None:
+        """Drop the in-memory array cache (needed only after rewriting a
+        dataset file in place — atomic replace is detected automatically)."""
+        self._array_cache.clear()
 
     def load_dataset_of_arrays(self, uri: str) -> "NumpyDataset":
         return NumpyDataset(self.download_dataset_from_uri(uri))

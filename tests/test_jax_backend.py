@@ -458,3 +458,45 @@ def test_scan_epoch_checkpoint_resume(tmp_path):
     for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(resumed)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
+
+
+def test_fit_reuses_device_dataset_across_calls(monkeypatch):
+    # HPO trials call fit() with the same host arrays; the device upload
+    # must happen once, not once per trial (it dominates small trials
+    # through a remote-chip tunnel)
+    x, y = _linear_data(n=64)
+
+    def apply_fn(params, xb):
+        return xb @ params["w"]
+
+    trainer = DataParallelTrainer(
+        loss_fn=softmax_classifier_loss(apply_fn),
+        optimizer=optax.sgd(1e-2))
+    import rafiki_tpu.sdk.jax_backend as jb
+    puts = []
+    real_put = jax.device_put
+    monkeypatch.setattr(jb.jax, "device_put",
+                        lambda v, s=None: (puts.append(np.shape(v)),
+                                           real_put(v, s))[1])
+    for trial in range(3):
+        p, o = trainer.init(lambda k: {"w": jnp.zeros((8, 3))})
+        trainer.fit(p, o, (x, y), epochs=1, batch_size=32,
+                    scan_epoch=True)
+    dataset_puts = [s for s in puts if s == np.shape(x)]
+    assert len(dataset_puts) == 1  # uploaded once, reused twice
+
+
+def test_dataset_array_cache_returns_identical_objects(tmp_path):
+    from rafiki_tpu.sdk.dataset import DatasetUtils, write_numpy_dataset
+
+    du = DatasetUtils()
+    x = np.zeros((16, 4, 4, 1), np.float32)
+    y = np.zeros((16,), np.int32)
+    uri = write_numpy_dataset(x, y, str(tmp_path / "d.npz"))
+    a1 = du.load_image_arrays(uri)
+    a2 = du.load_image_arrays(uri)
+    assert a1[0] is a2[0] and a1[1] is a2[1]
+    # rewriting the file invalidates the entry
+    write_numpy_dataset(x + 1, y, str(tmp_path / "d.npz"))
+    a3 = du.load_image_arrays(uri)
+    assert a3[0] is not a1[0]
