@@ -68,12 +68,14 @@ def _xla_flops(jitted, *args) -> Optional[float]:
         return None
 
 
-def bench_vit(batch_size: int = 128, image_size: int = 224,
+def bench_vit(batch_size: int = 192, image_size: int = 224,
               n_steps: int = 32, steps_per_call: int = 8,
               remat: Optional[str] = "dots") -> Dict[str, Any]:
     """ViT-B/16 fused train step (fwd+bwd+adamw), bf16 activations, donated
-    buffers, multi-step scan per dispatch, dots-saveable remat (batch 128
-    does not fit 16 GB HBM with full activation stashing)."""
+    buffers, multi-step scan per dispatch, dots-saveable remat (batches
+    this size do not fit 16 GB HBM with full activation stashing).
+    Batch 192 is the measured single-chip optimum (swept 128/192/224/256:
+    0.350/0.355/0.324/0.330 MFU)."""
     import dataclasses
 
     import jax
@@ -148,10 +150,12 @@ def bench_vit(batch_size: int = 128, image_size: int = 224,
     return out
 
 
-def bench_pggan(resolution: int = 64, minibatch: int = 64,
+def bench_pggan(resolution: int = 64, minibatch: int = 128,
                 n_steps: int = 20) -> Dict[str, Any]:
     """Progressive-GAN D+G step at full resolution (the steady-state cost
     once growth completes — the reference's headline img/s regime).
+    Minibatch 128 is the measured single-chip optimum (swept 64/128/256:
+    0.374/0.459/0.427 MFU).
 
     MFU here uses XLA's ``cost_analysis`` of the two compiled steps: unlike
     the ViT bench (whose ``lax.scan`` bodies cost_analysis counts once),
