@@ -65,17 +65,33 @@ def wait_until_train_job_has_stopped(client, app, timeout_s=1800):
 def quickstart(args):
     workdir = ensure_workdir()
 
-    from rafiki_tpu.admin.admin import Admin
-    from rafiki_tpu.admin.http import AdminServer
     from rafiki_tpu.client.client import Client
     from rafiki_tpu.config import SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD
-    from rafiki_tpu.db.database import Database
 
-    admin = Admin(db=Database(os.path.join(workdir, "quickstart.sqlite")))
-    server = AdminServer(admin).start()
-    print(f"Admin HTTP API on 127.0.0.1:{server.port}")
-    client = Client(admin_host="127.0.0.1", admin_port=server.port)
-    client.login(SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD)
+    # Drive an already-running stack (scripts/start.sh) when one answers at
+    # --admin-host/--admin-port; otherwise self-boot an in-process admin so
+    # the quickstart works standalone too.
+    import requests
+
+    admin = server = None
+    client = Client(admin_host=args.admin_host, admin_port=args.admin_port)
+    try:
+        client.login(SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD)
+        print(f"Using running admin at {args.admin_host}:{args.admin_port}")
+    except requests.exceptions.ConnectionError:
+        # nothing listening there — self-boot. Auth errors from a RUNNING
+        # admin (custom SUPERADMIN_PASSWORD) must propagate, not silently
+        # spawn a throwaway second stack.
+        from rafiki_tpu.admin.admin import Admin
+        from rafiki_tpu.admin.http import AdminServer
+        from rafiki_tpu.db.database import Database
+
+        admin = Admin(db=Database(os.path.join(workdir, "quickstart.sqlite")))
+        server = AdminServer(admin).start()
+        print(f"No admin at {args.admin_host}:{args.admin_port}; "
+              f"self-booted one on 127.0.0.1:{server.port}")
+        client = Client(admin_host="127.0.0.1", admin_port=server.port)
+        client.login(SUPERADMIN_EMAIL, SUPERADMIN_PASSWORD)
 
     if args.train_dataset:
         train_uri, test_uri = args.train_dataset, args.test_dataset
@@ -115,8 +131,10 @@ def quickstart(args):
     if status != "STOPPED":
         print("Train job errored — check worker logs under "
               f"{os.path.join(workdir, 'logs')}")
-        server.stop()
-        admin.shutdown()
+        if server is not None:
+            server.stop()
+        if admin is not None:
+            admin.shutdown()
         sys.exit(1)
 
     print("Best trials:")
@@ -135,14 +153,17 @@ def quickstart(args):
     print([np.argmax(p) for p in predictions])
 
     client.stop_inference_job(app=app)
-    client.stop_all_jobs()
-    server.stop()
-    admin.shutdown()
+    if server is not None:  # self-booted: tear the whole stack down
+        client.stop_all_jobs()
+        server.stop()
+        admin.shutdown()
     print("Quickstart complete.")
 
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
+    parser.add_argument("--admin-host", default="127.0.0.1")
+    parser.add_argument("--admin-port", type=int, default=3000)
     parser.add_argument("--trials", type=int, default=4)
     parser.add_argument("--chips", type=int, default=1)
     parser.add_argument("--train-dataset", default=None)

@@ -38,7 +38,10 @@ class TransformerConfig:
     mlp_ratio: int = 4
     dropout: float = 0.0
     causal: bool = False
-    use_flash: Optional[bool] = None  # None = auto by backend/seq-len
+    # None = auto: flash once the (S,S) score tensors would crowd HBM
+    # (ops/attention.py FLASH_SCORES_BYTES); XLA's fused attention is
+    # faster below that
+    use_flash: Optional[bool] = None
     moe_experts: int = 0  # >0 replaces the MLP with an expert-parallel MoE
     moe_capacity_factor: float = 1.25
     # "ring" routes attention through parallel/ring.py when the current mesh
