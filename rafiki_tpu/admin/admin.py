@@ -354,24 +354,36 @@ class Admin:
                 raise InvalidRequestError(
                     f"budget {key}={v} must be >= {minimum}")
 
+        def as_float(key, minimum, exclusive=False):
+            raw = budget.get(key)
+            if raw is None:
+                return
+            try:
+                v = float(raw)
+            except (TypeError, ValueError):
+                raise InvalidRequestError(
+                    f"budget {key}={raw!r} is not a number")
+            import math
+
+            # NaN would pass every comparison and silently disable the
+            # limit the value exists to enforce
+            if not math.isfinite(v):
+                raise InvalidRequestError(f"budget {key}={v} is not finite")
+            if v < minimum or (exclusive and v == minimum):
+                op = ">" if exclusive else ">="
+                raise InvalidRequestError(
+                    f"budget {key}={v} must be {op} {minimum}")
+
         as_int(BudgetType.MODEL_TRIAL_COUNT, 1)
         as_int(BudgetType.CHIP_COUNT, 0)
         as_int(BudgetType.GPU_COUNT, 0)
         as_int(BudgetType.CHIPS_PER_TRIAL, 1)
         as_int(BudgetType.ASHA_MIN_EPOCHS, 1)
         as_int(BudgetType.ASHA_ETA, 2)
-        raw = budget.get(BudgetType.TIME_HOURS)
-        if raw is not None:
-            try:
-                hours = float(raw)
-            except (TypeError, ValueError):
-                raise InvalidRequestError(
-                    f"budget TIME_HOURS={raw!r} is not a number")
-            if hours < 0:
-                # 0 is legal: the deadline is already spent, so the job
-                # stops before running any trial (tested behavior)
-                raise InvalidRequestError(
-                    f"budget TIME_HOURS={hours} must be >= 0")
+        # TIME_HOURS=0 is legal: the deadline is already spent, so the job
+        # stops before running any trial (tested behavior)
+        as_float(BudgetType.TIME_HOURS, 0)
+        as_float(BudgetType.TRIAL_TIMEOUT_S, 0, exclusive=True)
 
     def get_train_job(
         self, user_id: str, app: str, app_version: int = -1

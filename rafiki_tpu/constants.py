@@ -35,6 +35,11 @@ class BudgetType:
     EARLY_STOP = "EARLY_STOP"
     ASHA_MIN_EPOCHS = "ASHA_MIN_EPOCHS"
     ASHA_ETA = "ASHA_ETA"
+    # Per-trial wall-clock cap in seconds (new capability): a trial that
+    # exceeds it is truncated at its next metrics report and completes with
+    # the score its partial training earned — a runaway knob draw cannot
+    # hold an executor forever.
+    TRIAL_TIMEOUT_S = "TRIAL_TIMEOUT_S"
 
 
 class TaskType:

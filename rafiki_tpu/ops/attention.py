@@ -26,8 +26,17 @@ Params = Dict[str, Any]
 # seq 8192 the same shape needs ~13 GB of scores and fails to compile,
 # while flash runs it in 242 ms. 1 GB default leaves room for the scores
 # XLA saves for backward alongside params/activations.
-FLASH_SCORES_BYTES = int(
-    os.environ.get("RAFIKI_FLASH_THRESHOLD_BYTES", str(1 << 30)))
+def _flash_threshold_bytes() -> int:
+    raw = os.environ.get("RAFIKI_FLASH_THRESHOLD_BYTES", str(1 << 30))
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"RAFIKI_FLASH_THRESHOLD_BYTES={raw!r} must be a plain integer "
+            "byte count (e.g. 1073741824)") from None
+
+
+FLASH_SCORES_BYTES = _flash_threshold_bytes()
 
 
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -104,6 +104,14 @@ class TrainWorker:
         self._early_stop = bool(budget.get(BudgetType.EARLY_STOP, False))
         self._asha_min = int(budget.get(BudgetType.ASHA_MIN_EPOCHS, 1))
         self._asha_eta = int(budget.get(BudgetType.ASHA_ETA, 3))
+        # deadlines enforced MID-trial through the same stop-check channel:
+        # the job's TIME_HOURS deadline, and an optional per-trial wall cap
+        # (TRIAL_TIMEOUT_S). Without these a runaway trial (bad knob draw
+        # compiling into an enormous model) holds its executor forever —
+        # the between-trials deadline check alone cannot interrupt it.
+        self._job_deadline = deadline
+        tt = budget.get(BudgetType.TRIAL_TIMEOUT_S)
+        self._trial_timeout_s = float(tt) if tt is not None else None
         clazz = load_model_class(model["model_file_bytes"], model["model_class"])
         knob_config = clazz.get_knob_config()
         advisor_id = self._advisors.create_advisor(
@@ -266,32 +274,50 @@ class TrainWorker:
 
     def _install_stop_check(self, trial_logger: ModelLogger,
                             advisor_id: str, trial_id: str) -> None:
-        """Wire a trial's logger to the sub-job's ASHA scheduler: every
-        per-epoch METRICS report with a "loss" value becomes a rung check;
-        an uncompetitive trial's next log() raises StopTrialEarly, which
-        fit()/the trial runner treat as a normal (truncated) completion.
-        Advisor stores without report_rung (older remote admins) silently
-        disable early stopping — never fail a trial over it."""
-        if not getattr(self, "_early_stop", False):
-            return
+        """Wire a trial's logger to its in-flight stop conditions. Every
+        METRICS report is a decision point; a verdict makes the next log()
+        raise StopTrialEarly, which fit()/the trial runner treat as a
+        normal (truncated) completion. Conditions, cheapest first:
+
+        - per-trial wall cap (budget TRIAL_TIMEOUT_S),
+        - the job's TIME_HOURS deadline (otherwise only enforced between
+          trials — an in-flight runaway would sail past it),
+        - ASHA rung checks on per-epoch "loss" (budget EARLY_STOP; advisor
+          stores without report_rung silently disable this — never fail a
+          trial over it)."""
+        early_stop = getattr(self, "_early_stop", False)
         report = getattr(self._advisors, "report_rung", None)
-        if report is None:
+        if early_stop and report is None:
             logger.warning("EARLY_STOP budget set but the advisor store "
-                           "has no report_rung; trials run full-length")
+                           "has no report_rung; rung checks disabled")
+        job_deadline = getattr(self, "_job_deadline", None)
+        trial_timeout = getattr(self, "_trial_timeout_s", None)
+        if not ((early_stop and report is not None)
+                or job_deadline is not None or trial_timeout is not None):
             return
+        trial_start = time.time()
 
         def check(metrics: Dict[str, Any]) -> bool:
-            if "loss" not in metrics or "epoch" not in metrics:
-                return False
-            try:
-                return not report(
-                    advisor_id, trial_id, int(metrics["epoch"]) + 1,
-                    metrics["loss"], min_resource=self._asha_min,
-                    eta=self._asha_eta)
-            except Exception:
-                logger.warning("ASHA rung report failed; continuing trial",
-                               exc_info=True)
-                return False
+            now = time.time()
+            if trial_timeout is not None and now - trial_start > trial_timeout:
+                logger.info("trial %s hit TRIAL_TIMEOUT_S=%.0f; stopping",
+                            trial_id, trial_timeout)
+                return True
+            if job_deadline is not None and now >= job_deadline:
+                logger.info("trial %s crossed the job TIME_HOURS deadline; "
+                            "stopping", trial_id)
+                return True
+            if (early_stop and report is not None
+                    and "loss" in metrics and "epoch" in metrics):
+                try:
+                    return not report(
+                        advisor_id, trial_id, int(metrics["epoch"]) + 1,
+                        metrics["loss"], min_resource=self._asha_min,
+                        eta=self._asha_eta)
+                except Exception:
+                    logger.warning("ASHA rung report failed; continuing "
+                                   "trial", exc_info=True)
+            return False
 
         trial_logger.set_stop_check(check)
 

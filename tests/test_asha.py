@@ -210,3 +210,101 @@ def test_bad_asha_budget_rejected_at_creation(tmp_path):
                                    budget={"MODEL_TRIAL_COUNT": 1, **bad})
     finally:
         a.shutdown()
+
+
+SLOW_MODEL = b'''
+import time
+
+from rafiki_tpu.sdk import BaseModel, FixedKnob, FloatKnob
+
+
+class SlowModel(BaseModel):
+    """Logs a metric every 0.2 s for up to 50 epochs (~10 s), far past
+    the test's TRIAL_TIMEOUT_S."""
+
+    dependencies = {"numpy": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {"epochs": FixedKnob(50), "lr": FloatKnob(0.001, 0.1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._params = {"epochs_done": 0}
+
+    def train(self, dataset_uri):
+        for epoch in range(50):
+            time.sleep(0.2)
+            self._params = {"epochs_done": epoch + 1}
+            self.logger.log(loss=1.0, epoch=float(epoch))
+
+    def evaluate(self, dataset_uri):
+        return float(self._params["epochs_done"])
+
+    def predict(self, queries):
+        return [[1.0] for _ in queries]
+
+    def dump_parameters(self):
+        return self._params
+
+    def load_parameters(self, params):
+        self._params = params
+'''
+
+
+def test_trial_timeout_truncates_runaway_trial(tmp_path):
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.constants import TrialStatus
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    try:
+        uid = a.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        a.create_model(uid, "slow", "IMAGE_CLASSIFICATION", SLOW_MODEL,
+                       "SlowModel")
+        a.create_train_job(
+            uid, "slowapp", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+            budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 1,
+                    "TRIAL_TIMEOUT_S": 1.0},
+        )
+        a.wait_until_train_job_stopped(uid, "slowapp", timeout_s=30)
+        (trial,) = a.get_trials_of_train_job(uid, "slowapp")
+        # truncated, not errored: completes with the partial score
+        assert trial["status"] == TrialStatus.COMPLETED
+        # ~5 epochs fit in 1 s at 0.2 s/epoch; far fewer than 50
+        assert 1 <= trial["score"] <= 15
+    finally:
+        a.shutdown()
+
+
+def test_nan_budget_rejected(tmp_path):
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    try:
+        uid = a.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        a.create_model(uid, "probe", "IMAGE_CLASSIFICATION",
+                       ASHA_PROBE_MODEL, "AshaProbe")
+        for bad in ({"TRIAL_TIMEOUT_S": float("nan")},
+                    {"TIME_HOURS": float("inf")}):
+            with pytest.raises(InvalidRequestError, match="finite"):
+                a.create_train_job(uid, "nanapp", "IMAGE_CLASSIFICATION",
+                                   "uri://t", "uri://e",
+                                   budget={"MODEL_TRIAL_COUNT": 1, **bad})
+    finally:
+        a.shutdown()
