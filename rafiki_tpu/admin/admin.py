@@ -70,6 +70,9 @@ class Admin:
         self._predict_route_cache: Dict[Any, Any] = {}
         self._predict_route_lock = threading.Lock()
         self._predict_route_epoch = 0
+        # serving counters reported by out-of-process inference workers
+        # over the event channel (see handle_event / get_inference_job_stats)
+        self._remote_serving_stats: Dict[str, Dict[str, int]] = {}
         # RAFIKI_BROKER=shm selects the native cross-process data
         # plane (cache/shm_broker.py); default is in-process.
         # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to
@@ -544,6 +547,39 @@ class Admin:
         self.services.create_inference_services(inf["id"])
         return self.get_inference_job(user_id, app, job["app_version"])
 
+    def get_inference_job_stats(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        """Serving observability: per-worker batch/query counters and the
+        derived batch occupancy (mean queries/batch — the signal that
+        continuous batching coalesces under load). In-process workers are
+        read from worker/inference.py SERVING_STATS directly; process-mode
+        workers relay theirs over the event channel (at most every ~10 s,
+        so freshly-started remote workers may briefly read 0). Counters
+        reset with the worker."""
+        from rafiki_tpu.worker.inference import serving_stats
+
+        inf = self.get_inference_job(user_id, app, app_version)
+        local = serving_stats()
+        workers = []
+        total_b = total_q = 0
+        for w in inf["workers"]:
+            # in-process workers land in the local module counters;
+            # process-mode workers report over the event channel
+            s = local.get(w["service_id"]) or self._remote_serving_stats.get(
+                w["service_id"]) or {"batches": 0, "queries": 0}
+            total_b += s["batches"]
+            total_q += s["queries"]
+            workers.append({**w, **s})
+        return {
+            "inference_job_id": inf["id"],
+            "status": inf["status"],
+            "workers": workers,
+            "batches": total_b,
+            "queries": total_q,
+            "batch_occupancy": round(total_q / total_b, 2) if total_b else None,
+        }
+
     def get_inference_job(
         self, user_id: str, app: str, app_version: int = -1
     ) -> Dict:
@@ -587,12 +623,16 @@ class Admin:
         within the TTL its workers may still be draining, so predict must
         go back to the control plane and correctly report the stop. Bumps
         the route epoch so an in-flight predict() that resolved before this
-        stop cannot re-insert the dead route."""
+        stop cannot re-insert the dead route. Also prunes the job's relayed
+        serving counters — a long-lived admin cycling many jobs must not
+        accumulate entries for dead services forever."""
         with self._predict_route_lock:
             self._predict_route_epoch += 1
             for key, (_, predictor) in list(self._predict_route_cache.items()):
                 if predictor._job_id == inference_job_id:
                     self._predict_route_cache.pop(key, None)
+        for w in self.db.get_workers_of_inference_job(inference_job_id):
+            self._remote_serving_stats.pop(w["service_id"], None)
 
     def predict(
         self, user_id: str, app: str, queries: List[Any], app_version: int = -1
@@ -674,6 +714,14 @@ class Admin:
                 # forwarded by per-host placement agents (placement/agent.py)
                 # so job-level refresh fires even for remotely-placed workers
                 self._on_service_status(payload["service_id"], payload["status"])
+            elif name == "inference_worker_stats":
+                # serving counters from OUT-OF-PROCESS inference workers
+                # (process placement) — in-process workers update the local
+                # SERVING_STATS module dict directly
+                self._remote_serving_stats[payload["service_id"]] = {
+                    "batches": int(payload.get("batches", 0)),
+                    "queries": int(payload.get("queries", 0)),
+                }
         except Exception:
             logger.exception("event %s failed", name)
 

@@ -182,6 +182,9 @@ class AdminServer:
             r("GET", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)", _ANY,
                 lambda au, m, b, q: A.get_inference_job(
                     au["user_id"], m["app"], int(m["v"]))),
+            r("GET", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/stats",
+                _ANY, lambda au, m, b, q: A.get_inference_job_stats(
+                    au["user_id"], m["app"], int(m["v"]))),
             r("POST", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/stop",
                 _APP_DEVS, lambda au, m, b, q: A.stop_inference_job(
                     au["user_id"], m["app"], int(m["v"]))),

@@ -222,6 +222,10 @@ class Client:
     def get_inference_job(self, app: str, app_version: int = -1) -> Dict:
         return self._call("GET", f"/inference_jobs/{app}/{app_version}")
 
+    def get_inference_job_stats(self, app: str, app_version: int = -1) -> Dict:
+        """Serving counters: per-worker batches/queries and batch occupancy."""
+        return self._call("GET", f"/inference_jobs/{app}/{app_version}/stats")
+
     def stop_inference_job(self, app: str, app_version: int = -1) -> Dict:
         return self._call("POST", f"/inference_jobs/{app}/{app_version}/stop")
 

@@ -116,7 +116,7 @@ def main() -> int:
         if service_type == ServiceType.TRAIN:
             _run_train(ctx, db, admin_client)
         elif service_type == ServiceType.INFERENCE:
-            _run_inference(ctx, db)
+            _run_inference(ctx, db, admin_client)
         else:
             raise RuntimeError(f"bootstrap: unsupported type {service_type}")
     except Exception:
@@ -158,16 +158,23 @@ def _run_train(ctx, db, admin_client) -> None:
     worker.start(ctx)
 
 
-def _run_inference(ctx, db) -> None:
+def _run_inference(ctx, db, admin_client) -> None:
     from rafiki_tpu.cache.shm_broker import ShmBrokerClient
     from rafiki_tpu.worker.inference import InferenceWorker
 
     broker = ShmBrokerClient(_require("RAFIKI_BROKER_PREFIX"))
+    report = None
+    if admin_client is not None:
+        # relay serving counters to the admin (its in-process SERVING_STATS
+        # cannot see this process) for /inference_jobs/<app>/<v>/stats
+        report = lambda payload: admin_client.send_event(  # noqa: E731
+            "inference_worker_stats", **payload)
     worker = InferenceWorker(
         _require("RAFIKI_INFERENCE_JOB_ID"),
         _require("RAFIKI_TRIAL_ID"),
         db,
         broker,
+        report_stats=report,
     )
     worker.start(ctx)
 

@@ -57,11 +57,49 @@ class InferenceWorker:
         trial_id: str,
         db: Database,
         broker: Broker,
+        report_stats=None,
+        report_interval_s: float = 5.0,
     ):
+        """``report_stats({"service_id", "batches", "queries"})`` relays
+        cumulative serving counters to a remote admin (process placement —
+        the admin cannot see this process's SERVING_STATS). Pushed from a
+        background thread every ``report_interval_s`` (and once at ready
+        and at exit) so counters stay fresh even when traffic pauses;
+        best-effort."""
         self._job_id = inference_job_id
         self._trial_id = trial_id
         self._db = db
         self._broker = broker
+        self._report_stats = report_stats
+        self._report_interval_s = report_interval_s
+
+    def _stats_reporter(self, ctx: ServiceContext) -> None:
+        """Push cumulative counters on a fixed cadence, independent of
+        traffic (a throttle piggybacked on the serve loop would leave the
+        last batches before a pause unreported). First push immediately —
+        benches/dashboards read stats right after the first predicts."""
+        last = None
+
+        def push():
+            nonlocal last
+            s = serving_stats().get(ctx.service_id,
+                                    {"batches": 0, "queries": 0})
+            if s == last:
+                return
+            try:
+                self._report_stats({"service_id": ctx.service_id, **s})
+                # only remember a SUCCESSFUL push — a transient failure
+                # must retry on the next tick even with unchanged counters
+                last = s
+            except Exception:
+                logger.warning("stats report failed (continuing)",
+                               exc_info=True)
+
+        while True:
+            push()
+            if ctx.stop_event.wait(self._report_interval_s):
+                push()  # final snapshot: batches since the last tick
+                return
 
     def _load_model(self):
         trial = self._db.get_trial(self._trial_id)
@@ -93,6 +131,10 @@ class InferenceWorker:
                     "warm_up failed in worker %s (serving anyway):\n%s",
                     ctx.service_id, traceback.format_exc())
             ctx.ready()  # model + params loaded: startup succeeded
+            if self._report_stats is not None:
+                threading.Thread(
+                    target=self._stats_reporter, args=(ctx,),
+                    name="stats-reporter", daemon=True).start()
             while not ctx.stopping:
                 batch = queue.take_batch(
                     max_size=config.PREDICT_MAX_BATCH_SIZE,

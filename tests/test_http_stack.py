@@ -174,3 +174,29 @@ def test_web_dashboard_served_and_jobs_listing(server, superadmin):
     jobs = superadmin.get_train_jobs()
     assert len(jobs) == 1 and jobs[0]["app"] == "webapp"
     assert jobs[0]["status"] == "STOPPED"
+
+
+def test_inference_job_stats_over_http(superadmin):
+    c = superadmin
+    c.create_model("fake", "IMAGE_CLASSIFICATION", FIXTURE, "FakeModel")
+    c.create_train_job("statsapp", "IMAGE_CLASSIFICATION", "uri://t",
+                       "uri://e", budget={"MODEL_TRIAL_COUNT": 2,
+                                          "CHIP_COUNT": 1})
+    import time
+
+    for _ in range(60):
+        if c.get_train_job("statsapp")["status"] == "STOPPED":
+            break
+        time.sleep(0.5)
+    # fail HERE if training never finished — create_inference_job's "no
+    # completed trials" error would point away from the real cause
+    assert c.get_train_job("statsapp")["status"] == "STOPPED"
+    c.create_inference_job("statsapp")
+    for _ in range(4):
+        c.predict("statsapp", [[0.0]])
+    stats = c.get_inference_job_stats("statsapp")
+    assert stats["queries"] >= 4  # every query served by >=1 worker
+    assert stats["batches"] >= 1
+    assert stats["batch_occupancy"] is not None
+    assert all("batches" in w and "trial_id" in w for w in stats["workers"])
+    c.stop_inference_job("statsapp")

@@ -85,6 +85,18 @@ def test_full_cycle_with_process_workers(proc_admin):
     admin.predict(uid, "procapp", [[0.5]])
     assert time.monotonic() - t0 < 0.25, "cross-process serving beat the poll floor"
 
+    # serving counters from the WORKER PROCESSES reach the admin over the
+    # event channel (the admin's in-process SERVING_STATS can't see them);
+    # the first batch reports immediately, then throttled
+    stats = None
+    for _ in range(30):
+        stats = admin.get_inference_job_stats(uid, "procapp")
+        if stats["queries"] >= 3:
+            break
+        time.sleep(0.5)
+    assert stats["queries"] >= 3, stats
+    assert stats["batch_occupancy"] is not None
+
     admin.stop_all_jobs()
 
 
