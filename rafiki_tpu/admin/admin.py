@@ -15,6 +15,7 @@ REST use are the same code path.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import threading
@@ -71,8 +72,13 @@ class Admin:
         self._predict_route_lock = threading.Lock()
         self._predict_route_epoch = 0
         # serving counters reported by out-of-process inference workers
-        # over the event channel (see handle_event / get_inference_job_stats)
-        self._remote_serving_stats: Dict[str, Dict[str, int]] = {}
+        # over the event channel (see handle_event / get_inference_job_stats).
+        # Bounded LRU: stop-time pruning alone can lose the race with a
+        # worker's final drain-window push, so the cap — not the prune — is
+        # what makes unbounded growth impossible in a long-lived admin.
+        self._remote_serving_stats: "collections.OrderedDict[str, Dict[str, int]]" = (
+            collections.OrderedDict())
+        self._remote_serving_stats_cap = 512
         # RAFIKI_BROKER=shm selects the native cross-process data
         # plane (cache/shm_broker.py); default is in-process.
         # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to
@@ -554,9 +560,9 @@ class Admin:
         derived batch occupancy (mean queries/batch — the signal that
         continuous batching coalesces under load). In-process workers are
         read from worker/inference.py SERVING_STATS directly; process-mode
-        workers relay theirs over the event channel (at most every ~10 s,
-        so freshly-started remote workers may briefly read 0). Counters
-        reset with the worker."""
+        workers relay theirs over the event channel (every ~5 s while
+        counters change, so freshly-started remote workers may briefly
+        read 0). Counters reset with the worker."""
         from rafiki_tpu.worker.inference import serving_stats
 
         inf = self.get_inference_job(user_id, app, app_version)
@@ -718,10 +724,15 @@ class Admin:
                 # serving counters from OUT-OF-PROCESS inference workers
                 # (process placement) — in-process workers update the local
                 # SERVING_STATS module dict directly
-                self._remote_serving_stats[payload["service_id"]] = {
+                sid = payload["service_id"]
+                self._remote_serving_stats[sid] = {
                     "batches": int(payload.get("batches", 0)),
                     "queries": int(payload.get("queries", 0)),
                 }
+                self._remote_serving_stats.move_to_end(sid)
+                while (len(self._remote_serving_stats)
+                       > self._remote_serving_stats_cap):
+                    self._remote_serving_stats.popitem(last=False)
         except Exception:
             logger.exception("event %s failed", name)
 

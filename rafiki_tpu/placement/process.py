@@ -39,7 +39,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import config
 from rafiki_tpu.constants import ServiceStatus, ServiceType
@@ -190,6 +190,12 @@ class ProcessPlacementManager(PlacementManager):
         self.stop_grace_s = stop_grace_s
         self._lock = threading.Lock()
         self._runners: Dict[str, _ProcRunner] = {}
+        # runners detached by destroy_service(wait=False) whose children
+        # may still be in the SIGTERM->SIGKILL grace window; stop_all()
+        # must wait these out — otherwise an exiting admin kills its own
+        # daemon monitor threads mid-escalation and orphans a child that
+        # ignored SIGTERM (e.g. one stuck inside a long XLA dispatch)
+        self._dying: List[_ProcRunner] = []
 
     # -- PlacementManager --------------------------------------------------
 
@@ -240,6 +246,11 @@ class ProcessPlacementManager(PlacementManager):
     def destroy_service(self, service_id: str, wait: bool = True) -> None:
         with self._lock:
             runner = self._runners.pop(service_id, None)
+            # only track runners whose monitor thread still runs: appending
+            # an already-finished runner would leak it (its _on_runner_exit
+            # has already fired and won't prune it again)
+            if runner is not None and runner.thread.is_alive():
+                self._dying.append(runner)
         if runner is None:
             return  # tolerate concurrent deletion
         runner.ctx.stop_event.set()
@@ -260,11 +271,24 @@ class ProcessPlacementManager(PlacementManager):
             ids = list(self._runners)
         for sid in ids:
             self.destroy_service(sid)
+        # reap runners detached earlier with wait=False: their monitor
+        # threads may still be escalating SIGTERM->SIGKILL, and the caller
+        # (admin shutdown) exits right after this returns
+        with self._lock:
+            dying = list(self._dying)
+        for runner in dying:
+            runner.thread.join(timeout=self.stop_grace_s + 10)
+        with self._lock:
+            # sweep entries whose exit raced the is_alive() append guard
+            self._dying = [r for r in self._dying if r.thread.is_alive()]
 
     # -- internals ---------------------------------------------------------
 
     def _on_runner_exit(self, ctx: ServiceContext) -> None:
         self.allocator.release(ctx.chips)
+        with self._lock:
+            self._dying = [r for r in self._dying
+                           if r.ctx.service_id != ctx.service_id]
 
     def _child_env(self, ctx: ServiceContext) -> Dict[str, str]:
         env = dict(os.environ)

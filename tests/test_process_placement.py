@@ -121,3 +121,55 @@ def test_errored_child_is_restarted_then_marked(proc_admin):
         os.environ["RAFIKI_WORKDIR"], "logs", f"service-{svc['id']}.log")
     assert os.path.exists(log)
     admin.placement.destroy_service(svc["id"])
+
+
+@pytest.mark.slow
+def test_stop_all_reaps_sigterm_ignoring_child(tmp_workdir):
+    """An admin shutting down must not orphan a child that cannot honor
+    SIGTERM (e.g. stuck in one long XLA dispatch): destroy_service with
+    wait=False detaches the runner mid-grace, and stop_all() has to wait
+    out the SIGTERM->SIGKILL escalation before the process exits."""
+    import signal as _signal
+    import subprocess
+
+    from rafiki_tpu.placement import process as proc_mod
+
+    db = Database(str(tmp_workdir / "reap.sqlite3"))
+    mgr = ProcessPlacementManager(
+        db=db, broker=None, stop_grace_s=1.0,
+        allocator=__import__("rafiki_tpu.placement.manager",
+                             fromlist=["x"]).ChipAllocator([0]))
+    # stand in for a worker stuck in a dispatch: ignores SIGTERM entirely
+    stubborn = ("import signal, time; "
+                "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+                "print('up', flush=True); time.sleep(600)")
+    real_popen = subprocess.Popen
+
+    def fake_popen(cmd, **kw):
+        return real_popen([sys.executable, "-c", stubborn],
+                          stdout=subprocess.PIPE)
+
+    orig = proc_mod.subprocess.Popen
+    proc_mod.subprocess.Popen = fake_popen
+    try:
+        ctx = mgr.create_service("svc-stubborn", "TRAIN", n_chips=0,
+                             extra={"sub_train_job_id": "x"})
+        runner = mgr._runners["svc-stubborn"]
+        for _ in range(50):  # wait for the child to exist
+            if runner.proc is not None:
+                break
+            time.sleep(0.1)
+        pid = runner.proc.pid
+        mgr.destroy_service("svc-stubborn", wait=False)  # detach mid-grace
+        mgr.stop_all()  # must block until the SIGKILL escalation lands
+        for _ in range(20):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.2)
+        else:
+            os.kill(pid, _signal.SIGKILL)
+            pytest.fail("stop_all returned while the child still lived")
+    finally:
+        proc_mod.subprocess.Popen = orig
