@@ -300,7 +300,7 @@ class Admin:
         budget: Optional[Dict[str, Any]] = None,
         model_names: Optional[List[str]] = None,
     ) -> Dict:
-        budget = budget or {}
+        budget = {} if budget is None else budget
         self._validate_budget(budget)
         # pick the models: named ones, or all visible models for the task
         # (reference admin.py:118-161)
@@ -349,6 +349,10 @@ class Admin:
         degrading the job later (e.g. ASHA_ETA=1 disabling early stopping
         with a warning per epoch) is strictly worse than a 400 here."""
         from rafiki_tpu.constants import BudgetType
+
+        if not isinstance(budget, dict):
+            raise InvalidRequestError(
+                f"budget must be a JSON object, got {type(budget).__name__}")
 
         def as_int(key, minimum):
             raw = budget.get(key)

@@ -107,8 +107,13 @@ def _range_to_unit(v: float, lo: float, hi: float, is_exp: bool) -> float:
 def _unit_to_range(x: float, lo: float, hi: float, is_exp: bool) -> float:
     if is_exp:
         llo, lhi = math.log(lo), math.log(hi)
-        return math.exp(llo + x * (lhi - llo))
-    return lo + x * (hi - lo)
+        v = math.exp(llo + x * (lhi - llo))
+    else:
+        v = lo + x * (hi - lo)
+    # exp(log(lo)) can round a hair OUTSIDE [lo, hi]; a decoded value the
+    # knob's own validate() rejects would error a trial on a perfectly
+    # legitimate advisor proposal
+    return min(max(v, lo), hi)
 
 
 class _NumericKnob(BaseKnob):

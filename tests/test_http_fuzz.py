@@ -1,0 +1,88 @@
+"""HTTP robustness: malformed requests must map to 4xx with a JSON error —
+never a 500 (which would mean an unhandled server-side traceback) and
+never a hang."""
+
+import json
+import urllib.request
+
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.admin.http import AdminServer
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+
+
+@pytest.fixture()
+def server(tmp_path):
+    admin = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    srv = AdminServer(admin, port=0).start()
+    yield srv
+    srv.stop()
+    admin.shutdown()
+
+
+def _post(server, path, body: bytes, token=None,
+          content_type="application/json"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", data=body, method="POST",
+        headers={"Content-Type": content_type,
+                 **({"Authorization": f"Bearer {token}"} if token else {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _token(server):
+    status, body = _post(server, "/tokens", json.dumps(
+        {"email": config.SUPERADMIN_EMAIL,
+         "password": config.SUPERADMIN_PASSWORD}).encode())
+    assert status == 200
+    return json.loads(body)["data"]["token"]
+
+
+@pytest.mark.parametrize("body", [
+    b"",                          # empty body
+    b"not json at all",
+    b"\xff\xfe\x00garbage",       # invalid utf-8
+    b"[1, 2, 3]",                 # JSON but not an object
+    b'{"email": 42}',             # wrong field types
+    b'{"unclosed": ',
+])
+def test_malformed_login_bodies_get_4xx(server, body):
+    status, payload = _post(server, "/tokens", body)
+    assert 400 <= status < 500, (status, payload)
+    assert b"error" in payload
+
+
+def test_malformed_authed_bodies_get_4xx(server):
+    token = _token(server)
+    cases = [
+        ("/train_jobs", b'{"app": "x"}'),                 # missing fields
+        ("/train_jobs", b'{"app": "x", "task": "T", "train_dataset_uri": 1,'
+                        b' "test_dataset_uri": 2, "budget": "notadict"}'),
+        ("/train_jobs", b'{"app": "x", "task": "T", "train_dataset_uri": "u",'
+                        b' "test_dataset_uri": "u", "budget": []}'),
+        ("/advisors/nope/report_rung",
+         b'{"trial_id": "t", "resource": "three", "value": 0.5}'),
+        ("/advisors", b'{"knob_config": {"bad": {"type": "NOPE"}}}'),
+        ("/predict/ghost-app", b'{"queries": [[0]]}'),
+    ]
+    for path, body in cases:
+        status, payload = _post(server, path, body, token=token)
+        assert 400 <= status < 500, (path, status, payload)
+
+
+def test_unknown_route_and_method(server):
+    status, payload = _post(server, "/no/such/route", b"{}")
+    assert status == 404
+    token = _token(server)
+    status, _ = _post(server, "/users/../../etc", b"{}", token=token)
+    assert 400 <= status < 500
