@@ -163,39 +163,9 @@ class TrainWorker:
                 continue
             logger.info("resuming stale trial %s after worker restart",
                         stale["id"])
-            trial_logger = ModelLogger()
-            trial_logger.set_sink(
-                lambda line, _tid=stale["id"]: self._db.add_trial_log(
-                    _tid, line))
-            self._install_stop_check(trial_logger, advisor_id, stale["id"])
-            tracer = Tracer(stale["id"])
-            try:
-                score, params_path = self._run_trial(
-                    clazz, stale["knobs"], job, stale["id"], trial_logger,
-                    tracer)
-                if ctx.stopping:
-                    self._db.mark_trial_as_terminated(stale["id"])
-                    self._cleanup_ckpt(stale["id"])
-                    return
-                # feedback BEFORE mark-complete: a sibling restarting in
-                # between sees COMPLETED only once the observation is in the
-                # GP, so its empty-only replay can't double-feed (the
-                # reverse window re-runs the trial at worst — a duplicate
-                # noisy observation, which the GP tolerates). A feedback
-                # failure (e.g. remote advisor briefly down) must not cost
-                # the finished trial its result — warn and persist anyway.
-                self._feedback_best_effort(advisor_id, stale["knobs"], score)
-                self._db.mark_trial_as_complete(stale["id"], score,
-                                                params_path)
-            except Exception:
-                if ctx.stopping:
-                    self._db.mark_trial_as_terminated(stale["id"])
-                    self._cleanup_ckpt(stale["id"])
-                    return
-                logger.error("resumed trial %s errored:\n%s", stale["id"],
-                             traceback.format_exc())
-                self._db.mark_trial_as_errored(stale["id"])
-                self._cleanup_ckpt(stale["id"])
+            if not self._execute_trial(ctx, clazz, job, advisor_id,
+                                       stale["id"], stale["knobs"]):
+                return
 
         while not ctx.stopping:
             # shared budget accounting through the DB (reference
@@ -228,34 +198,50 @@ class TrainWorker:
                 )
                 return
             tracer.trace_id = trial["id"]
-            trial_logger = ModelLogger()
-            trial_logger.set_sink(
-                lambda line, _tid=trial["id"]: self._db.add_trial_log(_tid, line)
-            )
-            self._install_stop_check(trial_logger, advisor_id, trial["id"])
-            try:
-                score, params_path = self._run_trial(
-                    clazz, knobs, job, trial["id"], trial_logger, tracer
-                )
-                if ctx.stopping:
-                    self._db.mark_trial_as_terminated(trial["id"])
-                    self._cleanup_ckpt(trial["id"])
-                    return
-                # feedback first — see the stale-trial path above for why
-                self._feedback_best_effort(advisor_id, knobs, score)
-                self._db.mark_trial_as_complete(trial["id"], score, params_path)
-            except Exception:
-                if ctx.stopping:
-                    self._db.mark_trial_as_terminated(trial["id"])
-                    self._cleanup_ckpt(trial["id"])
-                    return
-                logger.error(
-                    "trial %s errored:\n%s", trial["id"], traceback.format_exc()
-                )
-                self._db.mark_trial_as_errored(trial["id"])
-                self._cleanup_ckpt(trial["id"])
-                # errored trials count toward budget (reference train.py:231);
-                # keep looping — the executor survives a bad knob combination
+            if not self._execute_trial(ctx, clazz, job, advisor_id,
+                                       trial["id"], knobs, tracer=tracer):
+                return
+
+    def _execute_trial(self, ctx, clazz, job, advisor_id: str,
+                       trial_id: str, knobs, tracer=None) -> bool:
+        """Run one trial end to end: per-trial logger + stop-check wiring,
+        train/evaluate/persist, and terminal bookkeeping. Shared by the
+        stale-resume path and the main loop. Returns False when the worker
+        is stopping (the trial was marked TERMINATED) so the caller exits
+        its loop; an ERRORED trial returns True — it consumed its budget
+        slot and the executor survives a bad knob combination (the
+        reference instead exited the worker, reference train.py:122-132)."""
+        trial_logger = ModelLogger()
+        trial_logger.set_sink(
+            lambda line, _tid=trial_id: self._db.add_trial_log(_tid, line))
+        self._install_stop_check(trial_logger, advisor_id, trial_id)
+        tracer = tracer or Tracer(trial_id)
+        try:
+            score, params_path = self._run_trial(
+                clazz, knobs, job, trial_id, trial_logger, tracer)
+            # feedback BEFORE mark-complete: a sibling restarting in between
+            # sees COMPLETED only once the observation is in the GP, so its
+            # empty-only replay can't double-feed (the reverse window
+            # re-runs the trial at worst — a duplicate noisy observation,
+            # which the GP tolerates). A feedback failure must not cost the
+            # finished trial its result — _feedback_best_effort queues it.
+            # A stop signal that lands after the work finished does NOT
+            # discard the result: the score and params exist, persisting
+            # them is free, and only the loop exits early.
+            self._feedback_best_effort(advisor_id, knobs, score)
+            self._db.mark_trial_as_complete(trial_id, score, params_path)
+            if ctx.stopping:
+                return False
+        except Exception:
+            if ctx.stopping:
+                self._db.mark_trial_as_terminated(trial_id)
+                self._cleanup_ckpt(trial_id)
+                return False
+            logger.error("trial %s errored:\n%s", trial_id,
+                         traceback.format_exc())
+            self._db.mark_trial_as_errored(trial_id)
+            self._cleanup_ckpt(trial_id)
+        return True
 
     def _feedback_best_effort(self, advisor_id: str, knobs, score) -> None:
         """Feed a trial score to the advisor, never letting an advisor
