@@ -1,0 +1,151 @@
+"""Bounded, subprocess-isolated probing of the JAX accelerator backend.
+
+The live TPU backend in this deployment is reached through a tunnel that
+can wedge: ``jax.devices()`` may then block indefinitely inside PJRT
+plugin init, and a signal delivered during first backend init can wedge
+the tunnel for every later process (round-3 postmortem, commit 88ab848).
+Anything that must stay responsive no matter what — the driver-facing
+``bench.py``, ``__graft_entry__.dryrun_multichip`` — therefore must never
+initialize the live backend in its own process. This module gives them:
+
+- :func:`probe_device_count` — device count read in a child interpreter
+  under a hard timeout; the caller never imports jax.
+- :func:`cpu_env` — an environment for child interpreters that cannot
+  touch the tunnel (``JAX_PLATFORMS=cpu`` plus the tunnel-hook trigger
+  vars stripped, so ``sitecustomize`` never registers the TPU plugin),
+  with an ``n``-device virtual CPU mesh.
+- :func:`defer_term_signals` — context manager that holds SIGTERM/SIGINT
+  delivery across a critical section (first backend init) and re-raises
+  afterwards, so this process cannot be the one that wedges the tunnel
+  by dying mid-init.
+
+Reference analogue: none — the reference assumed always-healthy local
+CUDA devices; a tunnelled accelerator needs an explicit health seam.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from contextlib import contextmanager
+
+# Env vars whose presence makes the baked sitecustomize register the
+# remote-TPU PJRT plugin at *interpreter start* of every child process.
+# Stripping them is the only reliable way to keep a child off the tunnel:
+# JAX_PLATFORMS=cpu alone does not stop the hook from running (it imports
+# jax and dials the tunnel before user code executes).
+TUNNEL_HOOK_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+PROBE_TIMEOUT_S = float(os.environ.get("RAFIKI_BACKEND_PROBE_TIMEOUT_S", 75))
+
+_PROBE_CODE = (
+    "import jax; print('DEVICE_COUNT=%d' % len(jax.devices()))"
+)
+
+
+def cpu_env(n_devices: int | None = None, base: dict | None = None) -> dict:
+    """Child-process environment guaranteed to stay off the TPU tunnel,
+    optionally with an ``n_devices``-wide virtual CPU mesh."""
+    env = dict(os.environ if base is None else base)
+    for var in TUNNEL_HOOK_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    if n_devices:
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags).strip()
+    return env
+
+
+def strip_tunnel_hook() -> None:
+    """Drop the tunnel-hook trigger vars from *this* process's environ so
+    every subsequently spawned child interpreter starts clean (the hook
+    adds ~10 s per interpreter on a slow tunnel and hangs on a wedged
+    one). Call only after this process has finished its own backend init
+    — jax reads these at init time, not after."""
+    for var in TUNNEL_HOOK_VARS:
+        os.environ.pop(var, None)
+
+
+def probe_device_count(
+    timeout_s: float = PROBE_TIMEOUT_S,
+) -> tuple[int, str | None]:
+    """(device_count, error) for the live backend, measured in a child
+    interpreter so a wedged tunnel costs at most ``timeout_s`` and never
+    blocks the caller. ``device_count`` is 0 on any failure; ``error``
+    carries the reason (None on success).
+
+    A timed-out probe child is ABANDONED, not killed: a signal delivered
+    during first backend init is exactly what wedges the tunnel for every
+    later process (round-3 postmortem), so the orphan is left to finish or
+    fail on its own — it holds no resources beyond one idle interpreter."""
+    out = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".probe", delete=False)
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CODE],
+            stdout=out, stderr=subprocess.STDOUT,
+            env=dict(os.environ), start_new_session=True,
+        )
+    except OSError as e:
+        out.close()
+        os.unlink(out.name)
+        return 0, f"backend probe failed to launch: {e!r}"
+    deadline = time.monotonic() + timeout_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.25)
+    if proc.poll() is None:
+        out.close()  # leave the file for the orphan; tiny, in tmpdir
+        return 0, (
+            f"backend probe still hung after {timeout_s:.0f}s "
+            f"(abandoned, pid {proc.pid})"
+        )
+    out.seek(0)
+    text = out.read()
+    out.close()
+    os.unlink(out.name)
+    for line in text.splitlines():
+        if line.startswith("DEVICE_COUNT="):
+            try:
+                return int(line.split("=", 1)[1]), None
+            except ValueError:
+                break
+    tail = text.strip().splitlines()
+    return 0, (
+        f"backend probe rc={proc.returncode}: "
+        + (tail[-1] if tail else "no output")
+    )
+
+
+@contextmanager
+def defer_term_signals():
+    """Hold SIGTERM/SIGINT across a critical section (e.g. first TPU
+    backend init) and re-deliver on exit. A process killed mid-init can
+    wedge the tunnel for every later process; deferring lets init finish
+    (or fail) cleanly first. Signals arriving while blocked in a C call
+    are queued by CPython until the call returns, so this also covers the
+    init path itself. No-op off the main thread (signal() would raise)."""
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    received: list[int] = []
+    previous = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        previous[sig] = signal.signal(
+            sig, lambda signum, frame: received.append(signum))
+    try:
+        yield
+    finally:
+        for sig, handler in previous.items():
+            signal.signal(sig, handler)
+        for sig in dict.fromkeys(received):  # each unique signal, in order
+            os.kill(os.getpid(), sig)

@@ -1,0 +1,191 @@
+"""Harness robustness: the driver-facing entry points (bench.py,
+__graft_entry__.dryrun_multichip) must survive a sick/wedged TPU backend
+— the round-3 failure mode where an in-process ``jax.devices()`` hung the
+driver (MULTICHIP_r03 rc=124) or crashed the bench (BENCH_r03 rc=1).
+
+Reference analogue: none — the reference assumed healthy local CUDA; a
+tunnelled accelerator needs an explicit, tested health seam.
+"""
+
+import os
+import signal
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rafiki_tpu.utils import backend_probe
+from rafiki_tpu.utils.backend_probe import (
+    cpu_env,
+    defer_term_signals,
+    probe_device_count,
+)
+
+
+def test_cpu_env_never_touches_tunnel():
+    base = {
+        "PALLAS_AXON_POOL_IPS": "10.0.0.1",
+        "JAX_PLATFORMS": "axon",
+        "XLA_FLAGS": "--xla_foo=1 --xla_force_host_platform_device_count=2",
+        "PATH": "/usr/bin",
+    }
+    env = cpu_env(n_devices=8, base=base)
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "--xla_force_host_platform_device_count=2" not in env["XLA_FLAGS"]
+    assert "--xla_foo=1" in env["XLA_FLAGS"]  # unrelated flags preserved
+    assert env["PATH"] == "/usr/bin"
+    assert base["JAX_PLATFORMS"] == "axon"  # input not mutated
+
+
+def test_probe_healthy_backend():
+    # the test env is a virtual 8-device CPU mesh (conftest.py)
+    n, err = probe_device_count(timeout_s=120)
+    assert err is None
+    assert n >= 1
+
+
+def test_probe_dead_backend(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "nosuchplatform")
+    n, err = probe_device_count(timeout_s=120)
+    assert n == 0
+    assert err and "rc=" in err
+
+
+def test_probe_timeout_abandons_child():
+    # a timeout must return promptly and must NOT signal the child (a
+    # signal during backend init is the tunnel-wedge trigger)
+    n, err = probe_device_count(timeout_s=0.05)
+    assert n == 0
+    assert err and "abandoned" in err
+
+
+def test_defer_term_signals_holds_and_redelivers():
+    got = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+    try:
+        with defer_term_signals():
+            os.kill(os.getpid(), signal.SIGTERM)
+            # inside the critical section: held, not delivered to ours
+            assert got == []
+        # on exit: restored handler receives the deferred signal
+        assert got == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_defer_term_signals_noop_off_main_thread():
+    ran = []
+
+    def body():
+        with defer_term_signals():
+            ran.append(True)
+
+    t = threading.Thread(target=body)
+    t.start()
+    t.join(5)
+    assert ran == [True]
+
+
+def test_dryrun_decision_falls_back_to_cpu(monkeypatch):
+    """With the backend dead, dryrun_multichip must route to a child env
+    that cannot touch the tunnel — without the parent importing jax."""
+    import __graft_entry__ as ge
+
+    monkeypatch.setenv("JAX_PLATFORMS", "nosuchplatform")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    calls = []
+
+    def fake_child(n, env, timeout_s):
+        calls.append((n, env))
+        return 0, "dryrun_multichip OK (stub)\n", ""
+
+    monkeypatch.setattr(ge, "_run_dryrun_child", fake_child)
+    ge.dryrun_multichip(8)
+    (n, env), = calls
+    assert n == 8
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "PALLAS_AXON_POOL_IPS" not in env
+
+
+def test_dryrun_live_failure_falls_back_to_cpu(monkeypatch):
+    """A live-backend child that dies mid-run must trigger the CPU-mesh
+    retry, not a hard failure."""
+    import __graft_entry__ as ge
+
+    monkeypatch.setattr(
+        backend_probe, "probe_device_count", lambda timeout_s=None: (8, None))
+    envs = []
+
+    def fake_child(n, env, timeout_s):
+        envs.append(env)
+        if len(envs) == 1:  # live attempt dies (e.g. tunnel dropped)
+            return 1, "", "UNAVAILABLE: tunnel dropped"
+        return 0, "dryrun_multichip OK (stub)\n", ""
+
+    monkeypatch.setattr(ge, "_run_dryrun_child", fake_child)
+    ge.dryrun_multichip(8)
+    assert len(envs) == 2
+    assert envs[1]["JAX_PLATFORMS"] == "cpu"
+
+
+def test_bench_run_cpu_fallback(monkeypatch):
+    """bench.run() with a dead backend must re-exec itself on CPU with the
+    failure reason labelled — never crash or hang."""
+    import bench
+
+    monkeypatch.delenv("RAFIKI_BENCH_FALLBACK_REASON", raising=False)
+    monkeypatch.setattr(
+        backend_probe, "probe_device_count",
+        lambda timeout_s=None: (0, "probe: tunnel wedged"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    captured = {}
+
+    def fake_run(argv, env=None, cwd=None):
+        captured["argv"] = argv
+        captured["env"] = env
+
+        class P:
+            returncode = 0
+
+        return P()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.run() == 0
+    env = captured["env"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["RAFIKI_BENCH_FALLBACK_REASON"] == "probe: tunnel wedged"
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert captured["argv"][1].endswith("bench.py")
+
+
+def test_bench_structured_error_record(monkeypatch, capsys):
+    """Any crash inside main() must end in one parseable JSON line, not a
+    bare traceback (round-3: BENCH_r03.json parsed:null)."""
+    import json
+
+    import bench
+
+    monkeypatch.setenv("RAFIKI_BENCH_FALLBACK_REASON", "already fallback")
+    monkeypatch.setattr(
+        bench, "main", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    rc = bench.run()
+    assert rc == 1
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert "RuntimeError" in rec["error"]
+    assert rec["value"] is None
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_end_to_end_with_dead_backend(monkeypatch):
+    """The full driver contract: with JAX_PLATFORMS pointed at a dead
+    backend, dryrun_multichip(8) completes via the virtual CPU mesh."""
+    import __graft_entry__ as ge
+
+    monkeypatch.setenv("JAX_PLATFORMS", "nosuchplatform")
+    ge.dryrun_multichip(8)  # raises on failure
