@@ -33,57 +33,24 @@ def _make_stage_apply(params_local: Any, block_fn):
     return apply_stage
 
 
-def _stage_local(params_local: Any, x_mbs: jax.Array, *, block_fn,
-                 axis_name: str, n_microbatches: int) -> jax.Array:
-    """Per-stage body, replicated-input fallback (inside shard_map).
-
-    params_local: this stage's layer stack (L_local, ...).
-    x_mbs: (M, mb, ...) full input microbatches (replicated; only stage 0
-    reads them). Costs O(B) input HBM per stage — the streamed body below
-    is preferred whenever M divides by the stage count.
-    """
-    n = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
-    m = n_microbatches
-
-    apply_stage = _make_stage_apply(params_local, block_fn)
-    fwd_perm = [(r, (r + 1) % n) for r in range(n)]
-    mb_shape = x_mbs.shape[1:]
-
-    def tick(carry, t):
-        buf = carry  # activation arriving from the previous stage
-        feed = x_mbs[jnp.minimum(t, m - 1)]
-        inp = jnp.where(my == 0, feed, buf)
-        out = apply_stage(inp)
-        nxt = jax.lax.ppermute(out, axis_name, fwd_perm)
-        return nxt, out
-
-    t_total = m + n - 1
-    _, outs = jax.lax.scan(tick, jnp.zeros(mb_shape, x_mbs.dtype),
-                           jnp.arange(t_total))
-    # the last stage emitted microbatch j at tick j + (n-1)
-    y = outs[n - 1:]                      # (M, mb, ...)
-    y = jnp.where(my == n - 1, y, 0.0)
-    # broadcast the final activations to every stage
-    return jax.lax.psum(y, axis_name)
-
-
 def _stage_local_streamed(params_local: Any, x_local: jax.Array, *, block_fn,
                           axis_name: str, n_microbatches: int) -> jax.Array:
     """Per-stage body with the input microbatches SHARDED over stages.
 
-    x_local: (M/n, mb, ...) — stage s starts holding microbatches
-    [s*M/n, (s+1)*M/n). The shards form one distributed queue in
-    stage-major order; every tick it rotates one slot toward stage 0
-    (a backward ``ppermute`` of each stage's head), so stage 0's local
-    head is always the next microbatch to feed. Input HBM per stage is
-    O(B/n) instead of the fallback's O(B) — activation memory now scales
-    with pipeline depth like the weights do.
+    x_local: (M'/n, mb, ...) — stage s starts holding queue slots
+    [s*M'/n, (s+1)*M'/n), where M' is the microbatch count padded up to a
+    multiple of the stage count (gpipe_apply pads; ``n_microbatches`` is
+    the REAL count M and alone drives the tick schedule). The shards form
+    one distributed queue in stage-major order; every tick it rotates one
+    slot toward stage 0 (a backward ``ppermute`` of each stage's head), so
+    stage 0's local head is always the next microbatch to feed. Input HBM
+    per stage is O(B/n) instead of a replicated feed's O(B) — activation
+    memory scales with pipeline depth like the weights do.
 
-    Ticks past M feed wrapped (stale) queue entries into stage 0; their
-    outputs can never reach the last stage before the schedule ends, so
-    they are never observed (same argument as the fallback's clamped
-    feed).
+    The real microbatches occupy the first M queue slots, so ticks
+    0..M-1 feed them in order; ticks past M feed padded/wrapped (dead)
+    entries into stage 0, whose outputs can never reach the last stage
+    before the M + n - 1 tick schedule ends, so they are never observed.
     """
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -129,23 +96,27 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
     assert b % n_microbatches == 0, "batch must divide into microbatches"
     x_mbs = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
 
-    # keep the microbatch dim data-sharded only when it divides; otherwise
-    # fall back to replicated input (correct, just more ICI traffic)
+    # pad the queue (NOT the schedule) up to a multiple of the stage count
+    # so the input microbatches always shard over stages — the padded
+    # entries sit behind the real ones and are only ever fed on dead
+    # ticks, so no extra compute reaches the output (see
+    # _stage_local_streamed). This keeps input HBM at O(B/n) per stage
+    # for every M, where a replicated-input fallback would cost O(B).
+    pad = (-n_microbatches) % n_stages
+    if pad:
+        x_mbs = jnp.concatenate(
+            [x_mbs, jnp.zeros((pad, *x_mbs.shape[1:]), x_mbs.dtype)], axis=0)
+
+    # keep the microbatch dim data-sharded only when it divides
     dp = data_axis if data_axis in mesh.axis_names else None
     if dp is not None and (b // n_microbatches) % mesh.shape[dp] != 0:
         dp = None
-    if n_microbatches % n_stages == 0:
-        # preferred: input microbatches sharded over stages and streamed
-        # toward stage 0 tick by tick — O(B/n) input HBM per stage
-        body, x_in_spec = _stage_local_streamed, P(pipe_axis, dp)
-    else:
-        body, x_in_spec = _stage_local, P(None, dp)
     param_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     fn = jax.shard_map(
-        partial(body, block_fn=block_fn, axis_name=pipe_axis,
-                n_microbatches=n_microbatches),
+        partial(_stage_local_streamed, block_fn=block_fn,
+                axis_name=pipe_axis, n_microbatches=n_microbatches),
         mesh=mesh,
-        in_specs=(param_specs, x_in_spec),
+        in_specs=(param_specs, P(pipe_axis, dp)),
         out_specs=P(None, dp),
         check_vma=False,
     )
