@@ -124,18 +124,22 @@ class JaxCnnPopulation(BaseModel):
         # memoized on the (cached) trainer so successive trials pass the
         # SAME split arrays — that identity is what fit()'s cross-trial
         # device cache keys on.
-        split_key = (id(x), id(y))
         cached_split = getattr(self._trainer, "_split_cache", None)
-        if cached_split is not None and cached_split[0] == split_key:
-            x_tr, y_tr, x_val, y_val = cached_split[1]
+        if (cached_split is not None
+                and cached_split[0] is x and cached_split[1] is y):
+            x_tr, y_tr, x_val, y_val = cached_split[2]
         else:
             perm = np.random.default_rng(0).permutation(len(x))
             xs, ys = x[perm], y[perm]
             n_val = max(len(xs) // 8, 1)
             x_tr, y_tr = xs[:-n_val], ys[:-n_val]
             x_val, y_val = xs[-n_val:], ys[-n_val:]
+            # the keyed arrays are stored IN the entry: identity compare is
+            # then safe against CPython id reuse after the dataset-cache
+            # LRU evicts (a bare (id(x), id(y)) key could alias a new
+            # dataset's arrays and silently reuse the old split)
             self._trainer._split_cache = (
-                split_key, (x_tr, y_tr, x_val, y_val))
+                x, y, (x_tr, y_tr, x_val, y_val))
         params, opt = self._trainer.init(
             self._make_init(x.shape[-1], num_classes),
             {"learning_rate": lrs})

@@ -39,13 +39,49 @@ _APP_DEVS = [UserType.APP_DEVELOPER] + _ADMINS
 Route = Tuple[str, re.Pattern, Optional[List[str]], Callable]
 
 
+def _field(body: Dict[str, Any], name: str) -> Any:
+    """A required body field. Raised as InvalidRequestError (→ 400) at the
+    route boundary so the dispatch loop never has to catch KeyError — a
+    KeyError from inside Admin is then a genuine 500, not a masked 400."""
+    try:
+        return body[name]
+    except (KeyError, TypeError):
+        raise InvalidRequestError(f"missing body field '{name}'")
+
+
+def _num_field(body: Dict[str, Any], name: str, cast, default=None):
+    """A numeric body field coerced with ``cast`` (int/float); malformed
+    values are client errors. ``default=None`` makes the field required."""
+    if name not in body:
+        if default is None:
+            raise InvalidRequestError(f"missing body field '{name}'")
+        return default
+    try:
+        return cast(body[name])
+    except (ValueError, TypeError) as e:
+        raise InvalidRequestError(
+            f"field '{name}' must be {cast.__name__}: {e}")
+
+
 def _b64_field(body: Dict[str, Any], name: str) -> bytes:
     """Decode a base64 body field; malformed input is a client error, not a
     server bug — keep broad except clauses out of the dispatch loop."""
     try:
-        return base64.b64decode(body[name])
+        return base64.b64decode(_field(body, name))
     except (ValueError, TypeError) as e:
         raise InvalidRequestError(f"field '{name}' is not valid base64: {e}")
+
+
+def _knob_config_field(body: Dict[str, Any]):
+    """Deserialize a client-supplied knob_config; any malformed shape or
+    unknown knob type is a client error, validated here at the route
+    boundary."""
+    from rafiki_tpu.sdk.knob import deserialize_knob_config
+
+    try:
+        return deserialize_knob_config(_field(body, "knob_config"))
+    except (ValueError, TypeError, KeyError, AttributeError) as e:
+        raise InvalidRequestError(f"invalid knob_config: {e}")
 
 
 def _int_param(query: Dict[str, str], name: str, default: int) -> int:
@@ -122,17 +158,17 @@ class AdminServer:
             r("GET", "/", "public", lambda au, m, b, q: {
                 "name": "rafiki_tpu admin", "status": "ok"}),
             r("POST", "/tokens", "public", lambda au, m, b, q: A.authenticate_user(
-                b["email"], b["password"])),
+                _field(b, "email"), _field(b, "password"))),
             # users
             r("POST", "/users", _ADMINS, lambda au, m, b, q: A.create_user(
-                b["email"], b["password"], b["user_type"])),
+                _field(b, "email"), _field(b, "password"), _field(b, "user_type"))),
             r("GET", "/users", _ADMINS, lambda au, m, b, q: A.get_users()),
             r("DELETE", "/users", _ADMINS, lambda au, m, b, q: A.ban_user(
-                b["email"])),
+                _field(b, "email"))),
             # models
             r("POST", "/models", _MODEL_DEVS, lambda au, m, b, q: A.create_model(
-                au["user_id"], b["name"], b["task"],
-                _b64_field(b, "model_file_base64"), b["model_class"],
+                au["user_id"], _field(b, "name"), _field(b, "task"),
+                _b64_field(b, "model_file_base64"), _field(b, "model_class"),
                 b.get("dependencies"), b.get("access_right", "PRIVATE"))),
             r("GET", "/models", _ANY, lambda au, m, b, q: A.get_models(
                 au["user_id"], q.get("task"))),
@@ -146,8 +182,8 @@ class AdminServer:
             # train jobs
             r("POST", "/train_jobs", _APP_DEVS, lambda au, m, b, q:
                 A.create_train_job(
-                    au["user_id"], b["app"], b["task"], b["train_dataset_uri"],
-                    b["test_dataset_uri"], b.get("budget"), b.get("models"))),
+                    au["user_id"], _field(b, "app"), _field(b, "task"), _field(b, "train_dataset_uri"),
+                    _field(b, "test_dataset_uri"), b.get("budget"), b.get("models"))),
             r("GET", "/train_jobs", _ANY, lambda au, m, b, q:
                 A.get_train_jobs_of_user(au["user_id"])),
             r("GET", r"/train_jobs/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:
@@ -178,7 +214,7 @@ class AdminServer:
             # inference jobs
             r("POST", "/inference_jobs", _APP_DEVS, lambda au, m, b, q:
                 A.create_inference_job(
-                    au["user_id"], b["app"], b.get("app_version", -1))),
+                    au["user_id"], _field(b, "app"), b.get("app_version", -1))),
             r("GET", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)", _ANY,
                 lambda au, m, b, q: A.get_inference_job(
                     au["user_id"], m["app"], int(m["v"]))),
@@ -192,30 +228,30 @@ class AdminServer:
             # reference predictor/app.py:23-31)
             r("POST", r"/predict/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:
                 {"predictions": A.predict(
-                    au["user_id"], m["app"], b["queries"],
+                    au["user_id"], m["app"], _field(b, "queries"),
                     b.get("app_version", -1))}),
             # advisor sessions (reference advisor/app.py:17-50)
             r("POST", "/advisors", _ANY, lambda au, m, b, q: {
                 "advisor_id": A.advisor_store.create_advisor(
-                    __import__("rafiki_tpu.sdk.knob", fromlist=["x"])
-                    .deserialize_knob_config(b["knob_config"]),
+                    _knob_config_field(b),
                     advisor_id=b.get("advisor_id"))}),
             r("POST", r"/advisors/(?P<aid>[^/]+)/propose", _ANY,
                 lambda au, m, b, q: {"knobs": A.advisor_store.propose(m["aid"])}),
             r("POST", r"/advisors/(?P<aid>[^/]+)/feedback", _ANY,
                 lambda au, m, b, q: {"knobs": A.advisor_store.feedback(
-                    m["aid"], b["knobs"], b["score"])}),
+                    m["aid"], _field(b, "knobs"), _field(b, "score"))}),
             r("POST", r"/advisors/(?P<aid>[^/]+)/replay", _ANY,
                 lambda au, m, b, q: {"replayed": A.advisor_store.replay_feedback(
                     m["aid"],
-                    [(i["knobs"], i["score"]) for i in b["items"]])}),
+                    [(_field(i, "knobs"), _field(i, "score"))
+                     for i in _field(b, "items")])}),
             # ASHA rung report (early stopping; advisor/asha.py)
             r("POST", r"/advisors/(?P<aid>[^/]+)/report_rung", _ANY,
                 lambda au, m, b, q: {"keep": A.advisor_store.report_rung(
-                    m["aid"], b["trial_id"], int(b["resource"]),
-                    float(b["value"]),
-                    min_resource=int(b.get("min_resource", 1)),
-                    eta=int(b.get("eta", 3)),
+                    m["aid"], _field(b, "trial_id"), _num_field(b, "resource", int),
+                    _num_field(b, "value", float),
+                    min_resource=_num_field(b, "min_resource", int, 1),
+                    eta=_num_field(b, "eta", int, 3),
                     mode=b.get("mode", "min"))}),
             r("DELETE", r"/advisors/(?P<aid>[^/]+)", _ANY, lambda au, m, b, q:
                 A.advisor_store.delete_advisor(m["aid"]) or {}),
@@ -290,13 +326,11 @@ class AdminServer:
             self._respond(handler, 404, {"error": f"No route {method} {path}"})
         except UnauthorizedError as e:
             self._respond(handler, 401, {"error": str(e)})
-        except (
-            InvalidRequestError,
-            InvalidModelClassError,
-            KeyError,    # missing body field
-            ValueError,  # malformed body field (bad int/float/enum value)
-            TypeError,   # wrong body field type
-        ) as e:
+        except (InvalidRequestError, InvalidModelClassError) as e:
+            # field presence/coercion is validated at the route boundary
+            # (_field/_num_field/_b64_field/_int_param), so ValueError &
+            # friends from inside Admin stay genuine 500s instead of being
+            # masked as client errors with internal text echoed back
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
         except InsufficientChipsError as e:
             self._respond(handler, 503, {"error": f"{type(e).__name__}: {e}"})
