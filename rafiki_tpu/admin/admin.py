@@ -93,6 +93,11 @@ class Admin:
             self.broker = ShmBroker()
         else:
             self.broker = make_broker()
+        # FleetBroker adds remote (agent-relayed) serving queues on top of
+        # whatever local data plane was chosen; pass-through otherwise
+        from rafiki_tpu.cache.fleet import FleetBroker
+
+        self.broker = FleetBroker(self.broker)
         if placement is not None:
             self.placement = placement
         elif process_mode:
@@ -104,9 +109,10 @@ class Admin:
                 on_status=self._on_service_status,
             )
             if placement_mode == "hosts":
-                # multi-host: train goes to per-host agents
-                # (RAFIKI_AGENTS=host:port,host:port), serving stays on
-                # this host's engine next to the shm data plane
+                # multi-host: train AND inference go to per-host agents
+                # (RAFIKI_AGENTS=host:port,host:port); remote inference
+                # workers are reached through the FleetBroker's agent
+                # relay, with this host's engine as the serving fallback
                 from rafiki_tpu.placement.hosts import HostAgentPlacementManager
 
                 agents = [a.strip() for a in
@@ -127,6 +133,10 @@ class Admin:
             )
         if self.placement.on_status is None:
             self.placement.on_status = self._on_service_status
+        if hasattr(self.placement, "set_broker"):
+            # multi-host placement registers remote serving queues with the
+            # FleetBroker when it places inference workers on agents
+            self.placement.set_broker(self.broker)
         self.services = ServicesManager(
             self.db,
             self.placement,
@@ -200,12 +210,19 @@ class Admin:
         access_right: str = ModelAccessRight.PRIVATE,
     ) -> Dict:
         # validate at upload, not at trial time: class loads, subclasses
-        # BaseModel, declares a sane knob config, deps importable
+        # BaseModel, declares a sane knob config, deps importable. With
+        # RAFIKI_INSTALL_DEPS=1 missing deps are accepted here — workers
+        # provision them per dependency-set at first use (sdk/deps.py,
+        # the reference's install synthesis re-homed,
+        # reference model/model.py:244-273)
+        from rafiki_tpu.sdk.deps import install_enabled
+
         clazz = load_model_class(model_file_bytes, model_class)
         missing = validate_model_dependencies(clazz)
-        if missing:
+        if missing and not install_enabled():
             raise InvalidModelClassError(
-                f"Dependencies not available in this environment: {missing}"
+                f"Dependencies not available in this environment: {missing} "
+                f"(set RAFIKI_INSTALL_DEPS=1 to let workers provision them)"
             )
         serialize_knob_config(clazz.get_knob_config())
         if self.db.get_model_by_name(user_id, name) is not None:
@@ -576,8 +593,10 @@ class Admin:
         for w in inf["workers"]:
             # in-process workers land in the local module counters;
             # process-mode workers report over the event channel
-            s = local.get(w["service_id"]) or self._remote_serving_stats.get(
-                w["service_id"]) or {"batches": 0, "queries": 0}
+            with self._predict_route_lock:
+                remote = self._remote_serving_stats.get(w["service_id"])
+            s = local.get(w["service_id"]) or remote or {
+                "batches": 0, "queries": 0}
             total_b += s["batches"]
             total_q += s["queries"]
             workers.append({**w, **s})
@@ -601,11 +620,22 @@ class Admin:
             raise InvalidRequestError("No inference job for this train job")
         inf = infs[0]
         workers = self.db.get_workers_of_inference_job(inf["id"])
+        # dedicated serving endpoint, when config.PREDICTOR_PORTS bound one
+        # (reference parity: the job info carried the predictor's published
+        # host port, reference admin/services_manager.py:379-384)
+        predictor_host = predictor_port = None
+        if inf.get("predictor_service_id"):
+            psvc = self.db.get_service(inf["predictor_service_id"])
+            if psvc:
+                predictor_host = psvc.get("host")
+                predictor_port = psvc.get("port")
         return {
             "id": inf["id"],
             "train_job_id": job["id"],
             "app": app,
             "app_version": job["app_version"],
+            "predictor_host": predictor_host,
+            "predictor_port": predictor_port,
             "status": inf["status"],
             "datetime_started": inf["datetime_started"],
             "datetime_stopped": inf["datetime_stopped"],
@@ -641,8 +671,10 @@ class Admin:
             for key, (_, predictor) in list(self._predict_route_cache.items()):
                 if predictor._job_id == inference_job_id:
                     self._predict_route_cache.pop(key, None)
-        for w in self.db.get_workers_of_inference_job(inference_job_id):
-            self._remote_serving_stats.pop(w["service_id"], None)
+        workers = self.db.get_workers_of_inference_job(inference_job_id)
+        with self._predict_route_lock:
+            for w in workers:
+                self._remote_serving_stats.pop(w["service_id"], None)
 
     def predict(
         self, user_id: str, app: str, queries: List[Any], app_version: int = -1
@@ -729,14 +761,18 @@ class Admin:
                 # (process placement) — in-process workers update the local
                 # SERVING_STATS module dict directly
                 sid = payload["service_id"]
-                self._remote_serving_stats[sid] = {
-                    "batches": int(payload.get("batches", 0)),
-                    "queries": int(payload.get("queries", 0)),
-                }
-                self._remote_serving_stats.move_to_end(sid)
-                while (len(self._remote_serving_stats)
-                       > self._remote_serving_stats_cap):
-                    self._remote_serving_stats.popitem(last=False)
+                # compound insert+move+evict must be atomic vs the API
+                # threads reading/pruning this dict (GIL atomicity only
+                # covers single C-level dict ops)
+                with self._predict_route_lock:
+                    self._remote_serving_stats[sid] = {
+                        "batches": int(payload.get("batches", 0)),
+                        "queries": int(payload.get("queries", 0)),
+                    }
+                    self._remote_serving_stats.move_to_end(sid)
+                    while (len(self._remote_serving_stats)
+                           > self._remote_serving_stats_cap):
+                        self._remote_serving_stats.popitem(last=False)
         except Exception:
             logger.exception("event %s failed", name)
 

@@ -15,6 +15,7 @@ HostAgentPlacementManager (placement/hosts.py) drives:
     POST /services             {service_id, service_type, n_chips,
                                 best_effort_chips, extra} -> {chips}
     POST /services/<id>/stop   {wait} -> {}
+    POST /predict_relay/<job>/<worker>   {queries} -> {predictions}
 
 Config via env:
 
@@ -31,9 +32,15 @@ Config via env:
     RAFIKI_ADMIN_ADDR                       host:port of the AdminServer for
                                             HPO coordination + status events
 
-Serving executors are NOT placed through agents: the serving data plane
-(shm queues) must be co-located with the predictor process, so inference
-stays on the admin host's local engine (see HostAgentPlacementManager).
+Serving across hosts (the reference placed inference workers on any swarm
+node, reference rafiki/admin/services_manager.py:204-239): agents place
+INFERENCE executors too. The shm data plane stays host-local — the agent
+process owns the segments its inference workers attach to — and the
+admin-side predictor reaches them through this server's
+``/predict_relay`` route, which submits a whole relayed batch to the
+worker's local queue and answers when the worker resolves it
+(cache/fleet.py holds the admin-side half). PREDICT itself never leaves
+the admin process.
 """
 
 from __future__ import annotations
@@ -56,6 +63,8 @@ from rafiki_tpu.placement.process import ProcessPlacementManager
 logger = logging.getLogger(__name__)
 
 _SERVICE_STOP = re.compile(r"^/services/(?P<sid>[^/]+)/stop$")
+_PREDICT_RELAY = re.compile(
+    r"^/predict_relay/(?P<job>[^/]+)/(?P<wid>[^/]+)$")
 
 
 class AgentServer:
@@ -124,11 +133,17 @@ class AgentServer:
                     "n_services": len(self.engine._runners),
                 })
             if method == "POST" and path == "/services":
-                if body.get("service_type") != ServiceType.TRAIN:
+                stype = body.get("service_type")
+                if stype not in (ServiceType.TRAIN, ServiceType.INFERENCE):
                     return self._respond(handler, 400, {
-                        "error": "agents place TRAIN services only (the "
-                                 "serving data plane lives with the "
-                                 "predictor on the admin host)"})
+                        "error": f"agents place TRAIN/INFERENCE services, "
+                                 f"not {stype!r} (PREDICT runs in the "
+                                 f"admin process)"})
+                if (stype == ServiceType.INFERENCE
+                        and self.engine.broker is None):
+                    return self._respond(handler, 503, {
+                        "error": "this agent has no serving data plane "
+                                 "(native shm broker unavailable)"})
                 try:
                     ctx = self.engine.create_service(
                         body["service_id"], body["service_type"],
@@ -144,10 +159,56 @@ class AgentServer:
                 self.engine.destroy_service(
                     m.group("sid"), wait=bool(body.get("wait", False)))
                 return self._respond(handler, 200, {})
+            m = _PREDICT_RELAY.match(path) if method == "POST" else None
+            if m:
+                return self._predict_relay(
+                    handler, m.group("job"), m.group("wid"), body)
             self._respond(handler, 404, {"error": f"no route {method} {path}"})
         except Exception as e:
             logger.exception("agent request failed")
             self._respond(handler, 500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _predict_relay(self, handler, job_id: str, worker_id: str,
+                       body: Dict[str, Any]) -> None:
+        """Data-plane hop for a remote predictor (cache/fleet.py): submit
+        the relayed batch to the named worker's host-local queue and
+        answer when the worker resolves it. All-or-nothing per call — a
+        worker error fails the whole relay request and the predictor's
+        hedged failover (predictor/predictor.py) takes it from there."""
+        import time as _time
+
+        from rafiki_tpu import config as _config
+
+        if self.engine.broker is None:
+            return self._respond(handler, 503, {
+                "error": "no serving data plane on this agent"})
+        queries = body.get("queries")
+        if not isinstance(queries, list) or not queries:
+            return self._respond(handler, 400, {
+                "error": "body must carry a non-empty 'queries' list"})
+        queue = self.engine.broker.get_worker_queues(job_id).get(worker_id)
+        if queue is None:
+            return self._respond(handler, 404, {
+                "error": f"no worker {worker_id} for job {job_id} "
+                         f"on this host"})
+        timeout_s = min(
+            float(body.get("timeout_s") or _config.PREDICT_TIMEOUT_S),
+            300.0)
+        futures = [queue.submit(q) for q in queries]
+        deadline = _time.monotonic() + timeout_s
+        try:
+            preds = [
+                f.result(max(deadline - _time.monotonic(), 0.0))
+                for f in futures
+            ]
+        except TimeoutError:
+            return self._respond(handler, 504, {
+                "error": f"worker {worker_id} missed the "
+                         f"{timeout_s:.0f}s relay deadline"})
+        except Exception as e:
+            return self._respond(handler, 502, {
+                "error": f"worker {worker_id}: {type(e).__name__}: {e}"})
+        self._respond(handler, 200, {"predictions": preds})
 
     @staticmethod
     def _respond(handler, code: int, payload: Dict[str, Any]) -> None:
@@ -217,10 +278,23 @@ def main() -> int:
     if admin_addr:
         host, _, port = admin_addr.rpartition(":")
         addr_tuple = (host, int(port))
+    # host-local serving data plane: this agent process owns the shm
+    # segments; its inference worker processes attach; remote predictors
+    # reach them via /predict_relay. Best-effort — a host without the
+    # native library still trains, it just can't serve.
+    broker = None
+    try:
+        from rafiki_tpu.cache.shm_broker import ShmBroker
+
+        broker = ShmBroker()
+    except Exception as e:
+        logger.warning("no serving data plane on this host (%s); "
+                       "agent will place TRAIN services only", e)
     engine = ProcessPlacementManager(
         db=db,
         admin_addr=addr_tuple,
         allocator=ChipAllocator(chips),
+        broker=broker,
         on_status=_admin_status_forwarder(db, admin_addr),
     )
     server = AgentServer(

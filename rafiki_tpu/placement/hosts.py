@@ -12,10 +12,14 @@ Division of labor:
 
 - TRAIN services  -> remote agents (pure processes; coordination runs over
   the shared store + admin REST, so host boundaries don't matter);
-- INFERENCE/PREDICT -> the ``local`` engine on the admin host, because the
-  serving data plane (cache/shm_broker.py) is shared memory co-located
-  with the predictor. Scaling serving across hosts means scaling admin
-  replicas, not scattering shm segments.
+- INFERENCE -> remote agents too (reference: inference workers on any
+  swarm node, services_manager.py:204-239). Each agent owns its host's
+  shm data plane; the admin-side predictor reaches remote workers through
+  the agent's ``/predict_relay`` via ``FleetBroker.register_remote_worker``
+  (cache/fleet.py) — wire the broker in with :meth:`set_broker`. Falls
+  back to the ``local`` engine when no agent can serve (no chips free
+  fleet-wide, or no FleetBroker wired);
+- PREDICT -> always the admin process (the predictor object lives there).
 
 Status flow: worker processes write their own service rows to the shared
 store (worker/bootstrap.py); each agent backstops crashes and forwards
@@ -25,15 +29,17 @@ refresh still fires (admin._on_service_status).
 
 from __future__ import annotations
 
-import json
 import logging
 import threading
 import time
-import urllib.error
-import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
 from rafiki_tpu.constants import ServiceType
+from rafiki_tpu.utils.agent_http import (
+    AgentHTTPError,
+    AgentTransportError,
+    call_agent,
+)
 from rafiki_tpu.placement.manager import (
     InsufficientChipsError,
     PlacementManager,
@@ -49,7 +55,7 @@ class AgentUnreachableError(Exception):
 
 
 class _AgentHandle:
-    """Client for one host agent."""
+    """Client for one host agent (wire protocol: utils/agent_http.py)."""
 
     def __init__(self, addr: str, key: Optional[str] = None,
                  timeout_s: float = 10.0):
@@ -59,27 +65,15 @@ class _AgentHandle:
 
     def _call(self, method: str, path: str,
               body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        url = f"http://{self.addr}{path}"
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
-        if self.key:
-            req.add_header("X-Rafiki-Agent-Key", self.key)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            payload = {}
-            try:
-                payload = json.loads(e.read() or b"{}")
-            except (ValueError, TypeError):
-                pass
-            msg = payload.get("error", str(e))
+            return call_agent(self.addr, method, path, body=body,
+                              key=self.key, timeout_s=self.timeout_s)
+        except AgentHTTPError as e:
             if e.code == 503:
-                raise InsufficientChipsError(msg)
-            raise AgentUnreachableError(f"{self.addr}: {msg}")
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise AgentUnreachableError(f"{self.addr}: {e}")
+                raise InsufficientChipsError(e.message)
+            raise AgentUnreachableError(f"{self.addr}: {e.message}")
+        except AgentTransportError as e:
+            raise AgentUnreachableError(str(e))
 
     def inventory(self) -> Dict[str, Any]:
         return self._call("GET", "/inventory")
@@ -153,6 +147,7 @@ class HostAgentPlacementManager(PlacementManager):
         # (that path, placement/agent.py _admin_status_forwarder, remains as
         # a faster best-effort signal).
         self.db = db
+        self.broker = None  # FleetBroker; see set_broker
         self.allocator = _FleetInventory(self)
         self._inventory_ttl_s = inventory_ttl_s
         self._monitor_interval_s = monitor_interval_s
@@ -160,6 +155,8 @@ class HostAgentPlacementManager(PlacementManager):
         self._inventory_at = 0.0
         self._lock = threading.Lock()
         self._placed: Dict[str, str] = {}  # service_id -> agent addr
+        # service_id -> inference_job_id, for relay-queue teardown
+        self._placed_jobs: Dict[str, str] = {}
         self._reported: set = set()
         self._monitor: Optional[threading.Thread] = None
         self._closed = threading.Event()
@@ -197,6 +194,12 @@ class HostAgentPlacementManager(PlacementManager):
 
     # -- PlacementManager --------------------------------------------------
 
+    def set_broker(self, broker) -> None:
+        """Wire in the admin's FleetBroker so remotely-placed inference
+        workers get an admin-side relay queue (cache/fleet.py). Without
+        it, inference falls back to the local engine."""
+        self.broker = broker
+
     def create_service(
         self,
         service_id: str,
@@ -206,16 +209,54 @@ class HostAgentPlacementManager(PlacementManager):
         extra: Optional[Dict[str, Any]] = None,
         best_effort_chips: bool = False,
     ) -> ServiceContext:
+        can_relay = (self.broker is not None
+                     and hasattr(self.broker, "register_remote_worker"))
+        if service_type == ServiceType.INFERENCE and can_relay:
+            # Only PROVABLY-unplaced failures may fall back to the local
+            # engine: InsufficientChipsError is pre-commit, and
+            # _create_on_agent returns None only when no agent was
+            # contacted or an ambiguous create was successfully undone.
+            # An ambiguous create whose undo also failed PROPAGATES —
+            # falling back would double-place the service (a remote copy
+            # may be serving) and leak its chips forever.
+            try:
+                ctx = self._create_on_agent(
+                    service_id, service_type, n_chips, best_effort_chips,
+                    extra)
+            except InsufficientChipsError as e:
+                logger.info("no agent can serve %s (%s); trying the local "
+                            "engine", service_id[:8], e)
+                ctx = None
+            if ctx is not None:
+                return ctx
+            # no agent can take it — fall through to the local engine
         if service_type != ServiceType.TRAIN:
             if self.local is None:
                 raise RuntimeError(
-                    "HostAgentPlacementManager needs a `local` engine for "
-                    "serving executors (the shm data plane is co-located "
-                    "with the predictor)")
+                    "HostAgentPlacementManager has no engine for "
+                    f"{service_type} executors: no agent can take it and "
+                    "no `local` engine is configured")
             return self.local.create_service(
                 service_id, service_type, run_fn, n_chips=n_chips,
                 extra=extra, best_effort_chips=best_effort_chips)
 
+        ctx = self._create_on_agent(
+            service_id, service_type, n_chips, best_effort_chips, extra)
+        if ctx is None:
+            raise AgentUnreachableError("no reachable agents")
+        return ctx
+
+    def _create_on_agent(
+        self,
+        service_id: str,
+        service_type: str,
+        n_chips: int,
+        best_effort_chips: bool,
+        extra: Optional[Dict[str, Any]],
+    ) -> Optional[ServiceContext]:
+        """Least-loaded agent placement. Returns None when no agent can
+        take the service (callers decide: TRAIN raises, INFERENCE falls
+        back to the local engine)."""
         addr = self._choose_agent(n_chips)
         if addr is None:
             if not best_effort_chips and n_chips > 0:
@@ -224,13 +265,37 @@ class HostAgentPlacementManager(PlacementManager):
                     f"(fleet: {[i for _, i in self._inventories()]})")
             addr = self._choose_agent(0)
             if addr is None:
-                raise AgentUnreachableError("no reachable agents")
+                return None  # nothing was contacted; caller decides
             n_chips = 0
-        chips = self.agents[addr].create_service(
-            service_id, service_type, n_chips, best_effort_chips,
-            dict(extra or {}))
+        try:
+            chips = self.agents[addr].create_service(
+                service_id, service_type, n_chips, best_effort_chips,
+                dict(extra or {}))
+        except AgentUnreachableError:
+            # AMBIGUOUS: the agent may have committed the worker before
+            # the wire failed. Try to undo; only a confirmed undo makes a
+            # retry/fallback safe (the remote copy would otherwise keep
+            # serving and hold its chips with no admin-side record).
+            try:
+                self.agents[addr].stop_service(service_id, wait=False)
+            except (AgentUnreachableError, InsufficientChipsError):
+                raise AgentUnreachableError(
+                    f"create on {addr} failed ambiguously and the undo "
+                    f"stop also failed — service {service_id} may be "
+                    f"running there; not falling back")
+            logger.warning("create on %s failed; undo confirmed, agent "
+                           "skipped", addr)
+            return None
+        job_id = (extra or {}).get("inference_job_id")
+        if service_type == ServiceType.INFERENCE and job_id:
+            # admin-side half of the data plane: a relay queue pointed at
+            # this agent, merged into the predictor's fan-out set
+            self.broker.register_remote_worker(
+                job_id, service_id, addr, key=self.agents[addr].key)
         with self._lock:
             self._placed[service_id] = addr
+            if service_type == ServiceType.INFERENCE and job_id:
+                self._placed_jobs[service_id] = job_id
             self._inventory_at = 0.0  # free-chip counts changed
             if (self.db is not None and self._monitor is None
                     and not self._closed.is_set()):
@@ -251,10 +316,18 @@ class HostAgentPlacementManager(PlacementManager):
     def destroy_service(self, service_id: str, wait: bool = True) -> None:
         with self._lock:
             addr = self._placed.pop(service_id, None)
+            job_id = self._placed_jobs.pop(service_id, None)
         if addr is None:
             if self.local is not None:
                 self.local.destroy_service(service_id, wait=wait)
             return
+        if job_id is not None and self.broker is not None:
+            # drop the admin-side relay queue first so no new predicts
+            # race the worker teardown
+            try:
+                self.broker.unregister_worker(job_id, service_id)
+            except Exception:
+                logger.exception("relay unregister failed for %s", service_id)
         try:
             self.agents[addr].stop_service(service_id, wait)
         except AgentUnreachableError:

@@ -1,0 +1,57 @@
+"""One wire protocol for talking to host agents (placement/agent.py).
+
+Both the control plane (placement/hosts.py `_AgentHandle`) and the serving
+data plane (cache/fleet.py `HttpWorkerQueue`) speak to agents; this is the
+single copy of the request/auth/error-decode logic so the two cannot
+drift. Callers map the two error types onto their own domains.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+AGENT_KEY_HEADER = "X-Rafiki-Agent-Key"
+
+
+class AgentHTTPError(Exception):
+    """The agent answered with an error status; ``code``/``message``
+    carry the decoded payload."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class AgentTransportError(Exception):
+    """The agent could not be reached (connect/timeout/socket error)."""
+
+
+def call_agent(
+    addr: str,
+    method: str,
+    path: str,
+    body: Optional[Dict[str, Any]] = None,
+    key: Optional[str] = None,
+    timeout_s: float = 10.0,
+) -> Dict[str, Any]:
+    url = f"http://{addr}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    if key:
+        req.add_header(AGENT_KEY_HEADER, key)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            message = json.loads(e.read() or b"{}").get("error", str(e))
+        except (ValueError, TypeError):
+            message = str(e)
+        raise AgentHTTPError(e.code, message) from None
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise AgentTransportError(f"{addr}: {e}") from None

@@ -1,0 +1,230 @@
+"""Cross-host serving: inference workers on remote host agents, reached
+through the agent predict relay (VERDICT r3 "next" #3; reference analogue:
+inference workers on any swarm node + central Redis data plane,
+reference rafiki/admin/services_manager.py:204-239, rafiki/cache/cache.py).
+
+Fast tests exercise the admin-side relay queue (cache/fleet.py) against a
+stub agent; the slow stack test places the inference workers of ONE job on
+TWO real agent processes and serves through the single admin predictor.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from rafiki_tpu.cache.fleet import FleetBroker, HttpWorkerQueue
+from rafiki_tpu.cache.queue import InProcessBroker
+
+
+class _StubAgent:
+    """Minimal /predict_relay endpoint: answers each query with
+    [query, served_batch_index] so tests can see coalescing."""
+
+    def __init__(self, fail_with=None, delay_s=0.0):
+        stub = self
+        stub.batches = []
+        stub.fail_with = fail_with
+        stub.delay_s = delay_s
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length))
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                if stub.fail_with is not None:
+                    data = json.dumps({"error": stub.fail_with}).encode()
+                    self.send_response(502)
+                else:
+                    idx = len(stub.batches)
+                    stub.batches.append(body["queries"])
+                    data = json.dumps({"predictions": [
+                        [q, idx] for q in body["queries"]]}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_http_worker_queue_roundtrip_and_coalescing():
+    stub = _StubAgent(delay_s=0.05)
+    q = HttpWorkerQueue(stub.addr, "job1", "w1")
+    try:
+        # a burst of submits while the first relay is in flight must
+        # coalesce into few requests, not one per query
+        futs = [q.submit(i) for i in range(10)]
+        results = [f.result(10.0) for f in futs]
+        assert [r[0] for r in results] == list(range(10))
+        assert len(stub.batches) < 10
+        assert sum(len(b) for b in stub.batches) == 10
+    finally:
+        q.close()
+        stub.close()
+
+
+def test_http_worker_queue_error_propagates():
+    stub = _StubAgent(fail_with="worker exploded")
+    q = HttpWorkerQueue(stub.addr, "job1", "w1")
+    try:
+        fut = q.submit([1.0])
+        with pytest.raises(RuntimeError, match="worker exploded"):
+            fut.result(10.0)
+    finally:
+        q.close()
+        stub.close()
+
+
+def test_http_worker_queue_unreachable_agent():
+    with socket.socket() as s:  # grab a port nothing listens on
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+    q = HttpWorkerQueue(dead, "job1", "w1", timeout_s=2.0)
+    try:
+        with pytest.raises(RuntimeError, match="unreachable"):
+            q.submit([1.0]).result(10.0)
+    finally:
+        q.close()
+
+
+def test_fleet_broker_merges_local_and_remote():
+    stub = _StubAgent()
+    broker = FleetBroker(InProcessBroker())
+    try:
+        local_q = broker.register_worker("job1", "local-w")
+        broker.register_remote_worker("job1", "remote-w", stub.addr)
+        queues = broker.get_worker_queues("job1")
+        assert set(queues) == {"local-w", "remote-w"}
+        # remote queue serves
+        assert queues["remote-w"].submit(7).result(10.0) == [7, 0]
+        # unregister routes to the right half
+        broker.unregister_worker("job1", "remote-w")
+        broker.unregister_worker("job1", "local-w")
+        assert broker.get_worker_queues("job1") == {}
+        fut = local_q.submit(1)  # closed local queue answers with error
+        with pytest.raises(RuntimeError):
+            fut.result(1.0)
+    finally:
+        broker.close()
+        stub.close()
+
+
+def test_fleet_broker_close_idempotent_and_closes_remote():
+    stub = _StubAgent()
+    broker = FleetBroker(InProcessBroker())
+    rq = broker.register_remote_worker("job1", "w", stub.addr)
+    broker.close()
+    broker.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        rq.submit(1).result(1.0)
+    stub.close()
+
+
+# ---------------------------------------------------------------------------
+# full stack: one inference job served from TWO real agent processes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_inference_spreads_across_two_agents_and_serves(tmp_workdir):
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.constants import ServiceType, TrainJobStatus
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.hosts import HostAgentPlacementManager
+
+    from tests.test_hosts_placement import FIXTURE, _free_port, _spawn_agent
+
+    db_path = tmp_workdir / "rafiki.sqlite3"
+    admin_port = _free_port()
+    agents, procs = [], []
+    try:
+        for chips in ([0, 1], [2, 3]):
+            proc, addr = _spawn_agent(chips, db_path, tmp_workdir, admin_port)
+            procs.append(proc)
+            agents.append(addr)
+
+        db = Database(str(db_path))
+        placement = HostAgentPlacementManager(agents, db=db)
+        admin = Admin(
+            db=db, placement=placement,
+            params_dir=str(tmp_workdir / "params"),
+        )
+        placement.on_status = admin._on_service_status
+        server = AdminServer(admin, port=admin_port).start()
+        try:
+            uid = admin.authenticate_user(
+                config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD
+            )["user_id"]
+            with open(FIXTURE, "rb") as f:
+                admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION",
+                                   f.read(), "FakeModel")
+            admin.create_train_job(
+                uid, "fleetserve", "IMAGE_CLASSIFICATION", "uri://t",
+                "uri://e",
+                budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 2},
+            )
+            job = admin.wait_until_train_job_stopped(
+                uid, "fleetserve", timeout_s=120)
+            assert job["status"] == TrainJobStatus.STOPPED
+
+            admin.create_inference_job(uid, "fleetserve")
+            # every inference worker landed on an agent, across BOTH hosts
+            placed = placement.placements()
+            inf_sids = [
+                w["service_id"]
+                for w in db.get_workers_of_inference_job(
+                    db.get_inference_jobs_by_statuses(["RUNNING"])[0]["id"])
+            ]
+            assert inf_sids, "no inference workers deployed"
+            assert all(sid in placed for sid in inf_sids), (
+                "inference workers fell back to the local engine")
+            assert {placed[sid] for sid in inf_sids} == set(agents)
+
+            # serve through the single admin predictor: queries relay to
+            # remote workers and ensemble across trials
+            preds = admin.predict(uid, "fleetserve", [[0.0], [1.0], [2.0]])
+            assert len(preds) == 3
+            for p in preds:
+                assert pytest.approx(p) == [0.5, 0.5]
+
+            # remote serving counters reach the admin over the event
+            # channel (workers push at ready + every 5 s)
+            deadline = time.monotonic() + 20
+            total_q = 0
+            while time.monotonic() < deadline:
+                stats = admin.get_inference_job_stats(uid, "fleetserve")
+                total_q = stats["queries"]
+                if total_q >= 3:
+                    break
+                time.sleep(0.5)
+            assert total_q >= 3
+
+            admin.stop_all_jobs()
+        finally:
+            server.stop()
+            admin.shutdown()
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
