@@ -1,0 +1,142 @@
+"""Per-model dependency provisioning.
+
+The reference synthesized ``pip install``/``conda install`` commands from
+each model's declared dependencies and ran them at worker boot inside the
+trial's container (/root/reference/rafiki/model/model.py:244-273,
+scripts/start_worker.py:6-9) — paying the install on EVERY trial start.
+Here provisioning is per *model*, cached on disk, and opt-in:
+
+- default: validate-only (sdk/model.py validate_model_dependencies) —
+  registration fails fast naming the missing packages and the exact
+  install command an operator would run;
+- ``RAFIKI_INSTALL_DEPS=1``: missing dependencies are pip-installed into
+  a per-model prefix under ``$RAFIKI_WORKDIR/deps/<fingerprint>`` which
+  is then put on ``sys.path`` for that model's trials. The fingerprint
+  is the sorted (name, version) set, so models sharing a dependency set
+  share one install and trials after the first pay nothing (the
+  reference re-installed per container boot). ``RAFIKI_PIP_ARGS`` passes
+  extra flags (e.g. ``--no-index --find-links /mirror`` for air-gapped
+  TPU pods — this build environment itself has no egress, which is also
+  why install mode is off by default).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import logging
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# import name != distribution name for these common cases
+IMPORT_ALIASES = {"scikit-learn": "sklearn", "pillow": "PIL",
+                  "pyyaml": "yaml", "opencv-python": "cv2"}
+
+
+class DependencyError(Exception):
+    pass
+
+
+def install_enabled() -> bool:
+    return os.environ.get("RAFIKI_INSTALL_DEPS") == "1"
+
+
+def import_name(dep: str) -> str:
+    return IMPORT_ALIASES.get(dep.lower(), dep.replace("-", "_"))
+
+
+def synthesize_pip_command(
+    deps: Dict[str, Optional[str]], target: Optional[str] = None,
+) -> List[str]:
+    """The exact pip invocation for a dependency dict ({name: version or
+    None}) — the reference's install-command synthesis
+    (reference model/model.py:244-273), pip-only and offline-overridable."""
+    cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+           "--disable-pip-version-check"]
+    cmd += os.environ.get("RAFIKI_PIP_ARGS", "").split()
+    if target:
+        cmd += ["--target", target]
+    for name in sorted(deps):
+        version = deps[name]
+        cmd.append(f"{name}=={version}" if version else name)
+    return cmd
+
+
+def deps_prefix(deps: Dict[str, Optional[str]],
+                workdir: Optional[str] = None) -> str:
+    """Shared on-disk prefix for a dependency set (content-addressed)."""
+    from rafiki_tpu import config
+
+    fp = hashlib.sha256(json_dumps_sorted(deps).encode()).hexdigest()[:16]
+    return os.path.join(workdir or config.WORKDIR, "deps", fp)
+
+
+def json_dumps_sorted(deps: Dict[str, Optional[str]]) -> str:
+    import json
+
+    return json.dumps(sorted((k, v) for k, v in deps.items()))
+
+
+def missing_dependencies(deps: Dict[str, Optional[str]],
+                         extra_path: Optional[str] = None) -> List[str]:
+    """Dependency names not importable right now (version pins are not
+    re-checked for already-importable packages — matching the reference,
+    which only guaranteed presence, not downgrade)."""
+    missing = []
+    for dep in deps or {}:
+        mod = import_name(dep)
+        if importlib.util.find_spec(mod) is not None:
+            continue
+        top = mod.split(".")[0]
+        if extra_path and (
+                os.path.isdir(os.path.join(extra_path, top))
+                # single-file-module distributions (six.py style)
+                or os.path.isfile(os.path.join(extra_path, top + ".py"))):
+            continue
+        missing.append(dep)
+    return missing
+
+
+def ensure_dependencies(deps: Dict[str, Optional[str]]) -> Optional[str]:
+    """Make a model's declared dependencies available.
+
+    Returns the per-set install prefix to put on ``sys.path`` (None when
+    everything already imports from the base environment). Validate-only
+    mode raises DependencyError for missing packages, naming the command
+    an operator would run — the fail-fast the reference deferred to
+    worker boot time."""
+    deps = deps or {}
+    prefix = deps_prefix(deps)
+    miss = missing_dependencies(deps, extra_path=prefix)
+    if not miss:
+        return prefix if os.path.isdir(prefix) else None
+    pinned = {k: deps[k] for k in miss}
+    if not install_enabled():
+        raise DependencyError(
+            f"model dependencies not installed: {sorted(miss)}. Install "
+            f"them (e.g. `{' '.join(synthesize_pip_command(pinned))}`) or "
+            f"set RAFIKI_INSTALL_DEPS=1 to let workers provision them.")
+    os.makedirs(prefix, exist_ok=True)
+    cmd = synthesize_pip_command(pinned, target=prefix)
+    logger.info("installing model dependencies: %s", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        raise DependencyError(
+            f"pip install of {sorted(miss)} failed (rc={proc.returncode}):\n"
+            f"{(proc.stderr or '')[-2000:]}")
+    still = missing_dependencies(deps, extra_path=prefix)
+    if still:
+        raise DependencyError(
+            f"dependencies still missing after install: {sorted(still)}")
+    return prefix
+
+
+def activate_prefix(prefix: Optional[str]) -> None:
+    """Put an install prefix at the FRONT of sys.path (pinned versions must
+    shadow base-environment copies)."""
+    if prefix and os.path.isdir(prefix) and prefix not in sys.path:
+        sys.path.insert(0, prefix)

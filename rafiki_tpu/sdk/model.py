@@ -138,16 +138,13 @@ def load_model_class(
 
 
 def validate_model_dependencies(clazz: type) -> List[str]:
-    """Check declared dependencies are importable in this environment; return
-    the missing ones. Replaces the reference's install-command synthesis
-    (reference rafiki/model/model.py:244-273)."""
-    _ALIASES = {"scikit-learn": "sklearn", "pillow": "PIL", "pyyaml": "yaml"}
-    missing = []
-    for dep in getattr(clazz, "dependencies", {}) or {}:
-        mod = _ALIASES.get(dep.lower(), dep.replace("-", "_"))
-        if importlib.util.find_spec(mod) is None:
-            missing.append(dep)
-    return missing
+    """Check declared dependencies are importable in this environment;
+    return the missing ones. Provisioning (the reference's
+    install-command synthesis, reference rafiki/model/model.py:244-273)
+    lives in sdk/deps.py behind RAFIKI_INSTALL_DEPS."""
+    from rafiki_tpu.sdk.deps import missing_dependencies
+
+    return missing_dependencies(getattr(clazz, "dependencies", {}) or {})
 
 
 def test_model_class(

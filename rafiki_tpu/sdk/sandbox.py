@@ -1,0 +1,308 @@
+"""Best-effort OS sandbox for untrusted model-template code.
+
+The reference got isolation for free: every trial executor was a Docker
+container with only its own volume mounts
+(/root/reference/dockerfiles/worker.Dockerfile:1-31,
+rafiki/container/docker_swarm.py:128-148). A process-native TPU stack
+needs its own story — SURVEY.md §7 lists it as a hard part. This module
+runs the untrusted slice of a trial (model import, train, evaluate,
+dump_parameters) in a restricted CHILD process; everything trusted —
+store access, advisor coordination, params persistence, budget
+accounting — stays in the worker (worker/train.py), which talks to the
+child over a line-framed pipe protocol.
+
+Threat model (documented, not absolute):
+
+- PROTECTED against an uploaded template that tries to (a) read other
+  trials' params or mid-trial checkpoints, (b) read/modify the metadata
+  store (SQLite file), (c) see admin credentials / agent keys / store
+  paths in its environment, (d) exhaust fds or address space, or
+  (e) scribble outside its jail cwd via relative paths.
+  Mechanisms: scrubbed environment (allowlist), cwd jailed to a
+  per-trial directory, RLIMIT_NOFILE/RLIMIT_AS/RLIMIT_CORE, and — when
+  the worker runs as root (the TPU-VM deployment default) — a uid drop
+  to ``RAFIKI_SANDBOX_UID`` (default 65534) with gid 0 retained, so
+  owner-only files (params dir 0700, DB 0600 — enforced by
+  db/database.py and worker/train.py) are unreadable while group
+  -readable code (repo, venv) still imports.
+- NOT protected: network access (the child may dial out — the TPU
+  tunnel itself needs sockets), CPU time by default (trials legitimately
+  train for hours; TRIAL_TIMEOUT_S covers runaways via the stop
+  protocol), and uid-drop isolation is unavailable when the worker
+  itself runs unprivileged — then only the env scrub + cwd jail +
+  rlimits apply. Full containment still calls for VMs/gVisor at the
+  fleet boundary.
+
+Protocol (child = python -m rafiki_tpu.sdk.sandbox_child):
+
+- parent -> child stdin: one setup JSON line, then optionally ``STOP\\n``
+  (the mid-trial stop verdict — TRIAL_TIMEOUT_S / TIME_HOURS / ASHA);
+- child -> parent stdout, one JSON frame per line:
+    {"t": "log",  "line": <ModelLogger serialized record>}
+    {"t": "done", "score": float, "params_b64": str}
+    {"t": "err",  "error": str, "traceback": str}
+  METRICS log frames double as the parent's stop-check decision points,
+  exactly like the in-process logger wiring they replace.
+
+Enable with ``RAFIKI_SANDBOX=1`` (worker/train.py checks per trial).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import stat
+import subprocess
+import sys
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# env vars the child KEEPS (everything else is scrubbed). Compute needs
+# the JAX/XLA/TPU-tunnel configuration; PATH/TMP for the interpreter.
+ENV_ALLOWLIST_PREFIXES = (
+    "JAX_", "XLA_", "TPU_", "PALLAS_", "LIBTPU_", "PJRT_", "AXON_",
+    "PYTHON", "LC_", "LANG",
+)
+ENV_ALLOWLIST = ("PATH", "TMPDIR", "TZ", "RAFIKI_CHIP_GRANT",
+                 "RAFIKI_COMPILE_CACHE_DIR")
+
+
+class SandboxError(Exception):
+    """The sandboxed trial failed (model error, limit hit, or protocol
+    breakdown); carries the child-side traceback when there is one."""
+
+
+def sandbox_enabled() -> bool:
+    return os.environ.get("RAFIKI_SANDBOX") == "1"
+
+
+def sandbox_uid() -> Optional[int]:
+    """Uid to drop to, or None when the worker is unprivileged (no drop
+    possible — the remaining layers still apply)."""
+    if os.geteuid() != 0:
+        return None
+    return int(os.environ.get("RAFIKI_SANDBOX_UID", "65534"))
+
+
+def _child_env(jail_dir: str) -> Dict[str, str]:
+    env = {
+        k: v for k, v in os.environ.items()
+        if k in ENV_ALLOWLIST or k.startswith(ENV_ALLOWLIST_PREFIXES)
+    }
+    env["HOME"] = jail_dir
+    env["TMPDIR"] = jail_dir
+    env["PYTHONPATH"] = _REPO_ROOT
+    return env
+
+
+def _ensure_group_traversal(path: str) -> None:
+    """Give gid-0 the directory-execute bit on every ancestor this uid
+    owns, so the uid-dropped child (gid 0 retained) can reach its jail
+    and datasets; never widens beyond group, never touches files we
+    don't own."""
+    p = os.path.abspath(path)
+    while True:
+        try:
+            st = os.stat(p)
+            if st.st_uid == os.getuid() and not st.st_mode & stat.S_IXGRP:
+                os.chmod(p, st.st_mode | stat.S_IXGRP | stat.S_IRGRP)
+        except OSError:
+            pass
+        parent = os.path.dirname(p)
+        if parent == p:
+            return
+        p = parent
+
+
+def grant_dataset_access(uri: str) -> None:
+    """Local-file dataset URIs must be readable by the jailed uid: add
+    group-read on the file and traversal on its ancestors (no-ops for
+    http(s) URIs and files we don't own)."""
+    path = uri[7:] if uri.startswith("file://") else uri
+    if not os.path.isabs(path) or not os.path.exists(path):
+        return
+    _ensure_group_traversal(os.path.dirname(path))
+    try:
+        st = os.stat(path)
+        if st.st_uid == os.getuid():
+            os.chmod(path, st.st_mode | stat.S_IRGRP)
+    except OSError:
+        pass
+
+
+def jail_path(base_dir: str, trial_id: str) -> str:
+    """THE definition of where a trial's jail lives — cleanup code
+    (worker/train.py _cleanup_ckpt) resolves through this too."""
+    return os.path.join(base_dir, "jail", trial_id)
+
+
+def make_jail(base_dir: str, trial_id: str) -> str:
+    """Per-trial jail cwd: group-writable (the dropped uid keeps gid 0),
+    stable across worker restarts so mid-trial checkpoints resume."""
+    jail = jail_path(base_dir, trial_id)
+    os.makedirs(jail, exist_ok=True)
+    os.chmod(jail, 0o770)
+    _ensure_group_traversal(jail)
+    return jail
+
+
+def run_trial_sandboxed(
+    model_bytes: bytes,
+    model_class: str,
+    knobs: Dict[str, Any],
+    train_uri: str,
+    test_uri: str,
+    jail_dir: str,
+    on_log_line: Callable[[str], None],
+    stop_check: Optional[Callable[[Dict[str, float]], bool]] = None,
+    timeout_s: Optional[float] = None,
+    extra_pythonpath: Optional[str] = None,
+) -> Tuple[float, bytes]:
+    """Run one trial's untrusted slice in the sandbox child.
+
+    Forwards every child log line to ``on_log_line`` (the worker's
+    trial-log sink); runs ``stop_check`` on each METRICS record and sends
+    the STOP verdict down the pipe when it fires — the child's logger
+    then raises StopTrialEarly at its next log call, the same contract
+    as the in-process wiring. Returns (score, params_bytes)."""
+    setup = {
+        "model_b64": base64.b64encode(model_bytes).decode(),
+        "model_class": model_class,
+        "knobs": knobs,
+        "train_uri": train_uri,
+        "test_uri": test_uri,
+        "jail_dir": jail_dir,
+        "drop_uid": sandbox_uid(),
+        "nofile": int(os.environ.get("RAFIKI_SANDBOX_NOFILE", "1024")),
+        "mem_mb": int(os.environ.get("RAFIKI_SANDBOX_MEM_MB", "0")),
+    }
+    for uri in (train_uri, test_uri):
+        grant_dataset_access(uri)
+    # the dropped uid (gid 0 kept) must still import this package — give
+    # group traversal along the repo path (e.g. /root is 0700 by default)
+    _ensure_group_traversal(_REPO_ROOT)
+    # NOT start_new_session: the child must die with the worker's process
+    # group (a stopped/killed worker may never reach the finally below)
+    env = _child_env(jail_dir)
+    if extra_pythonpath:
+        # per-model dependency prefix (sdk/deps.py) — pins shadow base
+        env["PYTHONPATH"] = (
+            extra_pythonpath + os.pathsep + env["PYTHONPATH"])
+        _ensure_group_traversal(extra_pythonpath)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rafiki_tpu.sdk.sandbox_child"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+        env=env, cwd=jail_dir,
+    )
+    stop_sent = threading.Event()
+
+    def send_stop() -> None:
+        if stop_sent.is_set():
+            return
+        stop_sent.set()
+        try:
+            proc.stdin.write("STOP\n")
+            proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            pass
+
+    result: Dict[str, Any] = {}
+    rc: Optional[int] = None
+    # stderr must be drained CONCURRENTLY: a chatty child (tqdm, per-step
+    # JAX warnings) fills the ~64 KB pipe buffer, blocks in write(), and
+    # stops emitting stdout frames — deadlocking the frame loop below if
+    # nothing reads this side
+    stderr_chunks: list = []
+
+    def _drain_stderr() -> None:
+        try:
+            for line in proc.stderr:
+                stderr_chunks.append(line)
+                if len(stderr_chunks) > 500:
+                    del stderr_chunks[:250]
+        except (OSError, ValueError):
+            pass
+
+    stderr_thread = threading.Thread(target=_drain_stderr, daemon=True)
+    stderr_thread.start()
+    # Runaway guard the in-process path can't have: a template that never
+    # logs cannot be stopped at a METRICS decision point, so past the
+    # trial deadline the child gets a STOP (in case it logs soon), then a
+    # grace period, then SIGTERM — the frame loop below unblocks on EOF.
+    watchdogs = []
+    if timeout_s:
+        watchdogs = [threading.Timer(timeout_s, send_stop),
+                     threading.Timer(timeout_s + 60.0, proc.terminate)]
+        for w in watchdogs:
+            w.daemon = True
+            w.start()
+    try:
+        proc.stdin.write(json.dumps(setup) + "\n")
+        proc.stdin.flush()
+        for raw in proc.stdout:
+            try:
+                frame = json.loads(raw)
+            except json.JSONDecodeError:
+                # stray print from model code: surface it as a log line
+                on_log_line(json.dumps({
+                    "type": "MESSAGE", "message": raw.rstrip("\n"),
+                    "time": __import__("time").time()}))
+                continue
+            t = frame.get("t")
+            if t == "log":
+                line = frame.get("line", "")
+                on_log_line(line)
+                if stop_check is not None and not stop_sent.is_set():
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        rec = {}
+                    if rec.get("type") == "METRICS" and stop_check(
+                            rec.get("metrics") or {}):
+                        send_stop()
+            elif t in ("done", "err"):
+                result = frame
+                break
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            # a model thread the template didn't join can keep the child
+            # interpreter alive past the done frame — with the result in
+            # hand that is the CHILD's problem, not the trial's (the
+            # finally kills it); without a result it stays a failure
+            rc = None
+    finally:
+        for w in watchdogs:
+            w.cancel()
+        if proc.poll() is None:
+            # the untrusted child is NOT abandoned on teardown (unlike
+            # backend-probe children, it can hold a chip grant)
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for s in (proc.stdin, proc.stdout, proc.stderr):
+            try:
+                s.close()
+            except OSError:
+                pass
+        stderr_thread.join(timeout=5)
+    if result.get("t") == "done":
+        return float(result["score"]), base64.b64decode(result["params_b64"])
+    if result.get("t") == "err":
+        raise SandboxError(
+            f"{result.get('error')}\n--- child traceback ---\n"
+            f"{result.get('traceback', '')}")
+    stderr_tail = "".join(stderr_chunks)[-2000:]
+    raise SandboxError(
+        f"sandbox child exited rc={rc} without a result frame; "
+        f"stderr tail:\n{stderr_tail}")

@@ -1,0 +1,106 @@
+"""Sandbox child: the untrusted half of a trial (see sdk/sandbox.py).
+
+Reads one setup JSON line on stdin, locks itself down (rlimits, cwd
+jail, uid drop when launched by a root worker), then runs the model
+template's train -> evaluate -> dump_parameters cycle, streaming logger
+lines as frames on stdout and finishing with a done/err frame. A
+``STOP`` line on stdin (the worker's mid-trial verdict) flips a flag the
+logger's stop-check reads — the next ``log()`` raises StopTrialEarly,
+identical to the in-process wiring (worker/train.py _install_stop_check).
+
+Isolation happens HERE, in the child, before any untrusted byte is
+imported; the parent only chooses the policy. Frames are written before
+the uid drop could matter: stdout/stderr are inherited pipes, writable
+regardless of uid.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import resource
+import sys
+import threading
+import traceback
+
+
+def _emit(frame: dict) -> None:
+    sys.stdout.write(json.dumps(frame) + "\n")
+    sys.stdout.flush()
+
+
+def _lockdown(setup: dict) -> None:
+    resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
+    nofile = int(setup.get("nofile") or 0)
+    if nofile:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (nofile, nofile))
+    mem_mb = int(setup.get("mem_mb") or 0)
+    if mem_mb:
+        cap = mem_mb << 20
+        resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
+    os.chdir(setup["jail_dir"])
+    drop_uid = setup.get("drop_uid")
+    if drop_uid and os.geteuid() == 0:
+        # gid 0 is RETAINED: group-readable code (repo, venv, datasets)
+        # stays importable while owner-only state (params 0700, DB 0600)
+        # becomes unreadable — the protection boundary of the threat
+        # model in sdk/sandbox.py
+        os.setgroups([])
+        os.setgid(0)
+        os.setuid(int(drop_uid))
+
+
+def main() -> int:
+    setup = json.loads(sys.stdin.readline())
+    try:
+        _lockdown(setup)
+    except Exception:
+        _emit({"t": "err", "error": "sandbox lockdown failed",
+               "traceback": traceback.format_exc()})
+        return 3
+
+    stop_flag = threading.Event()
+
+    def stdin_watcher() -> None:
+        for line in sys.stdin:
+            if line.strip() == "STOP":
+                stop_flag.set()
+
+    threading.Thread(target=stdin_watcher, daemon=True).start()
+
+    try:
+        from rafiki_tpu.sdk.log import ModelLogger, StopTrialEarly
+        from rafiki_tpu.sdk.model import load_model_class
+        from rafiki_tpu.sdk.params import dump_params
+
+        clazz = load_model_class(
+            base64.b64decode(setup["model_b64"]), setup["model_class"])
+        model = clazz(**setup["knobs"])
+        model_logger = ModelLogger()
+        model_logger.set_sink(lambda line: _emit({"t": "log", "line": line}))
+        model_logger.set_stop_check(lambda metrics: stop_flag.is_set())
+        model.logger = model_logger
+        model.checkpoint_path = os.path.join(
+            setup["jail_dir"], "trial.ckpt")
+        try:
+            try:
+                model.train(setup["train_uri"])
+            except StopTrialEarly:
+                model_logger.log("trial stopped early by scheduler")
+            model_logger.set_stop_check(None)
+            score = float(model.evaluate(setup["test_uri"]))
+            params_b64 = base64.b64encode(
+                dump_params(model.dump_parameters())).decode()
+        finally:
+            model.destroy()
+        _emit({"t": "done", "score": score, "params_b64": params_b64})
+        return 0
+    except Exception as e:
+        _emit({"t": "err", "error": f"{type(e).__name__}: {e}",
+               "traceback": traceback.format_exc()[-4000:]})
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

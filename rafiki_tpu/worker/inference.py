@@ -106,6 +106,9 @@ class InferenceWorker:
         assert trial is not None, f"no trial {self._trial_id}"
         model_row = self._db.get_model(trial["model_id"])
         assert model_row is not None
+        from rafiki_tpu.sdk.deps import activate_prefix, ensure_dependencies
+
+        activate_prefix(ensure_dependencies(model_row.get("dependencies")))
         clazz = load_model_class(
             model_row["model_file_bytes"], model_row["model_class"]
         )

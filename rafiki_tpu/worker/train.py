@@ -14,8 +14,10 @@ TPU-native differences:
 - the advisor is shared per sub-train-job through AdvisorStore (keyed by
   sub_train_job_id, not worker service id), so parallel workers coordinate —
   fixing reference train.py:213;
-- no per-boot pip install: dependencies are validated as importable once at
-  model registration (dead time removed from every trial).
+- no per-boot pip install: dependencies are validated at model registration,
+  and with RAFIKI_INSTALL_DEPS=1 provisioned ONCE per dependency-set into a
+  cached prefix (sdk/deps.py) instead of the reference's per-container-boot
+  install (reference scripts/start_worker.py:6-9).
 """
 
 from __future__ import annotations
@@ -112,7 +114,18 @@ class TrainWorker:
         self._job_deadline = deadline
         tt = budget.get(BudgetType.TRIAL_TIMEOUT_S)
         self._trial_timeout_s = float(tt) if tt is not None else None
+        # provision declared dependencies before touching the template
+        # (RAFIKI_INSTALL_DEPS=1 installs per dependency-set; default
+        # validates and fails the executor fast — sdk/deps.py)
+        from rafiki_tpu.sdk.deps import activate_prefix, ensure_dependencies
+
+        self._deps_prefix = ensure_dependencies(model.get("dependencies"))
+        activate_prefix(self._deps_prefix)
         clazz = load_model_class(model["model_file_bytes"], model["model_class"])
+        # kept for the sandbox path: the child re-imports from bytes in its
+        # own restricted process (sdk/sandbox.py)
+        self._model_bytes = model["model_file_bytes"]
+        self._model_class = model["model_class"]
         knob_config = clazz.get_knob_config()
         advisor_id = self._advisors.create_advisor(
             knob_config, advisor_id=self._sub_id
@@ -316,6 +329,63 @@ class TrainWorker:
             self._advisors.get(advisor_id).feedback(knobs, score)
             self._pending_feedback.pop(0)
 
+    def _run_trial_sandboxed(
+        self,
+        knobs: Dict[str, Any],
+        job: Dict[str, Any],
+        trial_id: str,
+        trial_logger: ModelLogger,
+        tracer: Optional[Tracer] = None,
+    ) -> tuple:
+        """Sandbox path (RAFIKI_SANDBOX=1): the untrusted slice — model
+        import, train, evaluate, dump — runs in a restricted child
+        (sdk/sandbox.py: env scrub, cwd jail, rlimits, uid drop under
+        root); this trusted side forwards its log stream to the trial
+        sink, applies the same mid-trial stop checks on METRICS records,
+        and persists the returned params bytes itself. The child never
+        sees the store, other trials' params, or admin credentials."""
+        from rafiki_tpu import config as rconfig
+        from rafiki_tpu.sdk.sandbox import make_jail, run_trial_sandboxed
+
+        tracer = tracer or Tracer(trial_id)
+        os.makedirs(self._params_dir, exist_ok=True)
+        os.chmod(self._params_dir, 0o700)  # owner-only: jailed uids locked out
+        jail = make_jail(rconfig.WORKDIR, trial_id)
+        # the logger sink writes lines to the store; stop checks ride the
+        # same METRICS records as the in-process path
+        stop_check = getattr(trial_logger, "_stop_check", None)
+        sink = (lambda line: trial_logger._sink(line)) if \
+            trial_logger._sink else (lambda line: None)
+        try:
+            with tracer.span("train"):
+                score, params_bytes = run_trial_sandboxed(
+                    self._model_bytes, self._model_class, knobs,
+                    job["train_dataset_uri"], job["test_dataset_uri"],
+                    jail, on_log_line=sink, stop_check=stop_check,
+                    timeout_s=getattr(self, "_trial_timeout_s", None),
+                    extra_pythonpath=getattr(self, "_deps_prefix", None),
+                )
+            with tracer.span("persist_params"):
+                params_path = os.path.join(
+                    self._params_dir, f"{trial_id}.params")
+                with open(params_path, "wb") as f:
+                    f.write(params_bytes)
+                os.chmod(params_path, 0o600)
+            import shutil
+
+            shutil.rmtree(jail, ignore_errors=True)
+            return score, params_path
+        finally:
+            try:
+                tracer.save()
+                trial_logger.set_stop_check(None)
+                trial_logger.log("trial phase breakdown", **{
+                    f"trace_{k}_s": round(v, 4)
+                    for k, v in tracer.summary().items()
+                })
+            except Exception:
+                logger.exception("failed to persist trial trace")
+
     def _cleanup_ckpt(self, trial_id: str) -> None:
         """Drop a trial's mid-trial checkpoint once the trial reached a
         terminal state it will never resume from (ERRORED/TERMINATED —
@@ -327,6 +397,15 @@ class TrainWorker:
                                        f"{trial_id}{suffix}"))
             except OSError:
                 pass
+        # sandbox-mode trials keep their checkpoint inside the jail
+        from rafiki_tpu import config as rconfig
+        from rafiki_tpu.sdk.sandbox import jail_path
+
+        jail = jail_path(rconfig.WORKDIR, trial_id)
+        if os.path.isdir(jail):
+            import shutil
+
+            shutil.rmtree(jail, ignore_errors=True)
 
     def _run_trial(
         self,
@@ -337,6 +416,11 @@ class TrainWorker:
         trial_logger: ModelLogger,
         tracer: Optional[Tracer] = None,
     ) -> tuple:
+        from rafiki_tpu.sdk.sandbox import sandbox_enabled
+
+        if sandbox_enabled():
+            return self._run_trial_sandboxed(knobs, job, trial_id,
+                                             trial_logger, tracer)
         tracer = tracer or Tracer(trial_id)
         model = clazz(**knobs)
         model.logger = trial_logger

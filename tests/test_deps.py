@@ -1,0 +1,112 @@
+"""Dependency provisioning (sdk/deps.py) — the reference's per-model
+install synthesis (reference rafiki/model/model.py:244-273) re-homed as
+validate-by-default + opt-in cached installs. The install path is
+exercised OFFLINE against a hand-built local wheel (this environment has
+no egress, like an air-gapped TPU pod — the exact case RAFIKI_PIP_ARGS
+exists for).
+"""
+
+import os
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rafiki_tpu.sdk import deps as deps_mod
+from rafiki_tpu.sdk.deps import (
+    DependencyError,
+    activate_prefix,
+    deps_prefix,
+    ensure_dependencies,
+    missing_dependencies,
+    synthesize_pip_command,
+)
+
+DIST = "rafiki-test-tinydep"
+MOD = "rafiki_test_tinydep"
+
+
+def _build_wheel(directory) -> str:
+    """A minimal valid wheel, written by hand — no network, no build
+    backend."""
+    name = f"{MOD}-0.1-py3-none-any.whl"
+    path = os.path.join(directory, name)
+    info = f"{MOD}-0.1.dist-info"
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr(f"{MOD}/__init__.py", "MAGIC = 42\n")
+        z.writestr(f"{info}/METADATA",
+                   f"Metadata-Version: 2.1\nName: {DIST}\nVersion: 0.1\n")
+        z.writestr(f"{info}/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib:"
+                   " true\nTag: py3-none-any\n")
+        z.writestr(
+            f"{info}/RECORD",
+            f"{MOD}/__init__.py,,\n{info}/METADATA,,\n{info}/WHEEL,,\n"
+            f"{info}/RECORD,,\n")
+    return path
+
+
+def test_synthesize_pip_command_pins_and_extra_args(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PIP_ARGS", "--no-index --find-links /mirror")
+    cmd = synthesize_pip_command({"torch": "2.1.0", "einops": None},
+                                 target="/p")
+    assert cmd[:4] == [sys.executable, "-m", "pip", "install"]
+    assert "--no-index" in cmd and "/mirror" in cmd
+    assert "--target" in cmd and "/p" in cmd
+    assert "einops" in cmd and "torch==2.1.0" in cmd
+
+
+def test_missing_dependencies_aliases_and_presence():
+    assert missing_dependencies({"numpy": None, "scikit-learn": None}) in (
+        [], ["scikit-learn"])  # numpy always present here
+    assert missing_dependencies({"no-such-package-xyz": "1.0"}) == [
+        "no-such-package-xyz"]
+
+
+def test_validate_mode_raises_with_install_command(monkeypatch, tmp_path):
+    monkeypatch.delenv("RAFIKI_INSTALL_DEPS", raising=False)
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    with pytest.raises(DependencyError, match="pip install"):
+        ensure_dependencies({"no-such-package-xyz": "1.0"})
+
+
+def test_install_mode_provisions_from_local_wheel(monkeypatch, tmp_path):
+    wheel_dir = tmp_path / "wheels"
+    wheel_dir.mkdir()
+    _build_wheel(str(wheel_dir))
+    monkeypatch.setenv("RAFIKI_INSTALL_DEPS", "1")
+    monkeypatch.setenv("RAFIKI_PIP_ARGS",
+                       f"--no-index --find-links {wheel_dir}")
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+
+    prefix = ensure_dependencies({DIST: "0.1"})
+    assert prefix == deps_prefix({DIST: "0.1"}, workdir=str(tmp_path))
+    assert os.path.isdir(os.path.join(prefix, MOD))
+
+    activate_prefix(prefix)
+    try:
+        import rafiki_test_tinydep
+
+        assert rafiki_test_tinydep.MAGIC == 42
+    finally:
+        sys.path.remove(prefix)
+        sys.modules.pop(MOD, None)
+
+    # second call is a cache hit: pip must NOT run again
+    def boom(*a, **k):
+        raise AssertionError("pip ran for an already-provisioned set")
+
+    monkeypatch.setattr(deps_mod.subprocess, "run", boom)
+    assert ensure_dependencies({DIST: "0.1"}) == prefix
+
+
+def test_install_failure_reports_pip_stderr(monkeypatch, tmp_path):
+    monkeypatch.setenv("RAFIKI_INSTALL_DEPS", "1")
+    monkeypatch.setenv("RAFIKI_PIP_ARGS",
+                       f"--no-index --find-links {tmp_path}")  # empty dir
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    with pytest.raises(DependencyError, match="failed"):
+        ensure_dependencies({"no-such-package-xyz": "9.9"})

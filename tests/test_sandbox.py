@@ -1,0 +1,246 @@
+"""Untrusted-model sandbox (sdk/sandbox.py): the isolation the reference
+got from per-trial Docker containers
+(/root/reference/dockerfiles/worker.Dockerfile:1-31), rebuilt process-
+native. The hostile-template test is the VERDICT r3 acceptance: model code
+trying to read another trial's params or the metadata store must FAIL,
+while its own training proceeds normally.
+"""
+
+import base64
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rafiki_tpu.sdk.params import load_params
+from rafiki_tpu.sdk.sandbox import (
+    SandboxError,
+    make_jail,
+    run_trial_sandboxed,
+    sandbox_uid,
+)
+
+BENIGN = textwrap.dedent("""
+    from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+    class Benign(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return {"k": FixedKnob(1)}
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._p = None
+
+        def train(self, uri):
+            self.logger.log("training started")
+            self.logger.log(loss=0.5, epoch=0)
+            # the jail cwd is writable scratch
+            with open("scratch.txt", "w") as f:
+                f.write("ok")
+            self._p = {"w": [1.0, 2.0]}
+
+        def evaluate(self, uri):
+            return 0.75
+
+        def predict(self, queries):
+            return [0 for _ in queries]
+
+        def dump_parameters(self):
+            return self._p
+
+        def load_parameters(self, p):
+            self._p = p
+    """).encode()
+
+# attempts the exact reads the threat model must block, and reports what
+# got through via its score (0.0 = fully contained)
+HOSTILE = textwrap.dedent("""
+    import os
+    from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+    class Hostile(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return {"victim_params": FixedKnob(""), "db_path": FixedKnob("")}
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._knobs = knobs
+            self._stolen = 0.0
+
+        def train(self, uri):
+            try:
+                open(self._knobs["victim_params"], "rb").read()
+                self._stolen += 1.0   # another trial's params readable
+            except OSError:
+                pass
+            try:
+                open(self._knobs["db_path"], "rb").read()
+                self._stolen += 2.0   # the metadata store readable
+            except OSError:
+                pass
+            if os.environ.get("RAFIKI_DB_PATH") or os.environ.get(
+                    "RAFIKI_AGENT_KEY"):
+                self._stolen += 4.0   # secrets leaked into the env
+
+        def evaluate(self, uri):
+            return self._stolen
+
+        def predict(self, queries):
+            return queries
+
+        def dump_parameters(self):
+            return {"x": [0.0]}
+
+        def load_parameters(self, p):
+            pass
+    """).encode()
+
+
+def _collect_logs():
+    lines = []
+    return lines, lines.append
+
+
+@pytest.fixture()
+def jail(tmp_path):
+    return make_jail(str(tmp_path), "trial-1")
+
+
+def test_sandboxed_trial_runs_and_returns_params(jail, tmp_path):
+    lines, sink = _collect_logs()
+    score, params_bytes = run_trial_sandboxed(
+        BENIGN, "Benign", {"k": 1}, "uri://t", "uri://e", jail,
+        on_log_line=sink)
+    assert score == 0.75
+    assert load_params(params_bytes) == {"w": [1.0, 2.0]}
+    records = [json.loads(l) for l in lines]
+    assert any(r.get("message") == "training started" for r in records)
+    assert any(r.get("type") == "METRICS" for r in records)
+    # the jail was the child's cwd
+    assert (tmp_path / "jail" / "trial-1" / "scratch.txt").read_text() == "ok"
+
+
+@pytest.mark.skipif(os.geteuid() != 0,
+                    reason="uid-drop isolation needs a root worker")
+def test_hostile_template_cannot_reach_protected_state(jail, tmp_path):
+    assert sandbox_uid() is not None
+    # victim state the way the trusted side writes it: owner-only
+    victim = tmp_path / "params" / "other-trial.params"
+    victim.parent.mkdir(mode=0o700)
+    victim.write_bytes(b"secret weights")
+    victim.chmod(0o600)
+    db = tmp_path / "store.sqlite3"
+    db.write_bytes(b"sqlite secrets")
+    db.chmod(0o600)
+    # secrets present in the WORKER env must not reach the child
+    os.environ["RAFIKI_DB_PATH"] = str(db)
+    os.environ["RAFIKI_AGENT_KEY"] = "hunter2"
+    try:
+        _, sink = _collect_logs()
+        score, _ = run_trial_sandboxed(
+            HOSTILE, "Hostile",
+            {"victim_params": str(victim), "db_path": str(db)},
+            "uri://t", "uri://e", jail, on_log_line=sink)
+    finally:
+        del os.environ["RAFIKI_DB_PATH"]
+        del os.environ["RAFIKI_AGENT_KEY"]
+    assert score == 0.0, f"containment breach bitmask: {score}"
+
+
+def test_stop_protocol_truncates_training(jail):
+    looper = textwrap.dedent("""
+        from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+        class Looper(BaseModel):
+            @staticmethod
+            def get_knob_config():
+                return {"k": FixedKnob(1)}
+
+            def __init__(self, **knobs):
+                super().__init__(**knobs)
+                self.epochs_done = 0
+
+            def train(self, uri):
+                for e in range(10_000):
+                    self.logger.log(loss=1.0 / (e + 1), epoch=e)
+                    self.epochs_done = e
+
+            def evaluate(self, uri):
+                return float(self.epochs_done)
+
+            def predict(self, queries):
+                return queries
+
+            def dump_parameters(self):
+                return {"x": [0.0]}
+
+            def load_parameters(self, p):
+                pass
+        """).encode()
+    seen = []
+
+    def stop_after_three(metrics):
+        seen.append(metrics)
+        return len(seen) >= 3
+
+    _, sink = _collect_logs()
+    score, _ = run_trial_sandboxed(
+        looper, "Looper", {"k": 1}, "uri://t", "uri://e", jail,
+        on_log_line=sink, stop_check=stop_after_three)
+    # stopped at (or shortly after — pipe latency) the third report, not
+    # after 10k epochs
+    assert score < 100
+
+
+def test_model_error_surfaces_with_traceback(jail):
+    bad = BENIGN.replace(b'self._p = {"w": [1.0, 2.0]}',
+                         b'raise ValueError("bad knob draw")')
+    _, sink = _collect_logs()
+    with pytest.raises(SandboxError, match="bad knob draw"):
+        run_trial_sandboxed(bad, "Benign", {"k": 1}, "uri://t", "uri://e",
+                            jail, on_log_line=sink)
+
+
+@pytest.mark.slow
+def test_full_stack_trains_and_serves_under_sandbox(tmp_workdir, monkeypatch):
+    """RAFIKI_SANDBOX=1 end to end: HPO trials run their untrusted slice
+    in sandbox children; params persist; serving works."""
+    from rafiki_tpu import config
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.constants import TrainJobStatus, TrialStatus
+
+    monkeypatch.setenv("RAFIKI_SANDBOX", "1")
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "fake_model.py")
+    admin = Admin(params_dir=str(tmp_workdir / "params"))
+    try:
+        uid = admin.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        with open(fixture, "rb") as f:
+            admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION",
+                               f.read(), "FakeModel")
+        admin.create_train_job(
+            uid, "sandboxapp", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+            budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 0},
+        )
+        job = admin.wait_until_train_job_stopped(
+            uid, "sandboxapp", timeout_s=180)
+        assert job["status"] == TrainJobStatus.STOPPED
+        trials = admin.get_trials_of_train_job(uid, "sandboxapp")
+        done = [t for t in trials if t["status"] == TrialStatus.COMPLETED]
+        assert len(done) == 2
+        assert all(t["score"] is not None for t in done)
+
+        admin.create_inference_job(uid, "sandboxapp")
+        preds = admin.predict(uid, "sandboxapp", [[0.0]])
+        assert len(preds) == 1
+        admin.stop_all_jobs()
+    finally:
+        admin.shutdown()
