@@ -62,6 +62,8 @@ class ServicesManager:
         self._send_event = send_event
         self._params_dir = params_dir or config.PARAMS_DIR
         self._predictors: Dict[str, Predictor] = {}
+        # inference_job_id -> PredictorServer (config.PREDICTOR_PORTS)
+        self._predict_servers: Dict[str, object] = {}
         self._lock = threading.Lock()
 
     # -- train -------------------------------------------------------------
@@ -286,11 +288,28 @@ class ServicesManager:
             )
             with self._lock:
                 self._predictors[inference_job_id] = predictor
+            if config.PREDICTOR_PORTS:
+                # dedicated serving door (reference parity: per-job
+                # published ports, reference services_manager.py:379-384)
+                from rafiki_tpu.predictor.server import PredictorServer
+
+                psrv = PredictorServer(
+                    predictor, train_job["app"],
+                    host=config.PREDICTOR_HOST).start()
+                with self._lock:
+                    self._predict_servers[inference_job_id] = psrv
+                self._db.update_service_host_port(
+                    predictor_service["id"], psrv.host, psrv.port)
             self._wait_until_services_running(created)
             self._db.mark_service_as_running(predictor_service["id"])
             self._db.mark_inference_job_as_running(inference_job_id)
             return predictor
         except Exception:
+            with self._lock:
+                self._predictors.pop(inference_job_id, None)
+                psrv = self._predict_servers.pop(inference_job_id, None)
+            if psrv is not None:
+                psrv.stop()
             for sid in created:
                 self._destroy_service(sid, wait=False)
             self._db.mark_inference_job_as_errored(inference_job_id)
@@ -308,6 +327,9 @@ class ServicesManager:
             self._db.mark_service_as_stopped(inf_job["predictor_service_id"])
         with self._lock:
             self._predictors.pop(inference_job_id, None)
+            psrv = self._predict_servers.pop(inference_job_id, None)
+        if psrv is not None:
+            psrv.stop()
         self._db.mark_inference_job_as_stopped(inference_job_id)
 
     # -- shared --------------------------------------------------------------

@@ -239,6 +239,31 @@ class Client:
         )
         return data["predictions"]
 
+    def predict_direct(
+        self, app: str, queries: List[Any], app_version: int = -1
+    ) -> List[Any]:
+        """Predict through the job's DEDICATED predictor port, bypassing
+        the admin control-plane server (available when the deployment set
+        RAFIKI_PREDICTOR_PORTS=1; reference parity: per-job published
+        predictor ports, reference admin/services_manager.py:379-384).
+        The same login token authorizes both doors."""
+        inf = self.get_inference_job(app, app_version)
+        host, port = inf.get("predictor_host"), inf.get("predictor_port")
+        if not host or not port:
+            raise RuntimeError(
+                f"inference job for {app} has no dedicated predictor port "
+                f"(deployment runs without RAFIKI_PREDICTOR_PORTS)")
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        resp = self._http.request(
+            "POST", f"http://{host}:{port}/predict",
+            json={"queries": queries}, headers=headers)
+        payload = resp.json()
+        if resp.status_code != 200:
+            raise RuntimeError(payload.get("error", f"HTTP {resp.status_code}"))
+        return payload["data"]["predictions"]
+
     # -- advisors (reference client.py:586-644) ----------------------------------
 
     def create_advisor(

@@ -74,6 +74,12 @@ _DYNAMIC_PATHS = {
         or os.environ.get("RAFIKI_DB_PATH")
         or os.path.join(workdir(), "rafiki.sqlite3")
     ),
+    # per-job predictor listeners: lazily resolved so a deployment (or a
+    # test) can flip RAFIKI_PREDICTOR_PORTS before deploying a job
+    "PREDICTOR_PORTS": lambda: (
+        os.environ.get("RAFIKI_PREDICTOR_PORTS", "0") == "1"),
+    "PREDICTOR_HOST": lambda: (
+        os.environ.get("RAFIKI_PREDICTOR_HOST", "127.0.0.1")),
 }
 
 

@@ -1,0 +1,121 @@
+"""Per-inference-job predictor HTTP listener.
+
+The reference published each inference job's predictor on its own host
+port (/root/reference/rafiki/admin/services_manager.py:379-384,
+predictor/app.py:23-31), so serving traffic never shared a socket with
+the control plane. Parity here: when ``RAFIKI_PREDICTOR_PORTS=1`` (or
+``predictor_ports=True`` on the Admin), ServicesManager binds one of
+these per deployed inference job; POST /predict traffic then bypasses
+the admin server entirely. The admin /predict/<app> route keeps working
+either way — this is an extra front door, not a move.
+
+Auth parity with the admin route: the same stateless JWTs
+(utils/auth.py) are accepted, so a client token works on both doors;
+set ``auth=False`` for a trusted-network deployment (the reference's
+predictor app had no auth at all).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from rafiki_tpu.utils.auth import UnauthorizedError, decode_token
+
+logger = logging.getLogger(__name__)
+
+
+class PredictorServer:
+    """One jsonified POST /predict + GET /healthz listener over one
+    Predictor (predictor/predictor.py)."""
+
+    def __init__(self, predictor, app: str, host: str = "127.0.0.1",
+                 port: int = 0, auth: bool = True):
+        self.predictor = predictor
+        self.app = app
+        self.host = host
+        self.port = port
+        self.auth = auth
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PredictorServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            timeout = 300
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path.split("?", 1)[0].rstrip("/") == "/healthz":
+                    server._respond(self, 200, {
+                        "app": server.app, "status": "ok"})
+                else:
+                    server._respond(self, 404, {"error": "no such route"})
+
+            def do_POST(self):
+                server._predict(self)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"predictor-{self.app}")
+        self._thread.start()
+        logger.info("predictor for %s listening on %s:%d",
+                    self.app, self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- handling ----------------------------------------------------------
+
+    def _predict(self, handler: BaseHTTPRequestHandler) -> None:
+        if handler.path.split("?", 1)[0].rstrip("/") != "/predict":
+            return self._respond(handler, 404, {"error": "no such route"})
+        try:
+            if self.auth:
+                token = (handler.headers.get("Authorization")
+                         or "").removeprefix("Bearer ")
+                decode_token(token)  # any authenticated user may predict
+            length = int(handler.headers.get("Content-Length") or 0)
+            body: Dict[str, Any] = json.loads(
+                handler.rfile.read(length) or b"{}")
+            queries = body.get("queries")
+            if not isinstance(queries, list) or not queries:
+                return self._respond(handler, 400, {
+                    "error": "body must carry a non-empty 'queries' list"})
+            preds = self.predictor.predict_batch(
+                queries, timeout_s=body.get("timeout_s"))
+            self._respond(handler, 200, {"data": {"predictions": preds}})
+        except UnauthorizedError as e:
+            self._respond(handler, 401, {"error": str(e)})
+        except json.JSONDecodeError as e:
+            self._respond(handler, 400, {"error": f"bad JSON body: {e}"})
+        except TimeoutError as e:
+            self._respond(handler, 504, {"error": str(e)})
+        except RuntimeError as e:
+            # no workers / job being torn down
+            self._respond(handler, 503, {"error": str(e)})
+        except Exception:
+            logger.exception("predict failed on dedicated port for %s",
+                             self.app)
+            self._respond(handler, 500, {"error": "internal server error"})
+
+    @staticmethod
+    def _respond(handler, code: int, payload: Dict[str, Any]) -> None:
+        data = json.dumps(payload).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
