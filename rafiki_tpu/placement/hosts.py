@@ -178,14 +178,16 @@ class HostAgentPlacementManager(PlacementManager):
             self._inventory_at = time.monotonic()
         return list(out)
 
-    def _choose_agent(self, n_chips: int) -> Optional[str]:
+    def _choose_agent(self, n_chips: int,
+                      exclude: frozenset = frozenset()) -> Optional[str]:
         """Least-loaded host with enough free chips (the reference's node
         choice: filter by free GPUs, then fewest services, reference
-        docker_swarm.py:53-70)."""
+        docker_swarm.py:53-70). ``exclude`` skips agents that already
+        refused this service."""
         candidates = [
             (inv.get("n_services", 0), -inv.get("free_chips", 0), addr)
             for addr, inv in self._inventories()
-            if inv.get("free_chips", 0) >= n_chips
+            if inv.get("free_chips", 0) >= n_chips and addr not in exclude
         ]
         if not candidates:
             return None
@@ -212,24 +214,39 @@ class HostAgentPlacementManager(PlacementManager):
         can_relay = (self.broker is not None
                      and hasattr(self.broker, "register_remote_worker"))
         if service_type == ServiceType.INFERENCE and can_relay:
-            # Only PROVABLY-unplaced failures may fall back to the local
-            # engine: InsufficientChipsError is pre-commit, and
-            # _create_on_agent returns None only when no agent was
+            # Try EVERY agent (least-loaded first) before the local
+            # fallback: one agent 503ing (no shm data plane, chip race)
+            # must not pin serving to the admin host while siblings have
+            # capacity. Only PROVABLY-unplaced failures continue the loop
+            # or fall back: InsufficientChipsError is pre-commit, and
+            # _create_on_agent returns None only when no candidate was
             # contacted or an ambiguous create was successfully undone.
             # An ambiguous create whose undo also failed PROPAGATES —
             # falling back would double-place the service (a remote copy
             # may be serving) and leak its chips forever.
-            try:
-                ctx = self._create_on_agent(
-                    service_id, service_type, n_chips, best_effort_chips,
-                    extra)
-            except InsufficientChipsError as e:
-                logger.info("no agent can serve %s (%s); trying the local "
-                            "engine", service_id[:8], e)
-                ctx = None
-            if ctx is not None:
-                return ctx
-            # no agent can take it — fall through to the local engine
+            tried: set = set()
+            while True:
+                before = len(tried)
+                try:
+                    ctx = self._create_on_agent(
+                        service_id, service_type, n_chips,
+                        best_effort_chips, extra, tried=tried)
+                except InsufficientChipsError as e:
+                    if len(tried) == before:
+                        # pre-choice fleet-wide verdict, not one agent
+                        # refusing — nothing left to iterate
+                        logger.info("fleet cannot serve %s (%s)",
+                                    service_id[:8], e)
+                        break
+                    logger.info("agent refused %s (%s); trying the next",
+                                service_id[:8], e)
+                    continue  # that agent is in `tried` now
+                if ctx is not None:
+                    return ctx
+                break  # candidates exhausted
+            logger.info("no agent can serve %s; trying the local engine",
+                        service_id[:8])
+            # fall through to the local engine
         if service_type != ServiceType.TRAIN:
             if self.local is None:
                 raise RuntimeError(
@@ -253,20 +270,26 @@ class HostAgentPlacementManager(PlacementManager):
         n_chips: int,
         best_effort_chips: bool,
         extra: Optional[Dict[str, Any]],
+        tried: Optional[set] = None,
     ) -> Optional[ServiceContext]:
         """Least-loaded agent placement. Returns None when no agent can
         take the service (callers decide: TRAIN raises, INFERENCE falls
-        back to the local engine)."""
-        addr = self._choose_agent(n_chips)
+        back to the local engine). ``tried`` (mutated) records the chosen
+        agent BEFORE the create attempt, so a caller retry loop always
+        makes progress and never re-asks a refusing agent."""
+        exclude = frozenset(tried or ())
+        addr = self._choose_agent(n_chips, exclude=exclude)
         if addr is None:
             if not best_effort_chips and n_chips > 0:
                 raise InsufficientChipsError(
                     f"No agent has {n_chips} free chips "
                     f"(fleet: {[i for _, i in self._inventories()]})")
-            addr = self._choose_agent(0)
+            addr = self._choose_agent(0, exclude=exclude)
             if addr is None:
                 return None  # nothing was contacted; caller decides
             n_chips = 0
+        if tried is not None:
+            tried.add(addr)
         try:
             chips = self.agents[addr].create_service(
                 service_id, service_type, n_chips, best_effort_chips,

@@ -228,3 +228,72 @@ def test_inference_spreads_across_two_agents_and_serves(tmp_workdir):
                 proc.wait(timeout=10)
             except Exception:
                 proc.kill()
+
+def test_inference_tries_next_agent_on_refusal():
+    """One agent 503ing must not pin serving to the local engine while a
+    sibling has capacity (review finding on the first fleet cut)."""
+    from rafiki_tpu.constants import ServiceType
+    from rafiki_tpu.placement.hosts import HostAgentPlacementManager
+    from rafiki_tpu.placement.manager import InsufficientChipsError
+
+    placement = HostAgentPlacementManager(["a:1", "b:2"])
+    placement.set_broker(FleetBroker(InProcessBroker()))
+    placement._inventories = lambda: [
+        ("a:1", {"free_chips": 1, "n_services": 0, "total_chips": 1}),
+        ("b:2", {"free_chips": 1, "n_services": 1, "total_chips": 1}),
+    ]
+
+    class Refuses:
+        key = None
+
+        def create_service(self, *a, **k):
+            raise InsufficientChipsError("no serving data plane here")
+
+    class Accepts:
+        key = None
+
+        def create_service(self, sid, stype, n, best, extra):
+            return [0]
+
+        def stop_service(self, sid, wait):
+            pass
+
+    placement.agents = {"a:1": Refuses(), "b:2": Accepts()}
+    ctx = placement.create_service(
+        "svc-1", ServiceType.INFERENCE, n_chips=1, best_effort_chips=True,
+        extra={"inference_job_id": "job-1"})
+    assert placement.placements()["svc-1"] == "b:2"
+    assert ctx.chips == [0]
+    # the relay queue was registered against the agent that accepted
+    assert "svc-1" in placement.broker.get_worker_queues("job-1")
+
+
+def test_ambiguous_agent_create_propagates_when_undo_fails():
+    """A create that dies on the wire with a failing undo must RAISE, not
+    fall back — a remote copy may be serving (double-place hazard)."""
+    from rafiki_tpu.constants import ServiceType
+    from rafiki_tpu.placement.hosts import (
+        AgentUnreachableError,
+        HostAgentPlacementManager,
+    )
+
+    placement = HostAgentPlacementManager(["a:1"])
+    placement.set_broker(FleetBroker(InProcessBroker()))
+    placement._inventories = lambda: [
+        ("a:1", {"free_chips": 1, "n_services": 0, "total_chips": 1}),
+    ]
+
+    class Vanishes:
+        key = None
+
+        def create_service(self, *a, **k):
+            raise AgentUnreachableError("timed out mid-create")
+
+        def stop_service(self, sid, wait):
+            raise AgentUnreachableError("still unreachable")
+
+    placement.agents = {"a:1": Vanishes()}
+    with pytest.raises(AgentUnreachableError, match="ambiguous"):
+        placement.create_service(
+            "svc-2", ServiceType.INFERENCE, n_chips=1,
+            best_effort_chips=True, extra={"inference_job_id": "job-2"})
