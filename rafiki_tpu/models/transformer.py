@@ -66,6 +66,11 @@ class TransformerConfig:
     # GSPMD weight-sharding of the scanned depth axis.
     pipeline: Optional[str] = None
     n_microbatches: int = 4
+    # lax.scan unroll factor for the depth scan: >1 lets XLA fuse and
+    # software-pipeline across adjacent blocks (scan bodies compile once
+    # and cannot overlap otherwise) at the cost of unroll x compile time.
+    # Single-chip throughput knob; numerics identical.
+    scan_unroll: int = 1
 
 
 def block_init(rng: jax.Array, cfg: TransformerConfig) -> Params:
@@ -215,7 +220,8 @@ def stack_apply(stacked: Params, x: jax.Array, cfg: TransformerConfig,
         y, aux = block(layer, x, sub)
         return (y, key), aux
 
-    (x, _), auxs = jax.lax.scan(body, (x, rng), stacked)
+    (x, _), auxs = jax.lax.scan(body, (x, rng), stacked,
+                                unroll=max(cfg.scan_unroll, 1))
     return x, jnp.sum(auxs)
 
 
