@@ -20,6 +20,19 @@ export SUPERADMIN_EMAIL="${SUPERADMIN_EMAIL:-superadmin@rafiki}"
 export SUPERADMIN_PASSWORD="${SUPERADMIN_PASSWORD:-rafiki}"
 export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 
+# Optional hardening / serving features (docs/deployment.md):
+#   RAFIKI_SANDBOX=1          run untrusted model code in locked-down
+#                             children (uid drop RAFIKI_SANDBOX_UID,
+#                             limits RAFIKI_SANDBOX_MEM_MB/_NOFILE)
+#   RAFIKI_PREDICTOR_PORTS=1  dedicated POST /predict port per inference
+#                             job (bind: RAFIKI_PREDICTOR_HOST)
+#   RAFIKI_INSTALL_DEPS=1     provision model dependencies per set into
+#                             $RAFIKI_WORKDIR/deps (pip flags via
+#                             RAFIKI_PIP_ARGS, e.g. an offline mirror)
+#   RAFIKI_AGENTS=h1:p,h2:p   multi-host placement (with
+#                             RAFIKI_PLACEMENT=hosts); train AND
+#                             inference spread across host agents
+
 # Persistent XLA compile cache shared across trials/restarts
 # (replaces the reference's per-boot `pip install` warmup cost,
 # reference scripts/start_worker.py:6-9).
