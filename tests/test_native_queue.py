@@ -178,7 +178,12 @@ def test_full_stack_over_shm_broker(tmp_workdir, monkeypatch):
     from rafiki_tpu.sdk.dataset import write_numpy_dataset
 
     admin = Admin(db=Database(str(tmp_workdir / "db.sqlite")))
-    assert isinstance(admin.broker, ShmBroker)
+    # the FleetBroker shell adds remote relay queues; the shm plane is
+    # the wrapped local base
+    from rafiki_tpu.cache.fleet import FleetBroker
+
+    assert isinstance(admin.broker, FleetBroker)
+    assert isinstance(admin.broker._base, ShmBroker)
     server = AdminServer(admin).start()
     try:
         client = Client(admin_host="127.0.0.1", admin_port=server.port)
