@@ -44,19 +44,12 @@ def _qname(prefix: str, *parts: str) -> str:
 
 def _json_dumps(obj: Any) -> bytes:
     """Shm wire format is JSON (it crosses process boundaries), which is
-    narrower than InProcessBroker's arbitrary-object handoff. Bridge the
-    common gap: numpy arrays/scalars a model predict() returns are converted
-    via tolist()/item(); anything else non-JSON raises TypeError."""
+    narrower than InProcessBroker's arbitrary-object handoff. The shared
+    wire convention (utils/jsonutil.py) converts numpy arrays/scalars at
+    any depth; anything else non-JSON raises TypeError."""
+    from rafiki_tpu.utils.jsonutil import dumps
 
-    def default(o):
-        if hasattr(o, "tolist"):
-            return o.tolist()
-        if hasattr(o, "item"):
-            return o.item()
-        raise TypeError(
-            f"{type(o).__name__} is not JSON-serializable on the shm wire")
-
-    return json.dumps(obj, default=default).encode()
+    return dumps(obj).encode()
 
 
 class ShmWorkerQueue:

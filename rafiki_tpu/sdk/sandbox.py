@@ -44,6 +44,11 @@ Protocol (child = python -m rafiki_tpu.sdk.sandbox_child):
   METRICS log frames double as the parent's stop-check decision points,
   exactly like the in-process logger wiring they replace.
 
+Serving runs under the same flag: inference workers host the uploaded
+template in a persistent serve-mode child (``SandboxedModelServer``) that
+answers one predict frame per batch — the trusted worker keeps the params
+file, store, and data plane (worker/inference.py).
+
 Enable with ``RAFIKI_SANDBOX=1`` (worker/train.py checks per trial).
 """
 
@@ -153,6 +158,55 @@ def make_jail(base_dir: str, trial_id: str) -> str:
     return jail
 
 
+def _base_setup(jail_dir: str) -> Dict[str, Any]:
+    """Isolation policy shared by trial and serve children — ONE place to
+    add a new rlimit or env knob."""
+    return {
+        "jail_dir": jail_dir,
+        "drop_uid": sandbox_uid(),
+        "nofile": int(os.environ.get("RAFIKI_SANDBOX_NOFILE", "1024")),
+        "mem_mb": int(os.environ.get("RAFIKI_SANDBOX_MEM_MB", "0")),
+    }
+
+
+def _spawn_child(jail_dir: str, extra_pythonpath: Optional[str]):
+    """Launch a sandbox child with the shared env policy and a bounded
+    concurrent stderr drain (an undrained pipe deadlocks a chatty child;
+    the tail is the only diagnostic when a child dies frameless).
+    Returns (proc, stderr_chunks, drain_thread)."""
+    env = _child_env(jail_dir)
+    if extra_pythonpath:
+        # per-model dependency prefix (sdk/deps.py) — pins shadow base
+        env["PYTHONPATH"] = (
+            extra_pythonpath + os.pathsep + env["PYTHONPATH"])
+        _ensure_group_traversal(extra_pythonpath)
+    # the dropped uid (gid 0 kept) must still import this package — give
+    # group traversal along the repo path (e.g. /root is 0700 by default)
+    _ensure_group_traversal(_REPO_ROOT)
+    # NOT start_new_session: the child must die with the worker's process
+    # group (a stopped/killed worker may never reach explicit teardown)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rafiki_tpu.sdk.sandbox_child"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+        env=env, cwd=jail_dir,
+    )
+    stderr_chunks: list = []
+
+    def _drain_stderr() -> None:
+        try:
+            for line in proc.stderr:
+                stderr_chunks.append(line)
+                if len(stderr_chunks) > 500:
+                    del stderr_chunks[:250]
+        except (OSError, ValueError):
+            pass
+
+    drain = threading.Thread(target=_drain_stderr, daemon=True)
+    drain.start()
+    return proc, stderr_chunks, drain
+
+
 def run_trial_sandboxed(
     model_bytes: bytes,
     model_class: str,
@@ -173,35 +227,17 @@ def run_trial_sandboxed(
     then raises StopTrialEarly at its next log call, the same contract
     as the in-process wiring. Returns (score, params_bytes)."""
     setup = {
+        **_base_setup(jail_dir),
         "model_b64": base64.b64encode(model_bytes).decode(),
         "model_class": model_class,
         "knobs": knobs,
         "train_uri": train_uri,
         "test_uri": test_uri,
-        "jail_dir": jail_dir,
-        "drop_uid": sandbox_uid(),
-        "nofile": int(os.environ.get("RAFIKI_SANDBOX_NOFILE", "1024")),
-        "mem_mb": int(os.environ.get("RAFIKI_SANDBOX_MEM_MB", "0")),
     }
     for uri in (train_uri, test_uri):
         grant_dataset_access(uri)
-    # the dropped uid (gid 0 kept) must still import this package — give
-    # group traversal along the repo path (e.g. /root is 0700 by default)
-    _ensure_group_traversal(_REPO_ROOT)
-    # NOT start_new_session: the child must die with the worker's process
-    # group (a stopped/killed worker may never reach the finally below)
-    env = _child_env(jail_dir)
-    if extra_pythonpath:
-        # per-model dependency prefix (sdk/deps.py) — pins shadow base
-        env["PYTHONPATH"] = (
-            extra_pythonpath + os.pathsep + env["PYTHONPATH"])
-        _ensure_group_traversal(extra_pythonpath)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "rafiki_tpu.sdk.sandbox_child"],
-        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE, text=True,
-        env=env, cwd=jail_dir,
-    )
+    proc, stderr_chunks, stderr_thread = _spawn_child(
+        jail_dir, extra_pythonpath)
     stop_sent = threading.Event()
 
     def send_stop() -> None:
@@ -216,23 +252,6 @@ def run_trial_sandboxed(
 
     result: Dict[str, Any] = {}
     rc: Optional[int] = None
-    # stderr must be drained CONCURRENTLY: a chatty child (tqdm, per-step
-    # JAX warnings) fills the ~64 KB pipe buffer, blocks in write(), and
-    # stops emitting stdout frames — deadlocking the frame loop below if
-    # nothing reads this side
-    stderr_chunks: list = []
-
-    def _drain_stderr() -> None:
-        try:
-            for line in proc.stderr:
-                stderr_chunks.append(line)
-                if len(stderr_chunks) > 500:
-                    del stderr_chunks[:250]
-        except (OSError, ValueError):
-            pass
-
-    stderr_thread = threading.Thread(target=_drain_stderr, daemon=True)
-    stderr_thread.start()
     # Runaway guard the in-process path can't have: a template that never
     # logs cannot be stopped at a METRICS decision point, so past the
     # trial deadline the child gets a STOP (in case it logs soon), then a
@@ -306,3 +325,145 @@ def run_trial_sandboxed(
     raise SandboxError(
         f"sandbox child exited rc={rc} without a result frame; "
         f"stderr tail:\n{stderr_tail}")
+
+
+class SandboxedModelServer:
+    """Serving-side sandbox: the uploaded template answers predict batches
+    from a locked-down child (same isolation policy as the trial path),
+    while the trusted inference worker keeps the store, the params file,
+    and the data plane. One JSON frame per batch over the pipe — the same
+    wire cost the shm broker already pays per batch, so the added latency
+    is encode/decode, not an extra scheduling hop. Serialized per worker:
+    one batch in flight, exactly like the in-process serve loop."""
+
+    def __init__(self, model_bytes: bytes, model_class: str,
+                 knobs: Dict[str, Any], params_bytes: bytes,
+                 jail_dir: str, extra_pythonpath: Optional[str] = None,
+                 ready_timeout_s: float = 600.0):
+        from rafiki_tpu.utils.jsonutil import dumps
+
+        self._jail_dir = jail_dir
+        self._lock = threading.Lock()
+        self._proc, self._stderr_chunks, self._stderr_thread = _spawn_child(
+            jail_dir, extra_pythonpath)
+        # frames arrive through a reader thread + queue so every wait is a
+        # REAL timeout — a silently hung child can never block the worker
+        # in readline() past its deadline
+        import queue as _queue
+
+        self._frames: "_queue.Queue" = _queue.Queue()
+
+        def _read_stdout() -> None:
+            try:
+                for raw in self._proc.stdout:
+                    try:
+                        frame = json.loads(raw)
+                    except json.JSONDecodeError:
+                        continue  # stray print from model code
+                    if frame.get("t") != "log":
+                        self._frames.put(frame)
+            except (OSError, ValueError):
+                pass
+            self._frames.put(None)  # EOF sentinel
+
+        self._reader = threading.Thread(target=_read_stdout, daemon=True)
+        self._reader.start()
+        setup = {
+            **_base_setup(jail_dir),
+            "mode": "serve",
+            "model_b64": base64.b64encode(model_bytes).decode(),
+            "model_class": model_class,
+            "knobs": knobs,
+            "params_b64": base64.b64encode(params_bytes).decode(),
+        }
+        self._proc.stdin.write(dumps(setup) + "\n")
+        self._proc.stdin.flush()
+        frame = self._next_frame(timeout_s=ready_timeout_s)
+        if frame.get("t") != "ready":
+            err = frame.get("error", "no ready frame")
+            tail = "".join(self._stderr_chunks)[-2000:]
+            self.close()
+            raise SandboxError(f"sandboxed model failed to start: {err}\n"
+                               f"{frame.get('traceback', '')}\n"
+                               f"stderr tail:\n{tail}")
+
+    def _next_frame(self, timeout_s: float) -> Dict[str, Any]:
+        import queue as _queue
+
+        try:
+            frame = self._frames.get(timeout=timeout_s)
+        except _queue.Empty:
+            return {"t": "err", "timeout": True,
+                    "error": f"no frame within {timeout_s:.0f}s"}
+        if frame is None:
+            return {"t": "err", "error": "sandbox child exited "
+                    f"(rc={self._proc.poll()})"}
+        return frame
+
+    @property
+    def dead(self) -> bool:
+        """True once the child can no longer serve. The worker loop exits
+        on this (worker/inference.py) so placement restarts the service —
+        unlike a transient model error, a dead child never recovers."""
+        return self._proc.poll() is not None
+
+    def warm_up(self) -> None:
+        """No-op: the child warmed up before its ready frame — this keeps
+        the object duck-compatible with a model in the worker serve loop."""
+
+    def predict(self, queries: list) -> list:
+        from rafiki_tpu import config as _config
+        from rafiki_tpu.utils.jsonutil import dumps
+
+        with self._lock:
+            if self.dead:
+                raise SandboxError(
+                    f"sandboxed model is gone (rc={self._proc.returncode})")
+            try:
+                self._proc.stdin.write(dumps(
+                    {"op": "predict", "queries": queries}) + "\n")
+                self._proc.stdin.flush()
+            except (BrokenPipeError, OSError, ValueError) as e:
+                raise SandboxError(f"sandboxed model pipe broken: {e}")
+            frame = self._next_frame(
+                timeout_s=_config.PREDICT_TIMEOUT_S + 60.0)
+            if frame.get("timeout"):
+                # the in-flight answer would desynchronize every later
+                # batch (stale preds for fresh queries) — a timed-out
+                # child is killed, and `dead` tells the worker to exit
+                self._proc.kill()
+                raise SandboxError(
+                    f"sandboxed predict timed out; child killed: "
+                    f"{frame.get('error')}")
+        if frame.get("t") == "preds":
+            return list(frame["predictions"])
+        raise SandboxError(
+            f"sandboxed predict failed: {frame.get('error')}\n"
+            f"{frame.get('traceback', '')}")
+
+    def destroy(self) -> None:
+        self.close()
+
+    def close(self) -> None:
+        try:
+            self._proc.stdin.write(json.dumps({"op": "exit"}) + "\n")
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            pass
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        for s in (self._proc.stdin, self._proc.stdout):
+            try:
+                s.close()
+            except OSError:
+                pass
+        # serving jails hold no resumable state (unlike trial jails)
+        import shutil
+
+        shutil.rmtree(self._jail_dir, ignore_errors=True)

@@ -26,7 +26,11 @@ import traceback
 
 
 def _emit(frame: dict) -> None:
-    sys.stdout.write(json.dumps(frame) + "\n")
+    # shared wire convention: numpy converts at any depth (a model's
+    # predictions may nest arrays/scalars inside dicts/lists)
+    from rafiki_tpu.utils.jsonutil import dumps
+
+    sys.stdout.write(dumps(frame) + "\n")
     sys.stdout.flush()
 
 
@@ -59,6 +63,9 @@ def main() -> int:
         _emit({"t": "err", "error": "sandbox lockdown failed",
                "traceback": traceback.format_exc()})
         return 3
+
+    if setup.get("mode") == "serve":
+        return _serve(setup)
 
     stop_flag = threading.Event()
 
@@ -100,6 +107,54 @@ def main() -> int:
         _emit({"t": "err", "error": f"{type(e).__name__}: {e}",
                "traceback": traceback.format_exc()[-4000:]})
         return 1
+
+
+def _serve(setup: dict) -> int:
+    """Serving mode: load the template + TRUSTED-side-supplied params,
+    warm up, then answer predict frames until stdin closes. One frame in
+    ({"op":"predict","queries":[...]}), one frame out ({"t":"preds"} or
+    {"t":"err"}) — a per-query error fails only that batch, never the
+    loop (parity with worker/inference.py's in-process error handling)."""
+    try:
+        from rafiki_tpu.sdk.model import load_model_class
+        from rafiki_tpu.sdk.params import load_params
+
+        clazz = load_model_class(
+            base64.b64decode(setup["model_b64"]), setup["model_class"])
+        model = clazz(**setup["knobs"])
+        model.load_parameters(
+            load_params(base64.b64decode(setup["params_b64"])))
+        try:
+            model.warm_up()
+        except Exception:
+            _emit({"t": "log", "line": json.dumps({
+                "type": "MESSAGE",
+                "message": "warm_up failed in sandbox (serving anyway)",
+                "time": 0})})
+        _emit({"t": "ready"})
+    except Exception as e:
+        _emit({"t": "err", "error": f"{type(e).__name__}: {e}",
+               "traceback": traceback.format_exc()[-4000:]})
+        return 1
+    try:
+        for line in sys.stdin:
+            try:
+                frame = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if frame.get("op") == "exit":
+                break
+            if frame.get("op") != "predict":
+                continue
+            try:
+                preds = model.predict(frame["queries"])
+                _emit({"t": "preds", "predictions": list(preds)})
+            except Exception as e:
+                _emit({"t": "err", "error": f"{type(e).__name__}: {e}",
+                       "traceback": traceback.format_exc()[-2000:]})
+        return 0
+    finally:
+        model.destroy()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,22 @@
+"""One JSON wire convention for everything that crosses a process
+boundary (shm broker, sandbox pipes, agent relays): numpy arrays/scalars
+serialize via tolist()/item() at ANY nesting depth; everything else
+non-JSON raises TypeError so silent corruption can't pass."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def json_default(o: Any):
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    if hasattr(o, "item"):
+        return o.item()
+    raise TypeError(
+        f"{type(o).__name__} is not JSON-serializable on the wire")
+
+
+def dumps(obj: Any) -> str:
+    return json.dumps(obj, default=json_default)

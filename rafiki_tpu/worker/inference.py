@@ -101,20 +101,40 @@ class InferenceWorker:
                 push()  # final snapshot: batches since the last tick
                 return
 
-    def _load_model(self):
+    def _load_model(self, service_id: str):
         trial = self._db.get_trial(self._trial_id)
         assert trial is not None, f"no trial {self._trial_id}"
         model_row = self._db.get_model(trial["model_id"])
         assert model_row is not None
         from rafiki_tpu.sdk.deps import activate_prefix, ensure_dependencies
+        from rafiki_tpu.sdk.sandbox import sandbox_enabled
 
-        activate_prefix(ensure_dependencies(model_row.get("dependencies")))
+        prefix = ensure_dependencies(model_row.get("dependencies"))
+        with open(trial["params_file_path"], "rb") as f:
+            params_bytes = f.read()
+        if sandbox_enabled():
+            # serving isolation parity with the trial path: the uploaded
+            # template answers batches from a locked-down child; this
+            # trusted worker keeps the store, the params file, and the
+            # data plane (sdk/sandbox.py SandboxedModelServer — warm-up
+            # happens child-side before the ready frame)
+            from rafiki_tpu.sdk.sandbox import (
+                SandboxedModelServer,
+                make_jail,
+            )
+
+            return SandboxedModelServer(
+                model_row["model_file_bytes"], model_row["model_class"],
+                trial["knobs"], params_bytes,
+                make_jail(config.WORKDIR, f"serve-{service_id}"),
+                extra_pythonpath=prefix,
+            )
+        activate_prefix(prefix)
         clazz = load_model_class(
             model_row["model_file_bytes"], model_row["model_class"]
         )
         model = clazz(**trial["knobs"])
-        with open(trial["params_file_path"], "rb") as f:
-            model.load_parameters(load_params(f.read()))
+        model.load_parameters(load_params(params_bytes))
         return model
 
     def start(self, ctx: ServiceContext) -> None:
@@ -122,7 +142,7 @@ class InferenceWorker:
         model = None
         queue = self._broker.register_worker(self._job_id, ctx.service_id)
         try:
-            model = self._load_model()
+            model = self._load_model(ctx.service_id)
             try:
                 # compile every serving batch bucket before accepting
                 # traffic — a mid-traffic XLA compile is a multi-second
@@ -167,6 +187,11 @@ class InferenceWorker:
                     )
                     for fut in futures:
                         fut.set_error(e)
+                    if getattr(model, "dead", False):
+                        # a dead sandbox child never recovers — exit so
+                        # placement's restart policy replaces this worker
+                        # instead of serving errors forever
+                        raise
         finally:
             self._broker.unregister_worker(self._job_id, ctx.service_id)
             if model is not None:

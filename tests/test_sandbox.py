@@ -244,3 +244,155 @@ def test_full_stack_trains_and_serves_under_sandbox(tmp_workdir, monkeypatch):
         admin.stop_all_jobs()
     finally:
         admin.shutdown()
+
+
+SERVER_TEMPLATE = textwrap.dedent("""
+    import os
+    from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+    class Server(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return {"victim": FixedKnob("")}
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._knobs = knobs
+            self._p = None
+
+        def train(self, uri):
+            pass
+
+        def evaluate(self, uri):
+            return 1.0
+
+        def predict(self, queries):
+            out = []
+            for q in queries:
+                if q == "steal":
+                    try:
+                        open(self._knobs["victim"], "rb").read()
+                        out.append("stolen")
+                    except OSError:
+                        out.append("denied")
+                elif q == "secret":
+                    out.append(os.environ.get("RAFIKI_DB_PATH", "scrubbed"))
+                elif q == "boom":
+                    raise ValueError("bad query")
+                else:
+                    out.append([q, self._p["w"]])
+            return out
+
+        def dump_parameters(self):
+            return self._p
+
+        def load_parameters(self, p):
+            self._p = p
+    """).encode()
+
+
+def test_sandboxed_model_server_roundtrip_and_error_recovery(tmp_path):
+    from rafiki_tpu.sdk.params import dump_params
+    from rafiki_tpu.sdk.sandbox import SandboxedModelServer, make_jail
+
+    jail = make_jail(str(tmp_path), "serve-w1")
+    srv = SandboxedModelServer(
+        SERVER_TEMPLATE, "Server", {"victim": ""},
+        dump_params({"w": 7}), jail)
+    try:
+        assert srv.predict(["a", "b"]) == [["a", 7], ["b", 7]]
+        # a bad batch errors WITHOUT killing the serve loop
+        with pytest.raises(SandboxError, match="bad query"):
+            srv.predict(["boom"])
+        assert srv.predict(["c"]) == [["c", 7]]
+    finally:
+        srv.close()
+    assert not os.path.isdir(jail)  # serving jail cleaned up
+
+
+@pytest.mark.skipif(os.geteuid() != 0,
+                    reason="uid-drop isolation needs a root worker")
+def test_sandboxed_serving_cannot_reach_protected_state(tmp_path, monkeypatch):
+    from rafiki_tpu.sdk.params import dump_params
+    from rafiki_tpu.sdk.sandbox import SandboxedModelServer, make_jail
+
+    victim = tmp_path / "params" / "victim.params"
+    victim.parent.mkdir(mode=0o700)
+    victim.write_bytes(b"weights")
+    victim.chmod(0o600)
+    monkeypatch.setenv("RAFIKI_DB_PATH", "/tmp/should-not-leak.sqlite")
+    jail = make_jail(str(tmp_path), "serve-w2")
+    srv = SandboxedModelServer(
+        SERVER_TEMPLATE, "Server", {"victim": str(victim)},
+        dump_params({"w": 1}), jail)
+    try:
+        assert srv.predict(["steal"]) == ["denied"]
+        assert srv.predict(["secret"]) == ["scrubbed"]
+    finally:
+        srv.close()
+
+
+def test_sandboxed_server_dead_child_is_detected(tmp_path):
+    from rafiki_tpu.sdk.params import dump_params
+    from rafiki_tpu.sdk.sandbox import SandboxedModelServer, make_jail
+
+    jail = make_jail(str(tmp_path), "serve-dead")
+    srv = SandboxedModelServer(
+        SERVER_TEMPLATE, "Server", {"victim": ""},
+        dump_params({"w": 1}), jail)
+    try:
+        assert not srv.dead
+        srv._proc.kill()
+        srv._proc.wait(timeout=10)
+        assert srv.dead
+        with pytest.raises(SandboxError, match="gone|exited"):
+            srv.predict(["a"])
+    finally:
+        srv.close()
+
+
+def test_sandboxed_server_nested_numpy_predictions(tmp_path):
+    """Models returning dicts/lists with numpy leaves must serve under
+    sandbox exactly as they do over the shm wire (shared jsonutil
+    convention)."""
+    from rafiki_tpu.sdk.params import dump_params
+    from rafiki_tpu.sdk.sandbox import SandboxedModelServer, make_jail
+
+    np_template = textwrap.dedent("""
+        import numpy as np
+        from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+        class NpServer(BaseModel):
+            @staticmethod
+            def get_knob_config():
+                return {"k": FixedKnob(1)}
+
+            def __init__(self, **knobs):
+                super().__init__(**knobs)
+
+            def train(self, uri):
+                pass
+
+            def evaluate(self, uri):
+                return 1.0
+
+            def predict(self, queries):
+                return [{"label": "cat",
+                         "prob": np.float32(0.9),
+                         "emb": np.arange(3)} for _ in queries]
+
+            def dump_parameters(self):
+                return {}
+
+            def load_parameters(self, p):
+                pass
+        """).encode()
+    jail = make_jail(str(tmp_path), "serve-np")
+    srv = SandboxedModelServer(
+        np_template, "NpServer", {"k": 1}, dump_params({}), jail)
+    try:
+        preds = srv.predict(["q"])
+        assert preds == [{"label": "cat", "prob": pytest.approx(0.9),
+                          "emb": [0, 1, 2]}]
+    finally:
+        srv.close()
