@@ -1,0 +1,154 @@
+"""Deployment health check: ``python -m rafiki_tpu.doctor``.
+
+One bounded pass over everything a rafiki_tpu deployment depends on,
+printing a PASS/WARN/FAIL line per check and exiting non-zero on FAIL.
+The accelerator check goes through the bounded subprocess probe
+(utils/backend_probe.py), so a wedged TPU tunnel costs one timeout here
+— never a hang (the failure mode that motivated the probe; this command
+is the operator's way to see it).
+
+The reference's closest analogue was docker/compose healthchecks plus
+reading container logs; a process-native stack gets a first-class
+doctor instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from typing import Callable, List, Tuple
+
+PASS, WARN, FAIL = "PASS", "WARN", "FAIL"
+
+Check = Tuple[str, str, str]  # (name, status, detail)
+
+
+def check_backend(timeout_s: float = 60.0) -> Check:
+    from rafiki_tpu.utils.backend_probe import probe_device_count
+
+    n, err = probe_device_count(timeout_s=timeout_s)
+    if n >= 1:
+        return ("accelerator", PASS, f"{n} device(s) visible")
+    return ("accelerator", WARN,
+            f"live backend unusable ({err}) — CPU fallbacks will engage")
+
+
+def check_workdir() -> Check:
+    from rafiki_tpu import config
+
+    wd = config.WORKDIR
+    try:
+        os.makedirs(wd, exist_ok=True)
+        probe = tempfile.NamedTemporaryFile(dir=wd, delete=True)
+        probe.close()
+    except OSError as e:
+        return ("workdir", FAIL, f"{wd} not writable: {e}")
+    return ("workdir", PASS, wd)
+
+
+def check_store() -> Check:
+    from rafiki_tpu import config
+    from rafiki_tpu.db.database import Database
+
+    target = str(config.DB_PATH)
+    try:
+        if target.startswith(("postgresql://", "postgres://")):
+            db = Database(target)  # connects (or raises) against the server
+            label = target
+        elif os.path.exists(target):
+            # exercise the REAL store the server will open (same WAL
+            # sidecar behavior the server has) — a corrupt or
+            # wrong-owner file must fail here, not at boot
+            db = Database(target)
+            label = target
+        else:
+            db = Database(":memory:")  # engine sanity; store not created yet
+            label = f"{target} (not created yet; embedded engine ok)"
+        db.get_users()
+        db.close()
+    except Exception as e:
+        return ("metadata store", FAIL, f"{target}: {type(e).__name__}: {e}")
+    return ("metadata store", PASS, label)
+
+
+def check_shm_broker() -> Check:
+    try:
+        from rafiki_tpu.native.shm_queue import available
+
+        if not available():
+            return ("shm data plane", WARN,
+                    "native shmqueue unavailable — in-process broker only "
+                    "(process placement/serving agents need it)")
+    except Exception as e:
+        return ("shm data plane", WARN, f"{type(e).__name__}: {e}")
+    return ("shm data plane", PASS, "native queue library loads")
+
+
+def check_sandbox() -> Check:
+    from rafiki_tpu.sdk.sandbox import sandbox_enabled, sandbox_uid
+
+    if not sandbox_enabled():
+        return ("model sandbox", WARN,
+                "RAFIKI_SANDBOX unset — uploaded model code runs with "
+                "worker privileges")
+    uid = sandbox_uid()
+    if uid is None:
+        return ("model sandbox", WARN,
+                "enabled, but worker is not root: uid-drop layer inactive "
+                "(env scrub + jail + rlimits still apply)")
+    return ("model sandbox", PASS, f"enabled, drops to uid {uid}")
+
+
+def check_agents() -> Check:
+    from rafiki_tpu.utils.agent_http import call_agent
+
+    agents = [a.strip() for a in os.environ.get("RAFIKI_AGENTS", "").split(",")
+              if a.strip()]
+    if not agents:
+        return ("host agents", PASS, "single-host (RAFIKI_AGENTS unset)")
+    key = os.environ.get("RAFIKI_AGENT_KEY")
+    down = []
+    total = 0
+    for addr in agents:
+        try:
+            inv = call_agent(addr, "GET", "/inventory", key=key, timeout_s=5)
+            total += int(inv.get("total_chips", 0))
+        except Exception:
+            down.append(addr)
+    if down:
+        return ("host agents", FAIL if len(down) == len(agents) else WARN,
+                f"unreachable: {down} (fleet chips visible: {total})")
+    return ("host agents", PASS,
+            f"{len(agents)} agent(s), {total} fleet chips")
+
+
+CHECKS: List[Callable[[], Check]] = [
+    check_workdir, check_store, check_shm_broker, check_sandbox,
+    check_agents, check_backend,
+]
+
+
+def run(json_out: bool = False) -> int:
+    results = []
+    for check in CHECKS:
+        try:
+            results.append(check())
+        except Exception as e:  # a doctor must never crash mid-diagnosis
+            results.append((check.__name__, FAIL,
+                            f"check crashed: {type(e).__name__}: {e}"))
+    worst = PASS
+    for name, status, detail in results:
+        if not json_out:
+            print(f"[{status}] {name}: {detail}")
+        if status == FAIL or (status == WARN and worst == PASS):
+            worst = status
+    if json_out:
+        print(json.dumps([
+            {"check": n, "status": s, "detail": d} for n, s, d in results]))
+    return 1 if worst == FAIL else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(json_out="--json" in sys.argv))

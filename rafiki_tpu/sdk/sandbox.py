@@ -270,7 +270,10 @@ def run_trial_sandboxed(
             try:
                 frame = json.loads(raw)
             except json.JSONDecodeError:
-                # stray print from model code: surface it as a log line
+                frame = None
+            if not isinstance(frame, dict) or "t" not in frame:
+                # stray print from model code (including prints that
+                # happen to be valid JSON): surface it as a log line
                 on_log_line(json.dumps({
                     "type": "MESSAGE", "message": raw.rstrip("\n"),
                     "time": __import__("time").time()}))
@@ -360,11 +363,20 @@ class SandboxedModelServer:
                         frame = json.loads(raw)
                     except json.JSONDecodeError:
                         continue  # stray print from model code
-                    if frame.get("t") != "log":
+                    if (not isinstance(frame, dict)
+                            or frame.get("t") not in (
+                                "ready", "preds", "err", "log")):
+                        # JSON-looking print (42, [..], {"step":1}, or a
+                        # dict with an unknown "t"): NOT a protocol
+                        # frame — enqueuing it would pair stale answers
+                        # with later queries
+                        continue
+                    if frame["t"] != "log":
                         self._frames.put(frame)
             except (OSError, ValueError):
                 pass
-            self._frames.put(None)  # EOF sentinel
+            finally:
+                self._frames.put(None)  # EOF sentinel, on every exit path
 
         self._reader = threading.Thread(target=_read_stdout, daemon=True)
         self._reader.start()
@@ -376,8 +388,18 @@ class SandboxedModelServer:
             "knobs": knobs,
             "params_b64": base64.b64encode(params_bytes).decode(),
         }
-        self._proc.stdin.write(dumps(setup) + "\n")
-        self._proc.stdin.flush()
+        try:
+            self._proc.stdin.write(dumps(setup) + "\n")
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            # child died before reading stdin (e.g. broken deps prefix
+            # crashes interpreter init): reap it and surface the stderr
+            # diagnostic instead of a raw BrokenPipeError
+            tail = "".join(self._stderr_chunks)[-2000:]
+            self.close()
+            raise SandboxError(
+                f"sandbox serve child died before setup ({e!r}); "
+                f"stderr tail:\n{tail}")
         frame = self._next_frame(timeout_s=ready_timeout_s)
         if frame.get("t") != "ready":
             err = frame.get("error", "no ready frame")
@@ -430,8 +452,13 @@ class SandboxedModelServer:
             if frame.get("timeout"):
                 # the in-flight answer would desynchronize every later
                 # batch (stale preds for fresh queries) — a timed-out
-                # child is killed, and `dead` tells the worker to exit
+                # child is killed AND reaped here, so `dead` is already
+                # True when the worker's error handler checks it
                 self._proc.kill()
+                try:
+                    self._proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
                 raise SandboxError(
                     f"sandboxed predict timed out; child killed: "
                     f"{frame.get('error')}")
@@ -458,11 +485,12 @@ class SandboxedModelServer:
                 self._proc.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 self._proc.kill()
-        for s in (self._proc.stdin, self._proc.stdout):
+        for s in (self._proc.stdin, self._proc.stdout, self._proc.stderr):
             try:
                 s.close()
             except OSError:
                 pass
+        self._stderr_thread.join(timeout=5)
         # serving jails hold no resumable state (unlike trial jails)
         import shutil
 

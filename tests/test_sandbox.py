@@ -396,3 +396,64 @@ def test_sandboxed_server_nested_numpy_predictions(tmp_path):
                           "emb": [0, 1, 2]}]
     finally:
         srv.close()
+
+
+def test_stray_prints_do_not_desync_protocol(tmp_path):
+    """Model code printing to stdout — including prints that parse as
+    JSON — must surface as logs (trial) or be ignored (serve), never be
+    read as protocol frames (review finding: a {"step":1} print could
+    pair stale predictions with later queries)."""
+    from rafiki_tpu.sdk.params import dump_params
+    from rafiki_tpu.sdk.sandbox import SandboxedModelServer, make_jail
+
+    noisy = textwrap.dedent("""
+        from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+        class Noisy(BaseModel):
+            @staticmethod
+            def get_knob_config():
+                return {"k": FixedKnob(1)}
+
+            def __init__(self, **knobs):
+                super().__init__(**knobs)
+
+            def train(self, uri):
+                print(42)
+                print('{"step": 1}')
+                print("plain text")
+
+            def evaluate(self, uri):
+                return 0.5
+
+            def predict(self, queries):
+                print(7)
+                print('{"t": "fake", "oops": true}')
+                return [q for q in queries]
+
+            def dump_parameters(self):
+                return {}
+
+            def load_parameters(self, p):
+                pass
+        """).encode()
+    # trial path: stray prints become MESSAGE log lines, score survives
+    lines, sink = _collect_logs()
+    jail = make_jail(str(tmp_path), "noisy-trial")
+    score, _ = run_trial_sandboxed(
+        noisy, "Noisy", {"k": 1}, "uri://t", "uri://e", jail,
+        on_log_line=sink)
+    assert score == 0.5
+    messages = [json.loads(l).get("message") for l in lines
+                if json.loads(l).get("type") == "MESSAGE"]
+    assert "42" in messages and '{"step": 1}' in messages
+
+    # serve path: stray prints (even dict-shaped) never become frames;
+    # answers stay paired with their own queries across batches
+    jail2 = make_jail(str(tmp_path), "noisy-serve")
+    srv = SandboxedModelServer(noisy, "Noisy", {"k": 1},
+                               dump_params({}), jail2)
+    try:
+        assert srv.predict(["a"]) == ["a"]
+        assert srv.predict(["b", "c"]) == ["b", "c"]
+    finally:
+        srv.close()
