@@ -210,6 +210,7 @@ class DataParallelTrainer:
         predict_fn: Optional[Callable[..., jax.Array]] = None,
         mesh: Optional[Mesh] = None,
         stateful: bool = False,
+        serve_int8: Optional[bool] = None,
     ):
         """``stateful=True`` threads a non-trained model state pytree
         (BatchNorm running statistics, EMA copies, ...) through training:
@@ -290,9 +291,25 @@ class DataParallelTrainer:
             in_shardings=(self._repl,) * 6,
             out_shardings=(self._repl,) * 4,
         )
+        # int8 weight-only serving (sdk/quant.py): quantize once per
+        # params identity host-side; the jitted predict dequantizes
+        # in-graph so the int8 copy is the HBM-resident one. Explicit
+        # arg wins over the env switch.
+        from rafiki_tpu.sdk.quant import serve_int8_enabled
+
+        self.serve_int8 = (serve_int8 if serve_int8 is not None
+                           else serve_int8_enabled())
+        self._qcache: Tuple[Any, Any] = (None, None)  # (params_ref, qparams)
         if predict_fn is not None:
+            serving_fn = predict_fn
+            if self.serve_int8:
+                from rafiki_tpu.sdk.quant import dequantize_pytree
+
+                def serving_fn(qp, *rest, _fn=predict_fn):
+                    return _fn(dequantize_pytree(qp), *rest)
+
             self._predict = jax.jit(
-                predict_fn,
+                serving_fn,
                 in_shardings=(self._repl,) * (1 + n_state) + (self._data,),
                 out_shardings=self._data,
             )
@@ -516,8 +533,24 @@ class DataParallelTrainer:
 
     # -- inference --------------------------------------------------------
 
+    def _serving_params(self, params: Any) -> Any:
+        """The params actually fed to the jitted predict: the int8 copy
+        when serve_int8 is on (quantized once per params object — the
+        cache holds the source pytree so CPython id reuse can't alias a
+        different trial's weights)."""
+        if not self.serve_int8:
+            return params
+        src, qp = self._qcache
+        if src is not params:
+            from rafiki_tpu.sdk.quant import quantize_pytree
+
+            qp = jax.device_put(quantize_pytree(params), self._repl)
+            self._qcache = (params, qp)
+        return qp
+
     def _run_predict(self, params: Any, chunk: np.ndarray,
                      state: Any) -> jax.Array:
+        params = self._serving_params(params)
         dev = jax.device_put(chunk, self._data)
         if self.stateful:
             return self._predict(params, state, dev)

@@ -271,9 +271,11 @@ def run_trial_sandboxed(
                 frame = json.loads(raw)
             except json.JSONDecodeError:
                 frame = None
-            if not isinstance(frame, dict) or "t" not in frame:
-                # stray print from model code (including prints that
-                # happen to be valid JSON): surface it as a log line
+            if (not isinstance(frame, dict)
+                    or frame.get("t") not in ("log", "done", "err")):
+                # stray output that slipped past the child's stdout
+                # redirection (defense in depth — including valid-JSON
+                # prints and unknown-t dicts): surface it as a log line
                 on_log_line(json.dumps({
                     "type": "MESSAGE", "message": raw.rstrip("\n"),
                     "time": __import__("time").time()}))
@@ -393,18 +395,19 @@ class SandboxedModelServer:
             self._proc.stdin.flush()
         except (BrokenPipeError, OSError) as e:
             # child died before reading stdin (e.g. broken deps prefix
-            # crashes interpreter init): reap it and surface the stderr
-            # diagnostic instead of a raw BrokenPipeError
-            tail = "".join(self._stderr_chunks)[-2000:]
+            # crashes interpreter init): reap it, THEN read the tail —
+            # close() joins the drain thread, so the diagnostic is
+            # complete rather than racing the reader
             self.close()
+            tail = "".join(self._stderr_chunks)[-2000:]
             raise SandboxError(
                 f"sandbox serve child died before setup ({e!r}); "
                 f"stderr tail:\n{tail}")
         frame = self._next_frame(timeout_s=ready_timeout_s)
         if frame.get("t") != "ready":
             err = frame.get("error", "no ready frame")
+            self.close()  # joins the stderr drain: tail is complete below
             tail = "".join(self._stderr_chunks)[-2000:]
-            self.close()
             raise SandboxError(f"sandboxed model failed to start: {err}\n"
                                f"{frame.get('traceback', '')}\n"
                                f"stderr tail:\n{tail}")

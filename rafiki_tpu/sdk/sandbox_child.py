@@ -25,13 +25,59 @@ import threading
 import traceback
 
 
+# The protocol channel is a PRIVATE dup of the original stdout fd,
+# claimed before any untrusted code runs (_claim_protocol_channel): model
+# prints — Python-level or C-level fd-1 writes — can then never be read
+# as protocol frames (the desync class the parent-side filters only
+# mitigate). Until claimed, frames go to plain stdout (e.g. lockdown
+# errors).
+_PROTO = sys.stdout
+
+
 def _emit(frame: dict) -> None:
     # shared wire convention: numpy converts at any depth (a model's
     # predictions may nest arrays/scalars inside dicts/lists)
     from rafiki_tpu.utils.jsonutil import dumps
 
-    sys.stdout.write(dumps(frame) + "\n")
-    sys.stdout.flush()
+    _PROTO.write(dumps(frame) + "\n")
+    _PROTO.flush()
+
+
+class _PrintsToLogFrames:
+    """sys.stdout replacement: model print() output becomes MESSAGE log
+    frames on the protocol channel, line-buffered."""
+
+    def __init__(self) -> None:
+        self._buf = ""
+
+    def write(self, text: str) -> int:
+        import time
+
+        self._buf += text
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            if line:
+                _emit({"t": "log", "line": json.dumps({
+                    "type": "MESSAGE", "message": line,
+                    "time": time.time()})})
+        return len(text)
+
+    def flush(self) -> None:
+        pass
+
+    def isatty(self) -> bool:
+        return False
+
+
+def _claim_protocol_channel() -> None:
+    """Make fd 1 unusable for protocol corruption: the harness keeps a
+    private dup for frames, raw fd-1 writes land in stderr (drained by
+    the parent), and Python-level prints become log frames."""
+    global _PROTO
+
+    _PROTO = os.fdopen(os.dup(1), "w")
+    os.dup2(2, 1)
+    sys.stdout = _PrintsToLogFrames()
 
 
 def _lockdown(setup: dict) -> None:
@@ -63,6 +109,8 @@ def main() -> int:
         _emit({"t": "err", "error": "sandbox lockdown failed",
                "traceback": traceback.format_exc()})
         return 3
+
+    _claim_protocol_channel()
 
     if setup.get("mode") == "serve":
         return _serve(setup)

@@ -26,6 +26,8 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                             limits RAFIKI_SANDBOX_MEM_MB/_NOFILE)
 #   RAFIKI_PREDICTOR_PORTS=1  dedicated POST /predict port per inference
 #                             job (bind: RAFIKI_PREDICTOR_HOST)
+#   RAFIKI_SERVE_INT8=1       int8 weight-only serving for SDK-trainer
+#                             templates (docs/performance.md)
 #   RAFIKI_INSTALL_DEPS=1     provision model dependencies per set into
 #                             $RAFIKI_WORKDIR/deps (pip flags via
 #                             RAFIKI_PIP_ARGS, e.g. an offline mirror)
