@@ -76,7 +76,11 @@ ENV_ALLOWLIST_PREFIXES = (
     "PYTHON", "LC_", "LANG",
 )
 ENV_ALLOWLIST = ("PATH", "TMPDIR", "TZ", "RAFIKI_CHIP_GRANT",
-                 "RAFIKI_COMPILE_CACHE_DIR")
+                 "RAFIKI_COMPILE_CACHE_DIR",
+                 # serving-numerics switch (sdk/quant.py) — config, not a
+                 # secret; the sandboxed trainer must see the same value
+                 # the in-process path would
+                 "RAFIKI_SERVE_INT8")
 
 
 class SandboxError(Exception):
