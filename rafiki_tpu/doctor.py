@@ -87,18 +87,26 @@ def check_shm_broker() -> Check:
 
 
 def check_sandbox() -> Check:
-    from rafiki_tpu.sdk.sandbox import sandbox_enabled, sandbox_uid
+    from rafiki_tpu.sdk.sandbox import (_uid_range, sandbox_enabled,
+                                        sandbox_gid, uid_for_jail)
 
     if not sandbox_enabled():
         return ("model sandbox", WARN,
                 "RAFIKI_SANDBOX unset — uploaded model code runs with "
                 "worker privileges")
-    uid = sandbox_uid()
-    if uid is None:
+    if uid_for_jail("doctor-probe") is None:
         return ("model sandbox", WARN,
                 "enabled, but worker is not root: uid-drop layer inactive "
                 "(env scrub + jail + rlimits still apply)")
-    return ("model sandbox", PASS, f"enabled, drops to uid {uid}")
+    gid = sandbox_gid()
+    note = " (gid 0 RETAINED — RAFIKI_SANDBOX_KEEP_GID0)" if gid == 0 else ""
+    if _uid_range()[1] <= 0:
+        return ("model sandbox", WARN,
+                "enabled, but RAFIKI_SANDBOX_UID_RANGE=0: ONE shared "
+                "sandbox uid — concurrent trials are not isolated from "
+                f"each other, gid {gid}{note}")
+    return ("model sandbox", PASS,
+            f"enabled, per-trial uid drop, gid {gid}{note}")
 
 
 def check_agents() -> Check:

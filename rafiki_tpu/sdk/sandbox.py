@@ -13,25 +13,40 @@ child over a line-framed pipe protocol.
 
 Threat model (documented, not absolute):
 
-- PROTECTED against an uploaded template that tries to (a) read other
-  trials' params or mid-trial checkpoints, (b) read/modify the metadata
-  store (SQLite file), (c) see admin credentials / agent keys / store
-  paths in its environment, (d) exhaust fds or address space, or
-  (e) scribble outside its jail cwd via relative paths.
+- PROTECTED against an uploaded template that tries to (a) read OR
+  write other trials' jails (params, mid-trial checkpoints — each jail
+  is 0700 and owned by its own per-trial uid), (b) read/modify the
+  metadata store (SQLite file), (c) see admin credentials / agent keys /
+  store paths in its environment, (d) exhaust fds or address space,
+  (e) scribble outside its jail cwd via relative paths, or (f) read
+  group-root files (0640 root:root) — the credential drop clears
+  supplementary groups and drops gid too (``os.setgroups([])`` +
+  ``setgid``), unlike r4's gid-0-retained design.
   Mechanisms: scrubbed environment (allowlist), cwd jailed to a
-  per-trial directory, RLIMIT_NOFILE/RLIMIT_AS/RLIMIT_CORE, and — when
-  the worker runs as root (the TPU-VM deployment default) — a uid drop
-  to ``RAFIKI_SANDBOX_UID`` (default 65534) with gid 0 retained, so
-  owner-only files (params dir 0700, DB 0600 — enforced by
-  db/database.py and worker/train.py) are unreadable while group
-  -readable code (repo, venv) still imports.
-- NOT protected: network access (the child may dial out — the TPU
-  tunnel itself needs sockets), CPU time by default (trials legitimately
-  train for hours; TRIAL_TIMEOUT_S covers runaways via the stop
-  protocol), and uid-drop isolation is unavailable when the worker
-  itself runs unprivileged — then only the env scrub + cwd jail +
-  rlimits apply. Full containment still calls for VMs/gVisor at the
-  fleet boundary.
+  per-trial directory, RLIMIT_NOFILE/RLIMIT_AS/RLIMIT_CORE,
+  PR_SET_NO_NEW_PRIVS, and — when the worker runs as root (the TPU-VM
+  deployment default) — a drop to a PER-TRIAL uid (hashed from the jail
+  name into [RAFIKI_SANDBOX_UID_BASE, +RAFIKI_SANDBOX_UID_RANGE); set
+  RAFIKI_SANDBOX_UID_RANGE=0 for the r4-style single
+  ``RAFIKI_SANDBOX_UID``) and to gid ``RAFIKI_SANDBOX_GID`` (default
+  65534; ``RAFIKI_SANDBOX_KEEP_GID0=1`` restores gid 0 for deployments
+  whose TPU device nodes are group-0 gated). Owner-only files (params
+  dir 0700, DB 0600 — enforced by db/database.py and worker/train.py)
+  and sibling jails are unreadable; world-readable code (repo, venv,
+  stdlib) still imports — the grants the parent makes to ensure that
+  (directory-traversal bits along the repo/dataset paths) are logged.
+- NOT protected BY DEFAULT: network access — the child shares the host
+  network namespace because the TPU tunnel itself needs sockets, so a
+  hostile template can dial loopback control-plane ports (which is why
+  the admin REST requires JWTs and agents require keys even from
+  localhost). ``RAFIKI_SANDBOX_NETNS=1`` closes this for CPU-only
+  trials by unsharing the network namespace (child keeps a down
+  loopback, no reachability at all). Also not bounded: CPU time
+  (trials legitimately train for hours; TRIAL_TIMEOUT_S covers
+  runaways via the stop protocol). Uid-drop isolation is unavailable
+  when the worker itself runs unprivileged — then only the env scrub +
+  cwd jail + rlimits apply. Full containment still calls for VMs/gVisor
+  at the fleet boundary.
 
 Protocol (child = python -m rafiki_tpu.sdk.sandbox_child):
 
@@ -93,11 +108,56 @@ def sandbox_enabled() -> bool:
 
 
 def sandbox_uid() -> Optional[int]:
-    """Uid to drop to, or None when the worker is unprivileged (no drop
-    possible — the remaining layers still apply)."""
+    """The fixed fallback uid (RAFIKI_SANDBOX_UID_RANGE=0 mode), or None
+    when the worker is unprivileged (no drop possible — the remaining
+    layers still apply). Per-jail uids come from :func:`uid_for_jail`."""
     if os.geteuid() != 0:
         return None
     return int(os.environ.get("RAFIKI_SANDBOX_UID", "65534"))
+
+
+def _uid_range() -> Tuple[int, int]:
+    base = int(os.environ.get("RAFIKI_SANDBOX_UID_BASE", "210000"))
+    rng = int(os.environ.get("RAFIKI_SANDBOX_UID_RANGE", "4096"))
+    return base, rng
+
+
+def uid_for_jail(jail_dir: str) -> Optional[int]:
+    """Uid the child in this jail drops to. STICKY: once make_jail has
+    chowned the jail, its owner IS the answer (so a resumed trial maps
+    to the uid that wrote its mid-trial checkpoint even across a
+    base/range reconfiguration — and collision probing stays stable).
+    For a jail that doesn't exist yet, the basename (trial id / serve
+    id) hashes into [RAFIKI_SANDBOX_UID_BASE, +RAFIKI_SANDBOX_UID_RANGE)
+    — make_jail then probes that choice against live sibling jails.
+    Distinct uids + 0700 jails are what isolate concurrent trials from
+    EACH OTHER (advisor r4 finding: a shared uid let one trial corrupt a
+    sibling's checkpoint). Range 0 restores the single shared
+    RAFIKI_SANDBOX_UID. None when the worker is unprivileged."""
+    if os.geteuid() != 0:
+        return None
+    base, rng = _uid_range()
+    if rng <= 0:
+        return sandbox_uid()
+    try:
+        owner = os.stat(jail_dir).st_uid
+        if base <= owner < base + rng:
+            return owner
+    except OSError:
+        pass
+    import zlib
+
+    ident = os.path.basename(os.path.abspath(jail_dir))
+    return base + (zlib.crc32(ident.encode()) % rng)
+
+
+def sandbox_gid() -> int:
+    """Gid the child drops to. Default 65534 (nogroup); gid 0 only via
+    the explicit RAFIKI_SANDBOX_KEEP_GID0=1 escape hatch (TPU device
+    nodes gated on group 0 in some deployments)."""
+    if os.environ.get("RAFIKI_SANDBOX_KEEP_GID0") == "1":
+        return 0
+    return int(os.environ.get("RAFIKI_SANDBOX_GID", "65534"))
 
 
 def _child_env(jail_dir: str) -> Dict[str, str]:
@@ -111,37 +171,52 @@ def _child_env(jail_dir: str) -> Dict[str, str]:
     return env
 
 
-def _ensure_group_traversal(path: str) -> None:
-    """Give gid-0 the directory-execute bit on every ancestor this uid
-    owns, so the uid-dropped child (gid 0 retained) can reach its jail
-    and datasets; never widens beyond group, never touches files we
-    don't own."""
+def _ensure_traversal(path: str, read: bool = False) -> None:
+    """Give the dropped child directory-traversal (execute) bits on
+    ``path`` and every ancestor this uid owns — group AND other x, since
+    the child may run with gid 0 (KEEP_GID0 mode) or an anonymous gid.
+    ``read=True`` additionally grants read on ``path`` itself (package
+    roots need listing for import; ancestors never do). Never touches
+    files we don't own; every widening is LOGGED (advisor r4: these are
+    system-visible side effects — e.g. /root gains o+x so the jailed
+    uid can reach /root/repo — and operators must be able to see them)."""
+    travers = stat.S_IXGRP | stat.S_IXOTH
     p = os.path.abspath(path)
+    want = travers | (stat.S_IRGRP | stat.S_IROTH if read else 0)
     while True:
         try:
             st = os.stat(p)
-            if st.st_uid == os.getuid() and not st.st_mode & stat.S_IXGRP:
-                os.chmod(p, st.st_mode | stat.S_IXGRP | stat.S_IRGRP)
+            if st.st_uid == os.getuid() and (st.st_mode & want) != want:
+                os.chmod(p, st.st_mode | want)
+                logger.info(
+                    "sandbox: widened %s %o -> %o (traversal grant for "
+                    "jailed uids)", p, stat.S_IMODE(st.st_mode),
+                    stat.S_IMODE(st.st_mode | want))
         except OSError:
             pass
         parent = os.path.dirname(p)
         if parent == p:
             return
         p = parent
+        want = travers  # ancestors get x only, never read
 
 
 def grant_dataset_access(uri: str) -> None:
     """Local-file dataset URIs must be readable by the jailed uid: add
-    group-read on the file and traversal on its ancestors (no-ops for
-    http(s) URIs and files we don't own)."""
+    group+other read on the file and traversal on its ancestors (no-ops
+    for http(s) URIs and files we don't own)."""
     path = uri[7:] if uri.startswith("file://") else uri
     if not os.path.isabs(path) or not os.path.exists(path):
         return
-    _ensure_group_traversal(os.path.dirname(path))
+    _ensure_traversal(os.path.dirname(path))
     try:
         st = os.stat(path)
-        if st.st_uid == os.getuid():
-            os.chmod(path, st.st_mode | stat.S_IRGRP)
+        want = stat.S_IRGRP | stat.S_IROTH
+        if st.st_uid == os.getuid() and (st.st_mode & want) != want:
+            os.chmod(path, st.st_mode | want)
+            logger.info("sandbox: widened dataset %s %o -> %o", path,
+                        stat.S_IMODE(st.st_mode),
+                        stat.S_IMODE(st.st_mode | want))
     except OSError:
         pass
 
@@ -153,12 +228,82 @@ def jail_path(base_dir: str, trial_id: str) -> str:
 
 
 def make_jail(base_dir: str, trial_id: str) -> str:
-    """Per-trial jail cwd: group-writable (the dropped uid keeps gid 0),
-    stable across worker restarts so mid-trial checkpoints resume."""
+    """Per-trial jail cwd: 0700 and owned by THIS trial's uid (when the
+    worker is root), so sibling trials — distinct uids, no shared
+    group — can neither read nor corrupt its mid-trial checkpoints.
+    Stable across worker restarts (an existing jail keeps its owner uid,
+    see uid_for_jail) so checkpoints resume; a fresh jail's hashed uid
+    is linear-probed against every sibling jail's owner so two LIVE
+    trials can never silently share a uid (review r5: crc32 % 4096
+    collides with ~50% odds by ~75 jails)."""
     jail = jail_path(base_dir, trial_id)
+    existed = os.path.isdir(jail)
     os.makedirs(jail, exist_ok=True)
-    os.chmod(jail, 0o770)
-    _ensure_group_traversal(jail)
+    uid = uid_for_jail(jail)
+    if uid is not None:
+        base, rng = _uid_range()
+        sticky = False
+        if existed and rng > 0:
+            try:
+                owner = os.stat(jail).st_uid
+                sticky = base <= owner < base + rng
+            except OSError:
+                pass
+        if rng > 0 and not sticky:
+            # Serialize (probe + chown) across worker processes sharing
+            # this WORKDIR: without the flock, two jails hashing to the
+            # same uid could both probe before either chown lands and
+            # silently share a uid (review r5 TOCTOU). A sibling that is
+            # still root-owned inside the lock is a creator WAITING on
+            # this lock — reserve the uid its name hashes to.
+            import fcntl
+            import zlib
+
+            parent = os.path.dirname(jail)
+            lockf = open(os.path.join(parent, ".uidlock"), "a")
+            try:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                taken = set()
+                for name in os.listdir(parent):
+                    p = os.path.join(parent, name)
+                    if p == jail or not os.path.isdir(p):
+                        continue
+                    try:
+                        owner = os.stat(p).st_uid
+                    except OSError:
+                        continue
+                    if base <= owner < base + rng:
+                        taken.add(owner)
+                    else:
+                        taken.add(base + (zlib.crc32(name.encode()) % rng))
+                for _ in range(rng):
+                    if uid not in taken:
+                        break
+                    uid = base + ((uid - base + 1) % rng)
+                else:
+                    logger.warning(
+                        "sandbox: uid range exhausted (%d jails in a "
+                        "range of %d) — jail %s SHARES uid %d with a "
+                        "live sibling; raise RAFIKI_SANDBOX_UID_RANGE",
+                        len(taken), rng, jail, uid)
+                os.chown(jail, uid, sandbox_gid())
+            finally:
+                lockf.close()  # releases the flock
+        else:
+            os.chown(jail, uid, sandbox_gid())
+        # a pre-existing jail may hold files owned under an earlier
+        # uid scheme (r4's shared 65534, or a base/range edit): rechown
+        # them or the resumed child can't read its own checkpoint
+        for root, dirs, files in os.walk(jail):
+            for name in dirs + files:
+                p = os.path.join(root, name)
+                try:
+                    if os.lstat(p).st_uid != uid:
+                        os.lchown(p, uid, sandbox_gid())
+                except OSError:
+                    pass
+    os.chmod(jail, 0o700)
+    _ensure_traversal(os.path.dirname(jail))
     return jail
 
 
@@ -167,7 +312,9 @@ def _base_setup(jail_dir: str) -> Dict[str, Any]:
     add a new rlimit or env knob."""
     return {
         "jail_dir": jail_dir,
-        "drop_uid": sandbox_uid(),
+        "drop_uid": uid_for_jail(jail_dir),
+        "drop_gid": sandbox_gid(),
+        "netns": os.environ.get("RAFIKI_SANDBOX_NETNS") == "1",
         "nofile": int(os.environ.get("RAFIKI_SANDBOX_NOFILE", "1024")),
         "mem_mb": int(os.environ.get("RAFIKI_SANDBOX_MEM_MB", "0")),
     }
@@ -183,10 +330,11 @@ def _spawn_child(jail_dir: str, extra_pythonpath: Optional[str]):
         # per-model dependency prefix (sdk/deps.py) — pins shadow base
         env["PYTHONPATH"] = (
             extra_pythonpath + os.pathsep + env["PYTHONPATH"])
-        _ensure_group_traversal(extra_pythonpath)
-    # the dropped uid (gid 0 kept) must still import this package — give
-    # group traversal along the repo path (e.g. /root is 0700 by default)
-    _ensure_group_traversal(_REPO_ROOT)
+        _ensure_traversal(extra_pythonpath, read=True)
+    # the dropped uid must still import this package — grant traversal
+    # along the repo path (e.g. /root is 0700 by default) and listing on
+    # the package root itself (import's FileFinder lists it)
+    _ensure_traversal(_REPO_ROOT, read=True)
     # NOT start_new_session: the child must die with the worker's process
     # group (a stopped/killed worker may never reach explicit teardown)
     proc = subprocess.Popen(

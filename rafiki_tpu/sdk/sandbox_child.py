@@ -80,6 +80,32 @@ def _claim_protocol_channel() -> None:
     sys.stdout = _PrintsToLogFrames()
 
 
+def _unshare_netns() -> None:
+    """Detach from the host network namespace (opt-in,
+    RAFIKI_SANDBOX_NETNS=1): the child keeps only a down loopback, so it
+    cannot reach the admin/agent control plane or dial out at all. Must
+    run before the uid drop (needs CAP_SYS_ADMIN); incompatible with
+    trials that use the TPU tunnel (which needs sockets)."""
+    import ctypes
+
+    CLONE_NEWNET = 0x40000000
+    libc = ctypes.CDLL(None, use_errno=True)
+    if libc.unshare(CLONE_NEWNET) != 0:
+        err = ctypes.get_errno()
+        raise OSError(err, "unshare(CLONE_NEWNET): " + os.strerror(err))
+
+
+def _no_new_privs() -> None:
+    """prctl(PR_SET_NO_NEW_PRIVS): execve of setuid/setcap binaries can
+    never re-escalate this process tree. Best-effort (old kernels)."""
+    import ctypes
+
+    try:
+        ctypes.CDLL(None, use_errno=True).prctl(38, 1, 0, 0, 0)
+    except Exception:
+        pass
+
+
 def _lockdown(setup: dict) -> None:
     resource.setrlimit(resource.RLIMIT_CORE, (0, 0))
     nofile = int(setup.get("nofile") or 0)
@@ -91,14 +117,29 @@ def _lockdown(setup: dict) -> None:
         resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
     os.chdir(setup["jail_dir"])
     drop_uid = setup.get("drop_uid")
-    if drop_uid and os.geteuid() == 0:
-        # gid 0 is RETAINED: group-readable code (repo, venv, datasets)
-        # stays importable while owner-only state (params 0700, DB 0600)
-        # becomes unreadable — the protection boundary of the threat
-        # model in sdk/sandbox.py
-        os.setgroups([])
-        os.setgid(0)
-        os.setuid(int(drop_uid))
+    if setup.get("netns") and os.geteuid() != 0:
+        # fail LOUDLY: silently skipping would leave the operator
+        # believing loopback is unreachable when it isn't
+        raise PermissionError(
+            "RAFIKI_SANDBOX_NETNS=1 requires a root worker "
+            "(unshare(CLONE_NEWNET) needs CAP_SYS_ADMIN)")
+    if os.geteuid() == 0:
+        if setup.get("netns"):
+            _unshare_netns()
+        if drop_uid:
+            # FULL credential drop: supplementary groups cleared, gid
+            # dropped to the sandbox gid (65534 by default — gid 0 is
+            # retained only when the operator sets
+            # RAFIKI_SANDBOX_KEEP_GID0=1 for deployments whose TPU
+            # device nodes are group-0 gated), then the per-trial uid.
+            # Group-root files (0640 root:root) and sibling trials'
+            # 0700 jails are unreachable; world-readable code (repo,
+            # venv, stdlib) stays importable — the protection boundary
+            # of the threat model in sdk/sandbox.py.
+            os.setgroups([])
+            os.setgid(int(setup.get("drop_gid", 65534)))
+            os.setuid(int(drop_uid))
+    _no_new_privs()
 
 
 def main() -> int:

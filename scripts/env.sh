@@ -22,8 +22,13 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 
 # Optional hardening / serving features (docs/deployment.md):
 #   RAFIKI_SANDBOX=1          run untrusted model code in locked-down
-#                             children (uid drop RAFIKI_SANDBOX_UID,
-#                             limits RAFIKI_SANDBOX_MEM_MB/_NOFILE)
+#                             children: per-trial uid drop (base/range
+#                             RAFIKI_SANDBOX_UID_BASE/_UID_RANGE; 0700
+#                             jails), gid drop (RAFIKI_SANDBOX_GID;
+#                             KEEP_GID0=1 to retain group root), limits
+#                             RAFIKI_SANDBOX_MEM_MB/_NOFILE; optional
+#                             RAFIKI_SANDBOX_NETNS=1 network unshare
+#                             for CPU-only trials
 #   RAFIKI_PREDICTOR_PORTS=1  dedicated POST /predict port per inference
 #                             job (bind: RAFIKI_PREDICTOR_HOST)
 #   RAFIKI_SERVE_INT8=1       int8 weight-only serving for SDK-trainer

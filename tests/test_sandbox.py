@@ -22,7 +22,9 @@ from rafiki_tpu.sdk.sandbox import (
     SandboxError,
     make_jail,
     run_trial_sandboxed,
+    sandbox_gid,
     sandbox_uid,
+    uid_for_jail,
 )
 
 BENIGN = textwrap.dedent("""
@@ -152,6 +154,224 @@ def test_hostile_template_cannot_reach_protected_state(jail, tmp_path):
         del os.environ["RAFIKI_DB_PATH"]
         del os.environ["RAFIKI_AGENT_KEY"]
     assert score == 0.0, f"containment breach bitmask: {score}"
+
+
+# Filesystem probe: tries exact reads/listings/writes the hardened
+# credential drop must block; reports what got through as a bitmask
+# score (0.0 = fully contained). Paths arrive ':'-joined in knobs.
+FILE_PROBE = textwrap.dedent("""
+    import os
+    from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+    class Prober(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return {"read_paths": FixedKnob(""),
+                    "list_paths": FixedKnob(""),
+                    "write_paths": FixedKnob("")}
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._knobs = knobs
+            self._breach = 0.0
+
+        def train(self, uri):
+            bit = 1.0
+            for p in self._knobs["read_paths"].split(":"):
+                if p:
+                    try:
+                        open(p, "rb").read()
+                        self._breach += bit
+                    except OSError:
+                        pass
+                    bit *= 2
+            for p in self._knobs["list_paths"].split(":"):
+                if p:
+                    try:
+                        os.listdir(p)
+                        self._breach += bit
+                    except OSError:
+                        pass
+                    bit *= 2
+            for p in self._knobs["write_paths"].split(":"):
+                if p:
+                    try:
+                        with open(p, "ab") as f:
+                            f.write(b"corrupted")
+                        self._breach += bit
+                    except OSError:
+                        pass
+                    bit *= 2
+
+        def evaluate(self, uri):
+            return self._breach
+
+        def predict(self, queries):
+            return queries
+
+        def dump_parameters(self):
+            return {"x": [0.0]}
+
+        def load_parameters(self, p):
+            pass
+    """).encode()
+
+
+def _probe_breach(jail, read="", list_="", write=""):
+    _, sink = _collect_logs()
+    score, _ = run_trial_sandboxed(
+        FILE_PROBE, "Prober",
+        {"read_paths": read, "list_paths": list_, "write_paths": write},
+        "uri://t", "uri://e", jail, on_log_line=sink)
+    return score
+
+
+@pytest.mark.skipif(os.geteuid() != 0,
+                    reason="credential-drop isolation needs a root worker")
+def test_gid_drop_blocks_group_root_files(tmp_path, monkeypatch):
+    """r5 hardening regression: a 0640 root:root file was READABLE under
+    r4's gid-0-retained drop; the full gid drop must deny it — unless the
+    operator explicitly opts back in with RAFIKI_SANDBOX_KEEP_GID0=1."""
+    secret = tmp_path / "group-secret.txt"
+    secret.write_text("root-group only")
+    os.chown(secret, 0, 0)
+    secret.chmod(0o640)
+    jail = make_jail(str(tmp_path), "gid-trial")
+    assert _probe_breach(jail, read=str(secret)) == 0.0
+
+    monkeypatch.setenv("RAFIKI_SANDBOX_KEEP_GID0", "1")
+    jail2 = make_jail(str(tmp_path), "gid-trial-2")
+    assert _probe_breach(jail2, read=str(secret)) == 1.0
+
+
+@pytest.mark.skipif(os.geteuid() != 0,
+                    reason="credential-drop isolation needs a root worker")
+def test_sibling_jails_are_isolated(tmp_path):
+    """Advisor r4 medium: with a shared uid + 0770 jails, one trial could
+    read AND corrupt a sibling's mid-trial checkpoint. Per-trial uids +
+    0700 jails must block read, listing, and write."""
+    jail_a = make_jail(str(tmp_path), "trial-a")
+    jail_b = make_jail(str(tmp_path), "trial-b")
+    uid_a, uid_b = uid_for_jail(jail_a), uid_for_jail(jail_b)
+    assert uid_a != uid_b, "hash-derived uids collided for distinct trials"
+    # the victim checkpoint as child B would have written it
+    ckpt = os.path.join(jail_b, "trial.ckpt")
+    with open(ckpt, "wb") as f:
+        f.write(b"victim checkpoint")
+    os.chown(ckpt, uid_b, sandbox_gid())
+    os.chmod(ckpt, 0o600)
+    breach = _probe_breach(
+        jail_a, read=ckpt, list_=jail_b,
+        write=":".join([ckpt, os.path.join(jail_b, "planted.txt")]))
+    assert breach == 0.0, f"sibling-jail breach bitmask: {breach}"
+    assert open(ckpt, "rb").read() == b"victim checkpoint"
+
+
+@pytest.mark.skipif(os.geteuid() != 0,
+                    reason="uid allocation needs a root worker")
+def test_uid_allocation_probes_collisions_and_resumes_sticky(
+        tmp_path, monkeypatch):
+    """Review r5: hashed uids must linear-probe around LIVE siblings
+    (range 2 forces any second jail into the collision path), an
+    existing jail must keep its owner uid on resume, and stale contents
+    from an earlier uid scheme must be rechowned."""
+    monkeypatch.setenv("RAFIKI_SANDBOX_UID_RANGE", "2")
+    a = make_jail(str(tmp_path), "t-a")
+    b = make_jail(str(tmp_path), "t-b")
+    ua, ub = os.stat(a).st_uid, os.stat(b).st_uid
+    assert ua != ub
+    assert uid_for_jail(a) == ua  # sticky: owner wins over the hash
+    ckpt = os.path.join(a, "trial.ckpt")
+    with open(ckpt, "wb") as f:
+        f.write(b"old-scheme checkpoint")
+    os.chown(ckpt, 65534, 0)  # r4's shared-uid scheme
+    a2 = make_jail(str(tmp_path), "t-a")
+    assert os.stat(a2).st_uid == ua
+    assert os.stat(ckpt).st_uid == ua  # resumed child can read it again
+
+
+NET_PROBE = textwrap.dedent("""
+    import socket
+    from rafiki_tpu.sdk import BaseModel, FixedKnob
+
+    class NetProbe(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return {"port": FixedKnob(0)}
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._knobs = knobs
+            self._reached = 0.0
+
+        def train(self, uri):
+            try:
+                s = socket.create_connection(
+                    ("127.0.0.1", int(self._knobs["port"])), timeout=5)
+                s.sendall(b"hello-from-jail")
+                s.close()
+                self._reached = 1.0
+            except OSError:
+                pass
+
+        def evaluate(self, uri):
+            return self._reached
+
+        def predict(self, queries):
+            return queries
+
+        def dump_parameters(self):
+            return {"x": [0.0]}
+
+        def load_parameters(self, p):
+            pass
+    """).encode()
+
+
+@pytest.fixture()
+def loopback_server():
+    import socket
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    srv.settimeout(0.2)
+    yield srv.getsockname()[1], srv
+    srv.close()
+
+
+def _probe_net(jail, port):
+    _, sink = _collect_logs()
+    score, _ = run_trial_sandboxed(
+        NET_PROBE, "NetProbe", {"port": port}, "uri://t", "uri://e", jail,
+        on_log_line=sink)
+    return score
+
+
+def test_loopback_is_reachable_by_default(tmp_path, loopback_server):
+    """Documents the DEFAULT network boundary: the child shares the host
+    netns (the TPU tunnel needs sockets), so loopback control-plane
+    ports are dialable — which is why admin REST/agents require auth
+    even from localhost (threat model, sdk/sandbox.py)."""
+    port, _srv = loopback_server
+    jail = make_jail(str(tmp_path), "net-default")
+    assert _probe_net(jail, port) == 1.0
+
+
+@pytest.mark.skipif(os.geteuid() != 0,
+                    reason="netns unshare needs a root worker")
+def test_netns_blocks_loopback(tmp_path, loopback_server, monkeypatch):
+    """RAFIKI_SANDBOX_NETNS=1 (CPU-only trials): the unshared netns has
+    only a down loopback — the admin/agent ports must be unreachable."""
+    monkeypatch.setenv("RAFIKI_SANDBOX_NETNS", "1")
+    port, _srv = loopback_server
+    jail = make_jail(str(tmp_path), "net-isolated")
+    try:
+        assert _probe_net(jail, port) == 0.0
+    except SandboxError as e:
+        if "unshare" in str(e):
+            pytest.skip(f"netns unshare unavailable here: {e}")
+        raise
 
 
 def test_stop_protocol_truncates_training(jail):
