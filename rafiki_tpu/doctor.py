@@ -110,24 +110,39 @@ def check_sandbox() -> Check:
 
 
 def check_agents() -> Check:
-    from rafiki_tpu.utils.agent_http import call_agent
+    from rafiki_tpu.utils.agent_http import AgentHTTPError, call_agent
 
     agents = [a.strip() for a in os.environ.get("RAFIKI_AGENTS", "").split(",")
               if a.strip()]
     if not agents:
         return ("host agents", PASS, "single-host (RAFIKI_AGENTS unset)")
     key = os.environ.get("RAFIKI_AGENT_KEY")
-    down = []
+    down, rejected = [], []
     total = 0
     for addr in agents:
         try:
             inv = call_agent(addr, "GET", "/inventory", key=key, timeout_s=5)
             total += int(inv.get("total_chips", 0))
+        except AgentHTTPError as e:
+            # a live agent refusing the key is a CONFIG problem, not an
+            # outage — agents are keyed by default since r5
+            (rejected if e.code in (401, 403) else down).append(addr)
         except Exception:
             down.append(addr)
+    if rejected:
+        why = ("RAFIKI_AGENT_KEY unset on this admin" if not key
+               else "this admin's RAFIKI_AGENT_KEY does not match")
+        return ("host agents", FAIL,
+                f"key rejected by: {rejected} ({why}; copy the agents' "
+                "agent.key here)")
     if down:
         return ("host agents", FAIL if len(down) == len(agents) else WARN,
                 f"unreachable: {down} (fleet chips visible: {total})")
+    if not key:
+        return ("host agents", WARN,
+                f"{len(agents)} agent(s), {total} fleet chips — keyless "
+                "admin talking to RAFIKI_AGENT_INSECURE agents; set a "
+                "fleet key")
     return ("host agents", PASS,
             f"{len(agents)} agent(s), {total} fleet chips")
 

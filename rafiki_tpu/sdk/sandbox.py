@@ -122,6 +122,15 @@ def _uid_range() -> Tuple[int, int]:
     return base, rng
 
 
+def _hashed_uid(ident: str, base: int, rng: int) -> int:
+    """THE uid-hash: make_jail's collision probe reserves this value for
+    still-root-owned sibling jails, so it must stay byte-identical with
+    what uid_for_jail computes — one copy only."""
+    import zlib
+
+    return base + (zlib.crc32(ident.encode()) % rng)
+
+
 def uid_for_jail(jail_dir: str) -> Optional[int]:
     """Uid the child in this jail drops to. STICKY: once make_jail has
     chowned the jail, its owner IS the answer (so a resumed trial maps
@@ -145,10 +154,8 @@ def uid_for_jail(jail_dir: str) -> Optional[int]:
             return owner
     except OSError:
         pass
-    import zlib
-
-    ident = os.path.basename(os.path.abspath(jail_dir))
-    return base + (zlib.crc32(ident.encode()) % rng)
+    return _hashed_uid(os.path.basename(os.path.abspath(jail_dir)),
+                       base, rng)
 
 
 def sandbox_gid() -> int:
@@ -257,11 +264,16 @@ def make_jail(base_dir: str, trial_id: str) -> str:
             # still root-owned inside the lock is a creator WAITING on
             # this lock — reserve the uid its name hashes to.
             import fcntl
-            import zlib
 
             parent = os.path.dirname(jail)
-            lockf = open(os.path.join(parent, ".uidlock"), "a")
+            # 0600 — the lock lives in a tree jailed children can
+            # traverse, and flock works on a read-only fd: a hostile
+            # template holding it would wedge all future jail creation
+            lock_fd = os.open(os.path.join(parent, ".uidlock"),
+                              os.O_WRONLY | os.O_CREAT, 0o600)
+            lockf = os.fdopen(lock_fd, "w")
             try:
+                os.fchmod(lock_fd, 0o600)  # pre-existing wider file
                 fcntl.flock(lockf, fcntl.LOCK_EX)
                 taken = set()
                 for name in os.listdir(parent):
@@ -275,7 +287,7 @@ def make_jail(base_dir: str, trial_id: str) -> str:
                     if base <= owner < base + rng:
                         taken.add(owner)
                     else:
-                        taken.add(base + (zlib.crc32(name.encode()) % rng))
+                        taken.add(_hashed_uid(name, base, rng))
                 for _ in range(rng):
                     if uid not in taken:
                         break

@@ -1,0 +1,34 @@
+"""Shared route-boundary field validation.
+
+The dedicated predictor port (predictor/server.py) and the agent predict
+relay (placement/agent.py) both accept a client-supplied ``timeout_s``;
+this is the single copy of its validate+clamp rule so the two doors
+cannot drift (review r5: the copies had already diverged on the 0 case).
+Reference analogue: none — the reference's predictor app read no client
+timeout at all (/root/reference/rafiki/predictor/app.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+
+def parse_timeout_s(
+    value: object,
+    default: float,
+    cap: float = 300.0,
+) -> Tuple[Optional[float], Optional[str]]:
+    """Validate a client-supplied timeout. Returns ``(timeout_s, None)``
+    on success or ``(None, error)`` for a 400: malformed input is the
+    CLIENT's error, and an unbounded (or NaN) value could pin a handler
+    thread past any deadline."""
+    if value is None:
+        return float(default), None
+    try:
+        t = float(value)  # bools are numbers here; fine
+    except (TypeError, ValueError):
+        return None, "timeout_s must be a number"
+    if not math.isfinite(t) or t <= 0:
+        return None, "timeout_s must be a positive finite number"
+    return min(t, cap), None

@@ -11,7 +11,11 @@
 #   RAFIKI_AGENT_PORT   bind port (default 7070)
 #   RAFIKI_AGENT_CHIPS  comma-sep chip indices this host contributes
 #                       (default: all visible devices)
-#   RAFIKI_AGENT_KEY    shared secret (set it when binding non-loopback)
+#   RAFIKI_AGENT_KEY    shared secret; generated into
+#                       $RAFIKI_WORKDIR/agent.key on first start if unset —
+#                       copy that file to every host and the admin
+#                       (RAFIKI_AGENT_INSECURE=1 to run keyless, NOT
+#                       recommended off-loopback)
 #   RAFIKI_ADMIN_ADDR   host:port of the admin server
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -20,6 +24,19 @@ source scripts/env.sh
 export RAFIKI_AGENT_HOST="${RAFIKI_AGENT_HOST:-0.0.0.0}"
 export RAFIKI_AGENT_PORT="${RAFIKI_AGENT_PORT:-7070}"
 mkdir -p "$RAFIKI_WORKDIR/logs"
+
+# Secure by default: the agent refuses to start keyless unless
+# RAFIKI_AGENT_INSECURE=1. Generate + persist a fleet key on first use.
+if [ -z "${RAFIKI_AGENT_KEY:-}" ] && [ "${RAFIKI_AGENT_INSECURE:-0}" != "1" ]; then
+    KEY_FILE="$RAFIKI_WORKDIR/agent.key"
+    if [ ! -f "$KEY_FILE" ]; then
+        umask 077
+        python -c "import secrets; print(secrets.token_hex(24))" > "$KEY_FILE"
+        echo "generated agent key at $KEY_FILE — copy it to every host's" \
+             "\$RAFIKI_WORKDIR and export RAFIKI_AGENT_KEY on the admin"
+    fi
+    export RAFIKI_AGENT_KEY="$(cat "$KEY_FILE")"
+fi
 AGENT_LOG="$RAFIKI_WORKDIR/logs/agent.log"
 AGENT_PID="$RAFIKI_WORKDIR/agent.pid"
 
@@ -30,7 +47,10 @@ fi
 
 nohup python -m rafiki_tpu.placement.agent >"$AGENT_LOG" 2>&1 &
 echo $! > "$AGENT_PID"
-for _ in $(seq 1 40); do
+# generous: chip discovery runs a bounded backend probe (up to
+# RAFIKI_BACKEND_PROBE_TIMEOUT_S, default 75 s) when RAFIKI_AGENT_CHIPS
+# is unset
+for _ in $(seq 1 240); do
     if ! kill -0 "$(cat "$AGENT_PID")" 2>/dev/null; then
         echo "agent failed to start; log tail:" >&2
         tail -20 "$AGENT_LOG" >&2

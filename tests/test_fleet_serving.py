@@ -149,7 +149,8 @@ def test_inference_spreads_across_two_agents_and_serves(tmp_workdir):
     from rafiki_tpu.db.database import Database
     from rafiki_tpu.placement.hosts import HostAgentPlacementManager
 
-    from tests.test_hosts_placement import FIXTURE, _free_port, _spawn_agent
+    from tests.test_hosts_placement import (TEST_KEY, FIXTURE, _free_port,
+                                            _spawn_agent)
 
     db_path = tmp_workdir / "rafiki.sqlite3"
     admin_port = _free_port()
@@ -161,7 +162,7 @@ def test_inference_spreads_across_two_agents_and_serves(tmp_workdir):
             agents.append(addr)
 
         db = Database(str(db_path))
-        placement = HostAgentPlacementManager(agents, db=db)
+        placement = HostAgentPlacementManager(agents, db=db, key=TEST_KEY)
         admin = Admin(
             db=db, placement=placement,
             params_dir=str(tmp_workdir / "params"),
@@ -266,6 +267,50 @@ def test_inference_tries_next_agent_on_refusal():
     assert ctx.chips == [0]
     # the relay queue was registered against the agent that accepted
     assert "svc-1" in placement.broker.get_worker_queues("job-1")
+
+
+def test_inference_continues_past_undone_ambiguous_create():
+    """An agent whose create died on the wire but whose undo was
+    CONFIRMED is excluded and the loop must continue to untried agents —
+    not break to the local fallback (advisor r4 low: the break
+    contradicted the try-every-agent contract)."""
+    from rafiki_tpu.constants import ServiceType
+    from rafiki_tpu.placement.hosts import (
+        AgentUnreachableError,
+        HostAgentPlacementManager,
+    )
+
+    placement = HostAgentPlacementManager(["a:1", "b:2"])
+    placement.set_broker(FleetBroker(InProcessBroker()))
+    placement._inventories = lambda: [
+        ("a:1", {"free_chips": 1, "n_services": 0, "total_chips": 1}),
+        ("b:2", {"free_chips": 1, "n_services": 1, "total_chips": 1}),
+    ]
+
+    class VanishesButUndoes:
+        key = None
+
+        def create_service(self, *a, **k):
+            raise AgentUnreachableError("timed out mid-create")
+
+        def stop_service(self, sid, wait):
+            pass  # undo confirmed
+
+    class Accepts:
+        key = None
+
+        def create_service(self, sid, stype, n, best, extra):
+            return [0]
+
+        def stop_service(self, sid, wait):
+            pass
+
+    placement.agents = {"a:1": VanishesButUndoes(), "b:2": Accepts()}
+    ctx = placement.create_service(
+        "svc-3", ServiceType.INFERENCE, n_chips=1, best_effort_chips=True,
+        extra={"inference_job_id": "job-3"})
+    assert placement.placements()["svc-3"] == "b:2"
+    assert ctx.chips == [0]
 
 
 def test_ambiguous_agent_create_propagates_when_undo_fails():

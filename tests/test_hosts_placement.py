@@ -20,6 +20,8 @@ from rafiki_tpu.placement.hosts import HostAgentPlacementManager
 
 FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fake_model.py")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# agents are auth-gated by default (r5); the whole fleet shares one key
+TEST_KEY = "test-fleet-key"
 
 
 def _free_port() -> int:
@@ -33,6 +35,7 @@ def _spawn_agent(chips, db_path, workdir, admin_port):
     env.update({
         "RAFIKI_AGENT_CHIPS": ",".join(str(c) for c in chips),
         "RAFIKI_AGENT_PORT": "0",
+        "RAFIKI_AGENT_KEY": TEST_KEY,
         "RAFIKI_DB_PATH": str(db_path),
         "RAFIKI_WORKDIR": str(workdir),
         "RAFIKI_ADMIN_ADDR": f"127.0.0.1:{admin_port}",
@@ -67,7 +70,7 @@ def test_train_job_spreads_across_two_agents(tmp_workdir):
             agents.append(addr)
 
         db = Database(str(db_path))
-        placement = HostAgentPlacementManager(agents, db=db)
+        placement = HostAgentPlacementManager(agents, db=db, key=TEST_KEY)
         admin = Admin(
             db=db,
             placement=placement,
@@ -126,6 +129,7 @@ def _spawn_agent_no_admin(chips, db_path, workdir):
     env.update({
         "RAFIKI_AGENT_CHIPS": ",".join(str(c) for c in chips),
         "RAFIKI_AGENT_PORT": "0",
+        "RAFIKI_AGENT_KEY": TEST_KEY,
         "RAFIKI_DB_PATH": str(db_path),
         "RAFIKI_WORKDIR": str(workdir),
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
@@ -146,6 +150,70 @@ def _spawn_agent_no_admin(chips, db_path, workdir):
     raise RuntimeError("agent did not start")
 
 
+def test_agent_api_is_auth_gated_by_default():
+    """r5 hardening (verdict r4 weak #5): a keyless agent refuses every
+    placement/relay route unless RAFIKI_AGENT_INSECURE=1 was explicit;
+    a keyed agent 401s wrong/missing keys. Only /healthz stays open."""
+    from rafiki_tpu.placement.agent import AgentServer
+    from rafiki_tpu.placement.manager import ChipAllocator
+    from rafiki_tpu.placement.process import ProcessPlacementManager
+    from rafiki_tpu.utils.agent_http import AgentHTTPError, call_agent
+
+    def _status(addr, path, key=None):
+        try:
+            call_agent(addr, "GET", path, key=key, timeout_s=5)
+            return 200
+        except AgentHTTPError as e:
+            return e.code
+
+    engine = ProcessPlacementManager(allocator=ChipAllocator([0]))
+    # keyed agent: right key passes, wrong/missing key is 401
+    srv = AgentServer(engine, key="sekrit").start()
+    addr = f"127.0.0.1:{srv.port}"
+    try:
+        assert _status(addr, "/inventory", key="sekrit") == 200
+        assert _status(addr, "/inventory", key="wrong") == 401
+        assert _status(addr, "/inventory") == 401
+        assert _status(addr, "/healthz") == 200  # liveness stays open
+    finally:
+        srv.stop()
+
+    # keyless WITHOUT the explicit insecure opt-in: locked down
+    engine2 = ProcessPlacementManager(allocator=ChipAllocator([0]))
+    srv2 = AgentServer(engine2).start()
+    addr2 = f"127.0.0.1:{srv2.port}"
+    try:
+        assert _status(addr2, "/inventory") == 403
+        assert _status(addr2, "/healthz") == 200
+    finally:
+        srv2.stop()
+
+    # keyless WITH the opt-in: open (trusted-network mode)
+    engine3 = ProcessPlacementManager(allocator=ChipAllocator([0]))
+    srv3 = AgentServer(engine3, allow_insecure=True).start()
+    addr3 = f"127.0.0.1:{srv3.port}"
+    try:
+        assert _status(addr3, "/inventory") == 200
+    finally:
+        srv3.stop()
+
+
+def test_agent_process_refuses_to_start_keyless(tmp_workdir):
+    env = dict(os.environ)
+    env.update({
+        "RAFIKI_DB_PATH": str(tmp_workdir / "db.sqlite3"),
+        "RAFIKI_WORKDIR": str(tmp_workdir),
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("RAFIKI_AGENT_KEY", None)
+    env.pop("RAFIKI_AGENT_INSECURE", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.placement.agent"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "RAFIKI_AGENT_KEY required" in proc.stderr
+
+
 @pytest.mark.slow
 def test_job_completes_without_agent_event_forwarding(tmp_workdir):
     # an agent with NO RAFIKI_ADMIN_ADDR cannot forward status events or
@@ -156,7 +224,7 @@ def test_job_completes_without_agent_event_forwarding(tmp_workdir):
     proc, addr = _spawn_agent_no_admin([0, 1], db_path, tmp_workdir)
     try:
         db = Database(str(db_path))
-        placement = HostAgentPlacementManager([addr], db=db,
+        placement = HostAgentPlacementManager([addr], db=db, key=TEST_KEY,
                                               monitor_interval_s=0.2)
         admin = Admin(db=db, placement=placement,
                       params_dir=str(tmp_workdir / "params"))

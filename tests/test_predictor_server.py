@@ -81,6 +81,21 @@ def test_dedicated_port_serves_with_admin_token(deployed_app):
     # move)
     assert admin.predict(uid, "portapp", [[0.0]])
 
+    # timeout_s is validated + clamped at the route boundary (advisor
+    # r4: malformed must be a 400, not a 500; huge values are capped
+    # server-side like the agent relay's min(timeout, 300))
+    status, payload = _post(host, port, "/predict",
+                            {"queries": [[0.0]], "timeout_s": "soon"},
+                            token=token)
+    assert status == 400 and "timeout_s" in payload["error"]
+    status, _ = _post(host, port, "/predict",
+                      {"queries": [[0.0]], "timeout_s": -3}, token=token)
+    assert status == 400
+    status, payload = _post(host, port, "/predict",
+                            {"queries": [[0.0]], "timeout_s": 1e12},
+                            token=token)
+    assert status == 200 and len(payload["data"]["predictions"]) == 1
+
 
 def test_client_predict_direct(deployed_app, tmp_workdir):
     admin, uid, token = deployed_app
