@@ -117,18 +117,29 @@ def check_agents() -> Check:
     if not agents:
         return ("host agents", PASS, "single-host (RAFIKI_AGENTS unset)")
     key = os.environ.get("RAFIKI_AGENT_KEY")
-    down, rejected = [], []
+    down, rejected, locked = [], [], []
     total = 0
     for addr in agents:
         try:
             inv = call_agent(addr, "GET", "/inventory", key=key, timeout_s=5)
             total += int(inv.get("total_chips", 0))
         except AgentHTTPError as e:
-            # a live agent refusing the key is a CONFIG problem, not an
-            # outage — agents are keyed by default since r5
-            (rejected if e.code in (401, 403) else down).append(addr)
+            # a live agent refusing the request is a CONFIG problem, not
+            # an outage — agents are keyed by default since r5. 401 =
+            # key mismatch (fix on the admin side); 403 = the AGENT has
+            # no key and no insecure opt-in (fix on the agent side)
+            if e.code == 401:
+                rejected.append(addr)
+            elif e.code == 403:
+                locked.append(addr)
+            else:
+                down.append(addr)
         except Exception:
             down.append(addr)
+    if locked:
+        return ("host agents", FAIL,
+                f"locked (keyless, no RAFIKI_AGENT_INSECURE): {locked} — "
+                "configure RAFIKI_AGENT_KEY on those agents")
     if rejected:
         why = ("RAFIKI_AGENT_KEY unset on this admin" if not key
                else "this admin's RAFIKI_AGENT_KEY does not match")

@@ -24,7 +24,10 @@ def parse_timeout_s(
     CLIENT's error, and an unbounded (or NaN) value could pin a handler
     thread past any deadline."""
     if value is None:
-        return float(default), None
+        # the cap bounds the DEFAULT too: an operator-raised
+        # PREDICT_TIMEOUT_S must not pin handler threads longer than any
+        # explicit client value could
+        return min(float(default), cap), None
     try:
         t = float(value)  # bools are numbers here; fine
     except (TypeError, ValueError):

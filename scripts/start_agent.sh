@@ -29,9 +29,13 @@ mkdir -p "$RAFIKI_WORKDIR/logs"
 # RAFIKI_AGENT_INSECURE=1. Generate + persist a fleet key on first use.
 if [ -z "${RAFIKI_AGENT_KEY:-}" ] && [ "${RAFIKI_AGENT_INSECURE:-0}" != "1" ]; then
     KEY_FILE="$RAFIKI_WORKDIR/agent.key"
-    if [ ! -f "$KEY_FILE" ]; then
+    # -s (not -f): an interrupted generation must not leave a 0-byte key
+    # that silently wedges every later start; temp+mv keeps it atomic
+    if [ ! -s "$KEY_FILE" ]; then
         umask 077
-        python -c "import secrets; print(secrets.token_hex(24))" > "$KEY_FILE"
+        python -c "import secrets; print(secrets.token_hex(24))" \
+            > "$KEY_FILE.tmp"
+        mv "$KEY_FILE.tmp" "$KEY_FILE"
         echo "generated agent key at $KEY_FILE — copy it to every host's" \
              "\$RAFIKI_WORKDIR and export RAFIKI_AGENT_KEY on the admin"
     fi
