@@ -414,6 +414,7 @@ class Admin:
         # stops before running any trial (tested behavior)
         as_float(BudgetType.TIME_HOURS, 0)
         as_float(BudgetType.TRIAL_TIMEOUT_S, 0, exclusive=True)
+        as_int(BudgetType.CHIPS_PER_WORKER, 1)
 
     def get_train_job(
         self, user_id: str, app: str, app_version: int = -1
@@ -555,8 +556,17 @@ class Admin:
     # -- inference jobs ----------------------------------------------------------
 
     def create_inference_job(
-        self, user_id: str, app: str, app_version: int = -1
+        self, user_id: str, app: str, app_version: int = -1,
+        budget: Optional[Dict[str, Any]] = None,
     ) -> Dict:
+        """``budget`` (serving-side, optional): ``CHIPS_PER_WORKER`` >= 1
+        grants every inference worker a multi-chip mesh, so one model
+        serves its pjit'd predict sharded across chips (the serving
+        analogue of CHIPS_PER_TRIAL; the reference was hard-wired to one
+        GPU per serving worker, reference services_manager.py:390-395)."""
+        # malformed input 400s regardless of job state (route-boundary
+        # validation, same policy as create_train_job)
+        self._validate_budget(budget or {})
         job = self.db.get_train_job_by_app_version(user_id, app, app_version)
         if job is None:
             raise InvalidRequestError(f"No such train job {app} v{app_version}")
@@ -570,7 +580,7 @@ class Admin:
             raise InvalidRequestError(
                 "An inference job is already running for this train job"
             )
-        inf = self.db.create_inference_job(user_id, job["id"])
+        inf = self.db.create_inference_job(user_id, job["id"], budget=budget)
         self.services.create_inference_services(inf["id"])
         return self.get_inference_job(user_id, app, job["app_version"])
 
@@ -629,6 +639,10 @@ class Admin:
             if psvc:
                 predictor_host = psvc.get("host")
                 predictor_port = psvc.get("port")
+        def _chips(service_id: str) -> list:
+            svc = self.db.get_service(service_id)
+            return (svc or {}).get("chips") or []
+
         return {
             "id": inf["id"],
             "train_job_id": job["id"],
@@ -637,10 +651,12 @@ class Admin:
             "predictor_host": predictor_host,
             "predictor_port": predictor_port,
             "status": inf["status"],
+            "budget": inf.get("budget") or {},
             "datetime_started": inf["datetime_started"],
             "datetime_stopped": inf["datetime_stopped"],
             "workers": [
-                {"service_id": w["service_id"], "trial_id": w["trial_id"]}
+                {"service_id": w["service_id"], "trial_id": w["trial_id"],
+                 "chips": _chips(w["service_id"])}
                 for w in workers
             ],
         }

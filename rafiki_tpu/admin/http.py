@@ -214,7 +214,8 @@ class AdminServer:
             # inference jobs
             r("POST", "/inference_jobs", _APP_DEVS, lambda au, m, b, q:
                 A.create_inference_job(
-                    au["user_id"], _field(b, "app"), b.get("app_version", -1))),
+                    au["user_id"], _field(b, "app"), b.get("app_version", -1),
+                    budget=b.get("budget"))),
             r("GET", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)", _ANY,
                 lambda au, m, b, q: A.get_inference_job(
                     au["user_id"], m["app"], int(m["v"]))),

@@ -241,10 +241,34 @@ class ServicesManager:
         # reference's 2 replicas each got their own GPU,
         # reference services_manager.py:390-395 + config.py:10-11).
         n_replicas = config.INFERENCE_WORKER_REPLICAS_PER_TRIAL
+        # CHIPS_PER_WORKER (inference budget): every serving executor gets
+        # a multi-chip mesh — its worker sets the device grant
+        # (worker/inference.py) and the model's pjit'd predict shards the
+        # batch/params over those chips. The serving analogue of
+        # CHIPS_PER_TRIAL; the reference pinned serving to 1 GPU/worker
+        # (reference services_manager.py:390-395).
+        budget = inf_job.get("budget") or {}
+        chips_per_worker = max(
+            int(budget.get(BudgetType.CHIPS_PER_WORKER, 1)), 1)
         alloc = getattr(self._placement, "allocator", None)
         if alloc is not None:
+            # one worker's grant can never span hosts: clamp to the
+            # largest single-host inventory, exactly like the
+            # CHIPS_PER_TRIAL clamp above (fleet-total would let a
+            # 6-chip ask through a 2x4-chip fleet and silently degrade
+            # to the local fallback)
+            max_per_service = getattr(
+                alloc, "max_chips_per_service", alloc.total_chips)
+            if chips_per_worker > max_per_service > 0:
+                logger.warning(
+                    "CHIPS_PER_WORKER=%d exceeds the largest host "
+                    "(%d chips); downsizing the serving mesh",
+                    chips_per_worker, max_per_service)
+                chips_per_worker = max_per_service
             n_replicas = max(1, min(
-                n_replicas, alloc.total_chips // max(len(best_trials), 1)))
+                n_replicas,
+                alloc.total_chips
+                // max(len(best_trials) * chips_per_worker, 1)))
         try:
             for trial in best_trials:
                 for _ in range(n_replicas):
@@ -263,7 +287,7 @@ class ServicesManager:
                             service["id"],
                             ServiceType.INFERENCE,
                             worker.start,
-                            n_chips=1,
+                            n_chips=chips_per_worker,
                             best_effort_chips=True,
                             extra={"inference_job_id": inference_job_id,
                                    "trial_id": trial["id"]},

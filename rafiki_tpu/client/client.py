@@ -214,10 +214,14 @@ class Client:
 
     # -- inference jobs ----------------------------------------------------------
 
-    def create_inference_job(self, app: str, app_version: int = -1) -> Dict:
-        return self._call(
-            "POST", "/inference_jobs", {"app": app, "app_version": app_version}
-        )
+    def create_inference_job(self, app: str, app_version: int = -1,
+                             budget: Optional[Dict] = None) -> Dict:
+        """``budget={"CHIPS_PER_WORKER": n}`` serves each worker on an
+        n-chip mesh (sharded predict) — see Admin.create_inference_job."""
+        body = {"app": app, "app_version": app_version}
+        if budget is not None:
+            body["budget"] = budget
+        return self._call("POST", "/inference_jobs", body)
 
     def get_inference_job(self, app: str, app_version: int = -1) -> Dict:
         return self._call("GET", f"/inference_jobs/{app}/{app_version}")

@@ -40,6 +40,11 @@ class BudgetType:
     # the score its partial training earned — a runaway knob draw cannot
     # hold an executor forever.
     TRIAL_TIMEOUT_S = "TRIAL_TIMEOUT_S"
+    # Chips granted to EACH inference worker (new capability): >1 gives a
+    # serving executor a multi-chip mesh, so a model too big (or too slow)
+    # for one chip serves its pjit'd predict sharded over ICI — the serving
+    # analogue of CHIPS_PER_TRIAL. Passed in create_inference_job's budget.
+    CHIPS_PER_WORKER = "CHIPS_PER_WORKER"
 
 
 class TaskType:

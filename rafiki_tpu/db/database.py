@@ -113,6 +113,7 @@ CREATE TABLE IF NOT EXISTS inference_job (
     train_job_id TEXT NOT NULL REFERENCES train_job(id),
     status TEXT NOT NULL,
     predictor_service_id TEXT,
+    budget TEXT,
     datetime_started REAL NOT NULL,
     datetime_stopped REAL
 );
@@ -301,6 +302,31 @@ class Database:
         conn_str = db_path or config.DB_PATH
         self._lock = threading.RLock()
         self._b = _make_backend(conn_str)
+        self._migrate()
+
+    # additive migrations for stores created by earlier versions — the
+    # CREATE TABLE IF NOT EXISTS schema pass never alters existing tables
+    _MIGRATIONS = (
+        # r5: inference jobs gained a serving budget (CHIPS_PER_WORKER)
+        "ALTER TABLE inference_job ADD COLUMN budget TEXT",
+    )
+
+    def _migrate(self) -> None:
+        for stmt in self._MIGRATIONS:
+            with self._lock:
+                try:
+                    self._b.execute(stmt)
+                except Exception as e:
+                    # duplicate-column: the store is already current
+                    # (both backends run statement-at-a-time autocommit,
+                    # so a failed ALTER leaves no broken transaction).
+                    # Anything ELSE is a real failure and must stay loud
+                    # — a silently missing column would surface later as
+                    # a confusing unrelated error.
+                    msg = str(e).lower()
+                    if not ("duplicate column" in msg
+                            or "already exists" in msg):
+                        raise
 
     @property
     def path(self) -> str:
@@ -755,33 +781,44 @@ class Database:
 
     # -- inference jobs ------------------------------------------------------
 
-    def create_inference_job(self, user_id: str, train_job_id: str) -> Dict:
+    def create_inference_job(self, user_id: str, train_job_id: str,
+                             budget: Optional[Dict[str, Any]] = None) -> Dict:
         iid = uuid.uuid4().hex
         self._exec(
             "INSERT INTO inference_job (id, user_id, train_job_id, status,"
-            " datetime_started) VALUES (?,?,?,?,?)",
-            (iid, user_id, train_job_id, InferenceJobStatus.STARTED, time.time()),
+            " budget, datetime_started) VALUES (?,?,?,?,?,?)",
+            (iid, user_id, train_job_id, InferenceJobStatus.STARTED,
+             json.dumps(budget or {}), time.time()),
         )
         return self.get_inference_job(iid)  # type: ignore[return-value]
 
+    @staticmethod
+    def _parse_inference_budget(row: Optional[Dict]) -> Optional[Dict]:
+        # NULL budget: row predates the r5 migration — treat as empty
+        if row is not None:
+            row["budget"] = json.loads(row["budget"] or "{}")
+        return row
+
     def get_inference_job(self, inference_job_id: str) -> Optional[Dict]:
-        return self._one(
+        return self._parse_inference_budget(self._one(
             "SELECT * FROM inference_job WHERE id=?", (inference_job_id,)
-        )
+        ))
 
     def get_inference_jobs_of_train_job(self, train_job_id: str) -> List[Dict]:
-        return self._all(
+        rows = self._all(
             "SELECT * FROM inference_job WHERE train_job_id=?"
             " ORDER BY datetime_started DESC",
             (train_job_id,),
         )
+        return [self._parse_inference_budget(r) for r in rows]
 
     def get_inference_jobs_by_statuses(self, statuses: List[str]) -> List[Dict]:
         marks = ",".join("?" * len(statuses))
-        return self._all(
+        rows = self._all(
             f"SELECT * FROM inference_job WHERE status IN ({marks})",
             tuple(statuses),
         )
+        return [self._parse_inference_budget(r) for r in rows]
 
     def get_train_jobs_by_statuses(self, statuses: List[str]) -> List[Dict]:
         marks = ",".join("?" * len(statuses))
@@ -795,10 +832,10 @@ class Database:
     def get_running_inference_job_of_train_job(
         self, train_job_id: str
     ) -> Optional[Dict]:
-        return self._one(
+        return self._parse_inference_budget(self._one(
             "SELECT * FROM inference_job WHERE train_job_id=? AND status IN (?,?)",
             (train_job_id, InferenceJobStatus.STARTED, InferenceJobStatus.RUNNING),
-        )
+        ))
 
     def update_inference_job_predictor(
         self, inference_job_id: str, predictor_service_id: str

@@ -48,8 +48,11 @@ if [ ! -f "$PGDATA/PG_VERSION" ]; then
     # multi-host production setup points RAFIKI_DB_URL at a managed server
     "${RUNAS[@]}" initdb -D "$PGDATA" -A trust -U "$PGUSER" >/dev/null
 fi
-"${RUNAS[@]}" pg_ctl -D "$PGDATA" -w -l "$PGLOG" \
-    -o "-p $PGPORT -h $PGHOST -k $PGDATA" start
+# idempotent: re-running with a live postmaster just reprints the URL
+if ! "${RUNAS[@]}" pg_ctl -D "$PGDATA" status >/dev/null 2>&1; then
+    "${RUNAS[@]}" pg_ctl -D "$PGDATA" -w -l "$PGLOG" \
+        -o "-p $PGPORT -h $PGHOST -k $PGDATA" start
+fi
 "${RUNAS[@]}" createdb -h "$PGHOST" -p "$PGPORT" -U "$PGUSER" rafiki \
     2>/dev/null || true
 

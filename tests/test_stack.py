@@ -104,6 +104,58 @@ def test_full_train_inference_cycle(admin, model_bytes):
         admin.predict(uid, "myapp", [[0.0]])
 
 
+def test_multichip_serving_budget(admin, model_bytes):
+    """CHIPS_PER_WORKER (r5, verdict r4 next #7): every inference worker
+    gets a multi-chip grant — the serving analogue of CHIPS_PER_TRIAL —
+    so one model serves its pjit'd predict over a mesh. The worker sets
+    the device grant from ctx.chips (worker/inference.py:141); here the
+    observable contract is the exclusive 2-chip grant per worker and a
+    working predict path."""
+    auth = _login(admin)
+    uid = auth["user_id"]
+    admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION", model_bytes,
+                       "FakeModel")
+    admin.create_train_job(
+        uid, "meshserve", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 1},
+    )
+    admin.wait_until_train_job_stopped(uid, "meshserve", timeout_s=30)
+
+    inf = admin.create_inference_job(uid, "meshserve",
+                                     budget={"CHIPS_PER_WORKER": 2})
+    assert inf["status"] == InferenceJobStatus.RUNNING
+    assert inf["budget"] == {"CHIPS_PER_WORKER": 2}
+    # the 4-chip allocator fits 2 two-chip workers for the single trial
+    assert len(inf["workers"]) == 2
+    for w in inf["workers"]:
+        assert len(w["chips"]) == 2, w
+    # grants are disjoint (exclusive chips, not shared)
+    all_chips = [c for w in inf["workers"] for c in w["chips"]]
+    assert len(set(all_chips)) == len(all_chips)
+    preds = admin.predict(uid, "meshserve", [[0.0], [1.0]])
+    assert len(preds) == 2
+    admin.stop_inference_job(uid, "meshserve")
+    # serving teardown releases chips when worker threads exit
+    # (destroy wait=False): wait for the grant to come home
+    deadline = time.monotonic() + 15
+    while (admin.placement.allocator.free_chips < 4
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert admin.placement.allocator.free_chips == 4
+
+    # a budget too big for the host downsizes instead of failing
+    inf2 = admin.create_inference_job(uid, "meshserve",
+                                      budget={"CHIPS_PER_WORKER": 64})
+    workers2 = inf2["workers"]
+    assert workers2 and all(len(w["chips"]) == 4 for w in workers2)
+    admin.stop_inference_job(uid, "meshserve")
+
+    # malformed budgets 400 at the boundary
+    with pytest.raises(InvalidRequestError):
+        admin.create_inference_job(uid, "meshserve",
+                                   budget={"CHIPS_PER_WORKER": 0})
+
+
 def test_train_job_auto_versioning_and_isolation(admin, model_bytes):
     auth = _login(admin)
     uid = auth["user_id"]
