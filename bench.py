@@ -38,6 +38,8 @@ N_TRAIN = int(os.environ.get("RAFIKI_BENCH_TRAIN_N", 8192))
 N_TEST = int(os.environ.get("RAFIKI_BENCH_TEST_N", 2048))
 N_CLIENTS = int(os.environ.get("RAFIKI_BENCH_CLIENTS", 32))
 N_REQS_PER_CLIENT = int(os.environ.get("RAFIKI_BENCH_REQS", 40))
+BENCH_ASHA = os.environ.get("RAFIKI_BENCH_ASHA", "1") not in ("0", "false")
+N_ASHA_TRIALS = int(os.environ.get("RAFIKI_BENCH_ASHA_TRIALS", 6))
 BENCH_MODELS = os.environ.get("RAFIKI_BENCH_MODELS", "1") not in ("0", "false")
 REFERENCE_TRIALS_PER_HOUR = 12.0  # see module docstring
 REFERENCE_P50_FLOOR_MS = 250.0
@@ -67,6 +69,49 @@ class BenchCnn(JaxCnn):
         # (defaults are the TPU measurement config)
         cfg["base_channels"] = FixedKnob(
             int(_os.environ.get("RAFIKI_BENCH_CNN_CHANNELS", "32")))
+        cfg["batch_size"] = FixedKnob(
+            int(_os.environ.get("RAFIKI_BENCH_CNN_BATCH", "256")))
+        return cfg
+
+
+class BenchCnnMulti(BenchCnn):
+    # multi-epoch variant for the ASHA phase: early stopping can only
+    # save work when a trial's full budget exceeds the first rung
+    @staticmethod
+    def get_knob_config():
+        import os as _os
+
+        cfg = dict(BenchCnn.get_knob_config())
+        cfg["epochs"] = FixedKnob(
+            int(_os.environ.get("RAFIKI_BENCH_ASHA_EPOCHS", "3")))
+        return cfg
+"""
+    return src
+
+
+def make_bench_pop_model_bytes() -> bytes:
+    """The population template (one trial = a vmapped population of
+    learning rates) with compute-affecting knobs pinned, for the
+    effective-search phase: each completed trial evaluates
+    population_size configurations."""
+    with open(
+        os.path.join(REPO, "examples", "models", "image_classification",
+                     "JaxCnnPopulation.py"), "rb",
+    ) as f:
+        src = f.read()
+    src += b"""
+
+class BenchCnnPop(JaxCnnPopulation):
+    @staticmethod
+    def get_knob_config():
+        import os as _os
+
+        cfg = dict(JaxCnnPopulation.get_knob_config())
+        cfg["epochs"] = FixedKnob(
+            int(_os.environ.get("RAFIKI_BENCH_ASHA_EPOCHS", "3")))
+        cfg["base_channels"] = FixedKnob(
+            int(_os.environ.get("RAFIKI_BENCH_CNN_CHANNELS", "32")))
+        cfg["population_size"] = FixedKnob(4)
         cfg["batch_size"] = FixedKnob(
             int(_os.environ.get("RAFIKI_BENCH_CNN_BATCH", "256")))
         return cfg
@@ -215,6 +260,58 @@ def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
     return out
 
 
+def _bench_asha(admin, uid: str, train_uri: str, test_uri: str) -> dict:
+    """Two identical multi-epoch HPO runs — EARLY_STOP off, then on —
+    reporting effective trials/hour side by side (verdict r4 next #8:
+    ASHA's throughput multiplier was prose, not a measurement). The
+    reference has no early stopping at all: every trial always trains
+    its full budget."""
+    epochs = int(os.environ.get("RAFIKI_BENCH_ASHA_EPOCHS", "3"))
+    out = {"trials": N_ASHA_TRIALS, "epochs_per_trial": epochs}
+    runs = (
+        ("plain", {}, "bench_cnn_multi", 1),
+        ("asha", {"EARLY_STOP": 1, "ASHA_MIN_EPOCHS": 1},
+         "bench_cnn_multi", 1),
+        # population: one trial trains a vmapped population of 4 learning
+        # rates for ~one member's wall time — configs/hour is the
+        # effective-search rate (SURVEY §7.3 "many trials per chip")
+        ("asha_pop", {"EARLY_STOP": 1, "ASHA_MIN_EPOCHS": 1},
+         "bench_cnn_pop", 4),
+    )
+    for label, extra, model_name, configs_per_trial in runs:
+        app = f"benchasha-{label}"
+        t0 = time.monotonic()
+        admin.create_train_job(
+            uid, app, "IMAGE_CLASSIFICATION", train_uri, test_uri,
+            budget={"MODEL_TRIAL_COUNT": N_ASHA_TRIALS, "CHIP_COUNT": 1,
+                    **extra},
+            model_names=[model_name],
+        )
+        admin.wait_until_train_job_stopped(uid, app, timeout_s=3600)
+        wall = time.monotonic() - t0
+        trials = admin.get_trials_of_train_job(uid, app)
+        n_done = sum(1 for t in trials if t["status"] == "COMPLETED")
+        best = max((t["score"] for t in trials if t["score"] is not None),
+                   default=None)
+        out[f"{label}_trials_per_hour"] = round(n_done / (wall / 3600.0), 1)
+        if configs_per_trial > 1:
+            out[f"{label}_configs_per_hour"] = round(
+                n_done * configs_per_trial / (wall / 3600.0), 1)
+        out[f"{label}_wall_s"] = round(wall, 1)
+        out[f"{label}_completed"] = n_done
+        out[f"{label}_best_accuracy_surrogate"] = (
+            round(best, 4) if best is not None else None)
+    plain = out.get("plain_trials_per_hour")
+    if plain:
+        if out.get("asha_trials_per_hour"):
+            out["effective_speedup_asha"] = round(
+                out["asha_trials_per_hour"] / plain, 2)
+        if out.get("asha_pop_configs_per_hour"):
+            out["effective_speedup_asha_pop"] = round(
+                out["asha_pop_configs_per_hour"] / plain, 2)
+    return out
+
+
 def main():
     from rafiki_tpu import config
     from rafiki_tpu.admin.admin import Admin
@@ -279,12 +376,25 @@ def main():
                 uid, "bench_cnn", "IMAGE_CLASSIFICATION",
                 make_bench_model_bytes(), "BenchCnn",
             )
+            if BENCH_ASHA:
+                admin.create_model(
+                    uid, "bench_cnn_multi", "IMAGE_CLASSIFICATION",
+                    make_bench_model_bytes(), "BenchCnnMulti",
+                )
+                admin.create_model(
+                    uid, "bench_cnn_pop", "IMAGE_CLASSIFICATION",
+                    make_bench_pop_model_bytes(), "BenchCnnPop",
+                )
 
             # ---- train: N_TRIALS HPO trials on one chip ----------------
             t0 = time.monotonic()
             admin.create_train_job(
                 uid, "benchapp", "IMAGE_CLASSIFICATION", train_uri, test_uri,
                 budget={"MODEL_TRIAL_COUNT": N_TRIALS, "CHIP_COUNT": 1},
+                # pin the model: without this the job trains EVERY
+                # registered model of the task — including the ASHA
+                # phase's multi-epoch variant
+                model_names=["bench_cnn"],
             )
             admin.wait_until_train_job_stopped(uid, "benchapp", timeout_s=3600)
             train_wall = time.monotonic() - t0
@@ -302,7 +412,51 @@ def main():
             serving = bench_serving_unloaded(server.port, "benchapp", query)
             serving.update(
                 bench_serving_concurrent(server.port, "benchapp", query))
+            admin.stop_inference_job(uid, "benchapp")
+
+            # ---- int8 weight-only serving: on/off delta ----------------
+            # The quant story's bandwidth win is a TPU-format property
+            # (docs/performance.md); measure it instead of claiming it.
+            if os.environ.get("RAFIKI_BENCH_INT8", "1") not in ("0", "false"):
+                try:
+                    # serving teardown releases chips when worker threads
+                    # exit (destroy wait=False): wait for the grant to
+                    # come home, or the int8 worker lands on a degraded
+                    # best-effort grant and the comparison is invalid
+                    alloc = getattr(admin.placement, "allocator", None)
+                    deadline = time.monotonic() + 30
+                    while (alloc is not None
+                           and alloc.free_chips < alloc.total_chips
+                           and time.monotonic() < deadline):
+                        time.sleep(0.1)
+                    os.environ["RAFIKI_SERVE_INT8"] = "1"
+                    admin.create_inference_job(uid, "benchapp")
+                    int8 = bench_serving_unloaded(
+                        server.port, "benchapp", query)
+                    p50_i8 = int8.get("serving_unloaded_p50_ms")
+                    serving["int8_unloaded_p50_ms"] = p50_i8
+                    base = serving.get("serving_unloaded_p50_ms")
+                    if base and p50_i8:
+                        serving["int8_unloaded_speedup"] = round(
+                            base / p50_i8, 3)
+                except Exception as e:
+                    serving["int8_error"] = repr(e)
+                finally:
+                    os.environ.pop("RAFIKI_SERVE_INT8", None)
             admin.stop_all_jobs()
+
+            # ---- ASHA: effective search throughput, side by side -------
+            # Same multi-epoch budget with and without EARLY_STOP: ASHA
+            # cuts uncompetitive trials at the first rung, so the search
+            # finishes the same trial COUNT in less wall time (the
+            # reference always trains every trial to completion). Errors
+            # here never cost the primary metric.
+            asha = {"error": None}
+            if BENCH_ASHA:
+                try:
+                    asha = _bench_asha(admin, uid, train_uri, test_uri)
+                except Exception as e:
+                    asha = {"error": repr(e)}
         finally:
             server.stop()
             admin.shutdown()
@@ -328,6 +482,8 @@ def main():
         "backend": jax.default_backend(),
         **serving,
     }
+    if BENCH_ASHA:
+        result["asha"] = asha
     if os.environ.get("RAFIKI_BENCH_FALLBACK_REASON"):
         # this run is the CPU-fallback re-exec: label it so the numbers
         # can't be mistaken for TPU results
@@ -379,6 +535,9 @@ def _cpu_fallback_env(reason: str) -> dict:
     env.setdefault("RAFIKI_BENCH_CLIENTS", "4")
     env.setdefault("RAFIKI_BENCH_REQS", "5")
     env.setdefault("RAFIKI_BENCH_MODELS", "0")
+    # the ASHA side-by-side doubles the train phase — a CPU liveness
+    # record doesn't need it (the TPU run measures it)
+    env.setdefault("RAFIKI_BENCH_ASHA", "0")
     env.setdefault("RAFIKI_BENCH_CNN_CHANNELS", "8")
     env.setdefault("RAFIKI_BENCH_CNN_BATCH", "64")
     return env

@@ -22,12 +22,11 @@ def parse_timeout_s(
     """Validate a client-supplied timeout. Returns ``(timeout_s, None)``
     on success or ``(None, error)`` for a 400: malformed input is the
     CLIENT's error, and an unbounded (or NaN) value could pin a handler
-    thread past any deadline."""
+    thread past any deadline. The cap bounds CLIENT values only — the
+    default is the operator's PREDICT_TIMEOUT_S, trusted config (a
+    long-predict deployment may legitimately set it above the cap)."""
     if value is None:
-        # the cap bounds the DEFAULT too: an operator-raised
-        # PREDICT_TIMEOUT_S must not pin handler threads longer than any
-        # explicit client value could
-        return min(float(default), cap), None
+        return float(default), None
     try:
         t = float(value)  # bools are numbers here; fine
     except (TypeError, ValueError):

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Probe the TPU tunnel every 10 min; on first success fire tpu_when_live.sh
+cd /root/repo
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 100 python -c "
+from rafiki_tpu.utils.backend_probe import probe_device_count
+n, err = probe_device_count(timeout_s=75)
+print(n if n else 'WEDGED:'+str(err))
+" 2>&1 | tail -1)
+  echo "$ts $out" >> /root/repo/logs/tpu_probe.log
+  case "$out" in
+    [1-9]*)
+      echo "$ts TPU LIVE ($out devices)" >> /root/repo/logs/tpu_probe.log
+      "$(dirname "$0")/tpu_when_live.sh" &
+      ;;
+  esac
+  sleep 600
+done
