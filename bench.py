@@ -417,6 +417,9 @@ def main():
             # ---- int8 weight-only serving: on/off delta ----------------
             # The quant story's bandwidth win is a TPU-format property
             # (docs/performance.md); measure it instead of claiming it.
+            # NOTE: the env toggle reaches the serving worker because the
+            # bench Admin is pinned to in-process LocalPlacementManager
+            # above — workers read RAFIKI_SERVE_INT8 in this interpreter
             if os.environ.get("RAFIKI_BENCH_INT8", "1") not in ("0", "false"):
                 try:
                     # serving teardown releases chips when worker threads

@@ -73,7 +73,8 @@ def bench_vit(batch_size: int = 192, image_size: int = 224,
               remat: Optional[str] = "dots",
               scan_unroll: int = 1,
               use_flash: Optional[bool] = None,
-              mu_bf16: bool = False) -> Dict[str, Any]:
+              mu_bf16: bool = False,
+              fused_qkv: bool = False) -> Dict[str, Any]:
     """ViT-B/16 fused train step (fwd+bwd+adamw), bf16 activations, donated
     buffers, multi-step scan per dispatch, dots-saveable remat (batches
     this size do not fit 16 GB HBM with full activation stashing).
@@ -96,7 +97,7 @@ def bench_vit(batch_size: int = 192, image_size: int = 224,
     cfg = dataclasses.replace(
         cfg, encoder=dataclasses.replace(
             cfg.encoder, remat=remat, scan_unroll=scan_unroll,
-            use_flash=use_flash))
+            use_flash=use_flash, fused_qkv=fused_qkv))
     params = jax.jit(lambda r: vit.init(r, cfg))(jax.random.key(0))
     opt = optax.adamw(
         1e-3, mu_dtype=jnp.bfloat16 if mu_bf16 else None)
@@ -148,6 +149,7 @@ def bench_vit(batch_size: int = 192, image_size: int = 224,
         "scan_unroll": scan_unroll,
         "use_flash": use_flash,
         "mu_bf16": mu_bf16,
+        "fused_qkv": fused_qkv,
         "steps_per_call": steps_per_call,
         "step_time_ms": round(step_s * 1000, 2),
         "steps_per_s": round(1.0 / step_s, 3),
@@ -277,29 +279,35 @@ def sweep_vit() -> None:
                for f in os.environ.get("RAFIKI_SWEEP_FLASH", "auto").split(",")]
     mus = [m == "bf16" for m in os.environ.get(
         "RAFIKI_SWEEP_MU", "f32,bf16").split(",")]
+    qkvs = [q == "1" for q in os.environ.get(
+        "RAFIKI_SWEEP_QKV", "0,1").split(",")]
     best = None
     for remat in remats:
         for unroll in unrolls:
             for flash in flashes:
                 for mu in mus:
-                    for batch in batches:
-                        tag = {"batch": batch, "remat": remat,
-                               "unroll": unroll, "flash": flash,
-                               "mu_bf16": mu}
-                        try:
-                            r = bench_vit(batch_size=batch, remat=remat,
-                                          scan_unroll=unroll,
-                                          use_flash=flash, mu_bf16=mu)
-                        except Exception as e:  # e.g. OOM without remat
+                    for qkv in qkvs:
+                        for batch in batches:
+                            tag = {"batch": batch, "remat": remat,
+                                   "unroll": unroll, "flash": flash,
+                                   "mu_bf16": mu, "fused_qkv": qkv}
+                            try:
+                                r = bench_vit(batch_size=batch, remat=remat,
+                                              scan_unroll=unroll,
+                                              use_flash=flash, mu_bf16=mu,
+                                              fused_qkv=qkv)
+                            except Exception as e:  # e.g. OOM without remat
+                                print(json.dumps(
+                                    {**tag, "error": repr(e)[:300]}),
+                                    flush=True)
+                                continue
                             print(json.dumps(
-                                {**tag, "error": repr(e)[:300]}), flush=True)
-                            continue
-                        print(json.dumps(
-                            {**tag, "mfu": r["mfu"],
-                             "images_per_s": r["images_per_s"],
-                             "step_time_ms": r["step_time_ms"]}), flush=True)
-                        if best is None or r["mfu"] > best[1]["mfu"]:
-                            best = (tag, r)
+                                {**tag, "mfu": r["mfu"],
+                                 "images_per_s": r["images_per_s"],
+                                 "step_time_ms": r["step_time_ms"]}),
+                                flush=True)
+                            if best is None or r["mfu"] > best[1]["mfu"]:
+                                best = (tag, r)
     if best is not None:
         print(json.dumps({"best": best[0], "result": best[1]}), flush=True)
 

@@ -71,6 +71,12 @@ class TransformerConfig:
     # and cannot overlap otherwise) at the cost of unroll x compile time.
     # Single-chip throughput knob; numerics identical.
     scan_unroll: int = 1
+    # One (BS, D) x (D, 3HDh) matmul for the q/k/v projections (x read
+    # from HBM once per layer, one wide MXU gemm) instead of three —
+    # runtime weight stack, param layout/checkpoints/TP specs unchanged.
+    # Sweep lever (bench_models.py RAFIKI_SWEEP_QKV); same math, low-bit
+    # differences only from contraction order.
+    fused_qkv: bool = False
 
 
 def block_init(rng: jax.Array, cfg: TransformerConfig) -> Params:
@@ -118,7 +124,7 @@ def block_apply(params: Params, x: jax.Array, cfg: TransformerConfig,
             q, k, v, mesh, causal=causal)
     h = multi_head_attention(params["attn"], core.layernorm(params["ln1"], x),
                              causal=cfg.causal, use_flash=cfg.use_flash,
-                             attn_fn=attn_fn)
+                             attn_fn=attn_fn, fused_qkv=cfg.fused_qkv)
     x = x + core.dropout(r1, h, cfg.dropout, deterministic)
     h = core.layernorm(params["ln2"], x)
     aux = jnp.zeros((), jnp.float32)

@@ -214,8 +214,12 @@ class AgentServer:
                          f"on this host"})
         from rafiki_tpu.utils.reqfields import parse_timeout_s
 
+        # cap=None: relay senders are key-authenticated infrastructure
+        # (the admin predictor forwarding ITS resolved timeout) — capping
+        # here would time remote replicas out earlier than local ones
         timeout_s, terr = parse_timeout_s(
-            body.get("timeout_s"), default=_config.PREDICT_TIMEOUT_S)
+            body.get("timeout_s"), default=_config.PREDICT_TIMEOUT_S,
+            cap=None)
         if terr:
             return self._respond(handler, 400, {"error": terr})
         futures = [queue.submit(q) for q in queries]

@@ -17,14 +17,18 @@ from typing import Optional, Tuple
 def parse_timeout_s(
     value: object,
     default: float,
-    cap: float = 300.0,
+    cap: Optional[float] = 300.0,
 ) -> Tuple[Optional[float], Optional[str]]:
     """Validate a client-supplied timeout. Returns ``(timeout_s, None)``
     on success or ``(None, error)`` for a 400: malformed input is the
     CLIENT's error, and an unbounded (or NaN) value could pin a handler
     thread past any deadline. The cap bounds CLIENT values only — the
     default is the operator's PREDICT_TIMEOUT_S, trusted config (a
-    long-predict deployment may legitimately set it above the cap)."""
+    long-predict deployment may legitimately set it above the cap).
+    ``cap=None`` skips the clamp for doors whose callers are themselves
+    authenticated infrastructure (the agent predict relay: its senders
+    hold the fleet key, and forwarding the admin's resolved timeout must
+    not time remote replicas out earlier than local ones)."""
     if value is None:
         return float(default), None
     try:
@@ -33,4 +37,4 @@ def parse_timeout_s(
         return None, "timeout_s must be a number"
     if not math.isfinite(t) or t <= 0:
         return None, "timeout_s must be a positive finite number"
-    return min(t, cap), None
+    return (t if cap is None else min(t, cap)), None

@@ -11,7 +11,7 @@ TS=$(date -u +%H%M%S)
 echo "$(date -u +%H:%M:%S) TPU live — starting bench.py" >> /root/repo/logs/tpu_probe.log
 timeout 5400 python -u bench.py > /root/repo/logs/bench_tpu_$TS.json 2> /root/repo/logs/bench_tpu_$TS.err
 echo "$(date -u +%H:%M:%S) bench.py rc=$? — starting ViT sweep" >> /root/repo/logs/tpu_probe.log
-RAFIKI_SWEEP_BATCHES=128,192,256 RAFIKI_SWEEP_REMATS=dots,none RAFIKI_SWEEP_UNROLLS=1,4 \
-RAFIKI_SWEEP_FLASH=auto RAFIKI_SWEEP_MU=f32,bf16 \
-timeout 3600 python -u bench_models.py --sweep-vit > /root/repo/logs/vit_sweep_$TS.jsonl 2> /root/repo/logs/vit_sweep_$TS.err
+RAFIKI_SWEEP_BATCHES=192,256 RAFIKI_SWEEP_REMATS=dots,none RAFIKI_SWEEP_UNROLLS=1,4 \
+RAFIKI_SWEEP_FLASH=auto RAFIKI_SWEEP_MU=f32,bf16 RAFIKI_SWEEP_QKV=0,1 \
+timeout 5400 python -u bench_models.py --sweep-vit > /root/repo/logs/vit_sweep_$TS.jsonl 2> /root/repo/logs/vit_sweep_$TS.err
 echo "$(date -u +%H:%M:%S) ViT sweep rc=$? — done" >> /root/repo/logs/tpu_probe.log
