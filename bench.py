@@ -120,7 +120,8 @@ class BenchCnnPop(JaxCnnPopulation):
 
 
 def _serving_client_proc(server_port: int, app: str, query, n_threads: int,
-                         n_reqs: int, barrier, out_q) -> None:
+                         n_reqs: int, barrier, out_q,
+                         direct: bool = False) -> None:
     """One client process: n_threads concurrent request loops. Runs in its
     own interpreter so client-side JSON encode/decode and HTTP work never
     contends with the server process's GIL — threads-in-the-server-process
@@ -139,12 +140,17 @@ def _serving_client_proc(server_port: int, app: str, query, n_threads: int,
     def loop():
         c = Client(admin_host="127.0.0.1", admin_port=server_port)
         c.login(rconfig.SUPERADMIN_EMAIL, rconfig.SUPERADMIN_PASSWORD)
-        c.predict(app, [query])  # warmup/connection
+        # direct = the job's dedicated predictor port (reference parity:
+        # its serving traffic went through a per-job Flask port, never
+        # the admin) — the endpoint resolves once and is cached
+        call = ((lambda: c.predict_direct(app, [query])) if direct
+                else (lambda: c.predict(app, [query])))
+        call()  # warmup/connection
         barrier.wait()
         for _ in range(n_reqs):
             t0 = time.monotonic()
             try:
-                c.predict(app, [query])
+                call()
                 dt = time.monotonic() - t0
                 with lat_lock:
                     latencies.append(dt)
@@ -192,16 +198,23 @@ def bench_serving_unloaded(server_port: int, app: str, query,
     }
 
 
-def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
+def bench_serving_concurrent(server_port: int, app: str, query,
+                             direct: bool = False) -> dict:
     """Drive POST /predict/<app> with N concurrent clients through the real
     HTTP layer (the reference's serving numbers went through its Flask
     predictor, reference predictor/app.py:23-31 — this is apples-to-apples,
     plus concurrency the reference bench never had). Clients run in
-    separate processes (see _serving_client_proc)."""
+    separate processes (see _serving_client_proc). ``direct=True``
+    saturates the job's DEDICATED predictor port instead of the admin
+    door — the closest analogue of the reference's per-job serving
+    port."""
     import multiprocessing as mp
 
     from rafiki_tpu.worker.inference import serving_stats
 
+    # key prefix derives from the door so the two phases can never
+    # clobber each other in the merged record
+    prefix = "serving_direct" if direct else "serving"
     # occupancy must reflect THIS phase only — counters are cumulative and
     # the unloaded phase already served singleton batches
     stats0 = serving_stats()
@@ -216,7 +229,7 @@ def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
         ctx.Process(
             target=_serving_client_proc,
             args=(server_port, app, query, per_proc + (1 if i < extra else 0),
-                  N_REQS_PER_CLIENT, barrier, out_q),
+                  N_REQS_PER_CLIENT, barrier, out_q, direct),
             daemon=True)
         for i in range(n_procs)
     ]
@@ -242,12 +255,14 @@ def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
 
     lat = np.array(sorted(latencies)) * 1000.0
     out = {
-        "serving_clients": N_CLIENTS,
-        "serving_requests": int(len(lat)),
-        "serving_errors": errors,
-        "serving_req_s": round(len(lat) / wall, 1) if wall > 0 else 0.0,
-        "serving_p50_ms": round(float(np.percentile(lat, 50)), 2) if len(lat) else None,
-        "serving_p99_ms": round(float(np.percentile(lat, 99)), 2) if len(lat) else None,
+        f"{prefix}_clients": N_CLIENTS,
+        f"{prefix}_requests": int(len(lat)),
+        f"{prefix}_errors": errors,
+        f"{prefix}_req_s": round(len(lat) / wall, 1) if wall > 0 else 0.0,
+        f"{prefix}_p50_ms": (
+            round(float(np.percentile(lat, 50)), 2) if len(lat) else None),
+        f"{prefix}_p99_ms": (
+            round(float(np.percentile(lat, 99)), 2) if len(lat) else None),
     }
     # batch occupancy: did continuous batching actually coalesce?
     stats = serving_stats()
@@ -256,7 +271,7 @@ def bench_serving_concurrent(server_port: int, app: str, query) -> dict:
     queries = sum(s["queries"] for s in stats.values()) - sum(
         s["queries"] for s in stats0.values())
     if batches > 0:
-        out["serving_batch_occupancy"] = round(queries / batches, 2)
+        out[f"{prefix}_batch_occupancy"] = round(queries / batches, 2)
     return out
 
 
@@ -407,11 +422,16 @@ def main():
 
             # ---- serve: both operating points over HTTP ----------------
             # unloaded first (an idle stack), then closed-loop saturation
+            # dedicated predictor ports on: the admin door AND the
+            # per-job port (the reference's serving door) both measured
+            os.environ["RAFIKI_PREDICTOR_PORTS"] = "1"
             admin.create_inference_job(uid, "benchapp")
             query = x[0].tolist()
             serving = bench_serving_unloaded(server.port, "benchapp", query)
             serving.update(
                 bench_serving_concurrent(server.port, "benchapp", query))
+            serving.update(bench_serving_concurrent(
+                server.port, "benchapp", query, direct=True))
             admin.stop_inference_job(uid, "benchapp")
 
             # ---- int8 weight-only serving: on/off delta ----------------

@@ -36,6 +36,9 @@ class Client:
         # Client is shared across threads (e.g. the placement agent's
         # status forwarder reports from per-service threads).
         self._tls = threading.local()
+        # predict_direct's resolved (app, version) -> (host, port); see
+        # that method for the invalidation rule
+        self._predictor_ports: Dict[Any, Any] = {}
 
     @property
     def _http(self) -> requests.Session:
@@ -250,22 +253,47 @@ class Client:
         the admin control-plane server (available when the deployment set
         RAFIKI_PREDICTOR_PORTS=1; reference parity: per-job published
         predictor ports, reference admin/services_manager.py:379-384).
-        The same login token authorizes both doors."""
-        inf = self.get_inference_job(app, app_version)
-        host, port = inf.get("predictor_host"), inf.get("predictor_port")
-        if not host or not port:
-            raise RuntimeError(
-                f"inference job for {app} has no dedicated predictor port "
-                f"(deployment runs without RAFIKI_PREDICTOR_PORTS)")
+        The same login token authorizes both doors. The resolved
+        host:port is cached per (app, version) with the same short TTL
+        the admin door uses for its predict route
+        (``PREDICT_ROUTE_TTL_S``) — one control-plane GET per TTL
+        window, not per predict — and dropped on any failure, so a
+        redeploy (or an app_version=-1 'latest' that moved) re-resolves
+        within seconds rather than serving a stale port forever."""
+        import time as _time
+
+        from rafiki_tpu import config as _config
+
+        key = (app, app_version)
+        cached = self._predictor_ports.get(key)
+        now = _time.monotonic()
+        if cached is None or cached[2] < now:
+            inf = self.get_inference_job(app, app_version)
+            host, port = inf.get("predictor_host"), inf.get("predictor_port")
+            if not host or not port:
+                raise RafikiError(
+                    f"inference job for {app} has no dedicated predictor "
+                    f"port (deployment runs without RAFIKI_PREDICTOR_PORTS)")
+            cached = (host, port, now + _config.PREDICT_ROUTE_TTL_S)
+            self._predictor_ports[key] = cached
         headers = {}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
-        resp = self._http.request(
-            "POST", f"http://{host}:{port}/predict",
-            json={"queries": queries}, headers=headers)
-        payload = resp.json()
+        try:
+            resp = self._http.request(
+                "POST", f"http://{cached[0]}:{cached[1]}/predict",
+                json={"queries": queries}, headers=headers)
+            payload = resp.json()
+        except (requests.RequestException, ValueError) as e:
+            # connect failure OR a non-JSON body (port reclaimed by some
+            # other server): drop the route and surface the door's error
+            # type, same contract as every _call path
+            self._predictor_ports.pop(key, None)
+            raise RafikiError(f"dedicated predictor unreachable: {e}")
         if resp.status_code != 200:
-            raise RuntimeError(payload.get("error", f"HTTP {resp.status_code}"))
+            self._predictor_ports.pop(key, None)
+            raise RafikiError(payload.get("error",
+                                          f"HTTP {resp.status_code}"))
         return payload["data"]["predictions"]
 
     # -- advisors (reference client.py:586-644) ----------------------------------
