@@ -109,6 +109,40 @@ def test_client_predict_direct(deployed_app, tmp_workdir):
         server.stop()
 
 
+def test_predict_direct_reresolves_after_redeploy(deployed_app):
+    """The client's cached direct route must drop on failure and
+    re-resolve: a stop makes the next call fail cleanly (RafikiError,
+    not a raw socket error), and a redeploy serves again through the
+    SAME client without manual cache busting (review r5)."""
+    from rafiki_tpu.client.client import RafikiError
+
+    admin, uid, token = deployed_app
+    server = AdminServer(admin).start()
+    try:
+        c = Client(admin_host="127.0.0.1", admin_port=server.port)
+        c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        assert len(c.predict_direct("portapp", [[0.0]])) == 1
+        admin.stop_inference_job(uid, "portapp")
+        # teardown drains asynchronously — the stale route may answer for
+        # a beat; what matters is that it FAILS as a RafikiError (never a
+        # raw socket error) and the cache drops with it
+        import time
+
+        deadline = time.monotonic() + 15
+        raised = False
+        while time.monotonic() < deadline and not raised:
+            try:
+                c.predict_direct("portapp", [[0.0]])
+                time.sleep(0.2)
+            except RafikiError:
+                raised = True
+        assert raised, "stale direct route kept answering after stop"
+        admin.create_inference_job(uid, "portapp")
+        assert len(c.predict_direct("portapp", [[0.5]])) == 1
+    finally:
+        server.stop()
+
+
 def test_port_closes_on_job_stop(deployed_app):
     admin, uid, token = deployed_app
     inf = admin.get_inference_job(uid, "portapp")
