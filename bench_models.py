@@ -262,6 +262,75 @@ def run_all(small: bool = False) -> Dict[str, Any]:
     }
 
 
+def bench_longctx(seqs=(2048, 4096, 8192), b: int = 4, h: int = 12,
+                  dh: int = 64, n_steps: int = 8) -> None:
+    """Long-context attention fwd+bwd: XLA fused vs the pallas flash
+    kernel at each sequence length, one JSON line per config (the
+    BASELINE long-context row was a one-off session script in r3; this
+    makes it reproducible). An XLA failure at long seq (the (S,S) score
+    tensors exceed HBM — through the tunnel it surfaces as a
+    remote_compile 500) is RECORDED, not fatal: that asymmetry is the
+    point of the flash kernel. Tile shapes come from
+    RAFIKI_FLASH_BLOCK_Q/_K read HERE and passed explicitly — the
+    production kernel's defaults stay untouched. Flash runs FIRST at
+    each seq: the XLA long-seq attempt is the one expected to fail, and
+    on a sick tunnel it can hang and eat the script budget — the flash
+    rows (the datapoints this bench exists for) must already be out."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from rafiki_tpu.ops import flash_attention, mha_reference
+
+    block_q = int(os.environ.get("RAFIKI_FLASH_BLOCK_Q", "128"))
+    block_k = int(os.environ.get("RAFIKI_FLASH_BLOCK_K", "128"))
+    for s in seqs:
+        for kind in ("flash", "xla"):
+            inner = (mha_reference if kind == "xla" else functools.partial(
+                flash_attention, block_q=block_q, block_k=block_k))
+
+            def loss(q, k, v):
+                return inner(q, k, v).astype(jnp.float32).sum()
+
+            def multi(q, k, v):
+                # n_steps grad computations in ONE dispatch (the tunnel
+                # adds ~15-20 ms per dispatch; see module docstring) —
+                # the tiny grad-scaled update forces each iteration to
+                # depend on the last so XLA cannot collapse the scan
+                def body(c, _):
+                    g = jax.grad(loss)(c, k, v)
+                    return c + g.astype(c.dtype) * 1e-9, ()
+
+                c, _ = lax.scan(body, q, None, length=n_steps)
+                return c.astype(jnp.float32).sum()
+
+            jitted = jax.jit(multi)
+            shape = (b, h, s, dh)
+            ks = jax.random.split(jax.random.key(0), 3)
+            q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16)
+                       for kk in ks)
+            tag = {"seq": s, "kind": kind, "batch": b, "heads": h,
+                   "dh": dh,
+                   "block_q": block_q if kind == "flash" else None,
+                   "block_k": block_k if kind == "flash" else None}
+            try:
+                _ = float(jitted(q, k, v))  # compile + warmup, fenced
+                t0 = time.perf_counter()
+                _ = float(jitted(q, k, v))
+                wall = time.perf_counter() - t0
+            except Exception as e:
+                print(json.dumps({**tag, "error": repr(e)[:300]}),
+                      flush=True)
+                continue
+            print(json.dumps({
+                **tag,
+                "ms_per_step": round(wall / n_steps * 1000, 2),
+                "backend": jax.default_backend(),
+            }), flush=True)
+
+
 def sweep_vit() -> None:
     """Single-chip ViT tuning sweep (VERDICT r3 "next" #2): remat policy x
     batch x scan-unroll, one JSON line per config (so a crash mid-sweep
@@ -317,9 +386,14 @@ if __name__ == "__main__":
 
     import jax
 
+    # "0"/"false"/"" must NOT count as small (env truthiness trap)
+    small = (jax.default_backend() == "cpu"
+             or os.environ.get("RAFIKI_BENCH_SMALL", "")
+             not in ("", "0", "false"))
     if "--sweep-vit" in sys.argv:
         sweep_vit()
+    elif "--longctx" in sys.argv:
+        bench_longctx(seqs=(256, 512) if small else (2048, 4096, 8192),
+                      n_steps=2 if small else 8)
     else:
-        small = jax.default_backend() == "cpu" or bool(
-            os.environ.get("RAFIKI_BENCH_SMALL"))
         print(json.dumps(run_all(small=small), indent=2))

@@ -14,4 +14,6 @@ echo "$(date -u +%H:%M:%S) bench.py rc=$? — starting ViT sweep" >> /root/repo/
 RAFIKI_SWEEP_BATCHES=192,256 RAFIKI_SWEEP_REMATS=dots,none RAFIKI_SWEEP_UNROLLS=1,4 \
 RAFIKI_SWEEP_FLASH=auto RAFIKI_SWEEP_MU=f32,bf16 RAFIKI_SWEEP_QKV=0,1 \
 timeout 5400 python -u bench_models.py --sweep-vit > /root/repo/logs/vit_sweep_$TS.jsonl 2> /root/repo/logs/vit_sweep_$TS.err
-echo "$(date -u +%H:%M:%S) ViT sweep rc=$? — done" >> /root/repo/logs/tpu_probe.log
+echo "$(date -u +%H:%M:%S) ViT sweep rc=$? — starting longctx" >> /root/repo/logs/tpu_probe.log
+timeout 1800 python -u bench_models.py --longctx > /root/repo/logs/longctx_$TS.jsonl 2> /root/repo/logs/longctx_$TS.err
+echo "$(date -u +%H:%M:%S) longctx rc=$? — done" >> /root/repo/logs/tpu_probe.log
