@@ -121,7 +121,8 @@ class BenchCnnPop(JaxCnnPopulation):
 
 def _serving_client_proc(server_port: int, app: str, query, n_threads: int,
                          n_reqs: int, barrier, out_q,
-                         direct: bool = False) -> None:
+                         direct: bool = False,
+                         binary: bool = False) -> None:
     """One client process: n_threads concurrent request loops. Runs in its
     own interpreter so client-side JSON encode/decode and HTTP work never
     contends with the server process's GIL — threads-in-the-server-process
@@ -147,9 +148,17 @@ def _serving_client_proc(server_port: int, app: str, query, n_threads: int,
         c.login(rconfig.SUPERADMIN_EMAIL, rconfig.SUPERADMIN_PASSWORD)
         # direct = the job's dedicated predictor port (reference parity:
         # its serving traffic went through a per-job Flask port, never
-        # the admin) — the endpoint resolves once and is cached
-        call = ((lambda: c.predict_direct(app, [query])) if direct
-                else (lambda: c.predict(app, [query])))
+        # the admin) — the endpoint resolves once and is cached.
+        # binary = same door, queries as one .npy body (no JSON floats).
+        if binary:
+            import numpy as _np
+
+            qarr = _np.asarray([query], dtype=_np.float32)
+            call = lambda: c.predict_direct(app, qarr)  # noqa: E731
+        elif direct:
+            call = lambda: c.predict_direct(app, [query])  # noqa: E731
+        else:
+            call = lambda: c.predict(app, [query])  # noqa: E731
         call()  # warmup/connection
         barrier.wait()
         for _ in range(n_reqs):
@@ -213,7 +222,8 @@ def bench_serving_unloaded(server_port: int, app: str, query,
 
 
 def bench_serving_concurrent(server_port: int, app: str, query,
-                             direct: bool = False) -> dict:
+                             direct: bool = False,
+                             binary: bool = False) -> dict:
     """Drive POST /predict/<app> with N concurrent clients through the real
     HTTP layer (the reference's serving numbers went through its Flask
     predictor, reference predictor/app.py:23-31 — this is apples-to-apples,
@@ -226,9 +236,10 @@ def bench_serving_concurrent(server_port: int, app: str, query,
 
     from rafiki_tpu.worker.inference import serving_stats
 
-    # key prefix derives from the door so the two phases can never
-    # clobber each other in the merged record
-    prefix = "serving_direct" if direct else "serving"
+    # key prefix derives from the door so the phases can never clobber
+    # each other in the merged record
+    prefix = ("serving_binary" if binary
+              else "serving_direct" if direct else "serving")
     # occupancy must reflect THIS phase only — counters are cumulative and
     # the unloaded phase already served singleton batches
     stats0 = serving_stats()
@@ -243,7 +254,7 @@ def bench_serving_concurrent(server_port: int, app: str, query,
         ctx.Process(
             target=_serving_client_proc,
             args=(server_port, app, query, per_proc + (1 if i < extra else 0),
-                  N_REQS_PER_CLIENT, barrier, out_q, direct),
+                  N_REQS_PER_CLIENT, barrier, out_q, direct, binary),
             daemon=True)
         for i in range(n_procs)
     ]
@@ -448,6 +459,8 @@ def main():
                 bench_serving_concurrent(server.port, "benchapp", query))
             serving.update(bench_serving_concurrent(
                 server.port, "benchapp", query, direct=True))
+            serving.update(bench_serving_concurrent(
+                server.port, "benchapp", query, direct=True, binary=True))
             admin.stop_inference_job(uid, "benchapp")
 
             # ---- int8 weight-only serving: on/off delta ----------------

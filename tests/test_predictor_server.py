@@ -109,6 +109,68 @@ def test_client_predict_direct(deployed_app, tmp_workdir):
         server.stop()
 
 
+def test_binary_npy_queries_on_dedicated_port(deployed_app):
+    """The dedicated door accepts one .npy body (leading batch axis) in
+    place of JSON queries — no float formatting/parsing on the wire —
+    and the client picks that path automatically for ndarray input.
+    Malformed npy is the client's 400, and pickled payloads are refused
+    (allow_pickle=False)."""
+    import io
+
+    import numpy as np
+
+    admin, uid, token = deployed_app
+    inf = admin.get_inference_job(uid, "portapp")
+    host, port = inf["predictor_host"], inf["predictor_port"]
+
+    # raw wire: npy body, JSON predictions
+    arr = np.zeros((2, 1), dtype=np.float32)
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict", data=buf.getvalue(), method="POST")
+    req.add_header("Content-Type", "application/x-npy")
+    req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+        preds = json.loads(r.read())["data"]["predictions"]
+    assert len(preds) == 2
+
+    # client auto-selects the binary path for ndarray queries
+    server = AdminServer(admin).start()
+    try:
+        c = Client(admin_host="127.0.0.1", admin_port=server.port)
+        c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        preds = c.predict_direct("portapp", np.zeros((3, 1), np.float32))
+        assert len(preds) == 3
+    finally:
+        server.stop()
+
+    # garbage npy -> 400, not a 500
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict", data=b"not-an-npy", method="POST")
+    req.add_header("Content-Type", "application/x-npy")
+    req.add_header("Authorization", f"Bearer {token}")
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("expected an HTTP error")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400, e.code
+
+    # a pickled-object payload must be REFUSED (allow_pickle=False)
+    evil = io.BytesIO()
+    np.save(evil, np.array([{"a": 1}], dtype=object), allow_pickle=True)
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict", data=evil.getvalue(), method="POST")
+    req.add_header("Content-Type", "application/x-npy")
+    req.add_header("Authorization", f"Bearer {token}")
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("expected an HTTP error")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400, e.code
+
+
 def test_predict_direct_reresolves_after_redeploy(deployed_app):
     """The client's cached direct route must drop on failure and
     re-resolve: a stop makes the next call fail cleanly (RafikiError,
