@@ -376,6 +376,8 @@ def test_netns_blocks_loopback(tmp_path, loopback_server, monkeypatch):
 
 def test_stop_protocol_truncates_training(jail):
     looper = textwrap.dedent("""
+        import time
+
         from rafiki_tpu.sdk import BaseModel, FixedKnob
 
         class Looper(BaseModel):
@@ -391,6 +393,10 @@ def test_stop_protocol_truncates_training(jail):
                 for e in range(10_000):
                     self.logger.log(loss=1.0 / (e + 1), epoch=e)
                     self.epochs_done = e
+                    # pace the loop: on a loaded 1-core box the STOP
+                    # round-trip can lag hundreds of tight-loop epochs,
+                    # flaking the stopped-early assertion
+                    time.sleep(0.002)
 
             def evaluate(self, uri):
                 return float(self.epochs_done)
