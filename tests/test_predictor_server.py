@@ -214,6 +214,42 @@ def test_port_closes_on_job_stop(deployed_app):
         _post(host, port, "/predict", {"queries": [[0.0]]}, token=token)
 
 
+@pytest.mark.slow
+def test_binary_door_through_sandboxed_serving(tmp_workdir, monkeypatch):
+    """RAFIKI_SANDBOX=1 + dedicated port + .npy queries together: the
+    ndarray queries cross the sandbox pipe via the shared jsonutil
+    convention and predictions come back intact."""
+    import numpy as np
+
+    monkeypatch.setenv("RAFIKI_SANDBOX", "1")
+    monkeypatch.setenv("RAFIKI_PREDICTOR_PORTS", "1")
+    admin = Admin(params_dir=str(tmp_workdir / "params"))
+    try:
+        uid = admin.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        with open(FIXTURE, "rb") as f:
+            admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION",
+                               f.read(), "FakeModel")
+        admin.create_train_job(
+            uid, "sbxbin", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+            budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 0})
+        job = admin.wait_until_train_job_stopped(uid, "sbxbin", timeout_s=120)
+        # wait returns on ERRORED too — a sandbox-training regression
+        # must read as one, not as a confusing serving-door failure
+        assert job["status"] == TrainJobStatus.STOPPED, job
+        admin.create_inference_job(uid, "sbxbin")
+        server = AdminServer(admin).start()
+        try:
+            c = Client(admin_host="127.0.0.1", admin_port=server.port)
+            c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+            preds = c.predict_direct("sbxbin", np.zeros((2, 1), np.float32))
+            assert len(preds) == 2
+        finally:
+            server.stop()
+    finally:
+        admin.shutdown()  # shutdown() stops all jobs itself
+
+
 def test_no_port_without_flag(tmp_workdir, monkeypatch):
     monkeypatch.delenv("RAFIKI_PREDICTOR_PORTS", raising=False)
     admin = Admin(params_dir=str(tmp_workdir / "params"))
