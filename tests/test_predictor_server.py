@@ -38,9 +38,13 @@ def _post(host, port, path, body, token=None):
         return e.code, json.loads(e.read() or b"{}")
 
 
-@pytest.fixture()
-def deployed_app(tmp_workdir, monkeypatch):
+def _deploy(tmp_workdir, monkeypatch, app, env=None, timeout_s=60):
+    """THE deploy recipe (model upload -> 1 trial -> inference job with a
+    dedicated port) — shared by the fixture and env-variant tests so the
+    recipe can never drift between copies."""
     monkeypatch.setenv("RAFIKI_PREDICTOR_PORTS", "1")
+    for k, val in (env or {}).items():
+        monkeypatch.setenv(k, val)
     admin = Admin(params_dir=str(tmp_workdir / "params"))
     auth = admin.authenticate_user(
         config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
@@ -49,12 +53,18 @@ def deployed_app(tmp_workdir, monkeypatch):
         admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION",
                            f.read(), "FakeModel")
     admin.create_train_job(
-        uid, "portapp", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        uid, app, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
         budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 0})
-    job = admin.wait_until_train_job_stopped(uid, "portapp", timeout_s=60)
-    assert job["status"] == TrainJobStatus.STOPPED
-    admin.create_inference_job(uid, "portapp")
-    yield admin, uid, auth["token"]
+    job = admin.wait_until_train_job_stopped(uid, app, timeout_s=timeout_s)
+    assert job["status"] == TrainJobStatus.STOPPED, job
+    admin.create_inference_job(uid, app)
+    return admin, uid, auth["token"]
+
+
+@pytest.fixture()
+def deployed_app(tmp_workdir, monkeypatch):
+    admin, uid, token = _deploy(tmp_workdir, monkeypatch, "portapp")
+    yield admin, uid, token
     admin.shutdown()
 
 
@@ -221,23 +231,10 @@ def test_binary_door_through_sandboxed_serving(tmp_workdir, monkeypatch):
     convention and predictions come back intact."""
     import numpy as np
 
-    monkeypatch.setenv("RAFIKI_SANDBOX", "1")
-    monkeypatch.setenv("RAFIKI_PREDICTOR_PORTS", "1")
-    admin = Admin(params_dir=str(tmp_workdir / "params"))
+    admin, uid, token = _deploy(
+        tmp_workdir, monkeypatch, "sbxbin",
+        env={"RAFIKI_SANDBOX": "1"}, timeout_s=120)
     try:
-        uid = admin.authenticate_user(
-            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
-        with open(FIXTURE, "rb") as f:
-            admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION",
-                               f.read(), "FakeModel")
-        admin.create_train_job(
-            uid, "sbxbin", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
-            budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 0})
-        job = admin.wait_until_train_job_stopped(uid, "sbxbin", timeout_s=120)
-        # wait returns on ERRORED too — a sandbox-training regression
-        # must read as one, not as a confusing serving-door failure
-        assert job["status"] == TrainJobStatus.STOPPED, job
-        admin.create_inference_job(uid, "sbxbin")
         server = AdminServer(admin).start()
         try:
             c = Client(admin_host="127.0.0.1", admin_port=server.port)
@@ -247,7 +244,7 @@ def test_binary_door_through_sandboxed_serving(tmp_workdir, monkeypatch):
         finally:
             server.stop()
     finally:
-        admin.shutdown()  # shutdown() stops all jobs itself
+        admin.shutdown()
 
 
 def test_no_port_without_flag(tmp_workdir, monkeypatch):
