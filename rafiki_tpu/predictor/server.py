@@ -115,6 +115,10 @@ class PredictorServer:
                 queries = list(arr)
             else:
                 body = json.loads(raw or b"{}")
+                if not isinstance(body, dict):
+                    return self._respond(handler, 400, {
+                        "error": "body must be a JSON object like "
+                                 '{"queries": [...]}'})
                 queries = body.get("queries")
             if not isinstance(queries, list) or not queries:
                 return self._respond(handler, 400, {
@@ -122,8 +126,15 @@ class PredictorServer:
             from rafiki_tpu import config as _config
             from rafiki_tpu.utils.reqfields import parse_timeout_s
 
+            # binary bodies have no JSON fields — the timeout rides a
+            # header there (validated by the same rule either way)
+            timeout_value = (handler.headers.get("X-Rafiki-Timeout-S")
+                             if ctype == "application/x-npy"
+                             else body.get("timeout_s"))
             timeout_s, terr = parse_timeout_s(
-                body.get("timeout_s"), default=_config.PREDICT_TIMEOUT_S)
+                timeout_value, default=_config.PREDICT_TIMEOUT_S,
+                label=("X-Rafiki-Timeout-S header"
+                       if ctype == "application/x-npy" else "timeout_s"))
             if terr:
                 return self._respond(handler, 400, {"error": terr})
             preds = self.predictor.predict_batch(

@@ -18,6 +18,7 @@ def parse_timeout_s(
     value: object,
     default: float,
     cap: Optional[float] = 300.0,
+    label: str = "timeout_s",
 ) -> Tuple[Optional[float], Optional[str]]:
     """Validate a client-supplied timeout. Returns ``(timeout_s, None)``
     on success or ``(None, error)`` for a 400: malformed input is the
@@ -34,7 +35,7 @@ def parse_timeout_s(
     try:
         t = float(value)  # bools are numbers here; fine
     except (TypeError, ValueError):
-        return None, "timeout_s must be a number"
+        return None, f"{label} must be a number"
     if not math.isfinite(t) or t <= 0:
-        return None, "timeout_s must be a positive finite number"
+        return None, f"{label} must be a positive finite number"
     return (t if cap is None else min(t, cap)), None

@@ -156,6 +156,28 @@ def test_binary_npy_queries_on_dedicated_port(deployed_app):
     finally:
         server.stop()
 
+    # binary bodies carry their timeout in a header (no JSON fields);
+    # the shared validation rule applies — malformed is a 400
+    buf2 = io.BytesIO()
+    np.save(buf2, arr, allow_pickle=False)
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict", data=buf2.getvalue(), method="POST")
+    req.add_header("Content-Type", "application/x-npy")
+    req.add_header("Authorization", f"Bearer {token}")
+    req.add_header("X-Rafiki-Timeout-S", "soon")
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("expected an HTTP error")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400, e.code
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict", data=buf2.getvalue(), method="POST")
+    req.add_header("Content-Type", "application/x-npy")
+    req.add_header("Authorization", f"Bearer {token}")
+    req.add_header("X-Rafiki-Timeout-S", "20")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+
     # garbage npy -> 400, not a 500
     req = urllib.request.Request(
         f"http://{host}:{port}/predict", data=b"not-an-npy", method="POST")
