@@ -91,3 +91,8 @@ def __getattr__(name: str) -> str:
 # How long Admin.predict may reuse a resolved app->predictor route without
 # re-reading the control-plane DB (serving hot path; see admin.predict).
 PREDICT_ROUTE_TTL_S = _env_float("PREDICT_ROUTE_TTL_S", 5.0)
+
+# Request-body ceiling on the dedicated predictor port: one absurd
+# Content-Length must not allocate server memory (predictor/server.py
+# refuses with 413 before reading).
+PREDICT_MAX_BODY_MB = _env_float("PREDICT_MAX_BODY_MB", 64.0)

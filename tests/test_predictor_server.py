@@ -178,6 +178,29 @@ def test_binary_npy_queries_on_dedicated_port(deployed_app):
     with urllib.request.urlopen(req, timeout=30) as r:
         assert r.status == 200
 
+    # an absurd Content-Length is refused before any allocation —
+    # quickly (a hang until the client timeout is the regression this
+    # test exists to catch, so it must NOT be swallowed)
+    import socket
+    import time
+
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict", data=b"x", method="POST")
+    req.add_header("Content-Type", "application/x-npy")
+    req.add_header("Authorization", f"Bearer {token}")
+    req.add_header("Content-Length", str(200 << 20))
+    t0 = time.monotonic()
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        raise AssertionError("expected a refusal")
+    except urllib.error.HTTPError as e:
+        assert e.code == 413, e.code
+    except urllib.error.URLError as e:
+        # the server may slam the connection mid-upload; a TIMEOUT
+        # means the guard is gone and the thread was pinned
+        assert not isinstance(e.reason, socket.timeout), "guard gone"
+    assert time.monotonic() - t0 < 10, "refusal was not prompt"
+
     # garbage npy -> 400, not a 500
     req = urllib.request.Request(
         f"http://{host}:{port}/predict", data=b"not-an-npy", method="POST")
