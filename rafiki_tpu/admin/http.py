@@ -23,11 +23,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from rafiki_tpu import config
 from rafiki_tpu.admin.admin import Admin, InvalidRequestError
 from rafiki_tpu.constants import UserType
 from rafiki_tpu.placement.manager import InsufficientChipsError
 from rafiki_tpu.sdk.model import InvalidModelClassError
 from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
+from rafiki_tpu.utils.reqfields import read_bounded_body
 
 logger = logging.getLogger(__name__)
 
@@ -296,14 +298,18 @@ class AdminServer:
                 return
             query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             body: Dict[str, Any] = {}
+            raw, berr = read_bounded_body(
+                handler, config.ADMIN_MAX_BODY_MB, fallback_mb=256.0)
+            if berr:
+                # this door's error channel is InvalidRequestError (400)
+                raise InvalidRequestError(f"{berr[1]} (ADMIN_MAX_BODY_MB)")
             try:
-                length = int(handler.headers.get("Content-Length") or 0)
-                if length:
-                    body = json.loads(handler.rfile.read(length) or b"{}")
+                if raw:
+                    body = json.loads(raw or b"{}")
             except (ValueError, UnicodeDecodeError) as e:
-                # bad Content-Length, malformed JSON, or non-UTF-8 bytes
+                # malformed JSON or non-UTF-8 bytes (body fully read)
                 raise InvalidRequestError(f"malformed request body: {e}")
-            if length and not isinstance(body, dict):
+            if raw and not isinstance(body, dict):
                 raise InvalidRequestError("request body must be a JSON object")
 
             for m, pattern, allowed, fn in self.routes:

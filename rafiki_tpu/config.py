@@ -96,3 +96,7 @@ PREDICT_ROUTE_TTL_S = _env_float("PREDICT_ROUTE_TTL_S", 5.0)
 # Content-Length must not allocate server memory (predictor/server.py
 # refuses with 413 before reading).
 PREDICT_MAX_BODY_MB = _env_float("PREDICT_MAX_BODY_MB", 64.0)
+
+# Same guard on the admin REST door — higher default because model
+# uploads legitimately carry template bytes (base64 in JSON).
+ADMIN_MAX_BODY_MB = _env_float("ADMIN_MAX_BODY_MB", 256.0)

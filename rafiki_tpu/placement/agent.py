@@ -141,9 +141,15 @@ class AgentServer:
                              "RAFIKI_AGENT_INSECURE=1 was not set — "
                              "refusing all placement/relay requests"})
             body: Dict[str, Any] = {}
-            length = int(handler.headers.get("Content-Length") or 0)
-            if length:
-                body = json.loads(handler.rfile.read(length) or b"{}")
+            from rafiki_tpu import config as _config
+            from rafiki_tpu.utils.reqfields import read_bounded_body
+
+            raw, berr = read_bounded_body(
+                handler, _config.PREDICT_MAX_BODY_MB)
+            if berr:
+                return self._respond(handler, berr[0], {"error": berr[1]})
+            if raw:
+                body = json.loads(raw or b"{}")
 
             if method == "GET" and path == "/inventory":
                 alloc = self.engine.allocator

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import json
 import logging
-import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
@@ -89,30 +88,14 @@ class PredictorServer:
                          or "").removeprefix("Bearer ")
                 decode_token(token)  # any authenticated user may predict
             from rafiki_tpu import config as _config
+            from rafiki_tpu.utils.reqfields import read_bounded_body
 
-            try:
-                length = int(handler.headers.get("Content-Length") or 0)
-            except ValueError:
-                handler.close_connection = True
-                return self._respond(handler, 400, {
-                    "error": "bad Content-Length"})
-            max_mb = _config.PREDICT_MAX_BODY_MB
-            if not math.isfinite(max_mb) or max_mb <= 0:
-                max_mb = 64.0  # a broken knob must not disable the cap
-            if length < 0:
-                handler.close_connection = True
-                return self._respond(handler, 400, {
-                    "error": "bad Content-Length"})
-            if length > max_mb * (1 << 20):
-                # read nothing: one absurd Content-Length must not
-                # allocate server memory (the real batch ceiling is the
-                # worker's PREDICT_MAX_BATCH_SIZE anyway). The unread
-                # body would desync keep-alive framing — close.
-                handler.close_connection = True
-                return self._respond(handler, 413, {
-                    "error": f"body exceeds {max_mb:g} MB "
-                             "(PREDICT_MAX_BODY_MB)"})
-            raw = handler.rfile.read(length)
+            raw, berr = read_bounded_body(
+                handler, _config.PREDICT_MAX_BODY_MB)
+            if berr:
+                return self._respond(
+                    handler, berr[0],
+                    {"error": f"{berr[1]} (PREDICT_MAX_BODY_MB)"})
             # media types are case-insensitive (RFC 9110); params follow ';'
             ctype = ((handler.headers.get("Content-Type") or "")
                      .split(";")[0].strip().lower())
@@ -147,7 +130,6 @@ class PredictorServer:
             if not isinstance(queries, list) or not queries:
                 return self._respond(handler, 400, {
                     "error": "body must carry a non-empty 'queries' list"})
-            from rafiki_tpu import config as _config
             from rafiki_tpu.utils.reqfields import parse_timeout_s
 
             # binary bodies have no JSON fields — the timeout rides a

@@ -39,3 +39,34 @@ def parse_timeout_s(
     if not math.isfinite(t) or t <= 0:
         return None, f"{label} must be a positive finite number"
     return (t if cap is None else min(t, cap)), None
+
+
+def read_bounded_body(handler, max_mb: float, fallback_mb: float = 64.0):
+    """THE Content-Length guard for every HTTP door (admin, predictor,
+    agent — copy-pasted variants drifted, review r5). Returns
+    ``(raw_bytes, None)`` or ``(None, (status, error))``:
+
+    - malformed / negative Content-Length -> 400 (reading ``-1`` would
+      block until EOF, pinning the handler thread to the socket timeout),
+    - oversized -> 413 before a single byte is read or allocated,
+    - a broken ``max_mb`` knob (NaN/<=0) falls back instead of rejecting
+      everything (``0 <= length <= nan`` is False even for GETs).
+
+    Refusals set ``close_connection`` — the unread body would desync
+    HTTP/1.1 keep-alive framing. Callers map the status onto their own
+    error channel (the admin door answers 400 via InvalidRequestError;
+    the predictor answers the status directly)."""
+    if not math.isfinite(max_mb) or max_mb <= 0:
+        max_mb = fallback_mb
+    try:
+        length = int(handler.headers.get("Content-Length") or 0)
+    except ValueError:
+        handler.close_connection = True
+        return None, (400, "bad Content-Length")
+    if length < 0:
+        handler.close_connection = True
+        return None, (400, "bad Content-Length")
+    if length > max_mb * (1 << 20):
+        handler.close_connection = True
+        return None, (413, f"request body exceeds {max_mb:g} MB")
+    return (handler.rfile.read(length) if length else b""), None
