@@ -86,3 +86,45 @@ def test_unknown_route_and_method(server):
     token = _token(server)
     status, _ = _post(server, "/users/../../etc", b"{}", token=token)
     assert 400 <= status < 500
+
+
+class _FakeHandler:
+    """Just enough BaseHTTPRequestHandler surface for read_bounded_body."""
+
+    def __init__(self, content_length, body=b""):
+        import io
+
+        self.headers = {"Content-Length": content_length}
+        self.rfile = io.BytesIO(body)
+        self.close_connection = False
+
+
+@pytest.mark.parametrize("length,code", [
+    ("abc", 400),      # malformed
+    ("-1", 400),       # negative: read(-1) would block to EOF
+    (str(999 << 20), 413),  # oversized: refuse before reading
+])
+def test_read_bounded_body_refusals(length, code):
+    from rafiki_tpu.utils.reqfields import read_bounded_body
+
+    h = _FakeHandler(length, body=b"should-never-be-read")
+    raw, err = read_bounded_body(h, 64.0)
+    assert raw is None and err[0] == code
+    assert h.close_connection  # unread body would desync keep-alive
+    assert h.rfile.tell() == 0  # refused BEFORE reading a byte
+
+
+@pytest.mark.parametrize("bad_knob", [float("nan"), 0.0, -5.0])
+def test_read_bounded_body_broken_knob_falls_back(bad_knob):
+    """A broken size knob must fall back, not reject everything:
+    '0 <= length <= nan' is False even for a GET with no body."""
+    from rafiki_tpu.utils.reqfields import read_bounded_body
+
+    h = _FakeHandler("5", b"hello")
+    raw, err = read_bounded_body(h, bad_knob, fallback_mb=64.0)
+    assert err is None and raw == b"hello"
+    # and the fallback still bounds: oversized is refused
+    h2 = _FakeHandler(str(999 << 20), body=b"should-never-be-read")
+    raw2, err2 = read_bounded_body(h2, bad_knob, fallback_mb=64.0)
+    assert raw2 is None and err2[0] == 413
+    assert h2.close_connection and h2.rfile.tell() == 0
