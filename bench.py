@@ -39,6 +39,9 @@ N_TEST = int(os.environ.get("RAFIKI_BENCH_TEST_N", 2048))
 N_CLIENTS = int(os.environ.get("RAFIKI_BENCH_CLIENTS", 32))
 N_REQS_PER_CLIENT = int(os.environ.get("RAFIKI_BENCH_REQS", 40))
 BENCH_ASHA = os.environ.get("RAFIKI_BENCH_ASHA", "1") not in ("0", "false")
+# serving phases skippable for cheap targeted reruns of train/ASHA phases
+BENCH_SERVING = os.environ.get(
+    "RAFIKI_BENCH_SERVING", "1") not in ("0", "false")
 N_ASHA_TRIALS = int(os.environ.get("RAFIKI_BENCH_ASHA_TRIALS", 6))
 BENCH_MODELS = os.environ.get("RAFIKI_BENCH_MODELS", "1") not in ("0", "false")
 REFERENCE_TRIALS_PER_HOUR = 12.0  # see module docstring
@@ -300,6 +303,19 @@ def bench_serving_concurrent(server_port: int, app: str, query,
     return out
 
 
+def _wait_chips_free(admin, timeout_s: float = 30.0) -> None:
+    """Service teardown releases chip grants asynchronously (worker threads
+    exit with destroy wait=False); a phase that needs exclusive chips must
+    wait for the grant to come home or it races InsufficientChipsError /
+    lands on a degraded best-effort grant."""
+    alloc = getattr(admin.placement, "allocator", None)
+    deadline = time.monotonic() + timeout_s
+    while (alloc is not None
+           and alloc.free_chips < alloc.total_chips
+           and time.monotonic() < deadline):
+        time.sleep(0.1)
+
+
 def _bench_asha(admin, uid: str, train_uri: str, test_uri: str) -> dict:
     """Two identical multi-epoch HPO runs — EARLY_STOP off, then on —
     reporting effective trials/hour side by side (verdict r4 next #8:
@@ -449,19 +465,27 @@ def main():
             # unloaded first (an idle stack), then closed-loop saturation
             # dedicated predictor ports on: the admin door AND the
             # per-job port (the reference's serving door) both measured
-            os.environ["RAFIKI_PREDICTOR_PORTS"] = "1"
-            admin.create_inference_job(uid, "benchapp")
+            # (RAFIKI_BENCH_SERVING=0 skips all serving phases — cheap
+            # targeted reruns of the train/ASHA phases while iterating)
+            serving = {}
             query = x[0].tolist()
-            serving = bench_serving_unloaded(server.port, "benchapp", query)
-            serving.update(bench_serving_unloaded(
-                server.port, "benchapp", query, direct=True))
-            serving.update(
-                bench_serving_concurrent(server.port, "benchapp", query))
-            serving.update(bench_serving_concurrent(
-                server.port, "benchapp", query, direct=True))
-            serving.update(bench_serving_concurrent(
-                server.port, "benchapp", query, direct=True, binary=True))
-            admin.stop_inference_job(uid, "benchapp")
+            if BENCH_SERVING:
+                os.environ["RAFIKI_PREDICTOR_PORTS"] = "1"
+                # train-worker teardown releases chips asynchronously too —
+                # the serving fleet must not race it onto a degraded grant
+                _wait_chips_free(admin)
+                admin.create_inference_job(uid, "benchapp")
+                serving = bench_serving_unloaded(
+                    server.port, "benchapp", query)
+                serving.update(bench_serving_unloaded(
+                    server.port, "benchapp", query, direct=True))
+                serving.update(
+                    bench_serving_concurrent(server.port, "benchapp", query))
+                serving.update(bench_serving_concurrent(
+                    server.port, "benchapp", query, direct=True))
+                serving.update(bench_serving_concurrent(
+                    server.port, "benchapp", query, direct=True, binary=True))
+                admin.stop_inference_job(uid, "benchapp")
 
             # ---- int8 weight-only serving: on/off delta ----------------
             # The quant story's bandwidth win is a TPU-format property
@@ -469,18 +493,14 @@ def main():
             # NOTE: the env toggle reaches the serving worker because the
             # bench Admin is pinned to in-process LocalPlacementManager
             # above — workers read RAFIKI_SERVE_INT8 in this interpreter
-            if os.environ.get("RAFIKI_BENCH_INT8", "1") not in ("0", "false"):
+            if BENCH_SERVING and os.environ.get(
+                    "RAFIKI_BENCH_INT8", "1") not in ("0", "false"):
                 try:
                     # serving teardown releases chips when worker threads
                     # exit (destroy wait=False): wait for the grant to
                     # come home, or the int8 worker lands on a degraded
                     # best-effort grant and the comparison is invalid
-                    alloc = getattr(admin.placement, "allocator", None)
-                    deadline = time.monotonic() + 30
-                    while (alloc is not None
-                           and alloc.free_chips < alloc.total_chips
-                           and time.monotonic() < deadline):
-                        time.sleep(0.1)
+                    _wait_chips_free(admin)
                     os.environ["RAFIKI_SERVE_INT8"] = "1"
                     admin.create_inference_job(uid, "benchapp")
                     int8 = bench_serving_unloaded(
@@ -506,6 +526,10 @@ def main():
             asha = {"error": None}
             if BENCH_ASHA:
                 try:
+                    # the int8 phase's inference job (and anything else
+                    # stop_all_jobs tore down) releases its chips
+                    # asynchronously — the ASHA train jobs need them back
+                    _wait_chips_free(admin)
                     asha = _bench_asha(admin, uid, train_uri, test_uri)
                 except Exception as e:
                     asha = {"error": repr(e)}
