@@ -471,8 +471,6 @@ def main():
             query = x[0].tolist()
             if BENCH_SERVING:
                 os.environ["RAFIKI_PREDICTOR_PORTS"] = "1"
-                # train-worker teardown releases chips asynchronously too —
-                # the serving fleet must not race it onto a degraded grant
                 _wait_chips_free(admin)
                 admin.create_inference_job(uid, "benchapp")
                 serving = bench_serving_unloaded(
@@ -496,10 +494,6 @@ def main():
             if BENCH_SERVING and os.environ.get(
                     "RAFIKI_BENCH_INT8", "1") not in ("0", "false"):
                 try:
-                    # serving teardown releases chips when worker threads
-                    # exit (destroy wait=False): wait for the grant to
-                    # come home, or the int8 worker lands on a degraded
-                    # best-effort grant and the comparison is invalid
                     _wait_chips_free(admin)
                     os.environ["RAFIKI_SERVE_INT8"] = "1"
                     admin.create_inference_job(uid, "benchapp")
@@ -526,9 +520,6 @@ def main():
             asha = {"error": None}
             if BENCH_ASHA:
                 try:
-                    # the int8 phase's inference job (and anything else
-                    # stop_all_jobs tore down) releases its chips
-                    # asynchronously — the ASHA train jobs need them back
                     _wait_chips_free(admin)
                     asha = _bench_asha(admin, uid, train_uri, test_uri)
                 except Exception as e:

@@ -29,7 +29,7 @@ from rafiki_tpu.constants import UserType
 from rafiki_tpu.placement.manager import InsufficientChipsError
 from rafiki_tpu.sdk.model import InvalidModelClassError
 from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
-from rafiki_tpu.utils.reqfields import read_bounded_body
+from rafiki_tpu.utils.reqfields import LowLatencyHandler, read_bounded_body
 
 logger = logging.getLogger(__name__)
 
@@ -109,7 +109,7 @@ class AdminServer:
     def start(self) -> "AdminServer":
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(LowLatencyHandler):
             # HTTP/1.1: keep-alive, so a client session reuses one
             # connection (and one server thread) across requests instead of
             # paying connect + thread-spawn per call. Safe because every
@@ -118,9 +118,6 @@ class AdminServer:
             # worker) — otherwise dead-connection threads pile up forever.
             protocol_version = "HTTP/1.1"
             timeout = 300
-
-            def log_message(self, fmt, *args):  # quiet
-                pass
 
             def do_GET(self):
                 server._dispatch(self, "GET")

@@ -62,6 +62,7 @@ from typing import Any, Dict, Optional
 from rafiki_tpu.constants import ServiceType
 from rafiki_tpu.placement.manager import ChipAllocator, InsufficientChipsError
 from rafiki_tpu.placement.process import ProcessPlacementManager
+from rafiki_tpu.utils.reqfields import LowLatencyHandler
 
 logger = logging.getLogger(__name__)
 
@@ -96,10 +97,7 @@ class AgentServer:
     def start(self) -> "AgentServer":
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):  # quiet
-                pass
-
+        class Handler(LowLatencyHandler):
             def do_GET(self):
                 server._dispatch(self, "GET")
 

@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from rafiki_tpu.utils.auth import UnauthorizedError, decode_token
+from rafiki_tpu.utils.reqfields import LowLatencyHandler
 
 logger = logging.getLogger(__name__)
 
@@ -45,12 +46,9 @@ class PredictorServer:
     def start(self) -> "PredictorServer":
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(LowLatencyHandler):
             protocol_version = "HTTP/1.1"
             timeout = 300
-
-            def log_message(self, fmt, *args):
-                pass
 
             def do_GET(self):
                 if self.path.split("?", 1)[0].rstrip("/") == "/healthz":

@@ -11,7 +11,29 @@ timeout at all (/root/reference/rafiki/predictor/app.py).
 from __future__ import annotations
 
 import math
+from http.server import BaseHTTPRequestHandler
 from typing import Optional, Tuple
+
+
+class LowLatencyHandler(BaseHTTPRequestHandler):
+    """Base handler for every HTTP door (admin, predictor, agent).
+
+    The stock handler writes a response as (at least) two TCP segments —
+    one for the batched header lines, one for the body — and with Nagle
+    on, the body segment sits behind the peer's delayed ACK of the header
+    segment: ~+40 ms on EVERY response, even over loopback (measured:
+    a 13 ms in-process ensemble predict answered in 60 ms over HTTP).
+    Buffering ``wfile`` coalesces the whole response into one segment
+    (``handle_one_request`` flushes it per request), and TCP_NODELAY
+    covers any path that still writes more than once (streamed/oversized
+    bodies).
+    """
+
+    wbufsize = 1 << 16
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):  # doors log through `logging`
+        pass
 
 
 def parse_timeout_s(
