@@ -79,14 +79,21 @@ class HttpWorkerQueue:
         self._thread.start()
 
     def submit(self, query: Any) -> QueryFuture:
-        fut = QueryFuture()
+        return self.submit_many([query])[0]
+
+    def submit_many(self, queries: List[Any]) -> List[QueryFuture]:
+        """Atomic enqueue of one request's queries (one lock, one wake-up)
+        so the sender relays them as one HTTP batch instead of racing the
+        sender thread into a singleton first batch."""
+        futs = [QueryFuture() for _ in queries]
         with self._cond:
             if self._closed:
-                fut.set_error(RuntimeError("remote worker queue closed"))
-                return fut
-            self._pending.append((fut, query))
+                for fut in futs:
+                    fut.set_error(RuntimeError("remote worker queue closed"))
+                return futs
+            self._pending.extend(zip(futs, queries))
             self._cond.notify()
-        return fut
+        return futs
 
     def _sender(self) -> None:
         while True:

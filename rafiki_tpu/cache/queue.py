@@ -5,10 +5,13 @@ The reference's serving data plane was Redis lists polled on 0.25 s sleeps on
 worker/inference.py:43-65), giving every request a ~0.25-0.5 s latency floor
 before any model time. Here the transport is a condition-variable handoff:
 
-- the predictor submits queries and gets futures back;
+- the predictor submits each request's queries atomically (submit_many) and
+  gets futures back;
 - each inference worker blocks on its queue, waking the moment work arrives,
-  and drains *up to* a max batch with a short deadline so TPU batches fill
-  under load but single queries don't wait (deadline <= a few ms, not 250);
+  and drains whatever has queued (continuous batching self-paces: queries
+  accumulate during the previous dispatch, so batches fill under load while
+  single queries never wait — the optional deadline adds a coalescing wait
+  only if an operator asks for one);
 - workers resolve futures directly — no scan-and-remove.
 
 ``Broker`` is the seam (the reference's Cache class shape, reference
@@ -60,14 +63,24 @@ class WorkerQueue:
         self._closed = False
 
     def submit(self, query: Any) -> QueryFuture:
-        fut = QueryFuture()
+        return self.submit_many([query])[0]
+
+    def submit_many(self, queries: List[Any]) -> List[QueryFuture]:
+        """Enqueue a whole request's queries atomically (one lock, one
+        wake-up). A per-query submit loop can lose a race with the worker:
+        it wakes after the first item, serves a singleton batch, and the
+        rest of the request waits a full dispatch behind it — with the
+        batch deadline at 0 (serve immediately), atomic enqueue is what
+        keeps one request one batch."""
+        futs = [QueryFuture() for _ in queries]
         with self._cond:
             if self._closed:
-                fut.set_error(RuntimeError("worker queue closed"))
-                return fut
-            self._items.append((fut, query))
+                for fut in futs:
+                    fut.set_error(RuntimeError("worker queue closed"))
+                return futs
+            self._items.extend(zip(futs, queries))
             self._cond.notify()
-        return fut
+        return futs
 
     def take_batch(
         self,

@@ -114,11 +114,18 @@ class ShmWorkerQueue:
         batch = [first]
         t0 = time.monotonic()
         while len(batch) < max_size:
-            remaining = deadline_s - (time.monotonic() - t0)
-            if remaining <= 0:
-                break
+            # drain whatever is ALREADY in the ring without waiting — same
+            # contract as WorkerQueue.take_batch (the deadline is only an
+            # optional coalescing wait, and at the default 0 a multi-query
+            # request pushed as consecutive messages must still come out
+            # as one batch)
             try:
-                nxt = self._qq.pop(timeout_s=max(remaining, 0.001))
+                nxt = self._qq.pop(timeout_s=0)
+                if nxt is None:
+                    remaining = deadline_s - (time.monotonic() - t0)
+                    if remaining <= 0:
+                        break
+                    nxt = self._qq.pop(timeout_s=remaining)
             except ShmQueueClosed:
                 break
             if nxt is None:
@@ -154,6 +161,14 @@ class _SubmitProxy:
             self._broker._pop_pending(self._job_id, qid)
             fut.set_error(e)
         return fut
+
+    def submit_many(self, queries: List[Any]) -> List[QueryFuture]:
+        # cross-process ring: one message per query; the ring preserves
+        # push order and the worker-side take_batch drains every
+        # already-queued message before it considers the deadline, so
+        # consecutive pushes land as one batch without in-process-style
+        # lock atomicity
+        return [self.submit(q) for q in queries]
 
 
 class ShmBroker(Broker):

@@ -36,9 +36,14 @@ INFERENCE_WORKER_REPLICAS_PER_TRIAL = _env_int(
 # hard p50 floor of ~0.25-0.5 s from sleep-polling (reference rafiki/config.py:14,17
 # and predictor/predictor.py:46-59); here queries are handed to the batcher via
 # condition variables and flushed either when the batch fills or after
-# PREDICT_BATCH_DEADLINE_MS, whichever is first.
+# PREDICT_BATCH_DEADLINE_MS, whichever is first. Deadline 0 = serve whatever
+# has queued the moment the worker is free: under load batches fill by
+# themselves (queries accumulate during the previous dispatch — continuous
+# batching self-paces), so an artificial coalescing wait only adds latency
+# at low load. Multi-query requests stay one batch via submit_many. Raise
+# the deadline only if single-query clients swamp dispatch overhead.
 PREDICT_MAX_BATCH_SIZE = _env_int("PREDICT_MAX_BATCH_SIZE", 64)
-PREDICT_BATCH_DEADLINE_MS = _env_float("PREDICT_BATCH_DEADLINE_MS", 5.0)
+PREDICT_BATCH_DEADLINE_MS = _env_float("PREDICT_BATCH_DEADLINE_MS", 0.0)
 PREDICT_TIMEOUT_S = _env_float("PREDICT_TIMEOUT_S", 30.0)
 
 DEFAULT_TRIAL_COUNT = _env_int("DEFAULT_TRIAL_COUNT", 5)

@@ -226,7 +226,7 @@ class AgentServer:
             cap=None)
         if terr:
             return self._respond(handler, 400, {"error": terr})
-        futures = [queue.submit(q) for q in queries]
+        futures = queue.submit_many(queries)
         deadline = _time.monotonic() + timeout_s
         try:
             preds = [

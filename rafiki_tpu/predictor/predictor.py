@@ -74,7 +74,7 @@ class Predictor:
             for trial, wids in groups.items()
         }
         inflight = {
-            trial: [queues[order[0]].submit(q) for q in queries]
+            trial: queues[order[0]].submit_many(queries)
             for trial, order in orders.items()
         }
         for trial, order in orders.items():
@@ -133,8 +133,7 @@ class Predictor:
                     return preds
             attempt += 1
             if attempt < len(order) and time.monotonic() < deadline:
-                issued.append(
-                    [queues[order[attempt]].submit(q) for q in queries])
+                issued.append(queues[order[attempt]].submit_many(queries))
         # final sweep: any in-flight batch may still land before the SLO
         preds = self._sweep(issued, deadline) if issued else None
         if preds is None:

@@ -8,8 +8,9 @@ trial's model (class bytes from the store + persisted params), serve batches.
 TPU-native difference: instead of popping <=32 queries from a Redis list every
 0.25 s (reference inference.py:43-65, config.py:17-18), the worker blocks on a
 condition-variable queue and wakes the instant a query lands, draining up to
-``PREDICT_MAX_BATCH_SIZE`` within a few-ms deadline so TPU batches fill under
-load without adding idle latency.
+``PREDICT_MAX_BATCH_SIZE`` of whatever has queued — batches fill under load
+because queries accumulate during the previous dispatch, and a single query
+at idle is served immediately (PREDICT_BATCH_DEADLINE_MS defaults to 0).
 """
 
 from __future__ import annotations

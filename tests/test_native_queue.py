@@ -166,6 +166,29 @@ def test_shm_broker_roundtrip():
         broker.close()
 
 
+def test_shm_submit_many_is_one_batch_at_zero_deadline():
+    # the in-process plane's one-request-one-batch contract (cache/queue.py
+    # submit_many) must hold over the ring too: with the batch deadline at
+    # its default 0, take_batch drains every already-queued message before
+    # deadline bookkeeping — otherwise the shm/process-mode path degrades
+    # to singleton batches
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("job1", "w1")
+        proxy = broker.get_worker_queues("job1")["w1"]
+        futs = proxy.submit_many([{"n": i} for i in range(5)])
+        batch = wq.take_batch(max_size=8, deadline_s=0.0, wait_timeout_s=1.0)
+        assert [q for _, q in batch] == [{"n": i} for i in range(5)]
+        for handle, query in batch:
+            handle.set_result({"echo": query})
+        assert [f.result(timeout=5.0) for f in futs] == [
+            {"echo": {"n": i}} for i in range(5)]
+    finally:
+        broker.close()
+
+
 def test_full_stack_over_shm_broker(tmp_workdir, monkeypatch):
     """The AutoML serving path with the native data plane selected."""
     monkeypatch.setenv("RAFIKI_BROKER", "shm")

@@ -115,6 +115,34 @@ def test_slow_replica_still_answers_after_hedge(broker):
     slow.stop()
 
 
+def test_submit_many_is_one_batch_at_zero_deadline(broker):
+    # deadline 0 serves whatever has queued the instant the worker is
+    # free; a multi-query request must still land as ONE batch — that is
+    # submit_many's atomicity contract (a per-query submit loop could be
+    # split by a worker wake-up between items)
+    q = broker.register_worker("job", "w")
+    futs = q.submit_many([[1.0], [2.0], [3.0]])
+    batch = q.take_batch(max_size=16, deadline_s=0.0, wait_timeout_s=0.5)
+    assert [qq for _, qq in batch] == [[1.0], [2.0], [3.0]]
+    for fut, (bf, _) in zip(futs, batch):
+        assert fut is bf
+    # a singleton with an empty queue is served without any coalescing wait
+    q.submit([4.0])
+    t0 = time.monotonic()
+    batch = q.take_batch(max_size=16, deadline_s=0.0, wait_timeout_s=0.5)
+    assert [qq for _, qq in batch] == [[4.0]]
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_submit_many_on_closed_queue_errors_every_future(broker):
+    q = broker.register_worker("job", "w")
+    broker.unregister_worker("job", "w")
+    futs = q.submit_many([[1.0], [2.0]])
+    for fut in futs:
+        with pytest.raises(RuntimeError):
+            fut.result(0.1)
+
+
 def test_take_batch_distinguishes_closed_from_timeout(broker):
     # a closed queue must return None (terminal), never [] in a tight loop —
     # regression for orphaned serving workers spinning on a torn-down data
