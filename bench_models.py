@@ -357,6 +357,63 @@ def bench_longctx(seqs=(2048, 4096, 8192), b: int = 4, h: int = 12,
             }), flush=True)
 
 
+def bench_int8(batches=(1, 8, 64), seq: int = 128, n_calls: int = 30) -> None:
+    """Weight-only int8 serving delta in the regime it targets: a
+    weight-bandwidth-bound predict (BERT-base, ~110M params — each
+    small-batch call streams every kernel out of HBM while the MXU
+    idles). The end-to-end bench measures the delta on its small CNN,
+    where dequant overhead dominates and int8 LOSES (BENCH_r05
+    int8_unloaded_speedup ~0.8); this is the companion measurement on a
+    model the feature is actually for, per batch size. One JSON line per
+    (batch, mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models import bert
+    from rafiki_tpu.sdk.quant import dequantize_pytree, quantize_pytree
+
+    cfg = bert.bert_base(num_classes=2)
+    params = jax.jit(lambda r: bert.init(r, cfg))(jax.random.key(0))
+    # serving keeps bf16 masters; the int8 copy is quantized from them
+    params = jax.tree.map(lambda a: a.astype(jnp.bfloat16)
+                          if a.dtype == jnp.float32 else a, params)
+    qparams = jax.device_put(quantize_pytree(params))
+
+    def predict(p, ids):
+        return jax.nn.softmax(bert.apply(p, ids, cfg), axis=-1)
+
+    def predict_q(qp, ids):
+        return jax.nn.softmax(
+            bert.apply(dequantize_pytree(qp), ids, cfg), axis=-1)
+
+    for batch in batches:
+        ids = jnp.zeros((batch, seq), jnp.int32)
+        base_wall = None
+        for mode, fn, p in (("bf16", predict, params),
+                            ("int8", predict_q, qparams)):
+            jitted = jax.jit(fn)
+            try:
+                _ = np.asarray(jitted(p, ids))  # compile + fence
+                t0 = time.perf_counter()
+                for _ in range(n_calls):
+                    out = jitted(p, ids)
+                _ = np.asarray(out)  # one fence: per-call overhead stays in
+                wall = (time.perf_counter() - t0) / n_calls
+            except Exception as e:
+                print(json.dumps({"model": "BERT-base", "batch": batch,
+                                  "mode": mode, "error": repr(e)[:300]}),
+                      flush=True)
+                continue
+            row = {"model": "BERT-base", "seq": seq, "batch": batch,
+                   "mode": mode, "ms_per_call": round(wall * 1000, 2),
+                   "backend": jax.default_backend()}
+            if mode == "bf16":
+                base_wall = wall
+            elif base_wall:
+                row["speedup_vs_bf16"] = round(base_wall / wall, 3)
+            print(json.dumps(row), flush=True)
+
+
 def sweep_vit() -> None:
     """Single-chip ViT tuning sweep (VERDICT r3 "next" #2): remat policy x
     batch x scan-unroll, one JSON line per config (so a crash mid-sweep
@@ -420,6 +477,10 @@ if __name__ == "__main__":
         sweep_vit()
     elif "--sweep-pggan" in sys.argv:
         sweep_pggan()
+    elif "--int8" in sys.argv:
+        bench_int8(batches=(1, 4) if small else (1, 8, 64),
+                   seq=32 if small else 128,
+                   n_calls=3 if small else 30)
     elif "--longctx" in sys.argv:
         bench_longctx(seqs=(256, 512) if small else (2048, 4096, 8192),
                       n_steps=2 if small else 8)
