@@ -415,6 +415,7 @@ class Admin:
         as_float(BudgetType.TIME_HOURS, 0)
         as_float(BudgetType.TRIAL_TIMEOUT_S, 0, exclusive=True)
         as_int(BudgetType.CHIPS_PER_WORKER, 1)
+        as_int(BudgetType.ENSEMBLE_FUSED, 0)
 
     def get_train_job(
         self, user_id: str, app: str, app_version: int = -1
@@ -563,7 +564,10 @@ class Admin:
         grants every inference worker a multi-chip mesh, so one model
         serves its pjit'd predict sharded across chips (the serving
         analogue of CHIPS_PER_TRIAL; the reference was hard-wired to one
-        GPU per serving worker, reference services_manager.py:390-395)."""
+        GPU per serving worker, reference services_manager.py:390-395).
+        ``ENSEMBLE_FUSED`` truthy co-locates ALL best trials in each
+        worker: one vmapped device dispatch serves the whole ensemble when
+        the trials share a compiled predict (admin/services.py)."""
         # malformed input 400s regardless of job state (route-boundary
         # validation, same policy as create_train_job)
         self._validate_budget(budget or {})

@@ -269,39 +269,63 @@ class ServicesManager:
                 n_replicas,
                 alloc.total_chips
                 // max(len(best_trials) * chips_per_worker, 1)))
+        # Fused ensemble (budget ENSEMBLE_FUSED): one worker per replica
+        # slot holds ALL best trials co-resident and answers with the
+        # final cross-trial ensemble — when the trials share a compiled
+        # predict, the whole ensemble is a single vmapped device dispatch
+        # (worker/inference.py _FusedEnsembleModel). Deployment shape
+        # becomes n_replicas fused workers instead of a fleet per trial.
+        fused = bool(budget.get(BudgetType.ENSEMBLE_FUSED, 0))
+        if fused:
+            if alloc is not None:
+                n_replicas = max(1, min(
+                    config.INFERENCE_WORKER_REPLICAS_PER_TRIAL,
+                    alloc.total_chips // max(chips_per_worker, 1)))
+            # each deployment unit serves the whole group; the bookkeeping
+            # row carries the group's top trial
+            units = [{"trial_id": best_trials[0]["id"],
+                      "group": f"fused:{inference_job_id}",
+                      "trial_ids": [t["id"] for t in best_trials]}
+                     for _ in range(n_replicas)]
+        else:
+            units = [{"trial_id": trial["id"], "group": trial["id"],
+                      "trial_ids": None}
+                     for trial in best_trials for _ in range(n_replicas)]
         try:
-            for trial in best_trials:
-                for _ in range(n_replicas):
-                    service = self._db.create_service(ServiceType.INFERENCE)
-                    self._db.create_inference_job_worker(
-                        service["id"], inference_job_id, trial["id"]
+            for unit in units:
+                service = self._db.create_service(ServiceType.INFERENCE)
+                self._db.create_inference_job_worker(
+                    service["id"], inference_job_id, unit["trial_id"]
+                )
+                worker_trials[service["id"]] = unit["group"]
+                worker = InferenceWorker(
+                    inference_job_id, unit["trial_id"], self._db,
+                    self._broker, trial_ids=unit["trial_ids"],
+                )
+                # serving executors prefer an exclusive chip but fall
+                # back to shared devices when training holds them all
+                try:
+                    ctx = self._placement.create_service(
+                        service["id"],
+                        ServiceType.INFERENCE,
+                        worker.start,
+                        n_chips=chips_per_worker,
+                        best_effort_chips=True,
+                        extra={"inference_job_id": inference_job_id,
+                               "trial_id": unit["trial_id"],
+                               **({"trial_ids": unit["trial_ids"]}
+                                  if unit["trial_ids"] else {})},
                     )
-                    worker_trials[service["id"]] = trial["id"]
-                    worker = InferenceWorker(
-                        inference_job_id, trial["id"], self._db, self._broker
-                    )
-                    # serving executors prefer an exclusive chip but fall
-                    # back to shared devices when training holds them all
-                    try:
-                        ctx = self._placement.create_service(
-                            service["id"],
-                            ServiceType.INFERENCE,
-                            worker.start,
-                            n_chips=chips_per_worker,
-                            best_effort_chips=True,
-                            extra={"inference_job_id": inference_job_id,
-                                   "trial_id": trial["id"]},
-                        )
-                    except Exception:
-                        # close the row: it was never placed, and rollback
-                        # only iterates sids in `created`
-                        self._db.mark_service_as_stopped(service["id"])
-                        raise
-                    # in `created` from the moment it is placed, so the
-                    # outer rollback tears it down even if the chip-index
-                    # bookkeeping below fails
-                    created.append(service["id"])
-                    self._db.update_service_chips(service["id"], ctx.chips)
+                except Exception:
+                    # close the row: it was never placed, and rollback
+                    # only iterates sids in `created`
+                    self._db.mark_service_as_stopped(service["id"])
+                    raise
+                # in `created` from the moment it is placed, so the
+                # outer rollback tears it down even if the chip-index
+                # bookkeeping below fails
+                created.append(service["id"])
+                self._db.update_service_chips(service["id"], ctx.chips)
             predictor_service = self._db.create_service(ServiceType.PREDICT)
             self._db.update_inference_job_predictor(
                 inference_job_id, predictor_service["id"]

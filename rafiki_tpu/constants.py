@@ -45,6 +45,15 @@ class BudgetType:
     # for one chip serves its pjit'd predict sharded over ICI — the serving
     # analogue of CHIPS_PER_TRIAL. Passed in create_inference_job's budget.
     CHIPS_PER_WORKER = "CHIPS_PER_WORKER"
+    # Fused ensemble serving (new capability): truthy deploys ONE worker
+    # (xN replicas) holding ALL best trials co-resident in HBM instead of
+    # a worker fleet per trial. When the trials share a compiled predict
+    # (same template, same architecture knobs), the whole ensemble answers
+    # in a single vmapped device dispatch (SURVEY §7 "ensembles across
+    # trials on one chip set"); otherwise the fused worker still serves
+    # them sequentially in-process. Passed in create_inference_job's
+    # budget.
+    ENSEMBLE_FUSED = "ENSEMBLE_FUSED"
 
 
 class TaskType:

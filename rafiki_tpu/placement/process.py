@@ -312,6 +312,9 @@ class ProcessPlacementManager(PlacementManager):
         elif ctx.service_type == ServiceType.INFERENCE:
             env["RAFIKI_INFERENCE_JOB_ID"] = ctx.extra["inference_job_id"]
             env["RAFIKI_TRIAL_ID"] = ctx.extra["trial_id"]
+            if ctx.extra.get("trial_ids"):
+                # fused ensemble group (budget ENSEMBLE_FUSED)
+                env["RAFIKI_TRIAL_IDS"] = ",".join(ctx.extra["trial_ids"])
             if self.broker is None or not hasattr(self.broker, "prefix"):
                 raise RuntimeError(
                     "process-mode inference needs the shm broker "

@@ -569,19 +569,10 @@ class DataParallelTrainer:
         set of compiled shapes is small, static, and warmable at deploy.
         """
         assert self.predict_fn is not None, "no predict_fn configured"
-        n = len(x)
-        cap = self.round_batch(max(batch_size, 1))
         outs = []
-        i = 0
-        while i < n:
-            chunk = x[i : i + cap]
-            bucket = self._bucket_for(len(chunk), cap)
-            pad = bucket - len(chunk)
-            if pad:
-                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
+        for chunk, pad in self._bucket_chunks(x, batch_size):
             out = np.asarray(self._run_predict(params, chunk, state))
             outs.append(out[: len(out) - pad] if pad else out)
-            i += bucket - pad
         return np.concatenate(outs) if outs else np.zeros((0,))
 
     def warm_predict(self, params: Any, example: np.ndarray,
@@ -591,12 +582,122 @@ class DataParallelTrainer:
         size. Called at serving deploy so no real request ever pays a
         compile. Returns the number of buckets warmed."""
         assert self.predict_fn is not None, "no predict_fn configured"
+        return self._warm_buckets(
+            lambda chunk: self._run_predict(params, chunk, state),
+            example, batch_size)
+
+    # -- fused ensemble serving -------------------------------------------
+
+    def _stacked_jit(self):
+        """The vmapped predict executable for fused-ensemble serving:
+        ``(stacked_params, x) -> (n_models, batch, ...)`` — every co-served
+        model answers the batch in ONE device dispatch instead of one
+        dispatch per trial (SURVEY §7 "ensembles across trials on one chip
+        set"). Runs under the trainer's mesh shardings — params replicated,
+        batch over the data axis — so CHIPS_PER_WORKER grants shard the
+        fused dispatch exactly like the single-model predict. int8 serving
+        composes: each model is quantized individually (see
+        ``stack_ensemble_params``) and dequantized in-graph per vmap
+        instance."""
+        jitted = getattr(self, "_predict_stacked", None)
+        if jitted is None:
+            assert self.predict_fn is not None, "no predict_fn configured"
+            assert not self.stateful, (
+                "fused ensemble serving supports stateless predict only")
+            serving_fn = self.predict_fn
+            if self.serve_int8:
+                from rafiki_tpu.sdk.quant import dequantize_pytree
+
+                def serving_fn(qp, x, _fn=self.predict_fn):
+                    return _fn(dequantize_pytree(qp), x)
+
+            jitted = self._predict_stacked = jax.jit(
+                jax.vmap(serving_fn, in_axes=(0, None)),
+                in_shardings=(self._repl, self._data),
+                out_shardings=NamedSharding(self.mesh, P(None, DATA_AXIS)),
+            )
+        return jitted
+
+    def stack_ensemble_params(self, params_list: list) -> Any:
+        """Stack N models' param trees along a new leading axis and place
+        them on the serving devices — the co-resident ensemble's HBM
+        layout. Under int8 serving each model's tree is quantized
+        INDIVIDUALLY first (its own per-channel scales, its own
+        small-leaf pass-through gates — identical numerics to its solo
+        int8 serving) and the q/scale leaves are then stacked."""
+        if self.serve_int8:
+            from rafiki_tpu.sdk.quant import is_quantized_leaf, quantize_pytree
+
+            qlist = [quantize_pytree(p) for p in params_list]
+
+            def stack_leaf(*xs):
+                if is_quantized_leaf(xs[0]):
+                    return {
+                        "q": np.stack([np.asarray(x["q"]) for x in xs]),
+                        "scale": np.stack(
+                            [np.asarray(x["scale"]) for x in xs]),
+                    }
+                return np.stack([np.asarray(x) for x in xs])
+
+            stacked = jax.tree.map(stack_leaf, *qlist,
+                                   is_leaf=is_quantized_leaf)
+        else:
+            stacked = jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *params_list)
+        return jax.device_put(stacked, self._repl)
+
+    def _bucket_chunks(self, x: np.ndarray, batch_size: int):
+        """Shared bucket walk for the predict paths: yields
+        ``(padded_chunk, pad)`` per bucket on the fixed ladder (the single
+        home of the pad-with-repeat rule — the stacked and single-model
+        paths must never drift)."""
+        n = len(x)
+        cap = self.round_batch(max(batch_size, 1))
+        i = 0
+        while i < n:
+            chunk = x[i: i + cap]
+            bucket = self._bucket_for(len(chunk), cap)
+            pad = bucket - len(chunk)
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], pad, axis=0)])
+            yield chunk, pad
+            i += bucket - pad
+
+    def predict_batched_stacked(
+        self, stacked_params: Any, x: np.ndarray, batch_size: int = 256,
+    ) -> np.ndarray:
+        """``predict_batched`` for a fused ensemble: returns
+        ``(n_models, len(x), ...)`` predictions, one vmapped dispatch per
+        padded bucket (same bucket ladder/compile-count guarantees)."""
+        jitted = self._stacked_jit()
+        outs = []
+        for chunk, pad in self._bucket_chunks(x, batch_size):
+            out = np.asarray(jitted(stacked_params, chunk))
+            outs.append(out[:, : out.shape[1] - pad] if pad else out)
+        if not outs:
+            n_models = np.shape(jax.tree.leaves(stacked_params)[0])[0]
+            return np.zeros((n_models, 0))
+        return np.concatenate(outs, axis=1)
+
+    def warm_predict_stacked(self, stacked_params: Any, example: np.ndarray,
+                             batch_size: int = 256) -> int:
+        """``warm_predict`` for the fused-ensemble path."""
+        jitted = self._stacked_jit()
+        return self._warm_buckets(
+            lambda chunk: jitted(stacked_params, chunk), example, batch_size)
+
+    def _warm_buckets(self, run, example: np.ndarray,
+                      batch_size: int) -> int:
+        """Shared deploy-time bucket warm-up: run ``run(chunk)`` once per
+        ladder rung so no real request ever pays an XLA compile."""
         example = np.asarray(example)
         cap = self.round_batch(max(batch_size, 1))
         buckets = self.predict_buckets(cap)
         for b in buckets:
             chunk = np.broadcast_to(example[None], (b,) + example.shape)
-            self._run_predict(params, np.ascontiguousarray(chunk), state)
+            run(np.ascontiguousarray(chunk))
         return len(buckets)
 
 

@@ -102,6 +102,21 @@ class BaseModel(abc.ABC):
     def destroy(self) -> None:
         """Release resources (default: no-op)."""
 
+    def ensemble_stack(self, models: List["BaseModel"]) -> Optional[Any]:
+        """Optional fused-ensemble serving hook (budget ``ENSEMBLE_FUSED``).
+
+        ``models`` is the full co-served group, ``self`` included. Return an
+        object with ``predict_all(queries) -> [n_models][n_queries]`` (and
+        optionally ``warm_up()``) that answers for EVERY model in one device
+        dispatch — for SDK-trainer templates that is
+        ``DataParallelTrainer.predict_batched_stacked`` over
+        ``stack_ensemble_params`` (see JaxCnn.ensemble_stack). Return None
+        when the group cannot share a compiled predict (different
+        architecture knobs, different param shapes, non-JAX template); the
+        fused worker then serves the group sequentially in-process.
+        Default: None."""
+        return None
+
 
 def load_model_class(
     model_bytes: bytes, class_name: str, temp_dir: Optional[str] = None

@@ -39,6 +39,10 @@ def _is_qleaf(x: Any) -> bool:
             and getattr(x["q"], "dtype", None) == jnp.int8)
 
 
+#: public name (fused-ensemble stacking walks quantized trees leaf-wise)
+is_quantized_leaf = _is_qleaf
+
+
 def quantize_pytree(params: Any, min_elems: int = 4096) -> Any:
     """Replace large float kernels (ndim >= 2) with
     ``{"q": int8, "scale": f32 per-last-axis-channel}``; biases, norms,

@@ -169,12 +169,15 @@ def _run_inference(ctx, db, admin_client) -> None:
         # cannot see this process) for /inference_jobs/<app>/<v>/stats
         report = lambda payload: admin_client.send_event(  # noqa: E731
             "inference_worker_stats", **payload)
+    trial_ids = os.environ.get("RAFIKI_TRIAL_IDS")
     worker = InferenceWorker(
         _require("RAFIKI_INFERENCE_JOB_ID"),
         _require("RAFIKI_TRIAL_ID"),
         db,
         broker,
         report_stats=report,
+        # fused ensemble group (budget ENSEMBLE_FUSED)
+        trial_ids=trial_ids.split(",") if trial_ids else None,
     )
     worker.start(ctx)
 

@@ -51,6 +51,68 @@ def _record_batch(service_id: str, n_queries: int) -> None:
         s["queries"] += n_queries
 
 
+class _FusedEnsembleModel:
+    """The fused-ensemble serving unit (budget ``ENSEMBLE_FUSED``): every
+    best trial's model co-resident in this worker, answering each batch as
+    one unit. When the group shares a compiled predict
+    (``BaseModel.ensemble_stack``), the whole ensemble is ONE vmapped
+    device dispatch; otherwise the models answer sequentially in-process.
+    Either way this worker resolves futures with the FINAL (cross-trial
+    ensembled) predictions, so the predictor treats the group as a single
+    replica set."""
+
+    def __init__(self, models, task: str):
+        from rafiki_tpu.predictor.ensemble import ensemble_predictions
+
+        self._models = models
+        self._task = task
+        self._ensemble = ensemble_predictions
+        # sandboxed serving children (sdk/sandbox.py SandboxedModelServer)
+        # are separate processes — co-residency is impossible there, so the
+        # hook may be absent entirely
+        stack_fn = getattr(models[0], "ensemble_stack", None)
+        self._stacked = stack_fn(models) if callable(stack_fn) else None
+        if self._stacked is None and len(models) > 1:
+            logger.info(
+                "fused worker: trials do not share a compiled predict; "
+                "serving %d models sequentially in-process", len(models))
+
+    @property
+    def fused_dispatch(self) -> bool:
+        return self._stacked is not None
+
+    @property
+    def dead(self) -> bool:
+        # sandbox-mode members expose .dead when their child process died
+        # and will never recover; the worker loop reads this to exit and
+        # let placement's restart policy replace the whole replica
+        return any(getattr(m, "dead", False) for m in self._models)
+
+    def predict(self, queries):
+        if self._stacked is not None:
+            per_model = self._stacked.predict_all(queries)
+        else:
+            per_model = [m.predict(queries) for m in self._models]
+        return [
+            self._ensemble([pm[i] for pm in per_model], self._task)
+            for i in range(len(queries))
+        ]
+
+    def warm_up(self):
+        if self._stacked is not None and hasattr(self._stacked, "warm_up"):
+            self._stacked.warm_up()
+        else:
+            for m in self._models:
+                m.warm_up()
+
+    def destroy(self):
+        for m in self._models:
+            try:
+                m.destroy()
+            except Exception:
+                logger.exception("destroy failed for a fused-ensemble model")
+
+
 class InferenceWorker:
     def __init__(
         self,
@@ -60,6 +122,7 @@ class InferenceWorker:
         broker: Broker,
         report_stats=None,
         report_interval_s: float = 5.0,
+        trial_ids: Optional[list] = None,
     ):
         """``report_stats({"service_id", "batches", "queries"})`` relays
         cumulative serving counters to a remote admin (process placement —
@@ -69,6 +132,10 @@ class InferenceWorker:
         best-effort."""
         self._job_id = inference_job_id
         self._trial_id = trial_id
+        #: fused-ensemble mode (budget ENSEMBLE_FUSED): ALL the job's best
+        #: trials co-served by this one worker; ``trial_id`` is then the
+        #: group's top trial (the bookkeeping row)
+        self._trial_ids = list(trial_ids) if trial_ids else [trial_id]
         self._db = db
         self._broker = broker
         self._report_stats = report_stats
@@ -103,8 +170,21 @@ class InferenceWorker:
                 return
 
     def _load_model(self, service_id: str):
-        trial = self._db.get_trial(self._trial_id)
-        assert trial is not None, f"no trial {self._trial_id}"
+        if len(self._trial_ids) > 1:
+            models = [
+                self._load_one(tid, f"{service_id}-m{i}")
+                for i, tid in enumerate(self._trial_ids)
+            ]
+            inf = self._db.get_inference_job(self._job_id)
+            assert inf is not None
+            train_job = self._db.get_train_job(inf["train_job_id"])
+            assert train_job is not None
+            return _FusedEnsembleModel(models, train_job["task"])
+        return self._load_one(self._trial_id, service_id)
+
+    def _load_one(self, trial_id: str, service_id: str):
+        trial = self._db.get_trial(trial_id)
+        assert trial is not None, f"no trial {trial_id}"
         model_row = self._db.get_model(trial["model_id"])
         assert model_row is not None
         from rafiki_tpu.sdk.deps import activate_prefix, ensure_dependencies

@@ -1,0 +1,181 @@
+"""Fused ensemble serving (budget ENSEMBLE_FUSED): all best trials
+co-resident in each worker, answered as one unit — a single vmapped device
+dispatch when the trials share a compiled predict (SURVEY §7 "ensembles
+across trials on one chip set"). The reference's serving fleet was always
+one container fleet per trial (reference admin/services_manager.py:390-395).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+from rafiki_tpu.sdk.dataset import write_numpy_dataset
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fake_model.py")
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples", "models",
+                        "image_classification")
+
+
+@pytest.fixture()
+def admin(tmp_path):
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0, 1, 2, 3])),
+        params_dir=str(tmp_path / "params"),
+    )
+    yield a
+    a.shutdown()
+
+
+def _login(admin):
+    return admin.authenticate_user(
+        config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+
+
+def _wait_chips(admin, n=4, timeout=15):
+    deadline = time.monotonic() + timeout
+    while (admin.placement.allocator.free_chips < n
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+
+
+def test_fused_deployment_shape_and_fallback(admin):
+    """With ENSEMBLE_FUSED the fleet is n_replicas fused workers, not
+    trials x replicas; a template without ensemble_stack still serves
+    (sequential in-process fallback)."""
+    uid = _login(admin)
+    with open(FIXTURE, "rb") as f:
+        admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION", f.read(),
+                           "FakeModel")
+    admin.create_train_job(
+        uid, "fusedapp", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": 3, "CHIP_COUNT": 1},
+    )
+    admin.wait_until_train_job_stopped(uid, "fusedapp", timeout_s=60)
+
+    inf = admin.create_inference_job(uid, "fusedapp",
+                                     budget={"ENSEMBLE_FUSED": 1})
+    # 2 best trials would mean 4 workers unfused; fused = replicas only
+    assert len(inf["workers"]) == config.INFERENCE_WORKER_REPLICAS_PER_TRIAL
+    preds = admin.predict(uid, "fusedapp", [[0.0], [1.0]])
+    assert len(preds) == 2
+    admin.stop_inference_job(uid, "fusedapp")
+    _wait_chips(admin)
+
+
+def _train_jaxcnn_job(admin, uid, app, tmp_path, n_trials=2):
+    sys.path.insert(0, EXAMPLES)
+    with open(os.path.join(EXAMPLES, "JaxCnn.py"), "rb") as f:
+        src = f.read()
+    # pin every compute knob so all trials land in ONE trainer bucket
+    src += (b"\n\nclass FusedCnn(JaxCnn):\n"
+            b"    @staticmethod\n"
+            b"    def get_knob_config():\n"
+            b"        cfg = dict(JaxCnn.get_knob_config())\n"
+            b"        cfg['epochs'] = FixedKnob(1)\n"
+            b"        cfg['num_stages'] = FixedKnob(1)\n"
+            b"        cfg['base_channels'] = FixedKnob(8)\n"
+            b"        cfg['batch_size'] = FixedKnob(32)\n"
+            b"        return cfg\n")
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 4, size=64).astype(np.int32)
+    train_uri = write_numpy_dataset(x, y, str(tmp_path / "train.npz"))
+    test_uri = write_numpy_dataset(x[:16], y[:16], str(tmp_path / "test.npz"))
+    admin.create_model(uid, f"cnn-{app}", "IMAGE_CLASSIFICATION", src,
+                       "FusedCnn")
+    admin.create_train_job(
+        uid, app, "IMAGE_CLASSIFICATION", train_uri, test_uri,
+        budget={"MODEL_TRIAL_COUNT": n_trials, "CHIP_COUNT": 1},
+        model_names=[f"cnn-{app}"],
+    )
+    admin.wait_until_train_job_stopped(uid, app, timeout_s=300)
+    return x
+
+
+def test_fused_matches_unfused_predictions(admin, tmp_path):
+    """The fused (vmapped single-dispatch) deployment must return the same
+    ensembled probabilities as the per-trial fleet on the same trials."""
+    uid = _login(admin)
+    x = _train_jaxcnn_job(admin, uid, "cnnapp", tmp_path)
+    queries = [x[0].tolist(), x[1].tolist()]
+
+    admin.create_inference_job(uid, "cnnapp")
+    plain = admin.predict(uid, "cnnapp", queries)
+    admin.stop_inference_job(uid, "cnnapp")
+    _wait_chips(admin)
+
+    inf = admin.create_inference_job(uid, "cnnapp",
+                                     budget={"ENSEMBLE_FUSED": 1})
+    assert len(inf["workers"]) == config.INFERENCE_WORKER_REPLICAS_PER_TRIAL
+    fused = admin.predict(uid, "cnnapp", queries)
+    admin.stop_inference_job(uid, "cnnapp")
+
+    assert np.allclose(np.asarray(plain), np.asarray(fused), atol=1e-4), (
+        plain, fused)
+
+
+def test_ensemble_stack_int8_matches_solo_int8(tmp_path, monkeypatch):
+    """Under RAFIKI_SERVE_INT8=1 the fused path must quantize each model
+    INDIVIDUALLY (its own scales and pass-through gates) — fused int8
+    predictions equal each model's solo int8 predictions, not a
+    shared-scale approximation."""
+    monkeypatch.setenv("RAFIKI_SERVE_INT8", "1")
+    sys.path.insert(0, EXAMPLES)
+    from JaxCnn import JaxCnn
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(32, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 3, size=32).astype(np.int32)
+    uri = write_numpy_dataset(x, y, str(tmp_path / "d.npz"))
+    # arch knobs distinct from the other tests': cached_trainer must build
+    # a FRESH trainer under the int8 env var, not reuse a bf16-mode one
+    knobs = dict(epochs=1, num_stages=2, base_channels=16,
+                 learning_rate=1e-3, batch_size=16, image_size=32)
+    m1, m2 = JaxCnn(**knobs), JaxCnn(**{**knobs, "learning_rate": 4e-3})
+    m1.train(uri)
+    m2.train(uri)
+
+    queries = [x[0].tolist(), x[1].tolist()]
+    solo = [m.predict(queries) for m in (m1, m2)]  # solo int8 serving
+    fused = m1.ensemble_stack([m1, m2])
+    assert fused is not None
+    per_model = fused.predict_all(queries)
+    assert np.allclose(np.asarray(per_model), np.asarray(solo), atol=1e-4)
+
+
+def test_ensemble_stack_requires_shared_bucket(tmp_path):
+    """JaxCnn.ensemble_stack fuses same-architecture models (one vmapped
+    predict over stacked params, numerically matching per-model predict)
+    and refuses a mixed-architecture group."""
+    sys.path.insert(0, EXAMPLES)
+    from JaxCnn import JaxCnn
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(32, 32, 32, 3)).astype(np.float32)
+    y = rng.integers(0, 3, size=32).astype(np.int32)
+    uri = write_numpy_dataset(x, y, str(tmp_path / "d.npz"))
+    knobs = dict(epochs=1, num_stages=1, base_channels=8,
+                 learning_rate=1e-3, batch_size=16, image_size=32)
+    m1, m2 = JaxCnn(**knobs), JaxCnn(**{**knobs, "learning_rate": 5e-3})
+    m1.train(uri)
+    m2.train(uri)
+
+    fused = m1.ensemble_stack([m1, m2])
+    assert fused is not None
+    per_model = fused.predict_all([x[0].tolist(), x[1].tolist()])
+    assert np.asarray(per_model).shape[:2] == (2, 2)
+    solo = [m.predict([x[0].tolist(), x[1].tolist()]) for m in (m1, m2)]
+    assert np.allclose(np.asarray(per_model), np.asarray(solo), atol=1e-4)
+
+    # different architecture -> different trainer bucket -> no fusion
+    m3 = JaxCnn(**{**knobs, "base_channels": 16})
+    m3.train(uri)
+    assert m1.ensemble_stack([m1, m3]) is None
