@@ -485,6 +485,50 @@ def main():
                     server.port, "benchapp", query, direct=True, binary=True))
                 admin.stop_inference_job(uid, "benchapp")
 
+            # ---- fused ensemble: both-trials-one-dispatch delta --------
+            # ENSEMBLE_FUSED co-locates the best trials in each worker and
+            # answers with ONE vmapped dispatch (docs/parallelism.md) —
+            # measured at both operating points on the dedicated door so
+            # the dispatch-halving shows up as latency/throughput, not
+            # prose. Runs before int8 so each phase compares to the same
+            # plain-serving baseline.
+            if BENCH_SERVING and os.environ.get(
+                    "RAFIKI_BENCH_FUSED", "1") not in ("0", "false"):
+                fused_job = False
+                try:
+                    _wait_chips_free(admin)
+                    admin.create_inference_job(
+                        uid, "benchapp", budget={"ENSEMBLE_FUSED": 1})
+                    fused_job = True
+                    fusedr = bench_serving_unloaded(
+                        server.port, "benchapp", query, direct=True)
+                    for k in ("requests", "errors", "p50_ms", "p99_ms"):
+                        serving[f"serving_fused_unloaded_{k}"] = fusedr.get(
+                            f"serving_direct_unloaded_{k}")
+                    base = serving.get("serving_direct_unloaded_p50_ms")
+                    p50f = serving.get("serving_fused_unloaded_p50_ms")
+                    if base and p50f:
+                        serving["fused_unloaded_speedup"] = round(
+                            base / p50f, 3)
+                    sat = bench_serving_concurrent(
+                        server.port, "benchapp", query, direct=True)
+                    for k in ("requests", "errors", "req_s", "p50_ms",
+                              "p99_ms", "batch_occupancy"):
+                        if f"serving_direct_{k}" in sat:
+                            serving[f"serving_fused_{k}"] = sat[
+                                f"serving_direct_{k}"]
+                except Exception as e:
+                    serving["fused_error"] = repr(e)
+                finally:
+                    if fused_job:
+                        # a leaked running job blocks the int8 phase's
+                        # create_inference_job (one running job per train
+                        # job, admin.py)
+                        try:
+                            admin.stop_inference_job(uid, "benchapp")
+                        except Exception:
+                            pass
+
             # ---- int8 weight-only serving: on/off delta ----------------
             # The quant story's bandwidth win is a TPU-format property
             # (docs/performance.md); measure it instead of claiming it.
