@@ -170,55 +170,16 @@ class JaxCnn(BaseModel):
         # Fused-ensemble serving (budget ENSEMBLE_FUSED): co-served trials
         # that landed in the same trainer bucket (same architecture knobs
         # -> cached_trainer returns the same instance) answer a batch in
-        # ONE vmapped dispatch over their stacked params. Different
+        # ONE vmapped dispatch over their stacked params; different
         # buckets/shapes -> None, and the worker serves sequentially.
-        if self._trainer is None or self._params is None:
+        from rafiki_tpu.sdk import trainer_ensemble_stack
+
+        if self._params is None:
             return None
-        for m in models:
-            if getattr(m, "_trainer", None) is not self._trainer:
-                return None
-        params_list = [m._params for m in models]
-        struct0 = jax.tree.structure(params_list[0])
-        shapes0 = [np.shape(x) for x in jax.tree.leaves(params_list[0])]
-        for p in params_list[1:]:
-            if (jax.tree.structure(p) != struct0
-                    or [np.shape(x) for x in jax.tree.leaves(p)] != shapes0):
-                return None
-        trainer = self._trainer
-        stacked = trainer.stack_ensemble_params(params_list)
         size = self._knobs["image_size"]
-        channels = int(np.shape(params_list[0]["stem"]["kernel"])[2])
-        # the stacked copy is now the HBM-resident ensemble; keeping every
-        # model's own device tree alive too would double the footprint of
-        # exactly the worker whose point is co-residency — move the
-        # per-model params to host (the sequential fallback never runs
-        # once fusion succeeded; predict would just re-upload)
-        for m in models:
-            m._params = jax.tree.map(np.asarray, m._params)
-
-        class _Fused:
-            n_models = len(models)
-
-            @staticmethod
-            def predict_all(queries):
-                from rafiki_tpu import config as rconfig
-
-                x = np.asarray(queries, dtype=np.float32)
-                out = trainer.predict_batched_stacked(
-                    stacked, x, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
-                return [[row.tolist() for row in per_model]
-                        for per_model in out]
-
-            @staticmethod
-            def warm_up():
-                from rafiki_tpu import config as rconfig
-
-                example = np.zeros((size, size, channels), np.float32)
-                trainer.warm_predict_stacked(
-                    stacked, example,
-                    batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
-
-        return _Fused()
+        channels = int(np.shape(self._params["stem"]["kernel"])[2])
+        return trainer_ensemble_stack(
+            models, np.zeros((size, size, channels), np.float32))
 
     def dump_parameters(self):
         return {

@@ -123,6 +123,17 @@ class JaxFeedForward(BaseModel):
         self._trainer.warm_predict(self._params, example,
                                    batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
 
+    def ensemble_stack(self, models):
+        # fused-ensemble serving (budget ENSEMBLE_FUSED; docs/parallelism.md)
+        from rafiki_tpu.sdk import trainer_ensemble_stack
+
+        if self._params is None or self._cfg is None:
+            return None
+        size = self._knobs["image_size"]
+        channels = self._cfg.in_dim // (size * size)
+        return trainer_ensemble_stack(
+            models, np.zeros((size, size, channels), np.float32))
+
     def dump_parameters(self):
         return {
             "params": jax.tree.map(np.asarray, self._params),

@@ -11,6 +11,7 @@ from rafiki_tpu.sdk.jax_backend import (  # noqa: F401
     classification_accuracy,
     enable_persistent_compile_cache,
     softmax_classifier_loss,
+    trainer_ensemble_stack,
     tunable_optimizer,
 )
 from rafiki_tpu.sdk.knob import (  # noqa: F401

@@ -701,6 +701,74 @@ class DataParallelTrainer:
         return len(buckets)
 
 
+def trainer_ensemble_stack(models: list, example: np.ndarray,
+                           to_predictions=None):
+    """Generic ``BaseModel.ensemble_stack`` implementation for SDK-trainer
+    templates: fuse ``models`` (each with ``_trainer`` / ``_params``
+    attributes, the full co-served group) into one vmapped predict over
+    stacked params, or return None when they cannot share a compiled
+    predict. ``example`` is one query's worth of input for deploy warm-up;
+    ``to_predictions(out_row) -> list`` converts one model's raw output
+    batch (default: ``.tolist()`` per row). Templates opt in with::
+
+        def ensemble_stack(self, models):
+            return trainer_ensemble_stack(
+                models, np.zeros(self._example_shape, np.float32))
+
+    Fusion requires every model to hold the SAME trainer instance (the
+    ``cached_trainer`` bucket — same template, same architecture knobs)
+    and identically-shaped param trees."""
+    from rafiki_tpu import config as rconfig
+
+    first = models[0]
+    trainer = getattr(first, "_trainer", None)
+    if trainer is None or getattr(first, "_params", None) is None:
+        return None
+    # enforce the contract here, not as a deploy-time assert in the worker:
+    # a stateful trainer (batch norm) or one without a predict_fn cannot
+    # share a vmapped compiled predict — fall back to sequential serving
+    if trainer.stateful or trainer.predict_fn is None:
+        return None
+    for m in models:
+        if getattr(m, "_trainer", None) is not trainer:
+            return None
+    params_list = [m._params for m in models]
+    struct0 = jax.tree.structure(params_list[0])
+    shapes0 = [np.shape(x) for x in jax.tree.leaves(params_list[0])]
+    for p in params_list[1:]:
+        if (jax.tree.structure(p) != struct0
+                or [np.shape(x) for x in jax.tree.leaves(p)] != shapes0):
+            return None
+    stacked = trainer.stack_ensemble_params(params_list)
+    # the stacked copy is now the HBM-resident ensemble; keeping every
+    # model's own device tree alive too would double the footprint of
+    # exactly the worker whose point is co-residency — move the per-model
+    # params to host (the sequential fallback never runs once fusion
+    # succeeded; plain predict would just re-upload)
+    for m in models:
+        m._params = jax.tree.map(np.asarray, m._params)
+    example = np.asarray(example)
+    convert = to_predictions or (lambda out: [row.tolist() for row in out])
+
+    class _Fused:
+        n_models = len(models)
+
+        @staticmethod
+        def predict_all(queries):
+            x = np.asarray(queries, dtype=np.float32)
+            out = trainer.predict_batched_stacked(
+                stacked, x, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
+            return [convert(per_model) for per_model in out]
+
+        @staticmethod
+        def warm_up():
+            trainer.warm_predict_stacked(
+                stacked, example,
+                batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
+
+    return _Fused()
+
+
 def softmax_classifier_loss(apply_fn: Callable[..., jax.Array]) -> LossFn:
     """Standard cross-entropy loss for an ``apply_fn(params, x) -> logits``
     classifier; batch = (x, labels)."""
