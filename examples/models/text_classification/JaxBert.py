@@ -142,17 +142,22 @@ class JaxBert(BaseModel):
 
         return classification_accuracy(self._trainer, self._params, x, y)
 
-    def predict(self, queries):
-        from rafiki_tpu import config as rconfig
-
+    def _to_ids(self, queries):
         k = self._knobs
-        ids = np.stack([
+        if not queries:  # np.stack refuses an empty list
+            return np.zeros((0, k["max_len"]), np.int32)
+        return np.stack([
             _hash_ids(q.split() if isinstance(q, str) else list(q),
                       k["vocab"], k["max_len"])
             for q in queries
         ])
+
+    def predict(self, queries):
+        from rafiki_tpu import config as rconfig
+
         probs = self._trainer.predict_batched(
-            self._params, ids, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
+            self._params, self._to_ids(queries),
+            batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
         return [p.tolist() for p in probs]
 
     def warm_up(self):
@@ -161,6 +166,16 @@ class JaxBert(BaseModel):
         example = np.zeros((self._knobs["max_len"],), np.int32)
         self._trainer.warm_predict(self._params, example,
                                    batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
+
+    def ensemble_stack(self, models):
+        # fused-ensemble serving (budget ENSEMBLE_FUSED; docs/parallelism.md)
+        from rafiki_tpu.sdk import trainer_ensemble_stack
+
+        if self._params is None:
+            return None
+        return trainer_ensemble_stack(
+            models, np.zeros((self._knobs["max_len"],), np.int32),
+            to_batch=self._to_ids)
 
     def dump_parameters(self):
         return {

@@ -702,14 +702,17 @@ class DataParallelTrainer:
 
 
 def trainer_ensemble_stack(models: list, example: np.ndarray,
-                           to_predictions=None):
+                           to_predictions=None, to_batch=None):
     """Generic ``BaseModel.ensemble_stack`` implementation for SDK-trainer
     templates: fuse ``models`` (each with ``_trainer`` / ``_params``
     attributes, the full co-served group) into one vmapped predict over
     stacked params, or return None when they cannot share a compiled
     predict. ``example`` is one query's worth of input for deploy warm-up;
     ``to_predictions(out_row) -> list`` converts one model's raw output
-    batch (default: ``.tolist()`` per row). Templates opt in with::
+    batch (default: ``.tolist()`` per row); ``to_batch(queries) ->
+    np.ndarray`` converts raw queries into the predict batch (default:
+    ``np.asarray(queries, np.float32)`` — text templates pass their
+    tokenizer here, see JaxBert). Templates opt in with::
 
         def ensemble_stack(self, models):
             return trainer_ensemble_stack(
@@ -749,13 +752,15 @@ def trainer_ensemble_stack(models: list, example: np.ndarray,
         m._params = jax.tree.map(np.asarray, m._params)
     example = np.asarray(example)
     convert = to_predictions or (lambda out: [row.tolist() for row in out])
+    batchify = to_batch or (
+        lambda queries: np.asarray(queries, dtype=np.float32))
 
     class _Fused:
         n_models = len(models)
 
         @staticmethod
         def predict_all(queries):
-            x = np.asarray(queries, dtype=np.float32)
+            x = batchify(queries)
             out = trainer.predict_batched_stacked(
                 stacked, x, batch_size=rconfig.PREDICT_MAX_BATCH_SIZE)
             return [convert(per_model) for per_model in out]
