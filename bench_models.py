@@ -357,6 +357,170 @@ def bench_longctx(seqs=(2048, 4096, 8192), b: int = 4, h: int = 12,
             }), flush=True)
 
 
+def bench_ablation() -> None:
+    """ViT-B/16 step-time COST ATTRIBUTION (not a tuning sweep): where
+    does the gap between measured MFU (~0.36) and peak go? One JSON line
+    per variant so a mid-run hang loses nothing. The first two rows
+    calibrate the ACHIEVABLE peak — if a chained square bf16 GEMM cannot
+    approach 197 TFLOP/s through this chip/tunnel, every MFU in the
+    record should be read against the calibrated ceiling, not the
+    datasheet. Then: fwd-only vs fwd+bwd vs full step splits compute
+    between forward, backward(+remat recompute), and optimizer;
+    remat=None at batches that fit without remat prices the recompute;
+    forced-flash prices the attention kernel choice at seq 196."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from rafiki_tpu.models import vit
+
+    peak = PEAK_TFLOPS * 1e12
+
+    def gemm(tag, make_operands, chain_body, flops, iters=24):
+        try:
+            ops = make_operands()
+
+            def chain(*ops):
+                c, _ = jax.lax.scan(lambda c, _: (chain_body(c, *ops[1:]), ()),
+                                    ops[0], None, length=iters)
+                return c
+
+            jitted = jax.jit(chain)
+            c = jitted(*ops)
+            _ = float(jnp.sum(c.astype(jnp.float32)))
+            t0 = time.perf_counter()
+            c = jitted(*ops)
+            _ = float(jnp.sum(c.astype(jnp.float32)))
+            dt = time.perf_counter() - t0
+            print(json.dumps({
+                "tag": tag, "tflops_per_s": round(flops * iters / dt / 1e12, 1),
+                "pct_of_peak": round(flops * iters / dt / peak * 100, 1),
+                "backend": jax.default_backend()}), flush=True)
+        except Exception as e:
+            print(json.dumps({"tag": tag, "error": repr(e)[:200]}), flush=True)
+
+    # CPU backend (or RAFIKI_ABLATE_SMALL=1) = tiny smoke of every
+    # variant's trace path: a trace error must surface before the run
+    # spends a TPU window, and a CPU box must never attempt 8192-cube
+    # GEMMs. Same falsy rule as __main__'s RAFIKI_BENCH_SMALL.
+    small = (jax.default_backend() == "cpu"
+             or os.environ.get("RAFIKI_ABLATE_SMALL", "").strip().lower()
+             not in ("", "0", "false"))
+    n = 256 if small else 8192
+    gemm(f"gemm_calibration_{n}",
+         lambda: (jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16),
+                  jax.random.normal(jax.random.key(1), (n, n), jnp.bfloat16)),
+         lambda c, b: c @ b, 2.0 * n * n * n)
+    m, k, nn = (256, 64, 128) if small else (192 * 196, 768, 3072)
+    gemm("gemm_vit_proj_shape",
+         lambda: (jax.random.normal(jax.random.key(2), (m, k), jnp.bfloat16),
+                  jax.random.normal(jax.random.key(3), (k, nn), jnp.bfloat16)),
+         lambda c, w: (c @ w)[:, :k], 2.0 * m * k * nn)
+
+    def mkcfg(remat, unroll=1, flash=None):
+        cfg = (vit.tiny(image_size=32) if small
+               else vit.vit_b16(num_classes=1000, image_size=224))
+        return dataclasses.replace(cfg, encoder=dataclasses.replace(
+            cfg.encoder, remat=remat, scan_unroll=unroll, use_flash=flash))
+
+    def run(tag, cfg, batch, steps_per_call=8, n_steps=32, mode="full",
+            flops_mult=3.0):
+        params = jax.jit(lambda r: vit.init(r, cfg))(jax.random.key(0))
+        opt = optax.adamw(1e-3)
+        opt_state = jax.jit(opt.init)(params)
+        x = jnp.zeros((batch, cfg.image_size, cfg.image_size, 3),
+                      jnp.bfloat16)
+        y = jnp.zeros((batch,), jnp.int32)
+
+        def loss_fn(p, rng):
+            logits = vit.apply(p, x, cfg, rng, deterministic=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        if mode == "fwd":
+            def multi(p, s, rng):
+                def one(carry, _):
+                    acc, r = carry
+                    r = jax.random.split(r)[0]
+                    # accumulate the real loss — a *0 here would let XLA
+                    # dead-code-eliminate the whole forward
+                    return (acc + loss_fn(p, r), r), acc
+                (acc, rng), _ = jax.lax.scan(
+                    one, (jnp.zeros(()), rng), None, length=steps_per_call)
+                return p, s, rng, acc
+        else:  # "grad"
+            def multi(p, s, rng):
+                def one(carry, _):
+                    pp, r = carry
+                    r, sub = jax.random.split(r)
+                    loss, g = jax.value_and_grad(loss_fn)(pp, sub)
+                    # consume the grads without an optimizer: a non-zero
+                    # scale keeps XLA from dead-code-eliminating backward
+                    pp = jax.tree.map(
+                        lambda a, b: a - jnp.asarray(1e-30, a.dtype)
+                        * b.astype(a.dtype), pp, g)
+                    return (pp, r), loss
+                (p, rng), ls = jax.lax.scan(one, (p, rng), None,
+                                            length=steps_per_call)
+                return p, s, rng, ls[-1]
+
+        jitted = jax.jit(multi, donate_argnums=(0, 1))
+        rng = jax.random.key(1)
+        try:
+            params, opt_state, rng, out = jitted(params, opt_state, rng)
+            _ = float(jnp.sum(out))
+            n_calls = max(n_steps // steps_per_call, 1)
+            t0 = time.perf_counter()
+            for _ in range(n_calls):
+                params, opt_state, rng, out = jitted(params, opt_state, rng)
+            _ = float(jnp.sum(out))
+            dt = (time.perf_counter() - t0) / (n_calls * steps_per_call)
+        except Exception as e:
+            print(json.dumps({"tag": tag, "error": repr(e)[:200]}),
+                  flush=True)
+            return
+        fl = vit_train_flops(cfg, batch) * flops_mult / 3.0
+        print(json.dumps({
+            "tag": tag, "batch": batch, "mode": mode,
+            "step_ms": round(dt * 1000, 2),
+            "eff_mfu": round(fl / (dt * peak), 4),
+            "imgs_per_s": round(batch / dt, 1),
+            "backend": jax.default_backend()}), flush=True)
+
+    def full(tag, **kwargs):
+        # full-step rows delegate to bench_vit — ONE timing harness for
+        # the fused train step, so ablation rows stay comparable to the
+        # sweep's and cannot drift from it
+        if small:
+            kwargs = {**kwargs, "batch_size": 4, "image_size": 64,
+                      "n_steps": 4, "steps_per_call": 2}
+        try:
+            r = bench_vit(**kwargs)
+        except Exception as e:
+            print(json.dumps({"tag": tag, "error": repr(e)[:200]}),
+                  flush=True)
+            return
+        print(json.dumps({"tag": tag, **{k: r[k] for k in (
+            "batch_size", "remat", "use_flash", "steps_per_call",
+            "step_time_ms", "images_per_s", "mfu", "backend")}}),
+            flush=True)
+
+    B = 4 if small else 192
+    steps = dict(steps_per_call=2, n_steps=4) if small else {}
+    full("full_dots", batch_size=192, remat="dots")
+    full("full_dots_spc16", batch_size=192, remat="dots", steps_per_call=16)
+    run("fwd_dots", mkcfg("dots"), B, mode="fwd", flops_mult=1.0, **steps)
+    run("grad_dots", mkcfg("dots"), B, mode="grad", **steps)
+    run("fwd_none", mkcfg(None), B, mode="fwd", flops_mult=1.0, **steps)
+    for b in ((8,) if small else (64, 96, 128)):
+        full(f"full_none_b{b}", batch_size=b, remat=None)
+        full(f"full_dots_b{b}", batch_size=b, remat="dots")
+    full("full_full_b192", batch_size=192, remat="full")
+    full("full_dots_flash", batch_size=192, remat="dots", use_flash=True)
+
+
 def bench_int8(batches=(1, 8, 64), seq: int = 128, n_calls: int = 30) -> None:
     """Weight-only int8 serving delta in the regime it targets: a
     weight-bandwidth-bound predict (BERT-base, ~110M params — each
@@ -477,6 +641,8 @@ if __name__ == "__main__":
         sweep_vit()
     elif "--sweep-pggan" in sys.argv:
         sweep_pggan()
+    elif "--ablate" in sys.argv:
+        bench_ablation()
     elif "--int8" in sys.argv:
         bench_int8(batches=(1, 4) if small else (1, 8, 64),
                    seq=32 if small else 128,
