@@ -107,6 +107,41 @@ def test_dedicated_port_serves_with_admin_token(deployed_app):
     assert status == 200 and len(payload["data"]["predictions"]) == 1
 
 
+def test_door_round_trip_has_no_nagle_stall(deployed_app):
+    """Regression for the ~40ms Nagle/delayed-ACK stall: the stock
+    handler wrote headers and body as separate TCP segments, so every
+    response waited out the peer's delayed ACK (LowLatencyHandler,
+    utils/reqfields.py). With the stall, loopback p50 sits at 40ms+
+    even for a trivial predictor; without it, single-digit ms — assert
+    p50 well under the stall, over a KEEP-ALIVE connection (the stalled
+    regime is per-response, not per-connect)."""
+    import http.client
+    import time
+
+    admin, uid, token = deployed_app
+    inf = admin.get_inference_job(uid, "portapp")
+    host, port = inf["predictor_host"], inf["predictor_port"]
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    body = json.dumps({"queries": [[0.0]]})
+    headers = {"Authorization": f"Bearer {token}",
+               "Content-Type": "application/json"}
+    samples = []
+    try:
+        for i in range(30):
+            t0 = time.monotonic()
+            conn.request("POST", "/predict", body, headers)
+            resp = conn.getresponse()
+            resp.read()
+            samples.append(time.monotonic() - t0)
+            assert resp.status == 200
+    finally:
+        conn.close()
+    p50 = sorted(samples)[len(samples) // 2] * 1000
+    # threshold sits between healthy (single-digit ms) and stalled
+    # (40ms+) with margin for loaded-CI scheduling jitter
+    assert p50 < 35.0, f"door p50 {p50:.1f}ms — Nagle stall is back?"
+
+
 def test_client_predict_direct(deployed_app, tmp_workdir):
     admin, uid, token = deployed_app
     server = AdminServer(admin).start()
