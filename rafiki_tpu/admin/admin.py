@@ -739,6 +739,25 @@ class Admin:
                 self._predict_route_cache[key] = (now, predictor)
         return predictor.predict_batch(queries)
 
+    def get_fleet_health(self) -> Dict[str, Any]:
+        """Operator view of the fleet health subsystem: per-agent
+        heartbeat state, circuit breaker state, and load
+        (placement/hosts.py agent_health). Single-host placements report
+        an empty agent map — the admin process itself answering IS the
+        health signal there."""
+        from rafiki_tpu.utils import chaos as _chaos
+
+        agents = {}
+        if hasattr(self.placement, "agent_health"):
+            agents = self.placement.agent_health()
+        down = [a for a, h in agents.items() if h["state"] == "DOWN"]
+        return {
+            "placement": type(self.placement).__name__,
+            "agents": agents,
+            "agents_down": down,
+            "chaos_active": _chaos.enabled(),
+        }
+
     def stop_all_jobs(self) -> None:
         """Stop every running train/inference job (reference client
         stop_all_jobs, rafiki/client/client.py:647), marking the job rows —
@@ -809,6 +828,16 @@ class Admin:
             sub = self.db.get_sub_train_job(worker["sub_train_job_id"])
             if sub is not None:
                 self.services.refresh_train_job_status(sub["train_job_id"])
+        # the last serving replica dying must terminate its inference job
+        # (fleet health: dead-host workers are errored by the heartbeat
+        # monitor, placement/hosts.py) — and its cached predict routes
+        if worker is None and status in ("STOPPED", "ERRORED"):
+            iworker = self.db.get_inference_job_worker(service_id)
+            if iworker is not None:
+                final = self.services.refresh_inference_job_status(
+                    iworker["inference_job_id"])
+                if final is not None:
+                    self._drop_predict_routes(iworker["inference_job_id"])
 
     def shutdown(self) -> None:
         self.stop_all_jobs()

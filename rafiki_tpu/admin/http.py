@@ -258,6 +258,10 @@ class AdminServer:
             # admin actions (reference scripts/stop_all_jobs.py via client)
             r("POST", "/actions/stop_all_jobs", _ADMINS,
                 lambda au, m, b, q: A.stop_all_jobs() or {}),
+            # fleet health: per-agent heartbeat + circuit breaker state
+            # (placement/hosts.py monitor; docs/failure-model.md)
+            r("GET", "/fleet/health", _ADMINS,
+                lambda au, m, b, q: A.get_fleet_health()),
             # internal events (reference admin/app.py:360). Workers
             # authenticate as superadmin (as the reference's did, reference
             # worker/train.py:261-263); plain users must not be able to stop

@@ -217,6 +217,55 @@ class ServicesManager:
             else:
                 self._db.mark_train_job_as_stopped(train_job_id)
 
+    def refresh_inference_job_status(
+        self, inference_job_id: str
+    ) -> Optional[str]:
+        """Serving analogue of refresh_train_job_status (fleet health):
+        when EVERY serving replica of an inference job is terminal — e.g.
+        its hosts died and the heartbeat monitor errored their services —
+        the job can never answer a query again, so it must reach a
+        terminal status in the store without operator action. Returns the
+        new job status when a transition happened, else None."""
+        inf = self._db.get_inference_job(inference_job_id)
+        if inf is None or inf["status"] in (
+            InferenceJobStatus.STOPPED,
+            InferenceJobStatus.ERRORED,
+        ):
+            return None
+        statuses = []
+        for w in self._db.get_workers_of_inference_job(inference_job_id):
+            svc = self._db.get_service(w["service_id"])
+            if svc:
+                statuses.append(svc["status"])
+        if not statuses or not all(
+            s in (ServiceStatus.STOPPED, ServiceStatus.ERRORED)
+            for s in statuses
+        ):
+            return None
+        return self._teardown_serving(
+            inference_job_id,
+            errored=any(s == ServiceStatus.ERRORED for s in statuses))
+
+    def _teardown_serving(self, inference_job_id: str,
+                          errored: bool) -> str:
+        """Shared serving-teardown tail: drop the predictor (and its
+        dedicated port), close the predictor service row, and mark the
+        job terminal. Used by the operator stop path and the all-replicas-
+        dead refresh so the two cannot drift."""
+        inf = self._db.get_inference_job(inference_job_id)
+        with self._lock:
+            self._predictors.pop(inference_job_id, None)
+            psrv = self._predict_servers.pop(inference_job_id, None)
+        if psrv is not None:
+            psrv.stop()
+        if inf and inf.get("predictor_service_id"):
+            self._db.mark_service_as_stopped(inf["predictor_service_id"])
+        if errored:
+            self._db.mark_inference_job_as_errored(inference_job_id)
+            return InferenceJobStatus.ERRORED
+        self._db.mark_inference_job_as_stopped(inference_job_id)
+        return InferenceJobStatus.STOPPED
+
     # -- inference -----------------------------------------------------------
 
     def create_inference_services(self, inference_job_id: str) -> Predictor:
@@ -370,15 +419,7 @@ class ServicesManager:
     def stop_inference_services(self, inference_job_id: str) -> None:
         for w in self._db.get_workers_of_inference_job(inference_job_id):
             self._destroy_service(w["service_id"], wait=False)
-        inf_job = self._db.get_inference_job(inference_job_id)
-        if inf_job and inf_job.get("predictor_service_id"):
-            self._db.mark_service_as_stopped(inf_job["predictor_service_id"])
-        with self._lock:
-            self._predictors.pop(inference_job_id, None)
-            psrv = self._predict_servers.pop(inference_job_id, None)
-        if psrv is not None:
-            psrv.stop()
-        self._db.mark_inference_job_as_stopped(inference_job_id)
+        self._teardown_serving(inference_job_id, errored=False)
 
     # -- shared --------------------------------------------------------------
 

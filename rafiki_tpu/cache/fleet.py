@@ -55,6 +55,7 @@ class HttpWorkerQueue:
     def __init__(self, agent_addr: str, inference_job_id: str,
                  worker_id: str, key: Optional[str] = None,
                  timeout_s: Optional[float] = None):
+        self.agent_addr = agent_addr  # health subsystem evicts by host
         self._addr = agent_addr
         self._job_id = inference_job_id
         self._worker_id = worker_id
@@ -100,7 +101,10 @@ class HttpWorkerQueue:
             with self._cond:
                 while not self._pending and not self._closed:
                     self._cond.wait()
-                if self._closed and not self._pending:
+                if self._closed:
+                    # close() already failed every pending future; relaying
+                    # a popped batch after close would block teardown on a
+                    # full transport timeout
                     return
                 batch = self._pending[:RELAY_MAX_BATCH]
                 del self._pending[:len(batch)]
@@ -131,13 +135,21 @@ class HttpWorkerQueue:
         except AgentTransportError as e:
             raise RuntimeError(f"relay unreachable: {e}") from None
 
-    def close(self) -> None:
+    def close(self, join_timeout_s: float = 1.0) -> None:
+        """Fail all pending work and stop the sender thread. The closed
+        flag short-circuits the sender's next loop iteration; the bounded
+        join makes broker teardown deterministic in tests. An in-flight
+        relay can still hold the (daemon) thread for up to its transport
+        timeout — we never wait that out, and the join is kept short so
+        wait=False teardown paths stay snappy even mid-relay."""
         with self._cond:
             self._closed = True
             for fut, _ in self._pending:
                 fut.set_error(RuntimeError("remote worker queue closed"))
             self._pending.clear()
             self._cond.notify_all()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=join_timeout_s)
 
 
 class FleetBroker(Broker):
@@ -187,12 +199,31 @@ class FleetBroker(Broker):
             old.close()
         return q
 
+    # fleet health (placement/hosts.py heartbeat monitor) ----------------
+    def evict_agent(self, agent_addr: str) -> List[Tuple[str, str]]:
+        """Drop and close every remote queue relayed through ``agent_addr``
+        (a host marked DOWN). Returns the evicted (job_id, worker_id)
+        pairs. Without this, the predictor's hedged fan-out keeps burning
+        deadline slices on replicas that can never answer."""
+        evicted: List[Tuple[str, HttpWorkerQueue]] = []
+        with self._lock:
+            for job_id, queues in self._remote.items():
+                for wid, q in list(queues.items()):
+                    if q.agent_addr == agent_addr:
+                        queues.pop(wid)
+                        evicted.append(((job_id, wid), q))
+        for _, q in evicted:
+            q.close(join_timeout_s=0.0)  # dead host: don't wait on its relay
+        return [pair for pair, _ in evicted]
+
     # optional base-broker capabilities ----------------------------------
     @property
     def prefix(self):
         # process placement needs the shm namespace of the underlying
-        # broker (placement/process.py); surface it when present
-        return getattr(self._base, "prefix")
+        # broker (placement/process.py); None — not AttributeError —
+        # when the base broker has no shm namespace, so callers can
+        # decide explicitly
+        return getattr(self._base, "prefix", None)
 
     def close(self) -> None:
         with self._lock:

@@ -53,6 +53,25 @@ ADMIN_PORT = _env_int("ADMIN_PORT", 3000)
 
 SERVICE_DEPLOY_TIMEOUT_S = _env_float("SERVICE_DEPLOY_TIMEOUT_S", 60.0)
 
+# -- fleet health (docs/failure-model.md) -----------------------------------
+# Heartbeats: the admin-side HostAgentPlacementManager probes each agent's
+# /healthz every AGENT_HEARTBEAT_INTERVAL_S; AGENT_DOWN_THRESHOLD
+# consecutive misses marks the host DOWN (queues evicted, services
+# errored/rescheduled). 0 disables the monitor thread.
+AGENT_HEARTBEAT_INTERVAL_S = _env_float("RAFIKI_AGENT_HEARTBEAT_S", 5.0)
+AGENT_DOWN_THRESHOLD = _env_int("RAFIKI_AGENT_DOWN_THRESHOLD", 3)
+AGENT_HEARTBEAT_TIMEOUT_S = _env_float("RAFIKI_AGENT_HEARTBEAT_TIMEOUT_S", 2.0)
+# Transport retry (idempotent agent calls only): up to AGENT_RETRY_MAX
+# re-attempts on transport failure, exponential backoff from
+# AGENT_RETRY_BACKOFF_S with full jitter.
+AGENT_RETRY_MAX = _env_int("RAFIKI_AGENT_RETRY_MAX", 2)
+AGENT_RETRY_BACKOFF_S = _env_float("RAFIKI_AGENT_RETRY_BACKOFF_S", 0.1)
+# Circuit breaker: AGENT_BREAKER_THRESHOLD consecutive transport failures
+# open an agent's circuit; calls then fail fast (no 10 s socket timeout)
+# until a half-open probe succeeds after AGENT_BREAKER_COOLDOWN_S.
+AGENT_BREAKER_THRESHOLD = _env_int("RAFIKI_AGENT_BREAKER_THRESHOLD", 3)
+AGENT_BREAKER_COOLDOWN_S = _env_float("RAFIKI_AGENT_BREAKER_COOLDOWN_S", 5.0)
+
 
 def workdir() -> str:
     return os.environ.get("RAFIKI_WORKDIR", os.path.abspath("."))

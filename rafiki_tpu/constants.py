@@ -115,6 +115,14 @@ class ServiceType:
     ADVISOR = "ADVISOR"
 
 
+class AgentHealth:
+    # Heartbeat-derived state of a host agent (placement/hosts.py monitor;
+    # docs/failure-model.md). UNKNOWN = not probed yet.
+    UNKNOWN = "UNKNOWN"
+    UP = "UP"
+    DOWN = "DOWN"
+
+
 class ModelAccessRight:
     PUBLIC = "PUBLIC"
     PRIVATE = "PRIVATE"

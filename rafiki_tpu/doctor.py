@@ -109,6 +109,23 @@ def check_sandbox() -> Check:
             f"enabled, per-trial uid drop, gid {gid}{note}")
 
 
+def check_chaos() -> Check:
+    from rafiki_tpu.utils import chaos
+
+    if not os.environ.get(chaos.ENV_VAR):
+        return ("fault injection", PASS, "off (RAFIKI_CHAOS unset)")
+    if chaos.enabled():
+        # loud on purpose: chaos left on after a failover drill makes a
+        # healthy fleet look like it's dying
+        return ("fault injection", WARN,
+                f"RAFIKI_CHAOS is ACTIVE: "
+                f"{os.environ[chaos.ENV_VAR]!r} — requests are being "
+                "dropped/delayed/errored on schedule")
+    return ("fault injection", WARN,
+            f"RAFIKI_CHAOS set but unparseable (ignored): "
+            f"{os.environ[chaos.ENV_VAR]!r}")
+
+
 def check_agents() -> Check:
     from rafiki_tpu.utils.agent_http import AgentHTTPError, call_agent
 
@@ -121,7 +138,20 @@ def check_agents() -> Check:
     total = 0
     for addr in agents:
         try:
-            inv = call_agent(addr, "GET", "/inventory", key=key, timeout_s=5)
+            # /healthz first (unauthenticated): separates "host process
+            # dead" from "alive but misconfigured" — the same liveness
+            # probe the admin's heartbeat monitor uses, so doctor and the
+            # /fleet/health API agree on what DOWN means
+            call_agent(addr, "GET", "/healthz", timeout_s=5,
+                       use_breaker=False)
+        except AgentHTTPError:
+            pass  # the host ANSWERED: alive (any config problem shows below)
+        except Exception:
+            down.append(addr)
+            continue
+        try:
+            inv = call_agent(addr, "GET", "/inventory", key=key, timeout_s=5,
+                             use_breaker=False)
             total += int(inv.get("total_chips", 0))
         except AgentHTTPError as e:
             # a live agent refusing the request is a CONFIG problem, not
@@ -148,7 +178,10 @@ def check_agents() -> Check:
                 "agent.key here)")
     if down:
         return ("host agents", FAIL if len(down) == len(agents) else WARN,
-                f"unreachable: {down} (fleet chips visible: {total})")
+                f"DOWN (no /healthz answer): {down} (fleet chips visible: "
+                f"{total}) — a hosts-mode admin evicts their serving "
+                "queues and fails their train executors over; see "
+                "GET /fleet/health and docs/failure-model.md")
     if not key:
         return ("host agents", WARN,
                 f"{len(agents)} agent(s), {total} fleet chips — keyless "
@@ -160,7 +193,7 @@ def check_agents() -> Check:
 
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
-    check_agents, check_backend,
+    check_chaos, check_agents, check_backend,
 ]
 
 

@@ -62,6 +62,7 @@ from typing import Any, Dict, Optional
 from rafiki_tpu.constants import ServiceType
 from rafiki_tpu.placement.manager import ChipAllocator, InsufficientChipsError
 from rafiki_tpu.placement.process import ProcessPlacementManager
+from rafiki_tpu.utils import chaos
 from rafiki_tpu.utils.reqfields import LowLatencyHandler
 
 logger = logging.getLogger(__name__)
@@ -122,6 +123,17 @@ class AgentServer:
     def _dispatch(self, handler: BaseHTTPRequestHandler, method: str) -> None:
         try:
             path = handler.path.split("?", 1)[0].rstrip("/")
+            rule = chaos.hit(chaos.SITE_AGENT, path)
+            if rule is not None:
+                # deterministic fault injection (RAFIKI_CHAOS): lets tier-1
+                # tests watch this agent "die" or stall on schedule
+                if rule.action == chaos.ACTION_DROP:
+                    handler.close_connection = True
+                    return  # no response: callers see a transport error
+                if rule.action == chaos.ACTION_ERROR:
+                    return self._respond(handler, rule.code,
+                                         {"error": "chaos-injected error"})
+                chaos.sleep_for(rule)
             if method == "GET" and path == "/healthz":
                 # liveness stays unauthenticated (monitors/doctor probes)
                 return self._respond(handler, 200, {
@@ -297,6 +309,9 @@ def main() -> int:
     )
     from rafiki_tpu.db.database import Database
 
+    if chaos.enabled():
+        logger.warning("RAFIKI_CHAOS set — fault injection ACTIVE on this "
+                       "agent (unset it outside failover drills)")
     key = os.environ.get("RAFIKI_AGENT_KEY")
     insecure = os.environ.get("RAFIKI_AGENT_INSECURE") == "1"
     if not key and not insecure:

@@ -34,11 +34,15 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from rafiki_tpu.constants import ServiceType
+from rafiki_tpu import config
+from rafiki_tpu.constants import AgentHealth, ServiceType
 from rafiki_tpu.utils.agent_http import (
+    AgentCircuitOpenError,
     AgentHTTPError,
     AgentTransportError,
+    breaker_states,
     call_agent,
+    reset_breaker,
 )
 from rafiki_tpu.placement.manager import (
     InsufficientChipsError,
@@ -54,6 +58,12 @@ class AgentUnreachableError(Exception):
     pass
 
 
+class AgentCircuitOpenUnreachable(AgentUnreachableError):
+    """Refused by an open circuit breaker: the request NEVER reached the
+    wire, so — unlike a generic transport failure — nothing can have been
+    committed on the agent. Placement treats this as provably unplaced."""
+
+
 class _AgentHandle:
     """Client for one host agent (wire protocol: utils/agent_http.py)."""
 
@@ -64,14 +74,18 @@ class _AgentHandle:
         self.timeout_s = timeout_s
 
     def _call(self, method: str, path: str,
-              body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+              body: Optional[Dict[str, Any]] = None,
+              idempotent: Optional[bool] = None) -> Dict[str, Any]:
         try:
             return call_agent(self.addr, method, path, body=body,
-                              key=self.key, timeout_s=self.timeout_s)
+                              key=self.key, timeout_s=self.timeout_s,
+                              idempotent=idempotent)
         except AgentHTTPError as e:
             if e.code == 503:
                 raise InsufficientChipsError(e.message)
             raise AgentUnreachableError(f"{self.addr}: {e.message}")
+        except AgentCircuitOpenError as e:
+            raise AgentCircuitOpenUnreachable(str(e))
         except AgentTransportError as e:
             raise AgentUnreachableError(str(e))
 
@@ -91,7 +105,10 @@ class _AgentHandle:
         return list(out.get("chips", []))
 
     def stop_service(self, service_id: str, wait: bool) -> None:
-        self._call("POST", f"/services/{service_id}/stop", {"wait": wait})
+        # stopping an already-stopped service is a no-op on the agent, so
+        # this POST is safe to retry on transport failures
+        self._call("POST", f"/services/{service_id}/stop", {"wait": wait},
+                   idempotent=True)
 
 
 class _FleetInventory:
@@ -132,6 +149,8 @@ class HostAgentPlacementManager(PlacementManager):
         db=None,
         inventory_ttl_s: float = 1.0,
         monitor_interval_s: float = 0.5,
+        heartbeat_interval_s: Optional[float] = None,
+        down_threshold: Optional[int] = None,
     ):
         if not agents:
             raise ValueError("at least one agent address required")
@@ -157,9 +176,35 @@ class HostAgentPlacementManager(PlacementManager):
         self._placed: Dict[str, str] = {}  # service_id -> agent addr
         # service_id -> inference_job_id, for relay-queue teardown
         self._placed_jobs: Dict[str, str] = {}
+        # service_id -> original create args, so a dead host's train
+        # executors can be replayed onto survivors (failover)
+        self._placed_specs: Dict[str, Dict[str, Any]] = {}
+        # addr -> service ids stripped from it while it was DOWN; fenced
+        # (stopped) on that agent if it ever rejoins, so a false-positive
+        # DOWN (partition, not crash) cannot leave two live executors for
+        # one service id
+        self._stripped: Dict[str, List[str]] = {}
         self._reported: set = set()
         self._monitor: Optional[threading.Thread] = None
         self._closed = threading.Event()
+        # -- fleet health: heartbeat/lease state per agent ----------------
+        self._heartbeat_interval_s = (
+            heartbeat_interval_s if heartbeat_interval_s is not None
+            else config.AGENT_HEARTBEAT_INTERVAL_S)
+        self._down_threshold = max(
+            down_threshold if down_threshold is not None
+            else config.AGENT_DOWN_THRESHOLD, 1)
+        self._health: Dict[str, Dict[str, Any]] = {
+            a: {"state": AgentHealth.UNKNOWN, "misses": 0,
+                "last_ok": None, "last_error": None}
+            for a in agents
+        }
+        self._heartbeat: Optional[threading.Thread] = None
+        if self._heartbeat_interval_s > 0:
+            self._heartbeat = threading.Thread(
+                target=self._heartbeat_loop, name="hosts-heartbeat",
+                daemon=True)
+            self._heartbeat.start()
 
     # -- inventories -------------------------------------------------------
 
@@ -167,8 +212,13 @@ class HostAgentPlacementManager(PlacementManager):
         with self._lock:
             if time.monotonic() - self._inventory_at < self._inventory_ttl_s:
                 return list(self._inventory_cache)
+        with self._lock:
+            down = {a for a, h in self._health.items()
+                    if h["state"] == AgentHealth.DOWN}
         out: List[Tuple[str, Dict[str, Any]]] = []
         for addr, handle in self.agents.items():
+            if addr in down:
+                continue  # heartbeat says dead; don't spend a timeout on it
             try:
                 out.append((addr, handle.inventory()))
             except AgentUnreachableError:
@@ -284,6 +334,7 @@ class HostAgentPlacementManager(PlacementManager):
         back to the local engine). ``tried`` (mutated) records the chosen
         agent BEFORE the create attempt, so a caller retry loop always
         makes progress and never re-asks a refusing agent."""
+        requested_chips = n_chips  # pre-downsize ask, for failover replay
         exclude = frozenset(tried or ())
         addr = self._choose_agent(n_chips, exclude=exclude)
         if addr is None:
@@ -301,6 +352,12 @@ class HostAgentPlacementManager(PlacementManager):
             chips = self.agents[addr].create_service(
                 service_id, service_type, n_chips, best_effort_chips,
                 dict(extra or {}))
+        except AgentCircuitOpenUnreachable as e:
+            # fail-fast refusal BEFORE any request was sent: provably
+            # unplaced, no undo needed — skip this agent and let the
+            # caller's loop try the remaining candidates
+            logger.warning("agent %s circuit open; skipped (%s)", addr, e)
+            return None
         except AgentUnreachableError:
             # AMBIGUOUS: the agent may have committed the worker before
             # the wire failed. Try to undo; only a confirmed undo makes a
@@ -326,6 +383,12 @@ class HostAgentPlacementManager(PlacementManager):
             self._placed[service_id] = addr
             if service_type == ServiceType.INFERENCE and job_id:
                 self._placed_jobs[service_id] = job_id
+            self._placed_specs[service_id] = {
+                "service_type": service_type,
+                "n_chips": requested_chips,
+                "best_effort_chips": best_effort_chips,
+                "extra": dict(extra or {}),
+            }
             self._inventory_at = 0.0  # free-chip counts changed
             if (self.db is not None and self._monitor is None
                     and not self._closed.is_set()):
@@ -347,6 +410,7 @@ class HostAgentPlacementManager(PlacementManager):
         with self._lock:
             addr = self._placed.pop(service_id, None)
             job_id = self._placed_jobs.pop(service_id, None)
+            self._placed_specs.pop(service_id, None)
         if addr is None:
             if self.local is not None:
                 self.local.destroy_service(service_id, wait=wait)
@@ -394,11 +458,225 @@ class HostAgentPlacementManager(PlacementManager):
                         except Exception:
                             logger.exception("status callback failed")
 
+    # -- fleet health: heartbeats, DOWN handling, failover -----------------
+
+    def _heartbeat_loop(self) -> None:
+        """Probe every agent's /healthz each interval. N consecutive
+        misses marks the agent DOWN (its lease lapses): serving queues are
+        evicted, its services errored, train executors rescheduled. A
+        successful probe after DOWN restores the agent and closes its
+        circuit breaker. Probes bypass the breaker — they ARE the signal
+        that decides recovery, so they must always reach the wire."""
+        while not self._closed.wait(self._heartbeat_interval_s):
+            for addr, handle in list(self.agents.items()):
+                if self._closed.is_set():
+                    return
+                try:
+                    call_agent(
+                        addr, "GET", "/healthz", key=handle.key,
+                        timeout_s=min(config.AGENT_HEARTBEAT_TIMEOUT_S,
+                                      max(self._heartbeat_interval_s, 0.1)),
+                        idempotent=False, use_breaker=False)
+                    alive = True
+                    err: Optional[str] = None
+                except AgentHTTPError as e:
+                    # the host answered; a non-200 /healthz is a config
+                    # problem, not a dead machine
+                    alive = True
+                    err = f"healthz {e.code}: {e.message}"
+                except Exception as e:
+                    alive = False
+                    err = str(e)
+                try:
+                    self._note_heartbeat(addr, alive, err)
+                except Exception:
+                    logger.exception("heartbeat bookkeeping failed for %s",
+                                     addr)
+
+    def _note_heartbeat(self, addr: str, alive: bool,
+                        err: Optional[str]) -> None:
+        went_down = came_up = False
+        with self._lock:
+            h = self._health.get(addr)
+            if h is None:
+                return
+            if alive:
+                h["misses"] = 0
+                h["last_ok"] = time.monotonic()
+                h["last_error"] = err
+                if h["state"] != AgentHealth.UP:
+                    came_up = h["state"] == AgentHealth.DOWN
+                    h["state"] = AgentHealth.UP
+                    self._inventory_at = 0.0  # re-include immediately
+            else:
+                h["misses"] += 1
+                h["last_error"] = err
+                if (h["state"] != AgentHealth.DOWN
+                        and h["misses"] >= self._down_threshold):
+                    h["state"] = AgentHealth.DOWN
+                    went_down = True
+        # reconciliation runs OFF the heartbeat thread: a slow failover
+        # (inventory refreshes + create calls at transport timeouts) must
+        # not stall failure detection for the other agents
+        if came_up:
+            reset_breaker(addr)
+            logger.warning("agent %s recovered; rejoining the fleet", addr)
+            threading.Thread(target=self._fence_rejoined, args=(addr,),
+                             name=f"fence-{addr}", daemon=True).start()
+        if went_down:
+            logger.error("agent %s DOWN after %d missed heartbeats (%s)",
+                         addr, self._down_threshold, err)
+            threading.Thread(target=self._run_failover, args=(addr,),
+                             name=f"failover-{addr}", daemon=True).start()
+
+    def _run_failover(self, addr: str) -> None:
+        try:
+            self._handle_agent_down(addr)
+        except Exception:
+            logger.exception("failover for dead agent %s failed", addr)
+
+    def _fence_rejoined(self, addr: str) -> None:
+        """A host back from DOWN may still be running the services that
+        were rescheduled or errored while it was away (false-positive DOWN:
+        a partition, not a crash). Stop those orphans on it, so one service
+        id never has two live executors."""
+        with self._lock:
+            orphans = self._stripped.pop(addr, [])
+        for sid in orphans:
+            try:
+                self.agents[addr].stop_service(sid, wait=False)
+                logger.warning("fenced orphan service %s on rejoined "
+                               "agent %s", sid[:8], addr)
+            except (AgentUnreachableError, InsufficientChipsError) as e:
+                logger.warning("could not fence orphan %s on %s (%s)",
+                               sid[:8], addr, e)
+
+    def _handle_agent_down(self, addr: str) -> None:
+        """Reconcile a dead host: (1) evict its relay queues so the
+        predictor's hedged fan-out stops burning deadline slices on
+        replicas that cannot answer; (2) reschedule its train executors
+        onto surviving agents (same service id, so the new worker resumes
+        the stale RUNNING trials from their checkpoints); (3) error
+        everything that could not be moved, so the admin's job-level
+        refresh and crash recovery fire without operator action."""
+        if self.broker is not None and hasattr(self.broker, "evict_agent"):
+            try:
+                evicted = self.broker.evict_agent(addr)
+                if evicted:
+                    logger.warning("evicted %d relay queue(s) of dead agent "
+                                   "%s: %s", len(evicted), addr, evicted)
+            except Exception:
+                logger.exception("relay eviction failed for %s", addr)
+        with self._lock:
+            doomed = [sid for sid, a in self._placed.items() if a == addr]
+            specs = {}
+            for sid in doomed:
+                self._placed.pop(sid, None)
+                self._placed_jobs.pop(sid, None)
+                specs[sid] = self._placed_specs.pop(sid, None)
+            self._stripped.setdefault(addr, []).extend(doomed)
+            self._inventory_at = 0.0
+        for sid in doomed:
+            if self.db is not None:
+                try:
+                    row = self.db.get_service(sid)
+                except Exception:
+                    row = None
+                if row is not None and row["status"] in ("STOPPED",
+                                                         "ERRORED"):
+                    # already terminal in the store (e.g. a budget-drained
+                    # worker that exited cleanly before its host died) —
+                    # nothing to rehome, nothing to error
+                    continue
+            spec = specs.get(sid)
+            if (spec is not None
+                    and spec["service_type"] == ServiceType.TRAIN
+                    and self._reschedule(sid, spec, dead=addr)):
+                continue
+            self._mark_errored(sid)
+
+    def _reschedule(self, service_id: str, spec: Dict[str, Any],
+                    dead: str) -> bool:
+        """Replay a dead host's train executor through the least-loaded
+        placement path, excluding every DOWN agent. The service keeps its
+        id, so the replacement worker's crash recovery resumes the trials
+        the dead one left RUNNING (worker/train.py)."""
+        with self._lock:
+            tried = {a for a, h in self._health.items()
+                     if h["state"] == AgentHealth.DOWN}
+        tried.add(dead)
+        while True:
+            before = len(tried)
+            try:
+                ctx = self._create_on_agent(
+                    service_id, spec["service_type"], spec["n_chips"],
+                    spec["best_effort_chips"], spec["extra"], tried=tried)
+            except InsufficientChipsError as e:
+                if len(tried) == before:
+                    logger.warning("cannot reschedule %s: %s",
+                                   service_id[:8], e)
+                    return False
+                continue
+            except AgentUnreachableError:
+                logger.exception("rescheduling %s failed", service_id[:8])
+                return False
+            if ctx is not None:
+                logger.warning("service %s failed over %s -> %s",
+                               service_id[:8], dead,
+                               self._placed.get(service_id))
+                return True
+            if len(tried) > before:
+                continue
+            logger.warning("no surviving agent can take %s", service_id[:8])
+            return False
+
+    def _mark_errored(self, service_id: str) -> None:
+        """Terminal-status backstop for a service whose host died with it:
+        the agent-side monitor died too, so the admin side must write the
+        store row (and fire the job-refresh side effects) itself."""
+        if self.db is not None:
+            try:
+                self.db.mark_service_as_errored(service_id)
+            except Exception:
+                logger.exception("could not mark %s ERRORED", service_id)
+        with self._lock:
+            self._reported.add(service_id)  # status monitor: already final
+        if self.on_status:
+            try:
+                self.on_status(service_id, "ERRORED")
+            except Exception:
+                logger.exception("status callback failed for %s", service_id)
+
+    def agent_health(self) -> Dict[str, Dict[str, Any]]:
+        """Operator view (admin API /fleet/health, doctor): heartbeat state
+        + circuit breaker state + load per agent."""
+        breakers = breaker_states()
+        now = time.monotonic()
+        with self._lock:
+            placed_by_addr: Dict[str, int] = {}
+            for a in self._placed.values():
+                placed_by_addr[a] = placed_by_addr.get(a, 0) + 1
+            return {
+                addr: {
+                    "state": h["state"],
+                    "consecutive_misses": h["misses"],
+                    "seconds_since_ok": (
+                        round(now - h["last_ok"], 3)
+                        if h["last_ok"] is not None else None),
+                    "last_error": h["last_error"],
+                    "breaker": breakers.get(addr, "CLOSED"),
+                    "services_placed": placed_by_addr.get(addr, 0),
+                }
+                for addr, h in self._health.items()
+            }
+
     def stop_all(self) -> None:
         self._closed.set()
         with self._lock:
             placed = dict(self._placed)
             self._placed.clear()
+            self._placed_jobs.clear()
+            self._placed_specs.clear()
         for sid, addr in placed.items():
             try:
                 self.agents[addr].stop_service(sid, wait=False)

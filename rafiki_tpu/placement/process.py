@@ -315,12 +315,16 @@ class ProcessPlacementManager(PlacementManager):
             if ctx.extra.get("trial_ids"):
                 # fused ensemble group (budget ENSEMBLE_FUSED)
                 env["RAFIKI_TRIAL_IDS"] = ",".join(ctx.extra["trial_ids"])
-            if self.broker is None or not hasattr(self.broker, "prefix"):
+            # a broker without an shm namespace reports prefix=None
+            # (e.g. FleetBroker over the in-process broker) — treat it
+            # the same as no broker at all, with an explicit error
+            prefix = getattr(self.broker, "prefix", None)
+            if prefix is None:
                 raise RuntimeError(
                     "process-mode inference needs the shm broker "
                     "(RAFIKI_BROKER=shm) so worker processes can attach "
                     "to the serving data plane")
-            env["RAFIKI_BROKER_PREFIX"] = self.broker.prefix
+            env["RAFIKI_BROKER_PREFIX"] = prefix
         else:
             raise ValueError(
                 f"unsupported process service type {ctx.service_type!r}")

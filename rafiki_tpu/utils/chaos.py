@@ -1,0 +1,207 @@
+"""Deterministic fault injection for the fleet control/data planes.
+
+Every failover path in the fleet health subsystem (placement/hosts.py
+heartbeats, utils/agent_http.py circuit breaker, cache/fleet.py eviction)
+must be exercisable by fast CPU-only tier-1 tests without real hosts
+dying. This module is the single switchboard: the two wire-protocol
+chokepoints — ``call_agent`` (client side) and the agent HTTP server
+(placement/agent.py) — ask it before each request, and it answers with an
+injected fault (or nothing) on a **deterministic schedule** driven by
+per-rule hit counters, never randomness.
+
+Rules come from the ``RAFIKI_CHAOS`` environment variable (off by
+default — empty/unset means every hook is a no-op) or programmatically
+via :func:`install` in tests. Env format: ``|``-separated rules of
+``;``-separated ``key=value`` fields, e.g. ::
+
+    RAFIKI_CHAOS='site=agent;action=error;code=503;match=/predict_relay;times=2'
+    RAFIKI_CHAOS='site=call_agent;action=drop;match=9001|site=agent;action=delay;delay_s=0.2'
+
+Fields:
+
+    site     where to inject: ``call_agent`` (admin-side transport) or
+             ``agent`` (host agent server). Required.
+    action   ``drop`` (connection-level failure), ``delay`` (sleep
+             ``delay_s`` then proceed), or ``error`` (HTTP ``code``).
+             Required.
+    match    substring filter on the target ("addr path" client-side,
+             request path server-side). Empty matches everything.
+    after    skip the first N matching requests (default 0).
+    times    inject into at most N matching requests (default: no cap) —
+             ``after``/``times`` windows let a test kill a host "mid-
+             serving" at an exact request ordinal.
+    every    of the post-``after`` matches, inject into every k-th
+             (default 1 = all).
+    delay_s  sleep for ``delay`` (default 0.05).
+    code     HTTP status for ``error`` (default 503).
+
+The controller re-parses ``RAFIKI_CHAOS`` whenever the env value changes
+(counters reset with it), so monkeypatched tests and spawned agent
+subprocesses both pick rules up without plumbing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "RAFIKI_CHAOS"
+
+SITE_CALL_AGENT = "call_agent"
+SITE_AGENT = "agent"
+
+ACTION_DROP = "drop"
+ACTION_DELAY = "delay"
+ACTION_ERROR = "error"
+
+
+class ChaosSpecError(ValueError):
+    """RAFIKI_CHAOS could not be parsed; raised at install, logged (once
+    per bad value) when coming from the environment."""
+
+
+@dataclass
+class ChaosRule:
+    site: str
+    action: str
+    match: str = ""
+    after: int = 0
+    times: Optional[int] = None
+    every: int = 1
+    delay_s: float = 0.05
+    code: int = 503
+    hits: int = field(default=0, compare=False)  # matching requests seen
+
+    def __post_init__(self) -> None:
+        if self.site not in (SITE_CALL_AGENT, SITE_AGENT):
+            raise ChaosSpecError(f"unknown chaos site {self.site!r}")
+        if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR):
+            raise ChaosSpecError(f"unknown chaos action {self.action!r}")
+        if self.every < 1:
+            raise ChaosSpecError("chaos 'every' must be >= 1")
+
+    def fires(self, site: str, target: str) -> bool:
+        """Count a request against this rule; True when the fault applies.
+        Deterministic: depends only on the request order seen so far."""
+        if site != self.site or self.match not in target:
+            return False
+        self.hits += 1
+        n = self.hits - self.after  # 1-based index past the warm-up window
+        if n <= 0:
+            return False
+        if self.times is not None and n > self.times * self.every:
+            return False
+        return (n - 1) % self.every == 0
+
+
+def parse_rules(spec: str) -> List[ChaosRule]:
+    rules: List[ChaosRule] = []
+    for chunk in spec.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = {}
+        for kv in chunk.split(";"):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ChaosSpecError(f"chaos field {kv!r} is not key=value")
+            k, v = kv.split("=", 1)
+            fields[k.strip()] = v.strip()
+        unknown = set(fields) - {"site", "action", "match", "after",
+                                 "times", "every", "delay_s", "code"}
+        if unknown:
+            raise ChaosSpecError(f"unknown chaos fields {sorted(unknown)}")
+        try:
+            rules.append(ChaosRule(
+                site=fields.get("site", ""),
+                action=fields.get("action", ""),
+                match=fields.get("match", ""),
+                after=int(fields.get("after", 0)),
+                times=(int(fields["times"]) if "times" in fields else None),
+                every=int(fields.get("every", 1)),
+                delay_s=float(fields.get("delay_s", 0.05)),
+                code=int(fields.get("code", 503)),
+            ))
+        except (TypeError, ValueError) as e:
+            if isinstance(e, ChaosSpecError):
+                raise
+            raise ChaosSpecError(f"bad chaos rule {chunk!r}: {e}") from e
+    return rules
+
+
+class ChaosController:
+    """Holds the active rule set; thread-safe (agent server handlers and
+    the admin's sender/heartbeat threads all consult it concurrently)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rules: List[ChaosRule] = []
+        self._installed = False      # programmatic rules win over env
+        self._env_value: Optional[str] = None
+        self._env_bad: Optional[str] = None
+
+    def install(self, rules: List[ChaosRule]) -> None:
+        with self._lock:
+            self._rules = list(rules)
+            self._installed = True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules = []
+            self._installed = False
+            self._env_value = None
+            self._env_bad = None
+
+    def enabled(self) -> bool:
+        with self._lock:
+            self._refresh_env_locked()
+            return bool(self._rules)
+
+    def hit(self, site: str, target: str) -> Optional[ChaosRule]:
+        """Record one request at ``site`` against every rule; return the
+        first rule whose schedule fires, else None."""
+        with self._lock:
+            self._refresh_env_locked()
+            for rule in self._rules:
+                if rule.fires(site, target):
+                    logger.warning("chaos %s@%s -> %s", site, target,
+                                   rule.action)
+                    return rule
+        return None
+
+    def _refresh_env_locked(self) -> None:
+        if self._installed:
+            return
+        value = os.environ.get(ENV_VAR, "")
+        if value == self._env_value:
+            return
+        self._env_value = value
+        try:
+            self._rules = parse_rules(value)
+            self._env_bad = None
+        except ChaosSpecError as e:
+            self._rules = []
+            if value != self._env_bad:
+                self._env_bad = value
+                logger.error("ignoring unparseable %s: %s", ENV_VAR, e)
+
+
+_controller = ChaosController()
+
+install = _controller.install
+clear = _controller.clear
+enabled = _controller.enabled
+hit = _controller.hit
+
+
+def sleep_for(rule: ChaosRule) -> None:
+    """Apply a delay rule (kept here so call sites stay one-liners)."""
+    time.sleep(rule.delay_s)

@@ -40,6 +40,19 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                             RAFIKI_PLACEMENT=hosts); train AND
 #                             inference spread across host agents
 
+# Fleet health (docs/failure-model.md). Safe defaults — tune only for
+# failover drills or unusual networks:
+#   RAFIKI_AGENT_HEARTBEAT_S=5          /healthz probe interval (0 = off)
+#   RAFIKI_AGENT_DOWN_THRESHOLD=3       consecutive misses before DOWN
+#   RAFIKI_AGENT_HEARTBEAT_TIMEOUT_S=2  per-probe timeout
+#   RAFIKI_AGENT_RETRY_MAX=2            retries for idempotent agent calls
+#   RAFIKI_AGENT_RETRY_BACKOFF_S=0.1    backoff base (exponential + jitter)
+#   RAFIKI_AGENT_BREAKER_THRESHOLD=3    transport failures to open a circuit
+#   RAFIKI_AGENT_BREAKER_COOLDOWN_S=5   fail-fast window before half-open
+# Deterministic fault injection — MUST stay off outside drills/tests:
+#   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
+export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
+
 # Persistent XLA compile cache shared across trials/restarts
 # (replaces the reference's per-boot `pip install` warmup cost,
 # reference scripts/start_worker.py:6-9).

@@ -1,0 +1,574 @@
+"""Fleet health subsystem under deterministic fault injection (chaos).
+
+Every failover path — predictor hedging past a dead host, FleetBroker
+eviction, train-executor reschedule, circuit breaker transitions — is
+driven here by utils/chaos.py rules on CPU only, with no real hosts
+dying (ISSUE 1; docs/failure-model.md). All fast: tier-1.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.cache.fleet import FleetBroker, HttpWorkerQueue
+from rafiki_tpu.cache.queue import InProcessBroker
+from rafiki_tpu.constants import AgentHealth, ServiceType
+from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.agent_http import (
+    AgentCircuitOpenError,
+    AgentHTTPError,
+    AgentTransportError,
+    CircuitBreaker,
+    call_agent,
+    get_breaker,
+    reset_breaker,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Chaos rules and breakers are process-global; isolate every test."""
+    chaos.clear()
+    reset_breaker()
+    yield
+    chaos.clear()
+    reset_breaker()
+
+
+class _FakeHost:
+    """In-process host agent: /healthz, /inventory, /predict_relay —
+    enough surface for heartbeats, placement choice, and serving."""
+
+    def __init__(self):
+        host = self
+        host.relays = 0
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/healthz":
+                    return self._send(200, {"status": "ok"})
+                if path == "/inventory":
+                    return self._send(200, {
+                        "host": "fake", "total_chips": 2,
+                        "free_chips": 2, "n_services": 0})
+                self._send(404, {"error": "no route"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.startswith("/predict_relay/"):
+                    host.relays += 1
+                    return self._send(200, {"predictions": [
+                        ["served", q] for q in body["queries"]]})
+                self._send(404, {"error": "no route"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# chaos schedule semantics
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_rules_fire_on_a_deterministic_schedule():
+    rule = chaos.ChaosRule(site="agent", action="drop", match="/x",
+                           after=2, times=2)
+    # miss: wrong site / no substring match
+    assert not rule.fires("call_agent", "/x")
+    assert not rule.fires("agent", "/other")
+    # hits 1-2 sit in the warm-up window; 3-4 fire; 5+ are spent
+    assert [rule.fires("agent", "/x") for _ in range(5)] == [
+        False, False, True, True, False]
+
+
+def test_chaos_env_parsing_and_reset(monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR,
+                       "site=agent;action=error;code=418;times=1")
+    assert chaos.enabled()
+    rule = chaos.hit(chaos.SITE_AGENT, "/anything")
+    assert rule is not None and rule.code == 418
+    assert chaos.hit(chaos.SITE_AGENT, "/anything") is None  # times spent
+    monkeypatch.setenv(chaos.ENV_VAR, "")
+    assert not chaos.enabled()
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_rules("site=nowhere;action=drop")
+
+
+# ---------------------------------------------------------------------------
+# transport hardening: retry + circuit breaker (satellite d, acceptance)
+# ---------------------------------------------------------------------------
+
+
+def test_idempotent_call_retries_through_transient_drop(monkeypatch):
+    monkeypatch.setattr(config, "AGENT_RETRY_BACKOFF_S", 0.01)
+    host = _FakeHost()
+    try:
+        chaos.install([chaos.ChaosRule(
+            site="call_agent", action="drop", match=host.addr, times=1)])
+        out = call_agent(host.addr, "GET", "/inventory", timeout_s=5)
+        assert out["total_chips"] == 2  # second attempt reached the host
+    finally:
+        host.close()
+
+
+def test_non_idempotent_call_never_retries():
+    host = _FakeHost()
+    try:
+        chaos.install([chaos.ChaosRule(
+            site="call_agent", action="drop", match=host.addr, times=1)])
+        with pytest.raises(AgentTransportError):
+            call_agent(host.addr, "POST", "/predict_relay/j/w",
+                       body={"queries": [1]}, timeout_s=5)
+        assert host.relays == 0  # the drop was not retried into the host
+    finally:
+        host.close()
+
+
+def test_circuit_breaker_open_half_open_close_transitions():
+    br = CircuitBreaker(threshold=2, cooldown_s=0.15)
+    assert br.state == "CLOSED" and br.allow()
+    br.record_failure()
+    assert br.state == "CLOSED"
+    br.record_failure()
+    assert br.state == "OPEN"
+    assert not br.allow()  # failing fast
+    time.sleep(0.2)
+    assert br.state == "HALF_OPEN"
+    assert br.allow()       # exactly one probe admitted
+    assert not br.allow()   # siblings still fail fast
+    br.record_failure()     # probe verdict: still dead
+    assert br.state == "OPEN"
+    time.sleep(0.2)
+    assert br.allow()
+    br.record_success()     # probe verdict: recovered
+    assert br.state == "CLOSED" and br.allow()
+
+
+def test_open_circuit_fails_fast_instead_of_transport_timeout(monkeypatch):
+    """Acceptance: a control-plane call to an agent whose circuit is open
+    must fail in <100 ms, not wait out the 10 s transport timeout."""
+    monkeypatch.setattr(config, "AGENT_BREAKER_THRESHOLD", 1)
+    addr = "127.0.0.1:59999"
+    chaos.install([chaos.ChaosRule(site="call_agent", action="drop",
+                                   match=addr)])
+    with pytest.raises(AgentTransportError):
+        call_agent(addr, "POST", "/services", body={}, timeout_s=10)
+    assert get_breaker(addr).state == "OPEN"
+    t0 = time.monotonic()
+    with pytest.raises(AgentCircuitOpenError):
+        call_agent(addr, "POST", "/services", body={}, timeout_s=10)
+    assert time.monotonic() - t0 < 0.1
+    # an HTTP-level answer is a breaker SUCCESS (the host is alive)
+    reset_breaker(addr)
+    chaos.install([chaos.ChaosRule(site="call_agent", action="error",
+                                   match=addr, code=503)])
+    with pytest.raises(AgentHTTPError):
+        call_agent(addr, "GET", "/inventory", timeout_s=5)
+    assert get_breaker(addr).state == "CLOSED"
+
+
+def test_agent_server_chaos_drop_reads_as_transport_error():
+    """Server-side injection: the agent closes the connection without a
+    response; callers see the same failure a SIGKILLed host produces."""
+    from rafiki_tpu.placement.agent import AgentServer
+    from rafiki_tpu.placement.manager import ChipAllocator
+    from rafiki_tpu.placement.process import ProcessPlacementManager
+
+    engine = ProcessPlacementManager(allocator=ChipAllocator([0]))
+    srv = AgentServer(engine, allow_insecure=True).start()
+    addr = f"127.0.0.1:{srv.port}"
+    try:
+        chaos.install([chaos.ChaosRule(site="agent", action="drop",
+                                       match="/healthz")])
+        with pytest.raises(AgentTransportError):
+            call_agent(addr, "GET", "/healthz", timeout_s=5,
+                       idempotent=False, use_breaker=False)
+        chaos.clear()
+        assert call_agent(addr, "GET", "/healthz",
+                          timeout_s=5)["status"] == "ok"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# FleetBroker eviction (satellite b) + queue close determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_broker_evicts_dead_agents_queues():
+    broker = FleetBroker(InProcessBroker())
+    broker.register_worker("job", "local-w")
+    q_dead = broker.register_remote_worker("job", "w-dead", "10.0.0.1:1")
+    broker.register_remote_worker("job", "w-live", "10.0.0.2:1")
+    evicted = broker.evict_agent("10.0.0.1:1")
+    assert evicted == [("job", "w-dead")]
+    assert set(broker.get_worker_queues("job")) == {"local-w", "w-live"}
+    with pytest.raises(RuntimeError, match="closed"):
+        q_dead.submit(1).result(1.0)
+    broker.close()
+
+
+def test_http_worker_queue_close_joins_sender_thread():
+    q = HttpWorkerQueue("127.0.0.1:1", "job", "w")
+    assert q._thread.is_alive()
+    q.close()
+    q._thread.join(timeout=2.0)
+    assert not q._thread.is_alive()
+
+
+def test_fleet_broker_prefix_is_none_without_shm_base():
+    broker = FleetBroker(InProcessBroker())
+    assert broker.prefix is None  # used to raise bare AttributeError
+    broker.close()
+
+
+# ---------------------------------------------------------------------------
+# heartbeats -> DOWN -> failover (tentpole; satellites a, c; acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _manager(agents, **kw):
+    from rafiki_tpu.placement.hosts import HostAgentPlacementManager
+
+    kw.setdefault("heartbeat_interval_s", 0)  # drive probes by hand
+    return HostAgentPlacementManager(agents, **kw)
+
+
+def _wait_for(cond, timeout_s=5.0):
+    """Failover runs on its own thread (probing must never stall on it),
+    so assertions about its effects poll briefly."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+class _AcceptingAgent:
+    key = None
+
+    def __init__(self):
+        self.created = []
+
+    def create_service(self, sid, stype, n, best, extra):
+        self.created.append(sid)
+        return [0]
+
+    def stop_service(self, sid, wait):
+        pass
+
+
+def test_down_threshold_and_recovery_bookkeeping():
+    placement = _manager(["a:1", "b:2"], down_threshold=2)
+    placement._note_heartbeat("a:1", False, "boom")
+    assert placement.agent_health()["a:1"]["state"] == AgentHealth.UNKNOWN
+    placement._note_heartbeat("a:1", False, "boom")
+    health = placement.agent_health()["a:1"]
+    assert health["state"] == AgentHealth.DOWN
+    assert health["consecutive_misses"] == 2
+    # one good probe restores the agent and clears the breaker
+    get_breaker("a:1").record_failure()
+    placement._note_heartbeat("a:1", True, None)
+    health = placement.agent_health()["a:1"]
+    assert health["state"] == AgentHealth.UP
+    assert health["consecutive_misses"] == 0
+    assert health["breaker"] == "CLOSED"
+
+
+def test_train_service_reschedules_onto_surviving_agent():
+    """Satellite (c): a dead host's train executor is replayed through the
+    least-loaded path onto a survivor under the SAME service id (so the
+    new worker resumes the trials the dead one left RUNNING)."""
+    placement = _manager(["dead:1", "live:2"], down_threshold=1)
+    statuses = []
+    placement.on_status = lambda sid, st: statuses.append((sid, st))
+    live = _AcceptingAgent()
+    placement.agents = {"dead:1": _AcceptingAgent(), "live:2": live}
+    placement._inventories = lambda: [
+        ("live:2", {"free_chips": 2, "n_services": 0, "total_chips": 2}),
+    ]
+    with placement._lock:
+        placement._placed["svc-t"] = "dead:1"
+        placement._placed_specs["svc-t"] = {
+            "service_type": ServiceType.TRAIN, "n_chips": 1,
+            "best_effort_chips": False,
+            "extra": {"sub_train_job_id": "sub-1"}}
+    placement._note_heartbeat("dead:1", False, "no route to host")
+    assert _wait_for(lambda: placement.placements().get("svc-t") == "live:2")
+    assert live.created == ["svc-t"]  # same id -> stale-trial resume
+    assert statuses == []  # rescheduled, not errored
+
+
+def test_unreschedulable_services_reach_terminal_status():
+    """With no surviving capacity, the dead host's services are ERRORED so
+    job-level refresh fires without operator action."""
+    placement = _manager(["dead:1"], down_threshold=1)
+    statuses = []
+    placement.on_status = lambda sid, st: statuses.append((sid, st))
+    broker = FleetBroker(InProcessBroker())
+    placement.set_broker(broker)
+    broker.register_remote_worker("job-i", "svc-i", "dead:1")
+    placement.agents = {"dead:1": _AcceptingAgent()}
+    placement._inventories = lambda: []
+    with placement._lock:
+        placement._placed.update({"svc-t": "dead:1", "svc-i": "dead:1"})
+        placement._placed_jobs["svc-i"] = "job-i"
+        placement._placed_specs.update({
+            "svc-t": {"service_type": ServiceType.TRAIN, "n_chips": 1,
+                      "best_effort_chips": False, "extra": {}},
+            "svc-i": {"service_type": ServiceType.INFERENCE, "n_chips": 1,
+                      "best_effort_chips": True,
+                      "extra": {"inference_job_id": "job-i"}},
+        })
+    placement._note_heartbeat("dead:1", False, "gone")
+    assert _wait_for(lambda: len(statuses) == 2)
+    assert sorted(statuses) == [("svc-i", "ERRORED"), ("svc-t", "ERRORED")]
+    assert placement.placements() == {}
+    # the dead host's relay queue left the serving fan-out
+    assert broker.get_worker_queues("job-i") == {}
+    broker.close()
+
+
+def test_false_down_rejoin_fences_orphan_services():
+    """A partition (not a crash) marked the host DOWN and its services
+    were stripped; when it rejoins, its orphans are STOPPED on it so one
+    service id never has two live executors (split-brain fence)."""
+
+    class _Rejoining(_AcceptingAgent):
+        def __init__(self):
+            super().__init__()
+            self.stopped = []
+
+        def stop_service(self, sid, wait):
+            self.stopped.append(sid)
+
+    placement = _manager(["part:1"], down_threshold=1)
+    agent = _Rejoining()
+    placement.agents = {"part:1": agent}
+    placement._inventories = lambda: []  # nowhere to reschedule
+    with placement._lock:
+        placement._placed["svc-p"] = "part:1"
+        placement._placed_specs["svc-p"] = {
+            "service_type": ServiceType.TRAIN, "n_chips": 0,
+            "best_effort_chips": False, "extra": {}}
+    placement._note_heartbeat("part:1", False, "partition")
+    assert _wait_for(lambda: placement.placements() == {})
+    placement._note_heartbeat("part:1", True, None)  # partition heals
+    assert _wait_for(lambda: agent.stopped == ["svc-p"])
+    assert placement.agent_health()["part:1"]["state"] == AgentHealth.UP
+
+
+def test_circuit_open_create_skips_agent_without_undo():
+    """An open-circuit refusal never reached the wire: placement must skip
+    the agent (no undo stop, no ambiguous-create escalation) and place on
+    the next candidate."""
+    from rafiki_tpu.placement.hosts import AgentCircuitOpenUnreachable
+
+    placement = _manager(["open:1", "ok:2"])
+    placement.set_broker(FleetBroker(InProcessBroker()))
+    placement._inventories = lambda: [
+        ("open:1", {"free_chips": 1, "n_services": 0, "total_chips": 1}),
+        ("ok:2", {"free_chips": 1, "n_services": 1, "total_chips": 1}),
+    ]
+
+    class _OpenCircuit:
+        key = None
+
+        def create_service(self, *a, **k):
+            raise AgentCircuitOpenUnreachable("circuit open")
+
+        def stop_service(self, sid, wait):
+            raise AssertionError("undo attempted for a call that "
+                                 "provably never reached the wire")
+
+    ok = _AcceptingAgent()
+    placement.agents = {"open:1": _OpenCircuit(), "ok:2": ok}
+    ctx = placement.create_service(
+        "svc-c", ServiceType.INFERENCE, n_chips=1, best_effort_chips=True,
+        extra={"inference_job_id": "job-c"})
+    assert placement.placements()["svc-c"] == "ok:2"
+    assert ctx.chips == [0]
+    placement.broker.close()
+
+
+def test_predict_survives_dead_host_within_slo():
+    """Satellite (a): chaos kills one of two hosts mid-serving; a predict
+    with two replicas of one trial still answers inside the SLO by
+    failing over to the live replica."""
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    live = _FakeHost()
+    dead = _FakeHost()
+    broker = FleetBroker(InProcessBroker())
+    try:
+        broker.register_remote_worker("job", "w-live", live.addr)
+        broker.register_remote_worker("job", "w-dead", dead.addr)
+        # kill the "dead" host from the wire's point of view: every call
+        # to it — relay included — now fails like a vanished machine
+        chaos.install([chaos.ChaosRule(site="call_agent", action="drop",
+                                       match=dead.addr)])
+        predictor = Predictor("job", broker, task=None,
+                              worker_trials={"w-live": "t1", "w-dead": "t1"})
+        t0 = time.monotonic()
+        preds = predictor.predict_batch([[1.0], [2.0]], timeout_s=10.0)
+        elapsed = time.monotonic() - t0
+        assert preds == [["served", [1.0]], ["served", [2.0]]]
+        assert elapsed < 5.0  # well inside the SLO, no 10 s stall
+        assert live.relays >= 1 and dead.relays == 0
+    finally:
+        broker.close()
+        live.close()
+        dead.close()
+
+
+def test_heartbeat_monitor_detects_chaos_killed_host_end_to_end(tmp_path):
+    """Acceptance: a REAL heartbeat monitor watches two live hosts; chaos
+    then kills one. The monitor marks it DOWN, evicts its relay queue,
+    errors its service in the store, and serving keeps answering."""
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    live = _FakeHost()
+    dead = _FakeHost()
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    svc_live = db.create_service(ServiceType.INFERENCE)["id"]
+    svc_dead = db.create_service(ServiceType.INFERENCE)["id"]
+    db.mark_service_as_running(svc_live)
+    db.mark_service_as_running(svc_dead)
+    broker = FleetBroker(InProcessBroker())
+    placement = _manager([live.addr, dead.addr],
+                         heartbeat_interval_s=0.05, down_threshold=2, db=db)
+    placement.set_broker(broker)
+    try:
+        broker.register_remote_worker("job", svc_live, live.addr)
+        broker.register_remote_worker("job", svc_dead, dead.addr)
+        with placement._lock:
+            placement._placed.update(
+                {svc_live: live.addr, svc_dead: dead.addr})
+            placement._placed_jobs.update(
+                {svc_live: "job", svc_dead: "job"})
+            for sid in (svc_live, svc_dead):
+                placement._placed_specs[sid] = {
+                    "service_type": ServiceType.INFERENCE, "n_chips": 1,
+                    "best_effort_chips": True,
+                    "extra": {"inference_job_id": "job"}}
+        # both hosts healthy first: wait for an UP verdict on each
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            h = placement.agent_health()
+            if all(v["state"] == AgentHealth.UP for v in h.values()):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(f"hosts never came UP: {placement.agent_health()}")
+
+        # mid-serving kill: all wire traffic to `dead` now drops
+        chaos.install([chaos.ChaosRule(site="call_agent", action="drop",
+                                       match=dead.addr)])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (placement.agent_health()[dead.addr]["state"]
+                    == AgentHealth.DOWN):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(f"dead host never marked DOWN: "
+                        f"{placement.agent_health()}")
+
+        # reconciliation: queue evicted, service terminal in the store —
+        # no operator action
+        assert set(broker.get_worker_queues("job")) == {svc_live}
+        assert db.get_service(svc_dead)["status"] == "ERRORED"
+        assert db.get_service(svc_live)["status"] == "RUNNING"
+
+        # serving still answers, fast (the dead replica is gone from the
+        # fan-out, so no deadline slice is spent on it at all)
+        predictor = Predictor("job", broker, task=None,
+                              worker_trials={svc_live: "t", svc_dead: "t"})
+        t0 = time.monotonic()
+        preds = predictor.predict_batch([[7.0]], timeout_s=10.0)
+        assert preds == [["served", [7.0]]]
+        assert time.monotonic() - t0 < 2.0
+        assert placement.agent_health()[dead.addr]["breaker"] in (
+            "CLOSED", "OPEN", "HALF_OPEN")  # surfaced for operators
+    finally:
+        placement.stop_all()
+        broker.close()
+        db.close()
+        live.close()
+        dead.close()
+
+
+def test_admin_refreshes_inference_job_when_all_replicas_die(tmp_path):
+    """The last serving replica dying terminates its inference job in the
+    store (ServicesManager.refresh_inference_job_status via the admin's
+    status callback) — no operator action."""
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.db.database import Database
+
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    admin = Admin(db=db, params_dir=str(tmp_path))
+    try:
+        uid = admin.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        tj = db.create_train_job(uid, "app", 1, "T", "uri://t", "uri://e", {})
+        model = db.create_model(uid, "m", "T", b"", "M", {}, "PRIVATE")
+        sub = db.create_sub_train_job(tj["id"], model["id"])
+        trial = db.create_trial(sub["id"], model["id"], {})
+        inf = db.create_inference_job(uid, tj["id"])
+        sids = []
+        for _ in range(2):
+            svc = db.create_service(ServiceType.INFERENCE)
+            db.create_inference_job_worker(svc["id"], inf["id"], trial["id"])
+            db.mark_service_as_running(svc["id"])
+            sids.append(svc["id"])
+        db.mark_inference_job_as_running(inf["id"])
+        admin._on_service_status(sids[0], "ERRORED")
+        assert db.get_inference_job(inf["id"])["status"] == "RUNNING"
+        admin._on_service_status(sids[1], "ERRORED")
+        assert db.get_inference_job(inf["id"])["status"] == "ERRORED"
+    finally:
+        admin.shutdown()
+        db.close()
+
+
+def test_fleet_health_surfaced_in_admin_api():
+    from rafiki_tpu.admin.admin import Admin
+
+    admin = Admin()
+    try:
+        out = admin.get_fleet_health()
+        assert out["placement"] == "LocalPlacementManager"
+        assert out["agents"] == {} and out["agents_down"] == []
+        assert out["chaos_active"] is False
+    finally:
+        admin.shutdown()
+    placement = _manager(["x:1"], down_threshold=1)
+    placement._note_heartbeat("x:1", False, "gone")
+    health = placement.agent_health()["x:1"]
+    assert health["state"] == AgentHealth.DOWN
+    assert health["last_error"] == "gone"

@@ -53,7 +53,9 @@ def test_down_agents_reported(tmp_path, monkeypatch, capsys):
     rc = doctor.run()
     out = capsys.readouterr().out
     assert rc == 1
-    assert "unreachable" in out
+    # dead host (no /healthz answer) reads as DOWN, distinct from the
+    # locked/key-rejected config failures
+    assert "DOWN (no /healthz answer)" in out
 
 
 def test_crashing_check_is_contained(monkeypatch, tmp_path, capsys):
