@@ -79,6 +79,13 @@ class Admin:
         self._remote_serving_stats: "collections.OrderedDict[str, Dict[str, int]]" = (
             collections.OrderedDict())
         self._remote_serving_stats_cap = 512
+        # overload control on the admin serving door (/predict/<app>):
+        # same bounded in-flight + estimated-wait gate the dedicated
+        # predictor port runs (predictor/admission.py); one controller for
+        # the whole door — it protects this process, not one job
+        from rafiki_tpu.predictor.admission import AdmissionController
+
+        self._predict_admission = AdmissionController()
         # RAFIKI_BROKER=shm selects the native cross-process data
         # plane (cache/shm_broker.py); default is in-process.
         # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to
@@ -706,17 +713,41 @@ class Admin:
         serialized metadata connection at high request rates, and a few
         seconds of staleness only delays visibility of a *newly swapped*
         inference job — a dead predictor raises and re-resolves
-        immediately."""
+        immediately.
+
+        Overload faults surface as typed exceptions the HTTP shell maps
+        to shed codes (admin/http.py): QueueFullError /
+        DeadlineUnmeetableError -> 429 + Retry-After,
+        ServerOverloadedError -> 503."""
+        from rafiki_tpu.cache.queue import QueueFullError
+        from rafiki_tpu.predictor.admission import (
+            DeadlineUnmeetableError,
+            ServerOverloadedError,
+        )
+
         key = (user_id, app, app_version)
         now = time.monotonic()
         with self._predict_route_lock:
             cached = self._predict_route_cache.get(key)
         if cached is not None and now - cached[0] < config.PREDICT_ROUTE_TTL_S:
             try:
-                return cached[1].predict_batch(queries)
-            except (RuntimeError, TimeoutError):
-                # workers gone (RuntimeError: job stopped/replaced) or
-                # registered-but-dead (TimeoutError): fall through and
+                return self._admitted_predict(cached[1], queries)
+            except (QueueFullError, ServerOverloadedError,
+                    DeadlineUnmeetableError):
+                # overload shed, not a dead route: re-resolving would only
+                # add two control-plane reads to an already-loaded path
+                raise
+            except TimeoutError:
+                # SLO missed. Drop the route (it MAY be stale) but do NOT
+                # resubmit: under overload a timeout is the common outcome,
+                # and a silent second full-length attempt doubles queue
+                # pressure and pins the handler for 2x PREDICT_TIMEOUT_S —
+                # retry policy belongs to the client, which just got a 504.
+                with self._predict_route_lock:
+                    self._predict_route_cache.pop(key, None)
+                raise
+            except RuntimeError:
+                # workers gone (job stopped/replaced): fall through and
                 # re-resolve against the control plane
                 with self._predict_route_lock:
                     self._predict_route_cache.pop(key, None)
@@ -737,25 +768,83 @@ class Admin:
             # resurrected by this thread's stale resolution
             if self._predict_route_epoch == epoch:
                 self._predict_route_cache[key] = (now, predictor)
-        return predictor.predict_batch(queries)
+        return self._admitted_predict(predictor, queries)
+
+    def _admitted_predict(self, predictor, queries: List[Any]) -> List[Any]:
+        """The admin door's admission wrapper: bounded in-flight +
+        estimated-wait shed before the predictor sees the request, and
+        latency feedback after (predictor/admission.py)."""
+        cap = int(config.PREDICT_QUEUE_DEPTH)
+        if cap > 0 and len(queries) > cap:
+            # can never fit in any worker queue: permanent client error,
+            # not the retryable 429
+            raise InvalidRequestError(
+                f"request carries {len(queries)} queries but the "
+                f"per-worker queue cap is {cap} "
+                "(RAFIKI_PREDICT_QUEUE_DEPTH) — split the request")
+        backlog_fn = getattr(predictor, "backlog_depth", None)
+        self._predict_admission.admit(
+            config.PREDICT_TIMEOUT_S,
+            backlog_depth=backlog_fn() if callable(backlog_fn) else None)
+        t0 = time.monotonic()
+        try:
+            preds = predictor.predict_batch(queries)
+        finally:
+            self._predict_admission.release()
+        self._predict_admission.observe(time.monotonic() - t0, len(queries))
+        return preds
 
     def get_fleet_health(self) -> Dict[str, Any]:
         """Operator view of the fleet health subsystem: per-agent
         heartbeat state, circuit breaker state, and load
         (placement/hosts.py agent_health). Single-host placements report
         an empty agent map — the admin process itself answering IS the
-        health signal there."""
+        health signal there.
+
+        The ``serving`` section is the overload picture (docs/
+        failure-model.md "Overload faults"): per-job queue depths and
+        hedge-suppression counters from each live Predictor — a job with
+        zero registered worker queues reads ``degraded``, the admin-side
+        twin of the per-job /healthz verdict — plus this door's admission
+        stats and the local workers' queue counters (SERVING_STATS)."""
         from rafiki_tpu.utils import chaos as _chaos
+        from rafiki_tpu.worker.inference import serving_stats
 
         agents = {}
         if hasattr(self.placement, "agent_health"):
             agents = self.placement.agent_health()
         down = [a for a, h in agents.items() if h["state"] == "DOWN"]
+        jobs: Dict[str, Any] = {}
+        for job_id, predictor in self.services.predictors().items():
+            try:
+                depths = predictor.queue_depths()
+                jobs[job_id] = {
+                    "status": "ok" if depths else "degraded",
+                    "workers": len(depths),
+                    "queue_depths": depths,
+                    "overload": predictor.overload_stats(),
+                }
+            except Exception:
+                logger.exception("fleet-health probe of job %s failed",
+                                 job_id)
+        # local workers update SERVING_STATS in-process; process/hosts
+        # placement workers relay the same counters over the event channel
+        # (handle_event inference_worker_stats) — merge both so the
+        # overload picture covers every deployment mode
+        workers = serving_stats()
+        with self._predict_route_lock:
+            for sid, s in self._remote_serving_stats.items():
+                workers.setdefault(sid, {}).update(s)
         return {
             "placement": type(self.placement).__name__,
             "agents": agents,
             "agents_down": down,
             "chaos_active": _chaos.enabled(),
+            "serving": {
+                "jobs": jobs,
+                "admission": self._predict_admission.stats(),
+                "workers": workers,
+            },
         }
 
     def stop_all_jobs(self) -> None:
@@ -807,6 +896,12 @@ class Admin:
                     self._remote_serving_stats[sid] = {
                         "batches": int(payload.get("batches", 0)),
                         "queries": int(payload.get("queries", 0)),
+                        # overload counters ride the same event when the
+                        # worker's queue exposes them (queue_depth gauge,
+                        # expired/shed totals)
+                        **{k: int(payload[k])
+                           for k in ("queue_depth", "expired", "shed")
+                           if k in payload},
                     }
                     self._remote_serving_stats.move_to_end(sid)
                     while (len(self._remote_serving_stats)

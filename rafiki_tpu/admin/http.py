@@ -25,8 +25,14 @@ from urllib.parse import parse_qs, urlparse
 
 from rafiki_tpu import config
 from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+from rafiki_tpu.cache.queue import QueueFullError
 from rafiki_tpu.constants import UserType
 from rafiki_tpu.placement.manager import InsufficientChipsError
+from rafiki_tpu.predictor.admission import (
+    DeadlineUnmeetableError,
+    ServerOverloadedError,
+    retry_after_headers,
+)
 from rafiki_tpu.sdk.model import InvalidModelClassError
 from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
 from rafiki_tpu.utils.reqfields import LowLatencyHandler, read_bounded_body
@@ -340,6 +346,23 @@ class AdminServer:
             # friends from inside Admin stay genuine 500s instead of being
             # masked as client errors with internal text echoed back
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
+        except (QueueFullError, DeadlineUnmeetableError) as e:
+            # serving overload, retryable backlog (docs/failure-model.md
+            # "Overload faults"): 429 + Retry-After, same contract as the
+            # dedicated predictor port
+            self._respond(handler, 429,
+                          {"error": f"{type(e).__name__}: {e}"},
+                          headers=retry_after_headers(e))
+        except ServerOverloadedError as e:
+            # serving door out of in-flight capacity
+            self._respond(handler, 503,
+                          {"error": f"{type(e).__name__}: {e}"},
+                          headers=retry_after_headers(e))
+        except TimeoutError as e:
+            # predict missed its SLO: a 504 the client may retry, not an
+            # internal error — same contract as the dedicated predictor
+            # port, and no spurious server-side traceback per miss
+            self._respond(handler, 504, {"error": f"{type(e).__name__}: {e}"})
         except InsufficientChipsError as e:
             self._respond(handler, 503, {"error": f"{type(e).__name__}: {e}"})
         except Exception:
@@ -349,10 +372,13 @@ class AdminServer:
             self._respond(handler, 500, {"error": "internal server error"})
 
     @staticmethod
-    def _respond(handler, code: int, payload: Dict[str, Any]) -> None:
+    def _respond(handler, code: int, payload: Dict[str, Any],
+                 headers: Optional[Dict[str, str]] = None) -> None:
         data = json.dumps(payload).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
         handler.wfile.write(data)

@@ -406,7 +406,9 @@ class ServicesManager:
                 self._predictors.pop(inference_job_id, None)
                 psrv = self._predict_servers.pop(inference_job_id, None)
             if psrv is not None:
-                psrv.stop()
+                # failed deploy: nothing admitted is worth draining for —
+                # close immediately rather than wait the drain window
+                psrv.stop(drain_timeout_s=0.0)
             for sid in created:
                 self._destroy_service(sid, wait=False)
             self._db.mark_inference_job_as_errored(inference_job_id)
@@ -415,6 +417,12 @@ class ServicesManager:
     def get_predictor(self, inference_job_id: str) -> Optional[Predictor]:
         with self._lock:
             return self._predictors.get(inference_job_id)
+
+    def predictors(self) -> Dict[str, Predictor]:
+        """Snapshot of the live {inference_job_id: Predictor} map (fleet
+        health reads every job's queue depths / overload counters)."""
+        with self._lock:
+            return dict(self._predictors)
 
     def stop_inference_services(self, inference_job_id: str) -> None:
         for w in self._db.get_workers_of_inference_job(inference_job_id):

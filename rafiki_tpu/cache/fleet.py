@@ -25,10 +25,11 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from rafiki_tpu import config
-from rafiki_tpu.cache.queue import Broker, QueryFuture
+from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
 from rafiki_tpu.utils.agent_http import (
     AgentHTTPError,
     AgentTransportError,
@@ -72,27 +73,55 @@ class HttpWorkerQueue:
                                   else config.PREDICT_TIMEOUT_S)
         self._timeout_s = self._worker_timeout_s + 5.0
         self._cond = threading.Condition()
-        self._pending: List[Tuple[QueryFuture, Any]] = []
+        self._pending: List[Tuple[QueryFuture, Any, Optional[float]]] = []
+        self._inflight = 0  # queries inside the current relay round-trip
+        self._expired = 0
+        self._rejected = 0
         self._closed = False
         self._thread = threading.Thread(
             target=self._sender, daemon=True,
             name=f"relay-{worker_id[:8]}@{agent_addr}")
         self._thread.start()
 
-    def submit(self, query: Any) -> QueryFuture:
-        return self.submit_many([query])[0]
+    def depth(self) -> int:
+        """Load signal for hedge suppression / wait estimation: queries
+        waiting for the sender PLUS queries riding the current relay RTT
+        (the remote worker is busy with those — they are its queue)."""
+        with self._cond:
+            return len(self._pending) + self._inflight
 
-    def submit_many(self, queries: List[Any]) -> List[QueryFuture]:
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            return {"depth": len(self._pending) + self._inflight,
+                    "expired": self._expired, "rejected": self._rejected}
+
+    def submit(self, query: Any,
+               deadline: Optional[float] = None) -> QueryFuture:
+        return self.submit_many([query], deadline=deadline)[0]
+
+    def submit_many(self, queries: List[Any],
+                    deadline: Optional[float] = None) -> List[QueryFuture]:
         """Atomic enqueue of one request's queries (one lock, one wake-up)
         so the sender relays them as one HTTP batch instead of racing the
-        sender thread into a singleton first batch."""
-        futs = [QueryFuture() for _ in queries]
+        sender thread into a singleton first batch. Bounded exactly like
+        the local WorkerQueue (RAFIKI_PREDICT_QUEUE_DEPTH counts pending +
+        in-flight): a stalled host must shed here, admin-side, not grow an
+        unbounded relay backlog."""
         with self._cond:
             if self._closed:
+                futs = [QueryFuture() for _ in queries]
                 for fut in futs:
                     fut.set_error(RuntimeError("remote worker queue closed"))
                 return futs
-            self._pending.extend(zip(futs, queries))
+            cap = int(config.PREDICT_QUEUE_DEPTH)
+            queued = len(self._pending) + self._inflight
+            if cap > 0 and queued + len(queries) > cap:
+                self._rejected += len(queries)
+                raise QueueFullError(
+                    f"relay queue to {self._addr} full ({queued}/{cap})")
+            futs = [QueryFuture() for _ in queries]
+            self._pending.extend(
+                (fut, q, deadline) for fut, q in zip(futs, queries))
             self._cond.notify()
         return futs
 
@@ -106,8 +135,21 @@ class HttpWorkerQueue:
                     # a popped batch after close would block teardown on a
                     # full transport timeout
                     return
-                batch = self._pending[:RELAY_MAX_BATCH]
-                del self._pending[:len(batch)]
+                now = time.monotonic()
+                batch = []
+                while (len(batch) < RELAY_MAX_BATCH and self._pending):
+                    fut, q, dl = self._pending.pop(0)
+                    if dl is not None and now >= dl:
+                        # expired while waiting for the sender: don't spend
+                        # a relay slot (and remote model time) on it
+                        self._expired += 1
+                        fut.set_error(TimeoutError(
+                            "query expired in the relay queue before send"))
+                        continue
+                    batch.append((fut, q))
+                self._inflight = len(batch)
+            if not batch:
+                continue
             futures = [f for f, _ in batch]
             try:
                 preds = self._relay([q for _, q in batch])
@@ -120,6 +162,9 @@ class HttpWorkerQueue:
             except Exception as e:
                 for fut in futures:
                     fut.set_error(e)
+            finally:
+                with self._cond:
+                    self._inflight = 0
 
     def _relay(self, queries: List[Any]) -> List[Any]:
         try:
@@ -144,7 +189,7 @@ class HttpWorkerQueue:
         wait=False teardown paths stay snappy even mid-relay."""
         with self._cond:
             self._closed = True
-            for fut, _ in self._pending:
+            for fut, _, _ in self._pending:
                 fut.set_error(RuntimeError("remote worker queue closed"))
             self._pending.clear()
             self._cond.notify_all()

@@ -22,9 +22,21 @@ remote broker implementing the same interface can back multi-host serving.
 from __future__ import annotations
 
 import abc
+import copy
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+
+class QueueFullError(RuntimeError):
+    """A bounded worker queue refused a submit (overload shed signal).
+
+    Carries ``retry_after_s`` so the HTTP doors can answer the shed with
+    ``429`` + a concrete ``Retry-After`` instead of a bare refusal."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.0)
 
 
 class QueryFuture:
@@ -49,38 +61,111 @@ class QueryFuture:
         if not self._event.wait(timeout):
             raise TimeoutError("prediction timed out")
         if self._error is not None:
-            raise self._error
+            # A failed batch shares ONE exception instance across all of
+            # its futures, and hedged gathers re-raise from several waiter
+            # threads at once — raising the shared instance would have
+            # every raise splice a different waiter's frames into the same
+            # __traceback__. Raise a per-waiter copy chained to the
+            # original, so each waiter owns its traceback and the causal
+            # (worker-side) one stays pristine on __cause__.
+            try:
+                mine = copy.copy(self._error)
+            except Exception:
+                raise self._error  # uncopyable exotic exception
+            if type(mine) is not type(self._error):
+                raise self._error
+            raise mine from self._error
         return self._value
 
 
 class WorkerQueue:
-    """A single inference worker's inbox of (future, query) pairs."""
+    """A single inference worker's bounded inbox of pending queries.
 
-    def __init__(self) -> None:
+    Each entry is (future, query, absolute-monotonic-deadline-or-None).
+    ``max_depth`` bounds the inbox: a submit that would exceed it raises
+    :class:`QueueFullError` (never blocks, never grows unbounded under a
+    stalled worker). ``take_batch`` drops entries whose deadline already
+    passed — their clients have stopped listening, so model time spent on
+    them is pure overload amplification."""
+
+    def __init__(self, max_depth: Optional[int] = None) -> None:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._items: List[Tuple[QueryFuture, Any]] = []
+        self._items: List[Tuple[QueryFuture, Any, Optional[float]]] = []
         self._closed = False
+        #: None defers to RAFIKI_PREDICT_QUEUE_DEPTH at each submit (lazy:
+        #: operators retune a live deployment; <=0 means uncapped)
+        self._max_depth = max_depth
+        self._expired = 0   # dropped by take_batch past their deadline
+        self._rejected = 0  # refused by the depth cap
 
-    def submit(self, query: Any) -> QueryFuture:
-        return self.submit_many([query])[0]
+    def _cap(self) -> int:
+        if self._max_depth is not None:
+            return self._max_depth
+        from rafiki_tpu import config
 
-    def submit_many(self, queries: List[Any]) -> List[QueryFuture]:
+        return int(config.PREDICT_QUEUE_DEPTH)
+
+    def depth(self) -> int:
+        """Current inbox depth — the predictor's hedge suppression and the
+        doors' wait estimation read this as the replica's load signal."""
+        with self._lock:
+            return len(self._items)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"depth": len(self._items), "expired": self._expired,
+                    "rejected": self._rejected}
+
+    def submit(self, query: Any,
+               deadline: Optional[float] = None) -> QueryFuture:
+        return self.submit_many([query], deadline=deadline)[0]
+
+    def submit_many(self, queries: List[Any],
+                    deadline: Optional[float] = None) -> List[QueryFuture]:
         """Enqueue a whole request's queries atomically (one lock, one
         wake-up). A per-query submit loop can lose a race with the worker:
         it wakes after the first item, serves a singleton batch, and the
         rest of the request waits a full dispatch behind it — with the
         batch deadline at 0 (serve immediately), atomic enqueue is what
-        keeps one request one batch."""
-        futs = [QueryFuture() for _ in queries]
+        keeps one request one batch. ``deadline`` is the request's absolute
+        ``time.monotonic()`` deadline; atomicity also means the depth cap
+        admits or rejects the request as a unit (no half-enqueued
+        requests)."""
         with self._cond:
             if self._closed:
+                futs = [QueryFuture() for _ in queries]
                 for fut in futs:
                     fut.set_error(RuntimeError("worker queue closed"))
                 return futs
-            self._items.extend(zip(futs, queries))
+            cap = self._cap()
+            if cap > 0 and len(self._items) + len(queries) > cap:
+                self._rejected += len(queries)
+                raise QueueFullError(
+                    f"worker queue full ({len(self._items)}/{cap} queued; "
+                    f"refusing {len(queries)} more)")
+            futs = [QueryFuture() for _ in queries]
+            self._items.extend(
+                (fut, q, deadline) for fut, q in zip(futs, queries))
             self._cond.notify()
         return futs
+
+    def _drain_fresh(
+        self, n: int, now: float,
+        batch: List[Tuple[QueryFuture, Any]],
+    ) -> None:
+        """Move up to ``n`` unexpired entries into ``batch``; entries past
+        their deadline resolve with TimeoutError instead of costing model
+        time. Caller holds the lock."""
+        while n > 0 and self._items:
+            fut, query, deadline = self._items.pop(0)
+            if deadline is not None and now >= deadline:
+                self._expired += 1
+                fut.set_error(TimeoutError(
+                    "query expired in the worker queue before dispatch"))
+                continue
+            batch.append((fut, query))
+            n -= 1
 
     def take_batch(
         self,
@@ -93,31 +178,33 @@ class WorkerQueue:
         item. Returns [] on timeout so callers can check stop flags, and
         None once the queue is CLOSED and drained — a closed queue answers
         instantly, so treating it like a timeout would turn the caller's
-        poll loop into a busy spin."""
+        poll loop into a busy spin. Entries whose request deadline has
+        passed are dropped (futures resolved with TimeoutError), never
+        returned; a take that drops everything returns [] like a timeout."""
         with self._cond:
             if not self._items and not self._closed:
                 self._cond.wait(wait_timeout_s)
             if not self._items:
                 return None if self._closed else []
             first_t = time.monotonic()
-            batch = self._items[:max_size]
-            del self._items[: len(batch)]
+            batch: List[Tuple[QueryFuture, Any]] = []
+            self._drain_fresh(max_size, first_t, batch)
             while len(batch) < max_size and not self._closed:
                 remaining = deadline_s - (time.monotonic() - first_t)
                 if remaining <= 0:
                     break
                 if not self._items:
                     self._cond.wait(remaining)
-                take = min(max_size - len(batch), len(self._items))
-                if take:
-                    batch.extend(self._items[:take])
-                    del self._items[:take]
+                self._drain_fresh(
+                    max_size - len(batch), time.monotonic(), batch)
+            if not batch and not self._items and self._closed:
+                return None
             return batch
 
     def close(self) -> None:
         with self._cond:
             self._closed = True
-            for fut, _ in self._items:
+            for fut, _, _ in self._items:
                 fut.set_error(RuntimeError("worker queue closed"))
             self._items.clear()
             self._cond.notify_all()

@@ -27,7 +27,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from rafiki_tpu.cache.queue import Broker, QueryFuture
+from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
 from rafiki_tpu.native.shm_queue import (
     ShmMessageQueue,
     ShmQueueClosed,
@@ -132,10 +132,20 @@ class ShmWorkerQueue:
                 break
             batch.append(nxt)
         out = []
+        now = time.monotonic()
         for raw in batch:
             msg = json.loads(raw)
-            out.append((self.ResponseHandle(self._rq, msg["id"]),
-                        msg["query"]))
+            handle = self.ResponseHandle(self._rq, msg["id"])
+            # overload control: a query whose request deadline passed while
+            # it sat in the ring is dropped here, not served — CLOCK_MONOTONIC
+            # is system-wide on one host, so the submitter's absolute
+            # deadline is directly comparable in this worker process
+            deadline = msg.get("deadline")
+            if deadline is not None and now >= float(deadline):
+                handle.set_error(TimeoutError(
+                    "query expired in the shm queue before dispatch"))
+                continue
+            out.append((handle, msg["query"]))
         return out
 
     def close(self) -> None:
@@ -143,32 +153,58 @@ class ShmWorkerQueue:
 
 
 class _SubmitProxy:
-    """Predictor-side view of one worker's query queue."""
+    """Predictor-side view of one worker's query queue.
 
-    def __init__(self, broker: "ShmBroker", job_id: str,
+    Overload control happens owner-side (this process): the broker counts
+    each worker's *outstanding* queries (submitted, not yet answered), so
+    ``depth()`` gives the hedge-suppression/admission load signal and
+    ``submit_many`` enforces RAFIKI_PREDICT_QUEUE_DEPTH with the same
+    QueueFullError contract as the in-process queue — the shm ring itself
+    cannot be asked its message count from here."""
+
+    def __init__(self, broker: "ShmBroker", job_id: str, worker_id: str,
                  query_q: ShmMessageQueue):
         self._broker = broker
         self._job_id = job_id
+        self._worker_id = worker_id
         self._qq = query_q
 
-    def submit(self, query: Any) -> QueryFuture:
-        qid = uuid.uuid4().hex
-        fut = QueryFuture()
-        self._broker._register_pending(self._job_id, qid, fut)
-        try:
-            self._qq.push(_json_dumps({"id": qid, "query": query}))
-        except Exception as e:
-            self._broker._pop_pending(self._job_id, qid)
-            fut.set_error(e)
-        return fut
+    def depth(self) -> int:
+        return self._broker._outstanding_count(self._job_id, self._worker_id)
 
-    def submit_many(self, queries: List[Any]) -> List[QueryFuture]:
+    def submit(self, query: Any,
+               deadline: Optional[float] = None) -> QueryFuture:
+        return self.submit_many([query], deadline=deadline)[0]
+
+    def submit_many(self, queries: List[Any],
+                    deadline: Optional[float] = None) -> List[QueryFuture]:
         # cross-process ring: one message per query; the ring preserves
         # push order and the worker-side take_batch drains every
         # already-queued message before it considers the deadline, so
         # consecutive pushes land as one batch without in-process-style
-        # lock atomicity
-        return [self.submit(q) for q in queries]
+        # lock atomicity. The depth-cap check is all-or-nothing per
+        # request, like WorkerQueue.submit_many, and the reservation is
+        # atomic with it (released on response, push failure, or expiry).
+        self._broker._reserve_capacity(
+            self._job_id, self._worker_id, len(queries))
+        out = []
+        for query in queries:
+            qid = uuid.uuid4().hex
+            fut = QueryFuture()
+            self._broker._register_pending(
+                self._job_id, self._worker_id, qid, fut, deadline)
+            msg = {"id": qid, "query": query}
+            if deadline is not None:
+                # absolute monotonic deadline; comparable worker-side
+                # because both processes share the host's CLOCK_MONOTONIC
+                msg["deadline"] = deadline
+            try:
+                self._qq.push(_json_dumps(msg))
+            except Exception as e:
+                self._broker._pop_pending(self._job_id, qid)
+                fut.set_error(e)
+            out.append(fut)
+        return out
 
 
 class ShmBroker(Broker):
@@ -183,7 +219,12 @@ class ShmBroker(Broker):
         self._lock = threading.Lock()
         self._query_qs: Dict[str, Dict[str, ShmMessageQueue]] = {}
         self._response_qs: Dict[str, ShmMessageQueue] = {}
-        self._pending: Dict[str, Dict[str, QueryFuture]] = {}
+        # qid -> (future, worker_id, expiry_ts): worker_id feeds the
+        # per-worker outstanding counts (the depth signal), expiry_ts lets
+        # a never-answered query (worker crashed mid-batch) be pruned
+        # instead of counting against the depth cap forever
+        self._pending: Dict[str, Dict[str, Tuple[QueryFuture, str, float]]] = {}
+        self._outstanding: Dict[Tuple[str, str], int] = {}
         self._listeners: Dict[str, threading.Thread] = {}
         self._graveyard: List[ShmMessageQueue] = []
         self._closed = False
@@ -216,7 +257,7 @@ class ShmBroker(Broker):
     def get_worker_queues(self, inference_job_id: str) -> Dict[str, Any]:
         with self._lock:
             return {
-                wid: _SubmitProxy(self, inference_job_id, qq)
+                wid: _SubmitProxy(self, inference_job_id, wid, qq)
                 for wid, qq in self._query_qs.get(inference_job_id, {}).items()
             }
 
@@ -237,13 +278,79 @@ class ShmBroker(Broker):
             t.start()
         return self._response_qs[job_id]
 
-    def _register_pending(self, job_id: str, qid: str, fut: QueryFuture) -> None:
+    def _register_pending(self, job_id: str, worker_id: str, qid: str,
+                          fut: QueryFuture,
+                          deadline: Optional[float]) -> None:
+        """Record one reserved query's future (the outstanding count was
+        already taken by _reserve_capacity — registering must NOT count
+        again). Expiry gets a grace period past the request deadline (or
+        the configured SLO): a query the worker never answers must stop
+        counting against its depth eventually, or one crash would pin the
+        replica "full" forever."""
+        from rafiki_tpu import config
+
+        expiry = (deadline if deadline is not None
+                  else time.monotonic() + config.PREDICT_TIMEOUT_S) + 30.0
         with self._lock:
-            self._pending.setdefault(job_id, {})[qid] = fut
+            self._pending.setdefault(job_id, {})[qid] = (
+                fut, worker_id, expiry)
 
     def _pop_pending(self, job_id: str, qid: str) -> Optional[QueryFuture]:
         with self._lock:
-            return self._pending.get(job_id, {}).pop(qid, None)
+            entry = self._pending.get(job_id, {}).pop(qid, None)
+            if entry is None:
+                return None
+            fut, worker_id, _ = entry
+            self._dec_outstanding_locked(job_id, worker_id)
+            return fut
+
+    def _dec_outstanding_locked(self, job_id: str, worker_id: str) -> None:
+        key = (job_id, worker_id)
+        n = self._outstanding.get(key, 0) - 1
+        if n <= 0:
+            self._outstanding.pop(key, None)
+        else:
+            self._outstanding[key] = n
+
+    def _prune_expired_locked(self, job_id: str, worker_id: str) -> None:
+        """Drop never-answered entries past their expiry (worker crashed
+        mid-batch). Must run on EVERY read of the count, not just on
+        submits: the admission layer sheds on depth() *before* any submit
+        happens, so a prune that only ran at submit time could never fire
+        again once phantoms pushed the estimated wait over every
+        deadline — a permanent-429 lockout."""
+        now = time.monotonic()
+        job_pending = self._pending.get(job_id, {})
+        for qid, (_, wid, expiry) in list(job_pending.items()):
+            if wid == worker_id and now >= expiry:
+                job_pending.pop(qid)
+                self._dec_outstanding_locked(job_id, wid)
+
+    def _outstanding_count(self, job_id: str, worker_id: str) -> int:
+        with self._lock:
+            if self._outstanding.get((job_id, worker_id), 0) > 0:
+                self._prune_expired_locked(job_id, worker_id)
+            return self._outstanding.get((job_id, worker_id), 0)
+
+    def _reserve_capacity(self, job_id: str, worker_id: str, n: int) -> None:
+        """Atomically check RAFIKI_PREDICT_QUEUE_DEPTH and claim ``n``
+        outstanding slots (one lock hold: a check-then-register split
+        would let concurrent submitters jointly overshoot the cap). The
+        claim is released by _pop_pending (response/push-failure) or by
+        expiry pruning."""
+        from rafiki_tpu import config
+
+        cap = int(config.PREDICT_QUEUE_DEPTH)
+        key = (job_id, worker_id)
+        with self._lock:
+            if self._outstanding.get(key, 0) > 0:
+                self._prune_expired_locked(job_id, worker_id)
+            queued = self._outstanding.get(key, 0)
+            if cap > 0 and queued + n > cap:
+                raise QueueFullError(
+                    f"shm worker {worker_id} full "
+                    f"({queued}/{cap} outstanding)")
+            self._outstanding[key] = queued + n
 
     def _listen(self, job_id: str, rq: ShmMessageQueue) -> None:
         while not self._closed:
@@ -292,9 +399,10 @@ class ShmBroker(Broker):
                 rq.destroy()
             self._response_qs.clear()
             for pend in self._pending.values():
-                for fut in pend.values():
+                for fut, _, _ in pend.values():
                     fut.set_error(RuntimeError("broker closed"))
             self._pending.clear()
+            self._outstanding.clear()
 
 
 class ShmBrokerClient:

@@ -46,6 +46,27 @@ PREDICT_MAX_BATCH_SIZE = _env_int("PREDICT_MAX_BATCH_SIZE", 64)
 PREDICT_BATCH_DEADLINE_MS = _env_float("PREDICT_BATCH_DEADLINE_MS", 0.0)
 PREDICT_TIMEOUT_S = _env_float("PREDICT_TIMEOUT_S", 30.0)
 
+# -- serving-plane overload control (docs/failure-model.md, "Overload
+# faults"). All four knobs resolve lazily (module __getattr__ below) so
+# tests and operators can retune a live deployment's next queue/server
+# without re-importing:
+#   RAFIKI_PREDICT_QUEUE_DEPTH      per-worker inbox cap; submits beyond it
+#                                   raise QueueFullError -> the doors shed
+#                                   with 429 + Retry-After instead of
+#                                   growing an unbounded backlog (0 = uncapped)
+#   RAFIKI_PREDICT_MAX_INFLIGHT     concurrently-admitted requests per
+#                                   serving door; excess is shed with 503
+#                                   before it can pile up handler threads
+#                                   (0 = unbounded)
+#   RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH
+#                                   a sibling replica whose queue depth
+#                                   exceeds this never receives a hedge
+#                                   batch — duplicate work onto an already
+#                                   saturated replica is how overload
+#                                   metastasizes ("The Tail at Scale")
+#   RAFIKI_PREDICT_DRAIN_S          PredictorServer.stop() waits this long
+#                                   for in-flight handlers before closing
+
 DEFAULT_TRIAL_COUNT = _env_int("DEFAULT_TRIAL_COUNT", 5)
 
 ADMIN_HOST = os.environ.get("ADMIN_HOST", "127.0.0.1")
@@ -104,6 +125,14 @@ _DYNAMIC_PATHS = {
         os.environ.get("RAFIKI_PREDICTOR_PORTS", "0") == "1"),
     "PREDICTOR_HOST": lambda: (
         os.environ.get("RAFIKI_PREDICTOR_HOST", "127.0.0.1")),
+    # overload-control knobs (commented where declared above)
+    "PREDICT_QUEUE_DEPTH": lambda: _env_int(
+        "RAFIKI_PREDICT_QUEUE_DEPTH", 256),
+    "PREDICT_MAX_INFLIGHT": lambda: _env_int(
+        "RAFIKI_PREDICT_MAX_INFLIGHT", 64),
+    "PREDICT_HEDGE_SUPPRESS_DEPTH": lambda: _env_int(
+        "RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH", PREDICT_MAX_BATCH_SIZE),
+    "PREDICT_DRAIN_S": lambda: _env_float("RAFIKI_PREDICT_DRAIN_S", 5.0),
 }
 
 

@@ -126,6 +126,38 @@ def check_chaos() -> Check:
             f"{os.environ[chaos.ENV_VAR]!r}")
 
 
+def check_overload_knobs() -> Check:
+    """Serving-plane overload control (docs/failure-model.md "Overload
+    faults"): the knobs must describe a coherent pipeline — a queue cap
+    below the batch size silently caps batch occupancy, and an uncapped
+    queue plus an uncapped door disables shedding entirely."""
+    from rafiki_tpu import config
+
+    depth = int(config.PREDICT_QUEUE_DEPTH)
+    inflight = int(config.PREDICT_MAX_INFLIGHT)
+    hedge = int(config.PREDICT_HEDGE_SUPPRESS_DEPTH)
+    batch = int(config.PREDICT_MAX_BATCH_SIZE)
+    if 0 < depth < batch:
+        # serving still works (take_batch dispatches whatever is queued);
+        # batches just can't reach max occupancy, and single requests
+        # above the cap are refused outright
+        return ("overload control", WARN,
+                f"RAFIKI_PREDICT_QUEUE_DEPTH={depth} is below "
+                f"PREDICT_MAX_BATCH_SIZE={batch}: batches cap at {depth} "
+                f"queries and requests above {depth} queries are refused "
+                "— intended?")
+    if depth <= 0 and inflight <= 0:
+        return ("overload control", WARN,
+                "queue depth AND in-flight caps disabled "
+                "(RAFIKI_PREDICT_QUEUE_DEPTH=0, "
+                "RAFIKI_PREDICT_MAX_INFLIGHT=0): overload will queue "
+                "unboundedly instead of shedding 429/503")
+    detail = (f"queue depth {depth or 'uncapped'}, in-flight "
+              f"{inflight or 'uncapped'}, hedge suppression at "
+              f"{hedge or 'off'}")
+    return ("overload control", PASS, detail)
+
+
 def check_agents() -> Check:
     from rafiki_tpu.utils.agent_http import AgentHTTPError, call_agent
 
@@ -193,7 +225,7 @@ def check_agents() -> Check:
 
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
-    check_chaos, check_agents, check_backend,
+    check_chaos, check_overload_knobs, check_agents, check_backend,
 ]
 
 

@@ -238,8 +238,19 @@ class AgentServer:
             cap=None)
         if terr:
             return self._respond(handler, 400, {"error": terr})
-        futures = queue.submit_many(queries)
         deadline = _time.monotonic() + timeout_s
+        from rafiki_tpu.cache.queue import QueueFullError
+
+        try:
+            # the relayed deadline rides into the host-local queue, so a
+            # stalled remote worker drops expired relayed queries exactly
+            # like local ones
+            futures = queue.submit_many(queries, deadline=deadline)
+        except QueueFullError as e:
+            # bounded queue refused: shed with the standard retryable code
+            # — the admin-side predictor treats the failed relay as a
+            # replica failure and fails over / suppresses its hedge
+            return self._respond(handler, 429, {"error": str(e)})
         try:
             preds = [
                 f.result(max(deadline - _time.monotonic(), 0.0))

@@ -1,0 +1,145 @@
+"""Deadline-aware admission control for the serving doors.
+
+Both serving doors — the per-job ``PredictorServer`` and the admin's
+``/predict/<app>`` route — sit on ``ThreadingHTTPServer``, which happily
+spawns one handler thread per connection forever. Under overload that is
+the metastable failure of "The Tail at Scale": every queued request is
+eventually served (long after its client gave up), each one slower than
+the last. This module is the shared front gate:
+
+- a **bounded in-flight semaphore** (``RAFIKI_PREDICT_MAX_INFLIGHT``):
+  requests beyond the cap are shed instantly with ``503`` — capacity is
+  the model fleet, not the thread scheduler;
+- an **estimated-wait check**: if the backlog already implies a wait
+  longer than the request's own deadline, admitting it only burns model
+  time on a doomed request — shed with ``429`` + ``Retry-After`` so
+  well-behaved clients back off;
+- **counters** (admitted/shed/in-flight + an EWMA of per-query service
+  time) surfaced through ``/healthz`` and ``GET /fleet/health``.
+
+Shed-code contract (docs/failure-model.md "Overload faults"): ``429``
+means *retryable later* — the queue is full or the wait exceeds your
+deadline, and ``Retry-After`` says when to come back; ``503`` means *no
+capacity right now* — in-flight slots are gone, retry is the client's
+call. Neither code is ever sent after work started; a shed request costs
+the server microseconds.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional
+
+
+class ServerOverloadedError(RuntimeError):
+    """The door's in-flight cap is exhausted (HTTP 503)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.0)
+
+
+class DeadlineUnmeetableError(RuntimeError):
+    """The estimated queue wait already exceeds the request's deadline
+    (HTTP 429 + Retry-After): admitting it would spend model time on an
+    answer nobody will read."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.0)
+
+
+def retry_after_headers(e: Exception) -> Dict[str, str]:
+    """The Retry-After header (whole seconds, >= 1) from a shed error's
+    estimate — THE one copy of the contract, used by every door."""
+    return {"Retry-After": str(max(
+        1, math.ceil(getattr(e, "retry_after_s", 1.0))))}
+
+
+class AdmissionController:
+    """One per serving door. Thread-safe; all operations are O(1) and
+    lock-held for nanoseconds — this gate must stay cheap precisely when
+    the server is busiest."""
+
+    def __init__(self, max_inflight: Optional[int] = None) -> None:
+        #: None defers to RAFIKI_PREDICT_MAX_INFLIGHT lazily per admit
+        self._max_inflight = max_inflight
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._admitted = 0
+        self._shed_capacity = 0   # 503s
+        self._shed_deadline = 0   # 429s
+        # EWMA of per-query service seconds, admission's unit of wait
+        # estimation; 0.0 until the first observation (estimate disabled —
+        # never shed on a guess)
+        self._ewma_query_s = 0.0
+
+    def _cap(self) -> int:
+        if self._max_inflight is not None:
+            return self._max_inflight
+        from rafiki_tpu import config
+
+        return int(config.PREDICT_MAX_INFLIGHT)
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, timeout_s: float,
+              backlog_depth: Optional[int] = None) -> None:
+        """Claim one in-flight slot or raise a shed error. The caller MUST
+        pair a successful admit with :meth:`release` (try/finally).
+
+        ``backlog_depth`` is the least-loaded replica path's queue depth
+        (``Predictor.min_backlog_depth``); with a service-time EWMA it
+        yields the estimated wait this request would face."""
+        with self._lock:
+            cap = self._cap()
+            if cap > 0 and self._inflight >= cap:
+                self._shed_capacity += 1
+                raise ServerOverloadedError(
+                    f"serving door at capacity ({self._inflight}/{cap} "
+                    f"in flight)",
+                    retry_after_s=max(self._ewma_query_s, 1.0))
+            est_wait = (backlog_depth * self._ewma_query_s
+                        if backlog_depth and self._ewma_query_s > 0 else 0.0)
+            if est_wait > timeout_s > 0:
+                self._shed_deadline += 1
+                raise DeadlineUnmeetableError(
+                    f"estimated queue wait {est_wait:.2f}s exceeds the "
+                    f"request deadline {timeout_s:.2f}s",
+                    retry_after_s=math.ceil(est_wait))
+            self._inflight += 1
+            self._admitted += 1
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(self._inflight - 1, 0)
+
+    # -- feedback + observability ------------------------------------------
+
+    def observe(self, latency_s: float, n_queries: int) -> None:
+        """Feed one served request's latency back into the wait model."""
+        if n_queries <= 0 or latency_s < 0:
+            return
+        per_query = latency_s / n_queries
+        with self._lock:
+            if self._ewma_query_s <= 0.0:
+                self._ewma_query_s = per_query
+            else:
+                self._ewma_query_s += 0.2 * (per_query - self._ewma_query_s)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "max_inflight": self._cap(),
+                "admitted": self._admitted,
+                "shed_capacity": self._shed_capacity,
+                "shed_deadline": self._shed_deadline,
+                "ewma_query_s": round(self._ewma_query_s, 6),
+            }

@@ -22,11 +22,12 @@ from __future__ import annotations
 
 import itertools
 import logging
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import config
-from rafiki_tpu.cache.queue import Broker, QueryFuture
+from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
 
 logger = logging.getLogger(__name__)
@@ -45,6 +46,48 @@ class Predictor:
         self._task = task
         self._worker_trials = dict(worker_trials or {})
         self._rr = itertools.count()
+        # overload-control counters (docs/failure-model.md "Overload
+        # faults"), surfaced via the per-job /healthz and GET /fleet/health
+        self._ol_lock = threading.Lock()
+        self._overload = {
+            "hedges": 0,             # failover batches actually issued
+            "hedges_suppressed": 0,  # withheld: target replica saturated
+            "trials_shed": 0,        # trials dropped: every replica full
+            "requests_shed": 0,      # whole requests refused (all full)
+        }
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._ol_lock:
+            self._overload[key] += n
+
+    def overload_stats(self) -> Dict[str, int]:
+        with self._ol_lock:
+            return dict(self._overload)
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Per-worker inbox depth (queues without a depth signal report
+        -1). The serving doors and /fleet/health read this as the job's
+        live load picture."""
+        out: Dict[str, int] = {}
+        for wid, q in self._broker.get_worker_queues(self._job_id).items():
+            depth = getattr(q, "depth", None)
+            out[wid] = depth() if callable(depth) else -1
+        return out
+
+    def backlog_depth(self) -> int:
+        """The queue depth a NEW request would actually face: each trial
+        answers via its least-loaded replica, and the request waits for
+        every trial in the ensemble — so the binding backlog is the max
+        across trials of the min across that trial's replicas."""
+        depths = self.queue_depths()
+        if not depths:
+            return 0
+        groups: Dict[str, List[int]] = {}
+        for wid, d in depths.items():
+            if d >= 0:
+                groups.setdefault(
+                    self._worker_trials.get(wid, wid), []).append(d)
+        return max((min(ds) for ds in groups.values()), default=0)
 
     def predict(self, query: Any, timeout_s: Optional[float] = None) -> Any:
         return self.predict_batch([query], timeout_s)[0]
@@ -73,13 +116,35 @@ class Predictor:
             trial: wids[rr % len(wids):] + wids[:rr % len(wids)]
             for trial, wids in groups.items()
         }
-        inflight = {
-            trial: queues[order[0]].submit_many(queries)
-            for trial, order in orders.items()
-        }
-        for trial, order in orders.items():
+        # First submit walks the replica order past bounded queues that
+        # refuse (QueueFullError): a full replica is just a load signal to
+        # try its sibling. The order is rotated so failover/hedging starts
+        # from whoever actually accepted; skipped-full replicas move to
+        # the back (they may have drained by hedge time). A trial whose
+        # EVERY replica refuses is shed from this request's ensemble; if
+        # every trial sheds, the whole request is refused — that is the
+        # doors' 429.
+        inflight: Dict[str, List[QueryFuture]] = {}
+        for trial, order in list(orders.items()):
+            for k, wid in enumerate(order):
+                try:
+                    inflight[trial] = queues[wid].submit_many(
+                        queries, deadline=deadline)
+                except QueueFullError:
+                    continue
+                orders[trial] = order[k:] + order[:k]
+                break
+            else:
+                self._bump("trials_shed")
+                logger.info("trial %s shed from this request: every "
+                            "replica queue of %s is full", trial, order)
+        if not inflight:
+            self._bump("requests_shed")
+            raise QueueFullError(
+                f"all serving queues for job {self._job_id} are full")
+        for trial, futs in inflight.items():
             preds = self._gather_with_failover(
-                trial, order, queues, queries, inflight[trial], deadline)
+                trial, orders[trial], queues, queries, futs, deadline)
             trial_predictions.append(preds)
         answered = [p for p in trial_predictions if p is not None]
         if not answered:
@@ -100,7 +165,15 @@ class Predictor:
         never abandoned: once more than one batch is in flight, a poll loop
         sweeps ALL of them, so a healthy-but-slow first replica that
         answers after its hedge fired still serves the request within the
-        SLO."""
+        SLO.
+
+        Hedging is load-aware: a sibling whose queue depth exceeds
+        ``RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH`` never receives the hedge
+        batch — when replicas are slow *because the job is overloaded*,
+        hedges are duplicate work that make every queue deeper, the
+        metastable "hedge storm" of Dean & Barroso's tail-latency paper.
+        A suppressed hedge keeps sweeping the batches already in flight
+        instead."""
         issued: List[List[QueryFuture]] = [list(first_futs)]
         attempt = 0
         while True:
@@ -133,13 +206,41 @@ class Predictor:
                     return preds
             attempt += 1
             if attempt < len(order) and time.monotonic() < deadline:
-                issued.append(queues[order[attempt]].submit_many(queries))
+                hedge = self._try_hedge(
+                    queues[order[attempt]], order[attempt], queries, deadline)
+                if hedge is not None:
+                    issued.append(hedge)
         # final sweep: any in-flight batch may still land before the SLO
         preds = self._sweep(issued, deadline) if issued else None
         if preds is None:
             logger.warning("trial %s dropped from ensemble: no replica of %s "
                            "answered within the SLO", trial, order)
         return preds
+
+    def _try_hedge(self, queue, worker_id: str, queries: List[Any],
+                   deadline: float) -> Optional[List[QueryFuture]]:
+        """Issue one failover batch unless the target replica is already
+        saturated (queue depth over the suppression threshold, or its
+        bounded queue refuses outright). Returns the hedge futures, or
+        None when the hedge was suppressed."""
+        threshold = int(config.PREDICT_HEDGE_SUPPRESS_DEPTH)
+        depth_fn = getattr(queue, "depth", None)
+        if (threshold > 0 and callable(depth_fn)
+                and depth_fn() > threshold):
+            self._bump("hedges_suppressed")
+            logger.info(
+                "hedge to replica %s suppressed: queue depth %d over the "
+                "suppression threshold %d", worker_id, depth_fn(), threshold)
+            return None
+        try:
+            futs = queue.submit_many(queries, deadline=deadline)
+        except QueueFullError:
+            self._bump("hedges_suppressed")
+            logger.info("hedge to replica %s suppressed: queue full",
+                        worker_id)
+            return None
+        self._bump("hedges")
+        return futs
 
     @staticmethod
     def _sweep(issued: List[List[QueryFuture]],

@@ -20,9 +20,17 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
+from rafiki_tpu.cache.queue import QueueFullError
+from rafiki_tpu.predictor.admission import (
+    AdmissionController,
+    DeadlineUnmeetableError,
+    ServerOverloadedError,
+    retry_after_headers,
+)
 from rafiki_tpu.utils.auth import UnauthorizedError, decode_token
 from rafiki_tpu.utils.reqfields import LowLatencyHandler
 
@@ -31,7 +39,15 @@ logger = logging.getLogger(__name__)
 
 class PredictorServer:
     """One jsonified POST /predict + GET /healthz listener over one
-    Predictor (predictor/predictor.py)."""
+    Predictor (predictor/predictor.py).
+
+    Overload control (docs/failure-model.md "Overload faults"): every
+    predict passes the door's AdmissionController first — a bounded
+    in-flight gate plus a deadline-aware estimated-wait check — and worker
+    queues underneath are bounded, so excess traffic is shed instantly
+    with ``429`` + ``Retry-After`` (backlog: retry later) or ``503`` (no
+    capacity) instead of accumulating ThreadingHTTPServer handler threads
+    until the host dies."""
 
     def __init__(self, predictor, app: str, host: str = "127.0.0.1",
                  port: int = 0, auth: bool = True):
@@ -40,8 +56,12 @@ class PredictorServer:
         self.host = host
         self.port = port
         self.auth = auth
+        self.admission = AdmissionController()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+        self._draining = False
 
     def start(self) -> "PredictorServer":
         server = self
@@ -52,8 +72,7 @@ class PredictorServer:
 
             def do_GET(self):
                 if self.path.split("?", 1)[0].rstrip("/") == "/healthz":
-                    server._respond(self, 200, {
-                        "app": server.app, "status": "ok"})
+                    server._healthz(self)
                 else:
                     server._respond(self, 404, {"error": "no such route"})
 
@@ -70,12 +89,71 @@ class PredictorServer:
                     self.app, self.host, self.port)
         return self
 
-    def stop(self) -> None:
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+    def stop(self, drain_timeout_s: Optional[float] = None) -> None:
+        """Graceful drain: stop accepting, let in-flight handlers finish
+        (bounded by ``drain_timeout_s``, default RAFIKI_PREDICT_DRAIN_S),
+        then close the socket and join the serve thread. Idempotent — the
+        teardown paths (operator stop, all-replicas-dead refresh, deploy
+        rollback) may race onto a double stop."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._draining = True
+        httpd, thread = self._httpd, self._thread
+        if httpd is None:
+            return
+        from rafiki_tpu import config
+
+        if drain_timeout_s is None:
+            drain_timeout_s = float(config.PREDICT_DRAIN_S)
+        httpd.shutdown()  # stop the accept loop; handler threads live on
+        deadline = time.monotonic() + max(drain_timeout_s, 0.0)
+        while (self.admission.inflight > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        leftover = self.admission.inflight
+        if leftover:
+            logger.warning(
+                "predictor %s closed with %d handler(s) still in flight "
+                "after the %.1fs drain window", self.app, leftover,
+                drain_timeout_s)
+        httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._draining = False
 
     # -- handling ----------------------------------------------------------
+
+    def _healthz(self, handler: BaseHTTPRequestHandler) -> None:
+        """Liveness + load: ``status`` is ``degraded`` when the serving
+        plane is live-but-empty (zero worker queues registered — the door
+        answers but no replica can), which the fleet-health monitor must
+        be able to tell apart from healthy. Also carries the overload
+        picture: queue depths, admission counters, hedge suppression."""
+        depths: Dict[str, int] = {}
+        depth_fn = getattr(self.predictor, "queue_depths", None)
+        if callable(depth_fn):
+            try:
+                depths = depth_fn()
+            except Exception:
+                logger.exception("healthz queue-depth probe failed")
+        overload_fn = getattr(self.predictor, "overload_stats", None)
+        status = "ok"
+        if self._draining:
+            status = "draining"
+        elif callable(depth_fn) and not depths:
+            status = "degraded"
+        payload: Dict[str, Any] = {
+            "app": self.app,
+            "status": status,
+            "workers": len(depths),
+            "queue_depths": depths,
+            "admission": self.admission.stats(),
+        }
+        if callable(overload_fn):
+            payload["overload"] = overload_fn()
+        self._respond(handler, 200, payload)
 
     def _predict(self, handler: BaseHTTPRequestHandler) -> None:
         if handler.path.split("?", 1)[0].rstrip("/") != "/predict":
@@ -128,6 +206,16 @@ class PredictorServer:
             if not isinstance(queries, list) or not queries:
                 return self._respond(handler, 400, {
                     "error": "body must carry a non-empty 'queries' list"})
+            cap = int(_config.PREDICT_QUEUE_DEPTH)
+            if cap > 0 and len(queries) > cap:
+                # bigger than any queue can EVER hold: a permanent
+                # condition — 400, never the retryable 429 (a well-behaved
+                # client would retry a 429 forever)
+                return self._respond(handler, 400, {
+                    "error": f"request carries {len(queries)} queries but "
+                             f"the per-worker queue cap is {cap} "
+                             "(RAFIKI_PREDICT_QUEUE_DEPTH) — split the "
+                             "request"})
             from rafiki_tpu.utils.reqfields import parse_timeout_s
 
             # binary bodies have no JSON fields — the timeout rides a
@@ -141,13 +229,34 @@ class PredictorServer:
                        if ctype == "application/x-npy" else "timeout_s"))
             if terr:
                 return self._respond(handler, 400, {"error": terr})
-            preds = self.predictor.predict_batch(
-                queries, timeout_s=timeout_s)
+            # admission: claim an in-flight slot AND prove the backlog
+            # leaves room to answer inside this request's own deadline —
+            # shed here costs microseconds; admitting a doomed request
+            # costs model time
+            backlog_fn = getattr(self.predictor, "backlog_depth", None)
+            backlog = backlog_fn() if callable(backlog_fn) else None
+            self.admission.admit(timeout_s, backlog_depth=backlog)
+            t0 = time.monotonic()
+            try:
+                preds = self.predictor.predict_batch(
+                    queries, timeout_s=timeout_s)
+            finally:
+                self.admission.release()
+            self.admission.observe(time.monotonic() - t0, len(queries))
             self._respond(handler, 200, {"data": {"predictions": preds}})
         except UnauthorizedError as e:
             self._respond(handler, 401, {"error": str(e)})
         except json.JSONDecodeError as e:
             self._respond(handler, 400, {"error": f"bad JSON body: {e}"})
+        except (QueueFullError, DeadlineUnmeetableError) as e:
+            # backlog shed: retryable, and Retry-After says when (full
+            # worker queues / estimated wait past the client's deadline)
+            self._respond(handler, 429, {"error": str(e)},
+                          headers=retry_after_headers(e))
+        except ServerOverloadedError as e:
+            # no capacity: the door's in-flight slots are gone
+            self._respond(handler, 503, {"error": str(e)},
+                          headers=retry_after_headers(e))
         except TimeoutError as e:
             self._respond(handler, 504, {"error": str(e)})
         except RuntimeError as e:
@@ -159,10 +268,13 @@ class PredictorServer:
             self._respond(handler, 500, {"error": "internal server error"})
 
     @staticmethod
-    def _respond(handler, code: int, payload: Dict[str, Any]) -> None:
+    def _respond(handler, code: int, payload: Dict[str, Any],
+                 headers: Optional[Dict[str, str]] = None) -> None:
         data = json.dumps(payload).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
         handler.wfile.write(data)

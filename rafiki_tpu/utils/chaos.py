@@ -19,11 +19,14 @@ via :func:`install` in tests. Env format: ``|``-separated rules of
 
 Fields:
 
-    site     where to inject: ``call_agent`` (admin-side transport) or
-             ``agent`` (host agent server). Required.
-    action   ``drop`` (connection-level failure), ``delay`` (sleep
-             ``delay_s`` then proceed), or ``error`` (HTTP ``code``).
+    site     where to inject: ``call_agent`` (admin-side transport),
+             ``agent`` (host agent server), or ``worker`` (inference
+             serve loop — overload drills: slow/stalled replicas).
              Required.
+    action   ``drop`` (connection-level failure; at site=worker the batch
+             is silently swallowed — a stalled replica), ``delay`` (sleep
+             ``delay_s`` then proceed — a slow replica), or ``error``
+             (HTTP ``code``; at site=worker the batch fails). Required.
     match    substring filter on the target ("addr path" client-side,
              request path server-side). Empty matches everything.
     after    skip the first N matching requests (default 0).
@@ -55,6 +58,12 @@ ENV_VAR = "RAFIKI_CHAOS"
 
 SITE_CALL_AGENT = "call_agent"
 SITE_AGENT = "agent"
+# inference worker serve loop (worker/inference.py): the overload-drill
+# site. `delay` makes a worker slow (queues back up behind a live model —
+# the condition that triggers admission shed + hedge suppression), `drop`
+# makes it silently swallow a batch (futures never resolve; the
+# predictor's SLO machinery takes over), `error` fails the batch.
+SITE_WORKER = "worker"
 
 ACTION_DROP = "drop"
 ACTION_DELAY = "delay"
@@ -79,7 +88,7 @@ class ChaosRule:
     hits: int = field(default=0, compare=False)  # matching requests seen
 
     def __post_init__(self) -> None:
-        if self.site not in (SITE_CALL_AGENT, SITE_AGENT):
+        if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR):
             raise ChaosSpecError(f"unknown chaos action {self.action!r}")

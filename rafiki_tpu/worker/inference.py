@@ -23,6 +23,7 @@ from typing import Dict, Optional
 from rafiki_tpu import config
 from rafiki_tpu.cache.queue import Broker
 from rafiki_tpu.db.database import Database
+from rafiki_tpu.utils import chaos
 from rafiki_tpu.parallel.mesh import set_device_grant
 from rafiki_tpu.placement.manager import ServiceContext
 from rafiki_tpu.sdk.model import load_model_class
@@ -33,13 +34,16 @@ logger = logging.getLogger(__name__)
 # Per-service serving counters (batches served, queries served), updated by
 # the worker loop so benchmarks and ops can compute *batch occupancy* —
 # mean queries/batch, the signal that continuous batching actually
-# coalesces under concurrent load instead of serving singletons.
+# coalesces under concurrent load instead of serving singletons. Overload
+# control adds the queue picture: `queue_depth` (gauge), `expired`
+# (queries dropped past their request deadline) and `shed` (queries the
+# bounded queue refused) — surfaced through GET /fleet/health.
 _stats_lock = threading.Lock()
 SERVING_STATS: Dict[str, Dict[str, int]] = {}
 
 
 def serving_stats() -> Dict[str, Dict[str, int]]:
-    """Snapshot of {service_id: {batches, queries}} for this process."""
+    """Snapshot of {service_id: {batches, queries, ...}} for this process."""
     with _stats_lock:
         return {k: dict(v) for k, v in SERVING_STATS.items()}
 
@@ -49,6 +53,24 @@ def _record_batch(service_id: str, n_queries: int) -> None:
         s = SERVING_STATS.setdefault(service_id, {"batches": 0, "queries": 0})
         s["batches"] += 1
         s["queries"] += n_queries
+
+
+def _record_queue(service_id: str, queue) -> None:
+    """Fold the queue's overload counters into this service's stats row
+    (queues without a stats() signal — e.g. shm response handles — just
+    contribute nothing)."""
+    stats_fn = getattr(queue, "stats", None)
+    if not callable(stats_fn):
+        return
+    try:
+        q = stats_fn()
+    except Exception:
+        return
+    with _stats_lock:
+        s = SERVING_STATS.setdefault(service_id, {"batches": 0, "queries": 0})
+        s["queue_depth"] = int(q.get("depth", 0))
+        s["expired"] = int(q.get("expired", 0))
+        s["shed"] = int(q.get("rejected", 0))
 
 
 class _FusedEnsembleModel:
@@ -252,10 +274,36 @@ class InferenceWorker:
                                 ctx.service_id)
                     break
                 if not batch:
+                    # still publish the queue gauge/counters on idle ticks
+                    # and on takes that only dropped expired entries
+                    _record_queue(ctx.service_id, queue)
                     continue
                 _record_batch(ctx.service_id, len(batch))
+                _record_queue(ctx.service_id, queue)
                 futures = [f for f, _ in batch]
                 queries = [q for _, q in batch]
+                rule = chaos.hit(chaos.SITE_WORKER,
+                                 f"{self._job_id}/{ctx.service_id}")
+                if rule is not None:
+                    # deterministic overload drills (RAFIKI_CHAOS
+                    # site=worker): slow replica / silent stall / failing
+                    # replica, injected between take and predict so queue
+                    # bounding and admission shed upstream are what a test
+                    # observes
+                    if rule.action == chaos.ACTION_DELAY:
+                        chaos.sleep_for(rule)
+                    elif rule.action == chaos.ACTION_DROP:
+                        # swallow the batch: futures never resolve — the
+                        # predictor's SLO/hedging machinery owns recovery
+                        logger.warning(
+                            "chaos: worker %s stalling a %d-query batch",
+                            ctx.service_id, len(batch))
+                        continue
+                    else:  # ACTION_ERROR
+                        err = RuntimeError("chaos-injected worker error")
+                        for fut in futures:
+                            fut.set_error(err)
+                        continue
                 try:
                     predictions = model.predict(queries)
                     for fut, pred in zip(futures, predictions):

@@ -40,6 +40,17 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                             RAFIKI_PLACEMENT=hosts); train AND
 #                             inference spread across host agents
 
+# Serving-plane overload control (docs/failure-model.md "Overload
+# faults"). Defaults shed instead of queueing unboundedly; 0 disables a cap:
+#   RAFIKI_PREDICT_QUEUE_DEPTH=256      per-worker inbox cap; submits past
+#                                       it shed 429 + Retry-After
+#   RAFIKI_PREDICT_MAX_INFLIGHT=64      per-door in-flight request cap;
+#                                       excess sheds 503
+#   RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH=64  never hedge onto a replica
+#                                       whose queue is deeper than this
+#   RAFIKI_PREDICT_DRAIN_S=5            predictor stop(): bounded wait for
+#                                       in-flight handlers before close
+
 # Fleet health (docs/failure-model.md). Safe defaults — tune only for
 # failover drills or unusual networks:
 #   RAFIKI_AGENT_HEARTBEAT_S=5          /healthz probe interval (0 = off)
@@ -49,7 +60,9 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #   RAFIKI_AGENT_RETRY_BACKOFF_S=0.1    backoff base (exponential + jitter)
 #   RAFIKI_AGENT_BREAKER_THRESHOLD=3    transport failures to open a circuit
 #   RAFIKI_AGENT_BREAKER_COOLDOWN_S=5   fail-fast window before half-open
-# Deterministic fault injection — MUST stay off outside drills/tests:
+# Deterministic fault injection — MUST stay off outside drills/tests
+# (sites: call_agent, agent, worker — the last stalls/slows serving
+# replicas for overload drills):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 

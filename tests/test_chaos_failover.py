@@ -500,7 +500,15 @@ def test_heartbeat_monitor_detects_chaos_killed_host_end_to_end(tmp_path):
                         f"{placement.agent_health()}")
 
         # reconciliation: queue evicted, service terminal in the store —
-        # no operator action
+        # no operator action. It runs on the failover thread spawned at
+        # the DOWN verdict (hosts.py _run_failover), so poll briefly
+        # instead of racing it.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (set(broker.get_worker_queues("job")) == {svc_live}
+                    and db.get_service(svc_dead)["status"] == "ERRORED"):
+                break
+            time.sleep(0.02)
         assert set(broker.get_worker_queues("job")) == {svc_live}
         assert db.get_service(svc_dead)["status"] == "ERRORED"
         assert db.get_service(svc_live)["status"] == "RUNNING"

@@ -1,0 +1,374 @@
+"""Serving-plane overload control, unit level (ISSUE 2; docs/
+failure-model.md "Overload faults"): bounded WorkerQueue semantics,
+deadline-expiry dropping, hedge suppression, admission control, and the
+per-waiter exception copy on shared batch errors. All fast, CPU-only,
+deterministic — tier-1."""
+
+import threading
+import time
+
+import pytest
+
+from rafiki_tpu.cache.queue import (
+    InProcessBroker,
+    QueryFuture,
+    QueueFullError,
+    WorkerQueue,
+)
+from rafiki_tpu.predictor.admission import (
+    AdmissionController,
+    DeadlineUnmeetableError,
+    ServerOverloadedError,
+)
+from rafiki_tpu.predictor.predictor import Predictor
+
+
+# -- bounded WorkerQueue ----------------------------------------------------
+
+
+def test_depth_cap_rejects_atomically():
+    q = WorkerQueue(max_depth=2)
+    with pytest.raises(QueueFullError):
+        q.submit_many([1, 2, 3])  # whole request over cap: all-or-nothing
+    assert q.depth() == 0  # nothing half-enqueued
+    q.submit_many([1, 2])
+    with pytest.raises(QueueFullError) as ei:
+        q.submit(3)
+    assert ei.value.retry_after_s >= 0
+    assert q.stats()["rejected"] == 4  # 3 + 1 refused queries
+    assert q.depth() == 2
+
+
+def test_depth_cap_from_env_is_lazy(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_QUEUE_DEPTH", "1")
+    q = WorkerQueue()  # cap resolved per submit, not at construction
+    q.submit(1)
+    with pytest.raises(QueueFullError):
+        q.submit(2)
+    monkeypatch.setenv("RAFIKI_PREDICT_QUEUE_DEPTH", "0")  # uncapped
+    q.submit_many(list(range(50)))
+    assert q.depth() == 51
+
+
+def test_take_batch_drops_expired_entries():
+    q = WorkerQueue(max_depth=0)
+    past = time.monotonic() - 0.01
+    future_dl = time.monotonic() + 30.0
+    doomed = q.submit_many([["old"]], deadline=past)
+    fresh = q.submit_many([["new"]], deadline=future_dl)
+    batch = q.take_batch(max_size=16, deadline_s=0.0, wait_timeout_s=0.2)
+    # the expired query never reaches the model: only the fresh one comes out
+    assert [query for _, query in batch] == [["new"]]
+    with pytest.raises(TimeoutError):
+        doomed[0].result(0.1)
+    assert q.stats()["expired"] == 1
+    fresh[0].set_result("ok")
+
+
+def test_take_batch_all_expired_returns_empty_not_none():
+    q = WorkerQueue(max_depth=0)
+    futs = q.submit_many([1, 2], deadline=time.monotonic() - 0.01)
+    batch = q.take_batch(max_size=4, deadline_s=0.0, wait_timeout_s=0.2)
+    assert batch == []  # a timeout-shaped answer, NOT the closed signal
+    for f in futs:
+        with pytest.raises(TimeoutError):
+            f.result(0.1)
+    assert q.stats() == {"depth": 0, "expired": 2, "rejected": 0}
+
+
+def test_deadline_ordering_with_coalescing_window():
+    """PREDICT_BATCH_DEADLINE_MS-style coalescing still drops entries
+    that expire and keeps submit order for the fresh ones."""
+    q = WorkerQueue(max_depth=0)
+    q.submit_many([["a"]], deadline=time.monotonic() + 30)
+
+    def late_submits():
+        time.sleep(0.05)
+        q.submit_many([["expired"]], deadline=time.monotonic() - 0.01)
+        q.submit_many([["b"]], deadline=time.monotonic() + 30)
+
+    t = threading.Thread(target=late_submits)
+    t.start()
+    batch = q.take_batch(max_size=3, deadline_s=0.4, wait_timeout_s=0.2)
+    t.join()
+    assert [query for _, query in batch] == [["a"], ["b"]]
+    assert q.stats()["expired"] == 1
+
+
+def test_close_while_full_fails_every_future():
+    q = WorkerQueue(max_depth=2)
+    futs = q.submit_many([1, 2])
+    with pytest.raises(QueueFullError):
+        q.submit(3)
+    q.close()
+    for f in futs:
+        with pytest.raises(RuntimeError, match="closed"):
+            f.result(0.1)
+    # post-close submits error their futures instead of raising
+    (fut,) = q.submit_many([4])
+    with pytest.raises(RuntimeError, match="closed"):
+        fut.result(0.1)
+    assert q.take_batch(max_size=4, deadline_s=0.0) is None
+
+
+# -- per-waiter exception copies -------------------------------------------
+
+
+def test_shared_batch_error_reraises_per_waiter_copy():
+    fut_a, fut_b = QueryFuture(), QueryFuture()
+    shared = RuntimeError("model exploded")
+    fut_a.set_error(shared)
+    fut_b.set_error(shared)
+    raised = []
+    for fut in (fut_a, fut_b):
+        try:
+            fut.result(0.1)
+        except RuntimeError as e:
+            raised.append(e)
+    assert len(raised) == 2
+    # same type + message, but each waiter got its OWN instance chained to
+    # the shared original, so concurrent raises can't mutate one traceback
+    assert all(type(e) is RuntimeError for e in raised)
+    assert all(str(e) == "model exploded" for e in raised)
+    assert all(e is not shared for e in raised)
+    assert raised[0] is not raised[1]
+    assert all(e.__cause__ is shared for e in raised)
+
+
+def test_shared_error_concurrent_waiters_get_distinct_tracebacks():
+    fut = QueryFuture()
+    fut.set_error(ValueError("bad batch"))
+    out = []
+    lock = threading.Lock()
+
+    def wait():
+        try:
+            fut.result(1.0)
+        except ValueError as e:
+            with lock:
+                out.append(e)
+
+    threads = [threading.Thread(target=wait) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(out) == 8
+    assert len({id(e) for e in out}) == 8  # no shared instance
+    assert len({id(e.__traceback__) for e in out}) == 8
+
+
+def test_timeout_result_still_raises_timeout():
+    with pytest.raises(TimeoutError):
+        QueryFuture().result(0.01)
+
+
+# -- predictor shed + hedge suppression ------------------------------------
+
+
+class StallServer:
+    """Serves a queue with a fixed per-batch stall (a slow replica)."""
+
+    def __init__(self, queue, answer, stall_s=0.0):
+        self.queue = queue
+        self.answer = answer
+        self.stall_s = stall_s
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            batch = self.queue.take_batch(
+                max_size=16, deadline_s=0.0, wait_timeout_s=0.05)
+            if batch is None:
+                return
+            if not batch:
+                continue
+            if self.stall_s:
+                time.sleep(self.stall_s)
+            for fut, _ in batch:
+                fut.set_result(self.answer)
+
+
+def test_predict_sheds_when_all_queues_full(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_QUEUE_DEPTH", "1")
+    broker = InProcessBroker()
+    # two replicas of one trial, nobody serving: fill both inboxes
+    q1 = broker.register_worker("job", "w1")
+    q2 = broker.register_worker("job", "w2")
+    q1.submit([0.0])
+    q2.submit([0.0])
+    p = Predictor("job", broker, "IMAGE_CLASSIFICATION",
+                  worker_trials={"w1": "trialA", "w2": "trialA"})
+    t0 = time.monotonic()
+    with pytest.raises(QueueFullError):
+        p.predict_batch([[1.0]], timeout_s=5.0)
+    # shed is an admission decision, not a timeout: instant
+    assert time.monotonic() - t0 < 0.5
+    stats = p.overload_stats()
+    assert stats["requests_shed"] == 1 and stats["trials_shed"] == 1
+
+
+def test_full_first_replica_fails_over_to_sibling(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_QUEUE_DEPTH", "1")
+    broker = InProcessBroker()
+    q_full = broker.register_worker("job", "wfull")
+    q_full.submit([0.0])  # saturate replica 1 (nobody serving it)
+    q_live = broker.register_worker("job", "wlive")
+    StallServer(q_live, [1.0, 0.0])
+    p = Predictor("job", broker, "IMAGE_CLASSIFICATION",
+                  worker_trials={"wfull": "trialA", "wlive": "trialA"})
+    # rr=0 starts on wfull -> QueueFullError -> first submit walks to wlive
+    assert p.predict([0.5], timeout_s=2.0) == [1.0, 0.0]
+    assert p.overload_stats()["requests_shed"] == 0
+
+
+def test_hedge_suppressed_onto_saturated_sibling(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH", "2")
+    monkeypatch.setenv("RAFIKI_PREDICT_QUEUE_DEPTH", "0")
+    broker = InProcessBroker()
+    q_slow = broker.register_worker("job", "slow")
+    StallServer(q_slow, [1.0, 0.0], stall_s=0.5)
+    q_sat = broker.register_worker("job", "sat")
+    q_sat.submit_many([[0.0]] * 3)  # depth 3 > threshold 2, nobody serving
+    p = Predictor("job", broker, "IMAGE_CLASSIFICATION",
+                  worker_trials={"slow": "trialA", "sat": "trialA"})
+    # rr=0 -> first submit to slow; its share of the SLO lapses -> the
+    # hedge would go to sat, but sat is saturated -> suppressed; the slow
+    # replica's late answer still serves the request
+    assert p.predict([0.5], timeout_s=1.0) == [1.0, 0.0]
+    stats = p.overload_stats()
+    assert stats["hedges_suppressed"] == 1
+    assert stats["hedges"] == 0
+    assert q_sat.depth() == 3  # NO hedge batch landed on the saturated queue
+
+
+def test_hedge_still_fires_below_threshold(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH", "5")
+    broker = InProcessBroker()
+    q_dead = broker.register_worker("job", "dead")  # registered, never serves
+    q_live = broker.register_worker("job", "live")
+    StallServer(q_live, [1.0, 0.0])
+    p = Predictor("job", broker, "IMAGE_CLASSIFICATION",
+                  worker_trials={"dead": "trialA", "live": "trialA"})
+    assert p.predict([0.5], timeout_s=1.5) == [1.0, 0.0]
+    assert p.overload_stats()["hedges"] == 1
+    assert p.overload_stats()["hedges_suppressed"] == 0
+
+
+def test_backlog_depth_is_max_over_trials_of_min_over_replicas():
+    broker = InProcessBroker()
+    qa1 = broker.register_worker("job", "a1")
+    qa2 = broker.register_worker("job", "a2")
+    qb1 = broker.register_worker("job", "b1")
+    qa1.submit_many([0] * 4)
+    qa2.submit_many([0] * 2)   # trial A's best path: depth 2
+    qb1.submit_many([0] * 3)   # trial B's only path: depth 3
+    p = Predictor("job", broker, None, worker_trials={
+        "a1": "trialA", "a2": "trialA", "b1": "trialB"})
+    assert p.backlog_depth() == 3
+    assert p.queue_depths() == {"a1": 4, "a2": 2, "b1": 3}
+
+
+# -- shm (cross-process) data plane mirrors the semantics ------------------
+
+
+def _shm_available():
+    try:
+        from rafiki_tpu.native.shm_queue import available
+
+        return available()
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _shm_available(), reason="native shmqueue needed")
+def test_shm_proxy_enforces_cap_and_reports_depth(monkeypatch):
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    monkeypatch.setenv("RAFIKI_PREDICT_QUEUE_DEPTH", "2")
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("job", "w1")
+        proxy = broker.get_worker_queues("job")["w1"]
+        futs = proxy.submit_many([[1.0], [2.0]],
+                                 deadline=time.monotonic() + 30)
+        assert proxy.depth() == 2
+        with pytest.raises(QueueFullError):
+            proxy.submit([3.0])
+        # the worker answers -> outstanding drains -> submits admit again
+        batch = wq.take_batch(max_size=4, deadline_s=0.0, wait_timeout_s=1.0)
+        for handle, q in batch:
+            handle.set_result(["ok", q])
+        assert [f.result(5.0) for f in futs] == [["ok", [1.0]],
+                                                 ["ok", [2.0]]]
+        deadline = time.monotonic() + 5
+        while proxy.depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert proxy.depth() == 0
+        proxy.submit([4.0])
+    finally:
+        broker.close()
+
+
+@pytest.mark.skipif(not _shm_available(), reason="native shmqueue needed")
+def test_shm_worker_drops_expired_entries():
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("job", "w1")
+        proxy = broker.get_worker_queues("job")["w1"]
+        doomed = proxy.submit([1.0], deadline=time.monotonic() - 0.01)
+        fresh = proxy.submit([2.0], deadline=time.monotonic() + 30)
+        batch = wq.take_batch(max_size=4, deadline_s=0.0, wait_timeout_s=1.0)
+        # the expired query never reaches the model
+        assert [q for _, q in batch] == [[2.0]]
+        for handle, q in batch:
+            handle.set_result(["ok", q])
+        assert fresh.result(5.0) == ["ok", [2.0]]
+        with pytest.raises(RuntimeError, match="expired"):
+            doomed.result(5.0)
+    finally:
+        broker.close()
+
+
+# -- admission controller ---------------------------------------------------
+
+
+def test_admission_inflight_cap_sheds_503():
+    adm = AdmissionController(max_inflight=2)
+    adm.admit(10.0)
+    adm.admit(10.0)
+    with pytest.raises(ServerOverloadedError):
+        adm.admit(10.0)
+    adm.release()
+    adm.admit(10.0)  # slot freed -> admitted again
+    s = adm.stats()
+    assert s["shed_capacity"] == 1 and s["admitted"] == 3
+    assert s["inflight"] == 2
+
+
+def test_admission_estimated_wait_sheds_429_with_retry_after():
+    adm = AdmissionController(max_inflight=0)  # uncapped door
+    adm.observe(1.0, 1)  # ewma: 1 s per query
+    with pytest.raises(DeadlineUnmeetableError) as ei:
+        adm.admit(2.0, backlog_depth=5)  # est wait 5s > 2s deadline
+    assert ei.value.retry_after_s >= 5
+    assert adm.stats()["shed_deadline"] == 1
+    adm.admit(10.0, backlog_depth=5)  # est wait 5s < 10s deadline: admitted
+
+
+def test_admission_never_sheds_on_estimate_without_history():
+    adm = AdmissionController(max_inflight=0)
+    adm.admit(0.001, backlog_depth=10_000)  # no ewma yet: never a guess-shed
+    assert adm.stats()["shed_deadline"] == 0
+
+
+def test_admission_release_pairs_with_observe():
+    adm = AdmissionController(max_inflight=1)
+    adm.admit(5.0)
+    adm.release()
+    adm.observe(0.4, 4)
+    assert adm.stats()["ewma_query_s"] == pytest.approx(0.1)
+    assert adm.inflight == 0
