@@ -303,6 +303,187 @@ def bench_serving_concurrent(server_port: int, app: str, query,
     return out
 
 
+def bench_wire_codec(n_floats: int = 3072, iters: int = 300) -> dict:
+    """Micro-bench the serving wire codec on one dense query: encode +
+    decode of a 3072-float float32 ndarray message through the legacy
+    JSON convention (utils/jsonutil: tolist -> float text -> json.loads
+    -> np.asarray) vs the binary frame (cache/wire: raw bytes,
+    zero-copy np.frombuffer). This is the per-hop serialization tax the
+    binary data plane removes at the shm broker and the fleet relay."""
+    import json as _json
+
+    from rafiki_tpu.cache import wire
+    from rafiki_tpu.utils import jsonutil
+
+    q = np.random.default_rng(0).normal(size=n_floats).astype(np.float32)
+    msg = {"ids": ["bench"], "query": q}
+
+    def timed(fn):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters
+
+    def json_roundtrip():
+        raw = jsonutil.dumps(msg).encode()
+        out = _json.loads(raw)
+        np.asarray(out["query"], dtype=np.float32)
+
+    def binary_roundtrip():
+        out = wire.decode(wire.encode(msg))
+        out["query"]  # zero-copy view; no further parse exists
+
+    t_json = timed(json_roundtrip)
+    t_bin = timed(binary_roundtrip)
+    return {
+        "query_floats": n_floats,
+        "json_encode_decode_us": round(t_json * 1e6, 1),
+        "binary_encode_decode_us": round(t_bin * 1e6, 1),
+        "binary_speedup": round(t_json / t_bin, 1) if t_bin > 0 else None,
+    }
+
+
+def _shm_binary_client_proc(port: int, n_reqs: int, query_floats: int,
+                            barrier, out_q) -> None:
+    """One closed-loop client for the shm-binary door phase: binary .npy
+    request AND Accept-negotiated binary .npy response, own interpreter
+    (same GIL-honesty rule as _serving_client_proc)."""
+    import io
+    import urllib.request
+
+    import numpy as _np
+
+    q = _np.random.default_rng(1).normal(size=(1, query_floats)).astype(
+        _np.float32)
+    buf = io.BytesIO()
+    _np.save(buf, q, allow_pickle=False)
+    body = buf.getvalue()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=body, method="POST",
+        headers={"Content-Type": "application/x-npy",
+                 "Accept": "application/x-npy"})
+
+    def call():
+        with urllib.request.urlopen(req, timeout=60) as r:
+            ctype = r.headers.get("Content-Type", "")
+            payload = r.read()
+            assert r.status == 200
+            if ctype == "application/x-npy":
+                _np.load(io.BytesIO(payload), allow_pickle=False)
+
+    latencies, errors = [], 0
+    call()  # warmup/connection
+    barrier.wait()
+    for _ in range(n_reqs):
+        t0 = time.monotonic()
+        try:
+            call()
+            latencies.append(time.monotonic() - t0)
+        except Exception:
+            errors += 1
+    out_q.put((latencies, errors))
+
+
+def bench_shm_binary_serving(n_clients: int = 4,
+                             query_floats: int = 3072) -> dict:
+    """End-to-end binary serving over the SHM data plane: 4 closed-loop
+    client processes drive a real PredictorServer -> Predictor ->
+    ShmBroker -> worker pipeline with binary requests AND binary
+    responses (`serving_shm_binary_*`). The worker serves a real matmul
+    so the number includes model-shaped work, but the pipeline is
+    deliberately deployment-free: this phase isolates the wire/transport
+    stack that the tentpole binary codec changed, on every hop."""
+    import multiprocessing as mp
+    import threading as _threading
+
+    from rafiki_tpu import config as _config
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+    from rafiki_tpu.predictor.predictor import Predictor
+    from rafiki_tpu.predictor.server import PredictorServer
+    from rafiki_tpu.worker.inference import _BatchAssembler
+
+    broker = ShmBroker()
+    server = None
+    try:
+        wq = broker.register_worker("shmbench", "w1")
+        rng = np.random.default_rng(0)
+        w_mat = rng.normal(size=(query_floats, 10)).astype(np.float32)
+        assembler = _BatchAssembler()
+        stop = _threading.Event()
+
+        def worker_loop():
+            while not stop.is_set():
+                batch = wq.take_batch(
+                    max_size=int(_config.PREDICT_MAX_BATCH_SIZE),
+                    deadline_s=0.0, wait_timeout_s=0.2)
+                if batch is None:
+                    return
+                if not batch:
+                    continue
+                futures = [f for f, _ in batch]
+                queries = assembler.assemble(
+                    [q for _, q in batch],
+                    reusable=getattr(wq, "reusable_batch_ok", False))
+                out = np.asarray(queries, dtype=np.float32) @ w_mat
+                for fut, row in zip(futures, out):
+                    fut.set_result(row)  # ndarray rows ride the wire raw
+
+        wt = _threading.Thread(target=worker_loop, daemon=True)
+        wt.start()
+        predictor = Predictor("shmbench", broker, task=None)
+        server = PredictorServer(
+            predictor, "shmbench", auth=False).start()
+
+        n_reqs = N_REQS_PER_CLIENT
+        ctx = mp.get_context("spawn")
+        barrier = ctx.Barrier(n_clients + 1)
+        out_q = ctx.Queue()
+        procs = [
+            ctx.Process(target=_shm_binary_client_proc,
+                        args=(server.port, n_reqs, query_floats, barrier,
+                              out_q),
+                        daemon=True)
+            for _ in range(n_clients)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            barrier.wait(timeout=120)
+        except threading.BrokenBarrierError:
+            dead = [p.pid for p in procs if not p.is_alive()]
+            raise RuntimeError(
+                f"shm-binary bench clients failed warmup (dead: {dead})")
+        t0 = time.monotonic()
+        latencies, errors = [], 0
+        for _ in procs:
+            lat, err = out_q.get(timeout=600)
+            latencies.extend(lat)
+            errors += err
+        wall = time.monotonic() - t0
+        for p in procs:
+            p.join(timeout=30)
+        stop.set()
+        lat = np.array(sorted(latencies)) * 1000.0
+        return {
+            "serving_shm_binary_clients": n_clients,
+            "serving_shm_binary_requests": int(len(lat)),
+            "serving_shm_binary_errors": errors,
+            "serving_shm_binary_req_s": (
+                round(len(lat) / wall, 1) if wall > 0 else 0.0),
+            "serving_shm_binary_p50_ms": (
+                round(float(np.percentile(lat, 50)), 2) if len(lat)
+                else None),
+            "serving_shm_binary_p99_ms": (
+                round(float(np.percentile(lat, 99)), 2) if len(lat)
+                else None),
+        }
+    finally:
+        if server is not None:
+            server.stop(drain_timeout_s=0.0)
+        broker.close()
+
+
 def _wait_chips_free(admin, timeout_s: float = 30.0) -> None:
     """Service teardown releases chip grants asynchronously (worker threads
     exit with destroy wait=False); a phase that needs exclusive chips must
@@ -553,6 +734,25 @@ def main():
                     serving["int8_error"] = repr(e)
                 finally:
                     os.environ.pop("RAFIKI_SERVE_INT8", None)
+
+            # ---- binary wire over shm: request AND response binary -----
+            # 4 clients, dedicated door, every hop on the binary codec
+            # (cache/wire.py) through a real ShmBroker — the number the
+            # tentpole is accountable to (vs the JSON-response binary
+            # door above). Deployment-free on purpose: no train-job
+            # coupling, same HTTP/admission/predictor/broker layers.
+            if BENCH_SERVING:
+                try:
+                    from rafiki_tpu.native.shm_queue import (
+                        available as _shm_ok)
+
+                    if _shm_ok():
+                        serving.update(bench_shm_binary_serving())
+                    else:
+                        serving["serving_shm_binary_error"] = \
+                            "native shmqueue unavailable"
+                except Exception as e:
+                    serving["serving_shm_binary_error"] = repr(e)
             admin.stop_all_jobs()
 
             # ---- ASHA: effective search throughput, side by side -------
@@ -593,6 +793,12 @@ def main():
         "backend": jax.default_backend(),
         **serving,
     }
+    # codec tax with and without the binary wire, measured every run
+    # (CPU-only: the codec never touches the accelerator)
+    try:
+        result["wire_codec"] = bench_wire_codec()
+    except Exception as e:
+        result["wire_codec_error"] = repr(e)
     if BENCH_ASHA:
         result["asha"] = asha
     if os.environ.get("RAFIKI_BENCH_FALLBACK_REASON"):

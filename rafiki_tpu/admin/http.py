@@ -25,7 +25,7 @@ from urllib.parse import parse_qs, urlparse
 
 from rafiki_tpu import config
 from rafiki_tpu.admin.admin import Admin, InvalidRequestError
-from rafiki_tpu.cache.queue import QueueFullError
+from rafiki_tpu.cache.queue import FrameTooLargeError, QueueFullError
 from rafiki_tpu.constants import UserType
 from rafiki_tpu.placement.manager import InsufficientChipsError
 from rafiki_tpu.predictor.admission import (
@@ -346,6 +346,10 @@ class AdminServer:
             # friends from inside Admin stay genuine 500s instead of being
             # masked as client errors with internal text echoed back
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
+        except FrameTooLargeError as e:
+            # the request's wire frame exceeds the shm ring: permanent for
+            # this payload — 413, never the retryable 429
+            self._respond(handler, 413, {"error": f"{type(e).__name__}: {e}"})
         except (QueueFullError, DeadlineUnmeetableError) as e:
             # serving overload, retryable backlog (docs/failure-model.md
             # "Overload faults"): 429 + Retry-After, same contract as the

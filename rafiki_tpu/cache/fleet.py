@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from rafiki_tpu import config
+from rafiki_tpu.cache import wire
 from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
 from rafiki_tpu.utils.agent_http import (
     AgentHTTPError,
@@ -72,6 +73,11 @@ class HttpWorkerQueue:
         self._worker_timeout_s = (timeout_s if timeout_s is not None
                                   else config.PREDICT_TIMEOUT_S)
         self._timeout_s = self._worker_timeout_s + 5.0
+        # binary wire negotiation (cache/wire.py): None = not yet probed.
+        # The agent advertises its supported codec versions on /healthz;
+        # a peer that doesn't (old version, probe failure) gets JSON
+        # framing — interop is the default, the binary hop is earned.
+        self._wire_ok: Optional[bool] = None
         self._cond = threading.Condition()
         self._pending: List[Tuple[QueryFuture, Any, Optional[float]]] = []
         self._inflight = 0  # queries inside the current relay round-trip
@@ -166,14 +172,39 @@ class HttpWorkerQueue:
                 with self._cond:
                     self._inflight = 0
 
+    def _wire_supported(self) -> bool:
+        """One lazy /healthz probe decides whether this relay may ship
+        binary wire frames; unknown/unreachable peers stay on JSON and
+        the probe retries on a later relay (the flag is only cached once
+        an answer arrives)."""
+        if not wire.binary_enabled():
+            return False
+        if self._wire_ok is None:
+            try:
+                h = call_agent(self._addr, "GET", "/healthz",
+                               timeout_s=min(self._timeout_s, 5.0))
+                self._wire_ok = wire.VERSION in (h.get("wire_versions") or [])
+            except Exception:
+                return False
+        return bool(self._wire_ok)
+
     def _relay(self, queries: List[Any]) -> List[Any]:
+        binary = self._wire_supported()
+        q_payload: Any = queries
+        if binary:
+            # homogeneous ndarray queries travel as ONE stacked array
+            # (single raw-bytes header entry instead of per-row JSON)
+            stacked = wire.stack_batch(queries)
+            if stacked is not None:
+                q_payload = stacked
         try:
             out = call_agent(
                 self._addr, "POST",
                 f"/predict_relay/{self._job_id}/{self._worker_id}",
-                body={"queries": queries,
+                body={"queries": q_payload,
                       "timeout_s": self._worker_timeout_s},
-                key=self._key, timeout_s=self._timeout_s)
+                key=self._key, timeout_s=self._timeout_s,
+                wire_frames=binary)
             return list(out["predictions"])
         except AgentHTTPError as e:
             raise RuntimeError(f"relay {self._addr}: {e.message}") from None

@@ -39,6 +39,14 @@ class QueueFullError(RuntimeError):
         self.retry_after_s = max(float(retry_after_s), 0.0)
 
 
+class FrameTooLargeError(ValueError):
+    """A single wire frame exceeds the shm ring capacity — a PERMANENT
+    condition for this request (retrying the same payload can never
+    succeed), unlike the transient, retryable :class:`QueueFullError`.
+    The doors answer it with 413: split the request or raise
+    ``RAFIKI_SHM_RING_BYTES``."""
+
+
 class QueryFuture:
     """A pending prediction for one query."""
 

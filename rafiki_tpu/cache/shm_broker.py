@@ -8,10 +8,18 @@ rpush'd over TCP to a Redis server and polled at 0.25 s). Queue names are
 deterministic in (prefix, job, worker), so a worker process can attach with
 `ShmWorkerQueue.attach(...)` knowing only its ids.
 
-Wire format: JSON messages {"id": ..., "query": ...} on the per-worker
-query queue; {"id": ..., "result": ...} | {"id": ..., "error": ...} on the
-per-job response queue. A listener thread on the predictor side resolves
-`QueryFuture`s by id.
+Wire format (cache/wire.py): one **binary frame per request** each way —
+``{"ids": [...], "qarr": <stacked ndarray> | "queries": [...],
+"deadline": ...}`` on the per-worker query queue, ``{"ids": [...],
+"results": [...], "errors": {...}}`` on the per-job response queue —
+ndarrays as raw bytes, decoded worker-side with zero-copy
+``np.frombuffer`` views. The float→text→float tax of the old per-query
+JSON messages was the serving path's dominant CPU cost (BENCH_r05), not
+the model. Receivers *sniff* every popped message (binary magic vs JSON),
+so legacy per-query JSON peers interoperate; responses echo the format
+their query frame arrived in, and ``RAFIKI_WIRE_BINARY=0`` forces JSON
+framing on the submit side for a version-mismatched fleet. A listener
+thread on the predictor side resolves `QueryFuture`s by id.
 
 Select with RAFIKI_BROKER=shm (Admin falls back to the in-process broker if
 the native library can't be built).
@@ -27,12 +35,22 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
+import numpy as np
+
+from rafiki_tpu.cache import wire
+from rafiki_tpu.cache.queue import (
+    Broker,
+    FrameTooLargeError,
+    QueryFuture,
+    QueueFullError,
+)
 from rafiki_tpu.native.shm_queue import (
     ShmMessageQueue,
     ShmQueueClosed,
     available,
 )
+from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.jsonutil import json_default
 
 logger = logging.getLogger(__name__)
 
@@ -42,50 +60,169 @@ def _qname(prefix: str, *parts: str) -> str:
     return f"/{prefix}-{digest}"
 
 
-def _json_dumps(obj: Any) -> bytes:
-    """Shm wire format is JSON (it crosses process boundaries), which is
-    narrower than InProcessBroker's arbitrary-object handoff. The shared
-    wire convention (utils/jsonutil.py) converts numpy arrays/scalars at
-    any depth; anything else non-JSON raises TypeError."""
-    from rafiki_tpu.utils.jsonutil import dumps
+def _encode_query_frame(ids: List[str], queries: List[Any],
+                        deadline: Optional[float]) -> bytes:
+    """One frame for a whole submit_many request (binary unless
+    RAFIKI_WIRE_BINARY=0). Homogeneous ndarray queries stack into ONE
+    contiguous array (single header entry, single memcpy) — the common
+    shape for the binary HTTP door, whose ``list(arr)`` rows share dtype
+    and shape by construction."""
+    msg: Dict[str, Any] = {"ids": ids}
+    if deadline is not None:
+        msg["deadline"] = deadline
+    # qarr only when the frame is actually binary: under JSON framing
+    # (RAFIKI_WIRE_BINARY=0) a stacked array would serialize as nested
+    # lists, which the receiving decoder must not confuse with rows
+    stacked = wire.stack_batch(queries) if wire.binary_enabled() else None
+    if stacked is not None:
+        msg["qarr"] = stacked
+    else:
+        msg["queries"] = queries
+    return wire.dumps(msg)
 
-    return dumps(obj).encode()
+
+def _decode_query_frame(raw: bytes) -> Tuple[
+        List[Tuple[str, Any, Optional[float]]], bool]:
+    """One popped query message -> ([(qid, query, deadline), ...],
+    arrived_binary). Accepts the batched binary frame, the batched JSON
+    frame (RAFIKI_WIRE_BINARY=0 submitter), and the legacy per-query
+    ``{"id", "query"}`` message. Raises WireFormatError on garbage."""
+    binary = wire.is_frame(raw)
+    msg = wire.decode_any(raw)
+    if not isinstance(msg, dict):
+        raise wire.WireFormatError("query frame is not an object")
+    try:
+        # the frame decoded, but every field is still untrusted input:
+        # ids must be strings (dict keys downstream) and the deadline a
+        # number (compared against time.monotonic()) — anything else is
+        # a malformed frame, absorbed by the caller, never a crash in
+        # the worker serve loop
+        deadline = msg.get("deadline")
+        if deadline is not None:
+            deadline = float(deadline)
+        if "id" in msg:  # legacy single-query message
+            if not isinstance(msg["id"], str):
+                raise wire.WireFormatError("query id is not a string")
+            return [(msg["id"], msg["query"], deadline)], binary
+        ids = msg["ids"]
+        if (not isinstance(ids, list)
+                or not all(isinstance(i, str) for i in ids)):
+            raise wire.WireFormatError("ids must be a list of strings")
+        if "qarr" in msg:
+            qarr = msg["qarr"]
+            if isinstance(qarr, np.ndarray) and qarr.ndim >= 1:
+                queries: List[Any] = list(qarr)  # zero-copy row views
+            elif isinstance(qarr, list):
+                # a JSON-framed qarr (old sender under the kill-switch)
+                # arrives as nested lists: rows stay rows
+                queries = qarr
+            else:
+                raise wire.WireFormatError("qarr is not a batch")
+        else:
+            queries = msg["queries"]
+        if not isinstance(queries, (list, np.ndarray)) \
+                or len(queries) != len(ids):
+            raise wire.WireFormatError("queries/ids length mismatch")
+        return [(qid, q, deadline) for qid, q in zip(ids, queries)], binary
+    except (KeyError, TypeError, ValueError) as e:
+        if isinstance(e, wire.WireFormatError):
+            raise
+        raise wire.WireFormatError(f"malformed query frame: {e}") from e
+
+
+class _FrameResponder:
+    """Accumulates one popped query frame's responses and flushes them as
+    ONE message in the same wire format the frame arrived in (binary
+    frame -> batched binary response; legacy JSON -> legacy per-id JSON
+    messages, so an old-version listener still resolves them).
+
+    Flush fires when every id has resolved — the worker loop always
+    resolves a batch completely (results, a shared error, or take-time
+    expiry), so a response frame is written exactly once per request.
+    Transport backpressure (full response ring, broker mid-close) must
+    not crash the serving worker loop — the predictor's SLO timeout
+    covers a dropped response frame."""
+
+    __slots__ = ("_rq", "_ids", "_binary", "_lock", "_out")
+
+    def __init__(self, rq: ShmMessageQueue, ids: List[str], binary: bool):
+        self._rq = rq
+        self._ids = ids
+        self._binary = binary
+        self._lock = threading.Lock()
+        self._out: Dict[str, Tuple[str, Any]] = {}
+
+    def resolve(self, qid: str, kind: str, value: Any) -> None:
+        with self._lock:
+            if qid in self._out:
+                return  # first resolution wins (double-set guard)
+            self._out[qid] = (kind, value)
+            if len(self._out) < len(self._ids):
+                return
+        self._flush()
+
+    def _flush(self) -> None:
+        try:
+            if self._binary:
+                results: List[Any] = []
+                errors: Dict[str, str] = {}
+                for i, qid in enumerate(self._ids):
+                    kind, value = self._out[qid]
+                    if kind == "error":
+                        errors[str(i)] = value
+                        results.append(None)
+                    else:
+                        results.append(value)
+                msg: Dict[str, Any] = {"ids": self._ids, "results": results}
+                if errors:
+                    msg["errors"] = errors
+                self._rq.push(wire.encode(msg))
+            else:
+                # legacy listener compatibility: per-id JSON messages
+                for qid in self._ids:
+                    kind, value = self._out[qid]
+                    payload = ({"id": qid, "error": value}
+                               if kind == "error"
+                               else {"id": qid, "result": value})
+                    self._rq.push(json.dumps(
+                        payload, default=json_default).encode())
+        except Exception:
+            logger.exception("dropping response frame for %d queries",
+                             len(self._ids))
 
 
 class ShmWorkerQueue:
     """Worker-side view: drains query batches, pushes responses.
 
     Duck-types cache.queue.WorkerQueue's `take_batch` but yields
-    (ResponseHandle, query) pairs — the handle writes the response message
-    instead of resolving an in-process future.
+    (ResponseHandle, query) pairs — the handle writes into its frame's
+    shared :class:`_FrameResponder` instead of resolving an in-process
+    future.
     """
 
-    class ResponseHandle:
-        __slots__ = ("_rq", "_id")
+    #: batches from this queue serialize at resolve time (the responder
+    #: encodes inside the worker's resolve loop, before the next take),
+    #: so the worker may assemble them into a REUSED batch buffer
+    #: (worker/inference.py) without aliasing hazards
+    reusable_batch_ok = True
 
-        def __init__(self, rq: ShmMessageQueue, qid: str):
-            self._rq = rq
+    class ResponseHandle:
+        __slots__ = ("_responder", "_id")
+
+        def __init__(self, responder: _FrameResponder, qid: str):
+            self._responder = responder
             self._id = qid
 
         def set_result(self, value: Any) -> None:
-            # transport backpressure (full response ring, broker mid-close)
-            # must not crash the serving worker loop — the predictor's SLO
-            # timeout covers the dropped response
-            try:
-                self._rq.push(_json_dumps({"id": self._id, "result": value}))
-            except Exception:
-                logger.exception("dropping response %s", self._id)
+            self._responder.resolve(self._id, "result", value)
 
         def set_error(self, error: BaseException) -> None:
-            try:
-                self._rq.push(_json_dumps(
-                    {"id": self._id, "error": str(error)}))
-            except Exception:
-                logger.exception("dropping error response %s", self._id)
+            self._responder.resolve(self._id, "error", str(error))
 
     def __init__(self, query_q: ShmMessageQueue, response_q: ShmMessageQueue):
         self._qq = query_q
         self._rq = response_q
+        self._wire_errors = 0  # undecodable frames dropped (see stats())
 
     @classmethod
     def attach(cls, prefix: str, inference_job_id: str,
@@ -97,6 +234,41 @@ class ShmWorkerQueue:
             _qname(prefix, "r", inference_job_id), create=False)
         return cls(qq, rq)
 
+    def stats(self) -> Dict[str, int]:
+        """Wire + ring picture folded into SERVING_STATS: undecodable
+        frames seen, and the ring occupancy high-water mark as seen from
+        THIS handle's pushes (RAFIKI_SHM_RING_BYTES headroom). A worker
+        process only pushes the RESPONSE ring, so that is the mark it
+        can honestly report; the query ring's mark lives owner-side
+        (_SubmitProxy.stats, surfaced via the predictor /healthz)."""
+        qr, rr = self._qq.stats(), self._rq.stats()
+        return {
+            "wire_errors": self._wire_errors,
+            "ring_used_bytes": qr["used_bytes"],
+            "ring_used_bytes_hw": max(qr["used_bytes_hw"],
+                                      rr["used_bytes_hw"]),
+        }
+
+    def _pop_decoded(self, timeout_s: float) -> Optional[
+            Tuple[List[Tuple[str, Any, Optional[float]]], bool]]:
+        """Pop + decode one query frame, absorbing corruption: a frame
+        that fails to decode is counted and reported as an EMPTY frame
+        (([], ...)) — the submitter's SLO timeout covers its queries; the
+        worker loop must keep serving. None means ring timeout."""
+        raw = self._qq.pop(timeout_s=timeout_s)
+        if raw is None:
+            return None
+        rule = chaos.hit(chaos.SITE_WIRE, self._qq.name)
+        if rule is not None and rule.action == chaos.ACTION_CORRUPT:
+            raw = chaos.corrupt_bytes(raw, rule)
+        try:
+            return _decode_query_frame(raw)
+        except wire.WireFormatError as e:
+            self._wire_errors += 1
+            logger.error("dropping undecodable query frame on %s: %s",
+                         self._qq.name, e)
+            return [], False
+
     def take_batch(self, max_size: int, deadline_s: float,
                    wait_timeout_s: float = 0.5
                    ) -> Optional[List[Tuple["ShmWorkerQueue.ResponseHandle",
@@ -104,48 +276,56 @@ class ShmWorkerQueue:
         """[] on timeout; None once the queue is closed-and-drained (same
         contract as cache.queue.WorkerQueue.take_batch — a closed ring
         answers instantly, and callers polling it as if it were a timeout
-        would spin hot)."""
+        would spin hot). One popped frame carries a whole request's
+        queries; draining stops once ``max_size`` is reached (a single
+        frame larger than ``max_size`` is still served whole — requests
+        are admitted as units)."""
         try:
-            first = self._qq.pop(timeout_s=wait_timeout_s)
+            first = self._pop_decoded(timeout_s=wait_timeout_s)
         except ShmQueueClosed:
             return None
         if first is None:
             return []
-        batch = [first]
+        groups = [first]
+        n_entries = len(first[0])
         t0 = time.monotonic()
-        while len(batch) < max_size:
+        while n_entries < max_size:
             # drain whatever is ALREADY in the ring without waiting — same
             # contract as WorkerQueue.take_batch (the deadline is only an
-            # optional coalescing wait, and at the default 0 a multi-query
-            # request pushed as consecutive messages must still come out
-            # as one batch)
+            # optional coalescing wait, and at the default 0 the already-
+            # queued frames must still come out as one batch)
             try:
-                nxt = self._qq.pop(timeout_s=0)
+                nxt = self._pop_decoded(timeout_s=0)
                 if nxt is None:
                     remaining = deadline_s - (time.monotonic() - t0)
                     if remaining <= 0:
                         break
-                    nxt = self._qq.pop(timeout_s=remaining)
+                    nxt = self._pop_decoded(timeout_s=remaining)
             except ShmQueueClosed:
                 break
             if nxt is None:
                 break
-            batch.append(nxt)
-        out = []
+            groups.append(nxt)
+            n_entries += len(nxt[0])
+        out: List[Tuple[ShmWorkerQueue.ResponseHandle, Any]] = []
         now = time.monotonic()
-        for raw in batch:
-            msg = json.loads(raw)
-            handle = self.ResponseHandle(self._rq, msg["id"])
-            # overload control: a query whose request deadline passed while
-            # it sat in the ring is dropped here, not served — CLOCK_MONOTONIC
-            # is system-wide on one host, so the submitter's absolute
-            # deadline is directly comparable in this worker process
-            deadline = msg.get("deadline")
-            if deadline is not None and now >= float(deadline):
-                handle.set_error(TimeoutError(
-                    "query expired in the shm queue before dispatch"))
-                continue
-            out.append((handle, msg["query"]))
+        for entries, binary in groups:
+            if not entries:
+                continue  # corrupt frame already absorbed
+            responder = _FrameResponder(
+                self._rq, [qid for qid, _, _ in entries], binary)
+            for qid, query, deadline in entries:
+                handle = self.ResponseHandle(responder, qid)
+                # overload control: a query whose request deadline passed
+                # while it sat in the ring is dropped here, not served —
+                # CLOCK_MONOTONIC is system-wide on one host, so the
+                # submitter's absolute deadline is directly comparable in
+                # this worker process
+                if deadline is not None and now >= deadline:
+                    handle.set_error(TimeoutError(
+                        "query expired in the shm queue before dispatch"))
+                    continue
+                out.append((handle, query))
         return out
 
     def close(self) -> None:
@@ -172,50 +352,73 @@ class _SubmitProxy:
     def depth(self) -> int:
         return self._broker._outstanding_count(self._job_id, self._worker_id)
 
+    def stats(self) -> Dict[str, int]:
+        """Submit-side queue picture: outstanding depth plus the query
+        ring's occupancy high-water mark (is RAFIKI_SHM_RING_BYTES sized
+        for the batched frames actually flowing?)."""
+        ring = self._qq.stats()
+        return {
+            "depth": self.depth(),
+            "ring_capacity": ring["capacity"],
+            "ring_used_bytes": ring["used_bytes"],
+            "ring_used_bytes_hw": ring["used_bytes_hw"],
+        }
+
     def submit(self, query: Any,
                deadline: Optional[float] = None) -> QueryFuture:
         return self.submit_many([query], deadline=deadline)[0]
 
     def submit_many(self, queries: List[Any],
                     deadline: Optional[float] = None) -> List[QueryFuture]:
-        # cross-process ring: one message per query; the ring preserves
-        # push order and the worker-side take_batch drains every
-        # already-queued message before it considers the deadline, so
-        # consecutive pushes land as one batch without in-process-style
-        # lock atomicity. The depth-cap check is all-or-nothing per
-        # request, like WorkerQueue.submit_many, and the reservation is
-        # atomic with it (released on response, push failure, or expiry).
+        """One wire frame per request (cache/wire.py): the whole request
+        travels as a single binary message and lands as one worker batch
+        by construction. The depth-cap check is all-or-nothing per
+        request, like WorkerQueue.submit_many, and the reservation is
+        atomic with it (released on response, push failure, or expiry).
+
+        Push failures keep the shed contract typed: a full ring maps to
+        the retryable :class:`QueueFullError`, an oversized frame to the
+        permanent :class:`FrameTooLargeError` (413 at the doors — split
+        the request or raise RAFIKI_SHM_RING_BYTES)."""
         self._broker._reserve_capacity(
             self._job_id, self._worker_id, len(queries))
-        out = []
-        for query in queries:
-            qid = uuid.uuid4().hex
-            fut = QueryFuture()
+        ids = [uuid.uuid4().hex for _ in queries]
+        futs = [QueryFuture() for _ in queries]
+        for qid, fut in zip(ids, futs):
+            # absolute monotonic deadline; comparable worker-side because
+            # both processes share the host's CLOCK_MONOTONIC
             self._broker._register_pending(
                 self._job_id, self._worker_id, qid, fut, deadline)
-            msg = {"id": qid, "query": query}
-            if deadline is not None:
-                # absolute monotonic deadline; comparable worker-side
-                # because both processes share the host's CLOCK_MONOTONIC
-                msg["deadline"] = deadline
-            try:
-                self._qq.push(_json_dumps(msg))
-            except Exception as e:
+        try:
+            self._qq.push(_encode_query_frame(ids, queries, deadline))
+        except BaseException as e:
+            for qid in ids:
                 self._broker._pop_pending(self._job_id, qid)
+            if isinstance(e, TimeoutError):
+                # ring full past the push timeout: transient backpressure,
+                # same retryable shed signal as a full bounded queue
+                raise QueueFullError(
+                    f"shm ring to worker {self._worker_id} full "
+                    f"(ring {self._qq.stats()['used_bytes']}B used)") from e
+            if isinstance(e, ValueError):
+                raise FrameTooLargeError(
+                    f"request frame for {len(queries)} queries exceeds the "
+                    f"shm ring capacity (RAFIKI_SHM_RING_BYTES) — split "
+                    f"the request or raise the ring size: {e}") from e
+            for fut in futs:
                 fut.set_error(e)
-            out.append(fut)
-        return out
+        return futs
 
 
 class ShmBroker(Broker):
     """Owner (predictor-process) side of the shm data plane."""
 
     def __init__(self, prefix: Optional[str] = None,
-                 queue_capacity: int = 1 << 20):
+                 queue_capacity: Optional[int] = None):
         if not available():
             raise RuntimeError("native shmqueue unavailable")
         self.prefix = prefix or f"rafiki{uuid.uuid4().hex[:8]}"
-        self._capacity = queue_capacity
+        self._capacity = queue_capacity  # None -> RAFIKI_SHM_RING_BYTES
         self._lock = threading.Lock()
         self._query_qs: Dict[str, Dict[str, ShmMessageQueue]] = {}
         self._response_qs: Dict[str, ShmMessageQueue] = {}
@@ -227,6 +430,7 @@ class ShmBroker(Broker):
         self._outstanding: Dict[Tuple[str, str], int] = {}
         self._listeners: Dict[str, threading.Thread] = {}
         self._graveyard: List[ShmMessageQueue] = []
+        self.wire_errors = 0  # undecodable response frames dropped
         self._closed = False
 
     # -- Broker interface --------------------------------------------------
@@ -352,6 +556,50 @@ class ShmBroker(Broker):
                     f"({queued}/{cap} outstanding)")
             self._outstanding[key] = queued + n
 
+    def _resolve_response(self, job_id: str, msg: Any) -> None:
+        """Resolve futures for one decoded response message — batched
+        frame ({"ids", "results", "errors"}) or legacy per-id JSON."""
+        if not isinstance(msg, dict):
+            raise wire.WireFormatError("response frame is not an object")
+        if "id" in msg:  # legacy single-response message
+            if not isinstance(msg["id"], str):
+                raise wire.WireFormatError("response id is not a string")
+            fut = self._pop_pending(job_id, msg["id"])
+            if fut is None:
+                return
+            if "error" in msg:
+                fut.set_error(RuntimeError(msg["error"]))
+            else:
+                fut.set_result(msg.get("result"))
+            return
+        # validate EVERY field before touching pending state: a frame
+        # that decodes but is malformed (results not a sequence,
+        # non-string ids, errors not a dict) must raise the one typed
+        # error _listen absorbs — the listener thread outlives any bad
+        # message, or the whole job's futures strand forever
+        try:
+            ids = msg["ids"]
+            results = msg["results"]
+            errors = msg.get("errors") or {}
+            if (not isinstance(ids, list)
+                    or not all(isinstance(i, str) for i in ids)
+                    or not isinstance(results, list)
+                    or not isinstance(errors, dict)
+                    or len(results) != len(ids)):
+                raise wire.WireFormatError("malformed response frame")
+        except (KeyError, TypeError) as e:
+            raise wire.WireFormatError(
+                f"malformed response frame: {e}") from e
+        for i, qid in enumerate(ids):
+            fut = self._pop_pending(job_id, qid)
+            if fut is None:
+                continue
+            err = errors.get(str(i))
+            if err is not None:
+                fut.set_error(RuntimeError(err))
+            else:
+                fut.set_result(results[i])
+
     def _listen(self, job_id: str, rq: ShmMessageQueue) -> None:
         while not self._closed:
             try:
@@ -363,18 +611,20 @@ class ShmBroker(Broker):
                 break
             if raw is None:
                 continue
+            rule = chaos.hit(chaos.SITE_WIRE, rq.name)
+            if rule is not None and rule.action == chaos.ACTION_CORRUPT:
+                raw = chaos.corrupt_bytes(raw, rule)
             try:
-                msg = json.loads(raw)
-            except json.JSONDecodeError:
-                logger.error("bad response message on %s", job_id)
+                self._resolve_response(job_id, wire.decode_any(raw))
+            except wire.WireFormatError as e:
+                # a corrupt response frame is absorbed here: its pending
+                # futures keep waiting and resolve with the request's own
+                # (typed) TimeoutError at the SLO — the listener thread
+                # must outlive any single bad message
+                self.wire_errors += 1
+                logger.error("dropping undecodable response frame on %s: %s",
+                             job_id, e)
                 continue
-            fut = self._pop_pending(job_id, msg.get("id", ""))
-            if fut is None:
-                continue
-            if "error" in msg:
-                fut.set_error(RuntimeError(msg["error"]))
-            else:
-                fut.set_result(msg.get("result"))
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -1,0 +1,255 @@
+"""Versioned binary wire codec for the serving data plane.
+
+Every internal hop of the serving path (shm broker frames, the fleet
+HTTP relay) used to ride ``utils/jsonutil.py``, which turns each ndarray
+into float *text* (``tolist()``) — ~20 bytes and a float parse per
+element, which for a dense 3072-float query is the transport's CPU, not
+the model (BENCH_r05: the JSON door saturates at ~1/3 the binary door's
+throughput on the same model). This module is the binary replacement:
+ndarrays travel as raw C-contiguous bytes behind a tiny JSON header and
+decode with **zero-copy** ``np.frombuffer`` views into the frame.
+
+Frame layout (all integers little-endian)::
+
+    [0:4]    magic  b"\\xabRWF"   (0xAB cannot start UTF-8 JSON text,
+                                   so frames and JSON bodies are
+                                   sniffable on one byte)
+    [4]      version (currently 1)
+    [5]      reserved (0)
+    [6:10]   u32 header length H
+    [10:10+H] header JSON: {"b": <body>, "a": [[dtype, shape, off, nbytes], ...]}
+    ...      zero padding to a 16-byte boundary
+    [P:]     array payload region; each array 16-byte aligned, ``off``
+             relative to P
+
+The body is an arbitrary JSON-able structure in which each ndarray was
+replaced by the placeholder ``{"\\u0000nd": k}`` (index into the array
+table). Dtypes are stored as ``np.dtype.str`` — byte order included —
+so a big-endian array round-trips bit-exact and the decoder never
+guesses endianness. Dict keys colliding with the placeholder sentinel
+are escaped, so untrusted JSON queries cannot forge an array reference.
+
+Escape hatch: values that are not numeric/bool ndarrays (strings, dicts,
+object arrays…) stay inside the JSON header via the shared
+``jsonutil.json_default`` convention — a frame with zero arrays is legal,
+so non-array traffic rides the same framing. ``decode_any`` sniffs the
+magic and falls back to plain ``json.loads``, which is what lets
+old-JSON and new-binary peers interoperate on the same queue: receivers
+always sniff, senders choose a format (``RAFIKI_WIRE_BINARY=0`` forces
+JSON framing everywhere for a version-mismatched fleet).
+
+All malformed input — short frames, bad version, garbled headers,
+out-of-range array extents — raises :class:`WireFormatError`, never an
+uncaught slice/KeyError: pop loops catch ONE exception type and a
+corrupt frame can never crash a worker loop.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List
+
+import numpy as np
+
+from rafiki_tpu.utils.jsonutil import json_default
+
+MAGIC = b"\xabRWF"
+VERSION = 1
+_ALIGN = 16
+# HTTP Content-Type for frames on the fleet relay (placement/agent.py
+# negotiates it via the /healthz "wire_versions" advertisement)
+CONTENT_TYPE = "application/x-rafiki-wire"
+
+# placeholder/escape sentinels: NUL ("\\x00") cannot appear in sane user keys,
+# but nothing stops a hostile JSON query from sending it — hence _ESC
+_ND_KEY = "\x00nd"
+_ESC_KEY = "\x00esc"
+
+# dtype kinds that travel as raw bytes (bool, (u)int, float, complex);
+# everything else falls back to the JSON escape hatch
+_BINARY_KINDS = frozenset("biufc")
+
+
+class WireFormatError(ValueError):
+    """Frame failed to parse (truncated, garbled, unknown version)."""
+
+
+def binary_enabled() -> bool:
+    """Global sender-side switch: RAFIKI_WIRE_BINARY=0 forces JSON
+    framing (receivers always sniff both, so this is the operator's
+    escape hatch for a mixed-version fleet)."""
+    import os
+
+    return os.environ.get("RAFIKI_WIRE_BINARY", "1") not in ("0", "false")
+
+
+def _pad16(n: int) -> int:
+    return (-n) % _ALIGN
+
+
+def _strip_arrays(obj: Any, arrays: List[np.ndarray]) -> Any:
+    """Replace every binary-kind ndarray in ``obj`` with a placeholder,
+    collecting the (C-contiguous) arrays; escape colliding dict keys."""
+    if isinstance(obj, np.ndarray):
+        if obj.dtype.kind in _BINARY_KINDS:
+            a = np.ascontiguousarray(obj)
+            if a.shape != obj.shape:  # ascontiguousarray promotes 0-d to 1-d
+                a = a.reshape(obj.shape)
+            arrays.append(a)
+            return {_ND_KEY: len(arrays) - 1}
+        return obj.tolist()  # str/object arrays: JSON escape hatch
+    if isinstance(obj, np.generic):
+        if obj.dtype.kind in _BINARY_KINDS:
+            arrays.append(np.asarray(obj))  # 0-d array
+            return {_ND_KEY: len(arrays) - 1}
+        return obj.item()
+    if isinstance(obj, dict):
+        out = {k: _strip_arrays(v, arrays) for k, v in obj.items()}
+        if _ND_KEY in obj or _ESC_KEY in obj:
+            # a user dict that *looks like* a placeholder must never
+            # decode as one (type confusion on untrusted queries)
+            return {_ESC_KEY: out}
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_strip_arrays(v, arrays) for v in obj]
+    return obj
+
+
+def _restore_arrays(obj: Any, views: List[np.ndarray]) -> Any:
+    if isinstance(obj, dict):
+        if _ND_KEY in obj:
+            try:
+                return views[int(obj[_ND_KEY])]
+            except (IndexError, TypeError, ValueError) as e:
+                raise WireFormatError(f"bad array reference: {e}") from e
+        if _ESC_KEY in obj:
+            inner = obj[_ESC_KEY]
+            if not isinstance(inner, dict):
+                raise WireFormatError("bad escape wrapper")
+            return {k: _restore_arrays(v, views) for k, v in inner.items()}
+        return {k: _restore_arrays(v, views) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_restore_arrays(v, views) for v in obj]
+    return obj
+
+
+def encode(obj: Any) -> bytes:
+    """One binary frame for ``obj`` (any JSON-able structure, ndarrays
+    at any depth). Raises TypeError for non-JSON, non-array leaves —
+    same contract as the JSON wire convention it replaces."""
+    arrays: List[np.ndarray] = []
+    body = _strip_arrays(obj, arrays)
+    table = []
+    off = 0
+    for a in arrays:
+        off += _pad16(off)
+        table.append([a.dtype.str, list(a.shape), off, a.nbytes])
+        off += a.nbytes
+    header = json.dumps({"b": body, "a": table},
+                        default=json_default).encode()
+    pieces = [MAGIC, bytes([VERSION, 0]),
+              len(header).to_bytes(4, "little"), header,
+              b"\x00" * _pad16(len(MAGIC) + 2 + 4 + len(header))]
+    pos = 0
+    for a, (_, _, o, _) in zip(arrays, table):
+        if o > pos:
+            pieces.append(b"\x00" * (o - pos))
+            pos = o
+        pieces.append(a.tobytes())  # C-contiguous by construction
+        pos += a.nbytes
+    return b"".join(pieces)
+
+
+def is_frame(raw: bytes) -> bool:
+    return len(raw) >= 4 and raw[:4] == MAGIC
+
+
+def decode(raw: bytes) -> Any:
+    """Decode one frame. Array leaves come back as **read-only
+    zero-copy views** into ``raw`` (they keep the frame alive); callers
+    that mutate must copy."""
+    if not is_frame(raw):
+        raise WireFormatError("not a wire frame (bad magic)")
+    if len(raw) < 10:
+        raise WireFormatError("truncated frame header")
+    if raw[4] != VERSION:
+        raise WireFormatError(f"unsupported wire version {raw[4]}")
+    hlen = int.from_bytes(raw[6:10], "little")
+    if 10 + hlen > len(raw):
+        raise WireFormatError("truncated frame (header extent)")
+    try:
+        header = json.loads(raw[10:10 + hlen])
+        body, table = header["b"], header["a"]
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+        raise WireFormatError(f"garbled frame header: {e}") from e
+    payload_start = 10 + hlen + _pad16(10 + hlen)
+    payload = memoryview(raw)[payload_start:]
+    views: List[np.ndarray] = []
+    if not isinstance(table, list):
+        raise WireFormatError("garbled array table")
+    for entry in table:
+        try:
+            dtype_str, shape, off, nbytes = entry
+            dt = np.dtype(dtype_str)
+            shape = tuple(int(s) for s in shape)
+            off, nbytes = int(off), int(nbytes)
+        except (ValueError, TypeError) as e:
+            raise WireFormatError(f"garbled array entry: {e}") from e
+        if dt.kind not in _BINARY_KINDS:
+            raise WireFormatError(f"non-binary dtype {dtype_str!r} on wire")
+        if any(s < 0 for s in shape):
+            raise WireFormatError("negative array dimension")
+        # Python-int product: a hostile shape like [2**32, 2**32] must
+        # not wrap to 0 the way a fixed-width product would and slip
+        # past the extent check
+        expected = dt.itemsize
+        for s in shape:
+            expected *= s
+        if nbytes != expected or off < 0 or off + nbytes > len(payload):
+            raise WireFormatError("array extent out of range")
+        try:
+            views.append(np.frombuffer(
+                payload[off:off + nbytes], dtype=dt).reshape(shape))
+        except ValueError as e:  # belt-and-braces: numpy's own refusals
+            raise WireFormatError(f"bad array extent: {e}") from e
+    return _restore_arrays(body, views)
+
+
+def decode_any(raw: bytes) -> Any:
+    """The receiver-side sniff: binary frame -> :func:`decode`; anything
+    else is parsed as JSON (the legacy framing). This single entry point
+    is what makes every receive end mixed-version tolerant."""
+    if is_frame(raw):
+        return decode(raw)
+    try:
+        return json.loads(raw)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireFormatError(f"neither wire frame nor JSON: {e}") from e
+
+
+def dumps(obj: Any) -> bytes:
+    """Sender-side entry point: binary frame, or the legacy JSON framing
+    when RAFIKI_WIRE_BINARY=0."""
+    if binary_enabled():
+        return encode(obj)
+    return json.dumps(obj, default=json_default).encode()
+
+
+def stackable(queries: List[Any]) -> bool:
+    """True when ``queries`` is a non-empty homogeneous batch of numeric
+    ndarrays (same dtype+shape) — the single definition of 'stackable'
+    shared by every hop that turns a request's rows into one contiguous
+    array (shm framing, fleet relay, worker batch assembly)."""
+    first = queries[0] if queries else None
+    return (isinstance(first, np.ndarray)
+            and first.dtype.kind in _BINARY_KINDS
+            and all(isinstance(q, np.ndarray) and q.dtype == first.dtype
+                    and q.shape == first.shape for q in queries))
+
+
+def stack_batch(queries: List[Any]) -> Any:
+    """One ``(n, ...)`` array for a stackable batch (zero-copy for the
+    single-row case), or None when the batch is not stackable."""
+    if not stackable(queries):
+        return None
+    return queries[0][None] if len(queries) == 1 else np.stack(queries)

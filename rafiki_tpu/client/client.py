@@ -289,9 +289,12 @@ class Client:
         if isinstance(queries, _np.ndarray):
             # binary door: ship the batch as one .npy body — no JSON
             # float formatting/parsing on either side (the serving CPU
-            # cost for dense queries like images). Encode OUTSIDE the
-            # request try: a local encode error (object dtype etc.) is
-            # the caller's bug, not a route failure
+            # cost for dense queries like images) — and ask for the
+            # predictions back the same way (Accept negotiation; the
+            # door falls back to JSON for ragged predictions, so the
+            # response Content-Type is sniffed below). Encode OUTSIDE
+            # the request try: a local encode error (object dtype etc.)
+            # is the caller's bug, not a route failure
             import io
 
             buf = io.BytesIO()
@@ -300,6 +303,7 @@ class Client:
             except ValueError as e:
                 raise RafikiError(f"queries array not npy-encodable: {e}")
             headers["Content-Type"] = "application/x-npy"
+            headers["Accept"] = "application/x-npy, application/json"
             body_kwargs = {"data": buf.getvalue()}
         else:
             body_kwargs = {"json": {"queries": queries}}
@@ -307,11 +311,17 @@ class Client:
             resp = self._http.request(
                 "POST", f"http://{cached[0]}:{cached[1]}/predict",
                 headers=headers, **body_kwargs)
+            rtype = (resp.headers.get("Content-Type") or "").split(";")[0]
+            if resp.status_code == 200 and rtype == "application/x-npy":
+                import io
+
+                arr = _np.load(io.BytesIO(resp.content), allow_pickle=False)
+                return list(arr)
             payload = resp.json()
         except (requests.RequestException, ValueError) as e:
-            # connect failure OR a non-JSON body (port reclaimed by some
-            # other server): drop the route and surface the door's error
-            # type, same contract as every _call path
+            # connect failure OR an undecodable body (port reclaimed by
+            # some other server): drop the route and surface the door's
+            # error type, same contract as every _call path
             self._predictor_ports.pop(key, None)
             raise RafikiError(f"dedicated predictor unreachable: {e}")
         if resp.status_code != 200:

@@ -74,16 +74,47 @@ def check_store() -> Check:
 
 
 def check_shm_broker() -> Check:
+    """Native build, configured ring size, and which wire format shm
+    traffic will actually ride — an operator who set RAFIKI_BROKER=shm
+    for the binary data plane must SEE it when framing silently fell
+    back to JSON (kill-switch, or a mixed-version fleet)."""
+    shm_selected = os.environ.get("RAFIKI_BROKER") == "shm"
     try:
-        from rafiki_tpu.native.shm_queue import available
+        from rafiki_tpu.cache import wire
+        from rafiki_tpu.native.shm_queue import available, default_capacity
 
         if not available():
+            if shm_selected:
+                return ("shm data plane", WARN,
+                        "RAFIKI_BROKER=shm but the native shmqueue did "
+                        "not build — falling back to the in-process "
+                        "broker (process placement/serving agents need "
+                        "the native library)")
             return ("shm data plane", WARN,
                     "native shmqueue unavailable — in-process broker only "
                     "(process placement/serving agents need it)")
+        from rafiki_tpu import config
+
+        ring = default_capacity()
+        if not wire.binary_enabled():
+            return ("shm data plane", WARN,
+                    f"binary wire framing DISABLED (RAFIKI_WIRE_BINARY=0): "
+                    f"shm/relay traffic rides JSON float text — ~an order "
+                    f"of magnitude more serialization CPU per dense query; "
+                    f"re-enable once every peer speaks wire v{wire.VERSION} "
+                    f"(ring {ring} B)")
+        if ring < 4 * (1 << 20) and int(config.PREDICT_QUEUE_DEPTH) > 0:
+            detail = (f"native queue library loads; ring {ring} B "
+                      f"(RAFIKI_SHM_RING_BYTES), binary wire v{wire.VERSION}"
+                      " — batched binary frames are larger than per-query "
+                      "JSON; watch ring_used_bytes_hw in serving stats")
+        else:
+            detail = (f"native queue library loads; ring {ring} B "
+                      f"(RAFIKI_SHM_RING_BYTES), binary wire "
+                      f"v{wire.VERSION}")
     except Exception as e:
         return ("shm data plane", WARN, f"{type(e).__name__}: {e}")
-    return ("shm data plane", PASS, "native queue library loads")
+    return ("shm data plane", PASS, detail)
 
 
 def check_sandbox() -> Check:

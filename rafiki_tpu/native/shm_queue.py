@@ -23,6 +23,20 @@ logger = logging.getLogger(__name__)
 _DEFAULT_CAPACITY = 1 << 20  # 1 MiB ring
 
 
+def default_capacity() -> int:
+    """Ring capacity in bytes: RAFIKI_SHM_RING_BYTES, default 1 MiB.
+    Read per call, not at import — batched binary frames (cache/wire.py)
+    are bigger than per-query JSON, and an operator sizing the ring up
+    for them must not need a process restart ordering dance."""
+    try:
+        return max(int(os.environ.get(
+            "RAFIKI_SHM_RING_BYTES", _DEFAULT_CAPACITY)), 1 << 12)
+    except ValueError:
+        logger.error("ignoring unparseable RAFIKI_SHM_RING_BYTES=%r",
+                     os.environ.get("RAFIKI_SHM_RING_BYTES"))
+        return _DEFAULT_CAPACITY
+
+
 def _lib():
     lib = load_library("shmqueue")
     if lib is None:
@@ -63,7 +77,7 @@ class ShmQueueClosed(Exception):
 class ShmMessageQueue:
     """One MPMC byte-message queue backed by POSIX shared memory."""
 
-    def __init__(self, name: str, capacity: int = _DEFAULT_CAPACITY,
+    def __init__(self, name: str, capacity: Optional[int] = None,
                  create: bool = True):
         lib = _lib()
         if lib is None:
@@ -71,6 +85,15 @@ class ShmMessageQueue:
         self._lib = lib
         self.name = name
         self._create = create
+        if capacity is None:
+            capacity = default_capacity()
+        #: ring size this handle was created with (0 when attached — the
+        #: native header is not re-read on open)
+        self.capacity = capacity if create else 0
+        #: high-water mark of ring occupancy seen through THIS handle's
+        #: pushes — the operator's early warning that batched frames are
+        #: approaching the -3 oversized/ring-full regime
+        self.used_bytes_hw = 0
         if create:
             self._h = lib.shmq_create(name.encode(), capacity)
         else:
@@ -103,6 +126,10 @@ class ShmMessageQueue:
         try:
             rc = self._lib.shmq_push(self._h, payload, len(payload),
                                      int(timeout_s * 1000))
+            if rc == 0:
+                used = int(self._lib.shmq_used(self._h))
+                if used > self.used_bytes_hw:
+                    self.used_bytes_hw = used
         finally:
             self._exit_native()
         if rc == -1:
@@ -142,6 +169,15 @@ class ShmMessageQueue:
             raise ShmQueueClosed(self.name)
         assert rc >= 0, rc
         return buf.raw[:rc]
+
+    def stats(self) -> dict:
+        """Ring occupancy picture for ops surfaces (broker stats, doctor):
+        capacity is 0 for attached (non-creator) handles."""
+        return {
+            "capacity": self.capacity,
+            "used_bytes": self.used_bytes(),
+            "used_bytes_hw": self.used_bytes_hw,
+        }
 
     def used_bytes(self) -> int:
         try:

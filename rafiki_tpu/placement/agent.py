@@ -59,10 +59,12 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
+from rafiki_tpu.cache import wire
 from rafiki_tpu.constants import ServiceType
 from rafiki_tpu.placement.manager import ChipAllocator, InsufficientChipsError
 from rafiki_tpu.placement.process import ProcessPlacementManager
 from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.jsonutil import json_default
 from rafiki_tpu.utils.reqfields import LowLatencyHandler
 
 logger = logging.getLogger(__name__)
@@ -135,9 +137,14 @@ class AgentServer:
                                          {"error": "chaos-injected error"})
                 chaos.sleep_for(rule)
             if method == "GET" and path == "/healthz":
-                # liveness stays unauthenticated (monitors/doctor probes)
+                # liveness stays unauthenticated (monitors/doctor probes).
+                # wire_versions advertises the binary codec this agent
+                # decodes — the admin-side relay (cache/fleet.py) probes
+                # it once before shipping binary frames, so an old agent
+                # keeps receiving JSON
                 return self._respond(handler, 200, {
-                    "host": self.hostname, "status": "ok"})
+                    "host": self.hostname, "status": "ok",
+                    "wire_versions": [wire.VERSION]})
             if self.key:
                 import hmac
 
@@ -158,8 +165,24 @@ class AgentServer:
                 handler, _config.PREDICT_MAX_BODY_MB)
             if berr:
                 return self._respond(handler, berr[0], {"error": berr[1]})
+            binary_req = False
             if raw:
-                body = json.loads(raw or b"{}")
+                ctype = ((handler.headers.get("Content-Type") or "")
+                         .split(";")[0].strip().lower())
+                if ctype == wire.CONTENT_TYPE or wire.is_frame(raw):
+                    # binary wire frame (cache/wire.py): ndarrays decode
+                    # as zero-copy views; the response answers in kind
+                    try:
+                        body = wire.decode(raw)
+                    except wire.WireFormatError as e:
+                        return self._respond(handler, 400, {
+                            "error": f"bad wire frame: {e}"})
+                    if not isinstance(body, dict):
+                        return self._respond(handler, 400, {
+                            "error": "wire frame body must be an object"})
+                    binary_req = True
+                else:
+                    body = json.loads(raw or b"{}")
 
             if method == "GET" and path == "/inventory":
                 alloc = self.engine.allocator
@@ -199,20 +222,25 @@ class AgentServer:
             m = _PREDICT_RELAY.match(path) if method == "POST" else None
             if m:
                 return self._predict_relay(
-                    handler, m.group("job"), m.group("wid"), body)
+                    handler, m.group("job"), m.group("wid"), body,
+                    binary=binary_req)
             self._respond(handler, 404, {"error": f"no route {method} {path}"})
         except Exception as e:
             logger.exception("agent request failed")
             self._respond(handler, 500, {"error": f"{type(e).__name__}: {e}"})
 
     def _predict_relay(self, handler, job_id: str, worker_id: str,
-                       body: Dict[str, Any]) -> None:
+                       body: Dict[str, Any], binary: bool = False) -> None:
         """Data-plane hop for a remote predictor (cache/fleet.py): submit
         the relayed batch to the named worker's host-local queue and
         answer when the worker resolves it. All-or-nothing per call — a
         worker error fails the whole relay request and the predictor's
-        hedged failover (predictor/predictor.py) takes it from there."""
+        hedged failover (predictor/predictor.py) takes it from there.
+        ``binary`` requests (one wire frame, queries possibly a stacked
+        ndarray) are answered with a wire frame; JSON stays JSON."""
         import time as _time
+
+        import numpy as _np
 
         from rafiki_tpu import config as _config
 
@@ -220,6 +248,11 @@ class AgentServer:
             return self._respond(handler, 503, {
                 "error": "no serving data plane on this agent"})
         queries = body.get("queries")
+        if isinstance(queries, _np.ndarray):
+            if queries.ndim < 1:
+                return self._respond(handler, 400, {
+                    "error": "stacked queries need a leading batch axis"})
+            queries = list(queries)  # zero-copy row views
         if not isinstance(queries, list) or not queries:
             return self._respond(handler, 400, {
                 "error": "body must carry a non-empty 'queries' list"})
@@ -263,13 +296,29 @@ class AgentServer:
         except Exception as e:
             return self._respond(handler, 502, {
                 "error": f"worker {worker_id}: {type(e).__name__}: {e}"})
+        if binary:
+            return self._respond_frame(handler, {"predictions": preds})
         self._respond(handler, 200, {"predictions": preds})
 
     @staticmethod
     def _respond(handler, code: int, payload: Dict[str, Any]) -> None:
-        data = json.dumps(payload).encode()
+        # json_default: worker predictions may be ndarrays (binary-era
+        # workers) even when the caller negotiated JSON
+        data = json.dumps(payload, default=json_default).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    @staticmethod
+    def _respond_frame(handler, payload: Dict[str, Any]) -> None:
+        """Success leg of a binary relay: one wire frame back (ndarray
+        predictions as raw bytes). Errors always answer JSON — the
+        client's error decode path is shared with the control plane."""
+        data = wire.encode(payload)
+        handler.send_response(200)
+        handler.send_header("Content-Type", wire.CONTENT_TYPE)
         handler.send_header("Content-Length", str(len(data)))
         handler.end_headers()
         handler.wfile.write(data)

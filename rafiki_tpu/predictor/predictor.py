@@ -74,6 +74,23 @@ class Predictor:
             out[wid] = depth() if callable(depth) else -1
         return out
 
+    def queue_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-worker submit-side queue stats for queues that expose
+        ``stats()`` — for the shm plane this is where the query ring's
+        occupancy high-water mark (``ring_used_bytes_hw``, the
+        RAFIKI_SHM_RING_BYTES sizing signal) actually lives: only the
+        owner process pushes that ring. Surfaced via the serving door's
+        /healthz."""
+        out: Dict[str, Dict[str, int]] = {}
+        for wid, q in self._broker.get_worker_queues(self._job_id).items():
+            stats_fn = getattr(q, "stats", None)
+            if callable(stats_fn):
+                try:
+                    out[wid] = stats_fn()
+                except Exception:
+                    logger.exception("queue stats probe failed for %s", wid)
+        return out
+
     def backlog_depth(self) -> int:
         """The queue depth a NEW request would actually face: each trial
         answers via its least-loaded replica, and the request waits for

@@ -24,7 +24,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
-from rafiki_tpu.cache.queue import QueueFullError
+from rafiki_tpu.cache.queue import FrameTooLargeError, QueueFullError
 from rafiki_tpu.predictor.admission import (
     AdmissionController,
     DeadlineUnmeetableError,
@@ -153,6 +153,17 @@ class PredictorServer:
         }
         if callable(overload_fn):
             payload["overload"] = overload_fn()
+        qstats_fn = getattr(self.predictor, "queue_stats", None)
+        if callable(qstats_fn):
+            try:
+                qstats = qstats_fn()
+            except Exception:
+                qstats = {}
+            if qstats:
+                # submit-side ring picture (shm plane): this is where
+                # ring_used_bytes_hw — the RAFIKI_SHM_RING_BYTES sizing
+                # signal — is actually measured
+                payload["queues"] = qstats
         self._respond(handler, 200, payload)
 
     def _predict(self, handler: BaseHTTPRequestHandler) -> None:
@@ -180,9 +191,10 @@ class PredictorServer:
                 # binary ndarray queries: first axis is the batch. JSON
                 # costs ~20 bytes AND a float parse per element — for a
                 # 3072-float image query that is the serving door's CPU,
-                # not the model. Responses stay JSON (predictions are
-                # small). allow_pickle=False: this door is pre-auth'd but
-                # still untrusted input.
+                # not the model. Responses are negotiated separately via
+                # Accept: application/x-npy (see below).
+                # allow_pickle=False: this door is pre-auth'd but still
+                # untrusted input.
                 import io
 
                 import numpy as _np
@@ -243,11 +255,37 @@ class PredictorServer:
             finally:
                 self.admission.release()
             self.admission.observe(time.monotonic() - t0, len(queries))
+            # Accept negotiation: a client that asked for
+            # application/x-npy gets the predictions back as ONE binary
+            # .npy body — the response-leg mirror of the binary request
+            # door (JSON float text was the remaining serialization tax
+            # on an end-to-end binary predict). Ragged/non-numeric
+            # predictions fall back to JSON; the client sniffs the
+            # response Content-Type either way.
+            if self._accepts_npy(handler):
+                import io
+
+                import numpy as _np
+
+                arr = None
+                try:
+                    arr = _np.asarray(preds)
+                except Exception:
+                    pass
+                if arr is not None and arr.dtype != object:
+                    buf = io.BytesIO()
+                    _np.save(buf, arr, allow_pickle=False)
+                    return self._respond_bytes(
+                        handler, 200, buf.getvalue(), "application/x-npy")
             self._respond(handler, 200, {"data": {"predictions": preds}})
         except UnauthorizedError as e:
             self._respond(handler, 401, {"error": str(e)})
         except json.JSONDecodeError as e:
             self._respond(handler, 400, {"error": f"bad JSON body: {e}"})
+        except FrameTooLargeError as e:
+            # the request's wire frame can never fit the shm ring: a
+            # PERMANENT condition — 413, never the retryable 429
+            self._respond(handler, 413, {"error": str(e)})
         except (QueueFullError, DeadlineUnmeetableError) as e:
             # backlog shed: retryable, and Retry-After says when (full
             # worker queues / estimated wait past the client's deadline)
@@ -268,13 +306,37 @@ class PredictorServer:
             self._respond(handler, 500, {"error": "internal server error"})
 
     @staticmethod
+    def _accepts_npy(handler) -> bool:
+        """RFC 9110-lite Accept check: any listed media range equal to
+        application/x-npy (params ignored, case-insensitive) opts the
+        response into binary. No q-value algebra — this is a two-format
+        door, not a content-negotiation engine."""
+        accept = handler.headers.get("Accept") or ""
+        return any(
+            part.split(";")[0].strip().lower() == "application/x-npy"
+            for part in accept.split(","))
+
+    @staticmethod
     def _respond(handler, code: int, payload: Dict[str, Any],
                  headers: Optional[Dict[str, str]] = None) -> None:
-        data = json.dumps(payload).encode()
+        from rafiki_tpu.utils.jsonutil import json_default
+
+        # json_default: predictions may carry stray numpy scalars/rows
+        # when a binary-era worker answers a JSON client
+        data = json.dumps(payload, default=json_default).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(data)))
         for k, v in (headers or {}).items():
             handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    @staticmethod
+    def _respond_bytes(handler, code: int, data: bytes,
+                       content_type: str) -> None:
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(data)))
         handler.end_headers()
         handler.wfile.write(data)

@@ -158,6 +158,7 @@ def _raw_call(
     body: Optional[Dict[str, Any]],
     key: Optional[str],
     timeout_s: float,
+    wire_frames: bool = False,
 ) -> Dict[str, Any]:
     rule = chaos.hit(chaos.SITE_CALL_AGENT, f"{addr} {path}")
     if rule is not None:
@@ -168,14 +169,39 @@ def _raw_call(
         elif rule.action == chaos.ACTION_ERROR:
             raise AgentHTTPError(rule.code, "chaos-injected error")
     url = f"http://{addr}{path}"
-    data = json.dumps(body).encode() if body is not None else None
+    # the serving data plane (cache/fleet.py) negotiates the binary wire
+    # codec: ndarrays in `body` ride as raw bytes instead of JSON float
+    # text. Control-plane calls stay plain JSON. Responses are sniffed
+    # either way, so a binary-answering peer never needs a second flag.
+    from rafiki_tpu.cache import wire as _wire
+
+    data = None
+    ctype = "application/json"
+    if body is not None:
+        if wire_frames:
+            data = _wire.dumps(body)  # JSON framing if RAFIKI_WIRE_BINARY=0
+            if _wire.is_frame(data):
+                ctype = _wire.CONTENT_TYPE
+        else:
+            # jsonutil convention: ndarrays as float text — the shape
+            # data-plane bodies take when the peer can't decode frames
+            from rafiki_tpu.utils.jsonutil import json_default
+
+            data = json.dumps(body, default=json_default).encode()
     req = urllib.request.Request(url, data=data, method=method)
-    req.add_header("Content-Type", "application/json")
+    req.add_header("Content-Type", ctype)
     if key:
         req.add_header(AGENT_KEY_HEADER, key)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            return json.loads(resp.read() or b"{}")
+            raw = resp.read() or b"{}"
+            if _wire.is_frame(raw):
+                try:
+                    return _wire.decode(raw)
+                except _wire.WireFormatError as e:
+                    raise AgentTransportError(
+                        f"{addr}: garbled wire response: {e}") from e
+            return json.loads(raw)
     except urllib.error.HTTPError as e:
         try:
             message = json.loads(e.read() or b"{}").get("error", str(e))
@@ -198,6 +224,7 @@ def call_agent(
     timeout_s: float = 10.0,
     idempotent: Optional[bool] = None,
     use_breaker: bool = True,
+    wire_frames: bool = False,
 ) -> Dict[str, Any]:
     """One request to a host agent, with retry + circuit breaking.
 
@@ -205,6 +232,9 @@ def call_agent(
     exponential backoff + jitter on transport failures. ``use_breaker``
     is disabled only by the heartbeat monitor, whose probes must reach
     the wire regardless of breaker state — they ARE the recovery signal.
+    ``wire_frames`` ships the body as one binary wire frame
+    (cache/wire.py) — data-plane callers only, after negotiating support
+    via the agent's /healthz ``wire_versions`` advertisement.
     """
     if idempotent is None:
         idempotent = method.upper() == "GET"
@@ -222,7 +252,8 @@ def call_agent(
             # storms of many callers hitting one recovering agent
             time.sleep(backoff * (2 ** (attempt - 1)) * random.uniform(0.5, 1.5))
         try:
-            out = _raw_call(addr, method, path, body, key, timeout_s)
+            out = _raw_call(addr, method, path, body, key, timeout_s,
+                            wire_frames=wire_frames)
         except AgentHTTPError:
             # the host answered — alive, whatever the status code says
             if breaker is not None:

@@ -20,13 +20,16 @@ via :func:`install` in tests. Env format: ``|``-separated rules of
 Fields:
 
     site     where to inject: ``call_agent`` (admin-side transport),
-             ``agent`` (host agent server), or ``worker`` (inference
-             serve loop — overload drills: slow/stalled replicas).
-             Required.
+             ``agent`` (host agent server), ``worker`` (inference
+             serve loop — overload drills: slow/stalled replicas), or
+             ``wire`` (shm frames popped off the serving rings, before
+             decode — corruption drills). Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
-             ``delay_s`` then proceed — a slow replica), or ``error``
-             (HTTP ``code``; at site=worker the batch fails). Required.
+             ``delay_s`` then proceed — a slow replica), ``error``
+             (HTTP ``code``; at site=worker the batch fails), or
+             ``corrupt`` (site=wire only: truncate/garble the raw frame
+             bytes). Required.
     match    substring filter on the target ("addr path" client-side,
              request path server-side). Empty matches everything.
     after    skip the first N matching requests (default 0).
@@ -64,10 +67,18 @@ SITE_AGENT = "agent"
 # makes it silently swallow a batch (futures never resolve; the
 # predictor's SLO machinery takes over), `error` fails the batch.
 SITE_WORKER = "worker"
+# serving wire chokepoint (cache/shm_broker.py): frames popped off the
+# shm rings, BEFORE decode. `corrupt` garbles/truncates the raw bytes on
+# a deterministic schedule — the drill that proves a corrupt frame
+# yields a typed per-request error (WireFormatError -> skip -> the
+# request's SLO timeout), never a worker-loop crash. Target string is
+# the shm queue name, so `match` can pick the query vs response ring.
+SITE_WIRE = "wire"
 
 ACTION_DROP = "drop"
 ACTION_DELAY = "delay"
 ACTION_ERROR = "error"
+ACTION_CORRUPT = "corrupt"
 
 
 class ChaosSpecError(ValueError):
@@ -88,10 +99,16 @@ class ChaosRule:
     hits: int = field(default=0, compare=False)  # matching requests seen
 
     def __post_init__(self) -> None:
-        if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER):
+        if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
+                             SITE_WIRE):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
-        if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR):
+        if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
+                               ACTION_CORRUPT):
             raise ChaosSpecError(f"unknown chaos action {self.action!r}")
+        if self.action == ACTION_CORRUPT and self.site != SITE_WIRE:
+            raise ChaosSpecError(
+                "chaos action 'corrupt' only applies at site=wire "
+                "(raw frame bytes)")
         if self.every < 1:
             raise ChaosSpecError("chaos 'every' must be >= 1")
 
@@ -214,3 +231,18 @@ hit = _controller.hit
 def sleep_for(rule: ChaosRule) -> None:
     """Apply a delay rule (kept here so call sites stay one-liners)."""
     time.sleep(rule.delay_s)
+
+
+def corrupt_bytes(raw: bytes, rule: ChaosRule) -> bytes:
+    """Apply a site=wire `corrupt` rule to popped frame bytes.
+    Deterministic in the rule's hit count: odd hits truncate (a partial
+    write), even hits garble bytes in place (bit rot) — both classes of
+    damage a decoder must survive."""
+    if not raw:
+        return raw
+    if rule.hits % 2:
+        return raw[: max(len(raw) // 2, 1)]
+    buf = bytearray(raw)
+    for i in range(0, len(buf), max(len(buf) // 8, 1)):
+        buf[i] ^= 0xA5
+    return bytes(buf)

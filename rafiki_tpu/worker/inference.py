@@ -18,7 +18,9 @@ from __future__ import annotations
 import logging
 import threading
 import traceback
-from typing import Dict, Optional
+from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 from rafiki_tpu import config
 from rafiki_tpu.cache.queue import Broker
@@ -57,8 +59,10 @@ def _record_batch(service_id: str, n_queries: int) -> None:
 
 def _record_queue(service_id: str, queue) -> None:
     """Fold the queue's overload counters into this service's stats row
-    (queues without a stats() signal — e.g. shm response handles — just
-    contribute nothing)."""
+    (queues without a stats() signal just contribute nothing). Only the
+    keys a queue actually reports are written: condvar queues carry the
+    depth/expired/rejected overload picture, shm queues carry the wire
+    picture (undecodable frames, ring occupancy high-water)."""
     stats_fn = getattr(queue, "stats", None)
     if not callable(stats_fn):
         return
@@ -68,9 +72,69 @@ def _record_queue(service_id: str, queue) -> None:
         return
     with _stats_lock:
         s = SERVING_STATS.setdefault(service_id, {"batches": 0, "queries": 0})
-        s["queue_depth"] = int(q.get("depth", 0))
-        s["expired"] = int(q.get("expired", 0))
-        s["shed"] = int(q.get("rejected", 0))
+        for src, dst in (("depth", "queue_depth"), ("expired", "expired"),
+                         ("rejected", "shed"), ("wire_errors", "wire_errors"),
+                         ("ring_used_bytes_hw", "ring_used_bytes_hw")):
+            if src in q:
+                s[dst] = int(q[src])
+
+
+def _resolve_batch(futures: List[Any], predictions: Any,
+                   service_id: str) -> None:
+    """Resolve one served batch, delivering every computed prediction
+    and failing the rest with a TYPED error when a buggy model returns
+    fewer predictions than queries. Every future MUST resolve here: the
+    shm plane's per-frame response flushes only once a frame's futures
+    have all resolved, so a silently-dropped future would strand its
+    whole request — computed results included — until the SLO."""
+    n = len(predictions)
+    for fut, pred in zip(futures, predictions):
+        fut.set_result(pred)
+    if n < len(futures):
+        logger.error(
+            "model in worker %s returned %d predictions for %d queries",
+            service_id, n, len(futures))
+        err = RuntimeError(
+            f"model returned {n} predictions for {len(futures)} queries")
+        for fut in futures[n:]:
+            fut.set_error(err)
+
+
+class _BatchAssembler:
+    """Single-copy batch assembly for ndarray queries.
+
+    The old path handed the model a Python list, so every predict paid a
+    per-query ``np.asarray`` shuffle over N separate objects. When a
+    batch's queries are homogeneous ndarrays (the shape the binary wire
+    delivers: zero-copy frombuffer rows), they are now copied ONCE into a
+    contiguous batch — into a reused preallocated buffer when the queue
+    declares ``reusable_batch_ok`` (shm queues: responses serialize
+    inside the resolve loop, so the buffer is dead by the next take;
+    in-process futures hand objects across threads, so those batches get
+    a fresh ``np.stack`` instead of a buffer a pathological input-echoing
+    model could alias). Heterogeneous/non-array batches pass through
+    untouched."""
+
+    def __init__(self) -> None:
+        self._buf: Optional[np.ndarray] = None
+
+    def assemble(self, queries: List[Any], reusable: bool):
+        from rafiki_tpu.cache import wire
+
+        if not wire.stackable(queries):  # the one shared predicate
+            return queries
+        first = queries[0]
+        n = len(queries)
+        if not reusable:
+            return wire.stack_batch(queries)
+        buf = self._buf
+        if (buf is None or buf.shape[1:] != first.shape
+                or buf.dtype != first.dtype or buf.shape[0] < n):
+            cap = max(int(config.PREDICT_MAX_BATCH_SIZE), n)
+            buf = self._buf = np.empty((cap,) + first.shape, first.dtype)
+        for i, q in enumerate(queries):
+            buf[i] = q
+        return buf[:n]
 
 
 class _FusedEnsembleModel:
@@ -243,6 +307,7 @@ class InferenceWorker:
     def start(self, ctx: ServiceContext) -> None:
         set_device_grant(ctx.chips)
         model = None
+        assembler = _BatchAssembler()
         queue = self._broker.register_worker(self._job_id, ctx.service_id)
         try:
             model = self._load_model(ctx.service_id)
@@ -281,7 +346,9 @@ class InferenceWorker:
                 _record_batch(ctx.service_id, len(batch))
                 _record_queue(ctx.service_id, queue)
                 futures = [f for f, _ in batch]
-                queries = [q for _, q in batch]
+                queries = assembler.assemble(
+                    [q for _, q in batch],
+                    reusable=getattr(queue, "reusable_batch_ok", False))
                 rule = chaos.hit(chaos.SITE_WORKER,
                                  f"{self._job_id}/{ctx.service_id}")
                 if rule is not None:
@@ -306,8 +373,7 @@ class InferenceWorker:
                         continue
                 try:
                     predictions = model.predict(queries)
-                    for fut, pred in zip(futures, predictions):
-                        fut.set_result(pred)
+                    _resolve_batch(futures, predictions, ctx.service_id)
                 except Exception as e:
                     logger.error(
                         "predict failed in worker %s:\n%s",

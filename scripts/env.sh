@@ -51,6 +51,21 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #   RAFIKI_PREDICT_DRAIN_S=5            predictor stop(): bounded wait for
 #                                       in-flight handlers before close
 
+# Serving wire formats (docs/performance.md "Wire formats"). Internal
+# serving hops (shm broker, fleet relay) ride a binary ndarray codec;
+# the dedicated predictor port answers binary when clients send
+# Accept: application/x-npy. Defaults are right for same-version fleets:
+#   RAFIKI_WIRE_BINARY=1            0 = force JSON framing on every
+#                                   sender (mixed-version fleet escape
+#                                   hatch; receivers always sniff both,
+#                                   doctor warns while set)
+#   RAFIKI_SHM_RING_BYTES=1048576   shm ring bytes per queue; batched
+#                                   binary frames are bigger than
+#                                   per-query JSON — size ≳4x the
+#                                   largest request body and watch
+#                                   ring_used_bytes_hw in serving stats
+#                                   (oversized frames shed as typed 413)
+
 # Fleet health (docs/failure-model.md). Safe defaults — tune only for
 # failover drills or unusual networks:
 #   RAFIKI_AGENT_HEARTBEAT_S=5          /healthz probe interval (0 = off)
@@ -61,8 +76,9 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #   RAFIKI_AGENT_BREAKER_THRESHOLD=3    transport failures to open a circuit
 #   RAFIKI_AGENT_BREAKER_COOLDOWN_S=5   fail-fast window before half-open
 # Deterministic fault injection — MUST stay off outside drills/tests
-# (sites: call_agent, agent, worker — the last stalls/slows serving
-# replicas for overload drills):
+# (sites: call_agent, agent, worker — stalls/slows serving replicas for
+# overload drills — and wire, whose `corrupt` action garbles shm frames
+# for codec-corruption drills):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 

@@ -114,6 +114,167 @@ def test_read_bounded_body_refusals(length, code):
     assert h.rfile.tell() == 0  # refused BEFORE reading a byte
 
 
+# ---------------------------------------------------------------------------
+# binary predictor door: fuzz both directions (request .npy bodies and
+# Accept-negotiated .npy responses) — malformed input is 4xx with a JSON
+# error, never a 500/hang, and binary responses only appear when asked for
+# ---------------------------------------------------------------------------
+
+
+class _EchoSumPredictor:
+    """predict_batch returns one float per query (sum) — ndarray-friendly
+    but JSON-serializable, so both response formats are exercised."""
+
+    def __init__(self, ragged=False):
+        self._ragged = ragged
+
+    def predict_batch(self, queries, timeout_s=None):
+        import numpy as np
+
+        if self._ragged:  # defeats np.asarray -> JSON fallback path
+            return [[1.0], [2.0, 3.0]][: max(len(queries), 1)]
+        return [float(np.asarray(q, dtype=np.float64).sum())
+                for q in queries]
+
+
+@pytest.fixture()
+def binary_door():
+    from rafiki_tpu.predictor.server import PredictorServer
+
+    srv = PredictorServer(_EchoSumPredictor(), "fuzzapp", auth=False).start()
+    yield srv
+    srv.stop(drain_timeout_s=0.0)
+
+
+def _post_npy(port, body, accept=None, content_type="application/x-npy"):
+    headers = {"Content-Type": content_type}
+    if accept:
+        headers["Accept"] = accept
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=body, method="POST",
+        headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+def _npy_bytes(arr):
+    import io
+
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+@pytest.mark.parametrize("body", [
+    b"",                                  # empty
+    b"\x93NUMPY garbage",                 # truncated npy magic
+    b"not npy at all",
+    b"\xab" * 64,                         # wire-magic-ish noise
+])
+def test_binary_door_malformed_request_bodies_get_4xx(binary_door, body):
+    status, ctype, payload = _post_npy(binary_door.port, body)
+    assert 400 <= status < 500, (status, payload)
+    assert ctype.startswith("application/json") and b"error" in payload
+
+
+def test_binary_door_fuzzed_npy_mutations_never_500(binary_door):
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    good = _npy_bytes(np.ones((2, 4), np.float32))
+    for _ in range(40):
+        bad = bytearray(good)
+        for _ in range(int(rng.integers(1, 8))):
+            bad[int(rng.integers(0, len(bad)))] ^= int(rng.integers(1, 256))
+        status, _, payload = _post_npy(binary_door.port, bytes(bad))
+        # a mutation may survive as a VALID npy (2x4 floats of any bits
+        # still predict); anything else must be a clean client error
+        assert status in (200, 400), (status, payload)
+
+
+def test_binary_door_binary_both_ways(binary_door):
+    import io
+
+    import numpy as np
+
+    q = np.arange(8, dtype=np.float32).reshape(2, 4)
+    status, ctype, payload = _post_npy(
+        binary_door.port, _npy_bytes(q),
+        accept="application/x-npy, application/json")
+    assert status == 200 and ctype == "application/x-npy"
+    out = np.load(io.BytesIO(payload), allow_pickle=False)
+    assert out.shape == (2,)
+    assert out.tolist() == [6.0, 22.0]
+
+
+def test_binary_door_without_accept_answers_json(binary_door):
+    q = _npy_bytes(__import__("numpy").ones((1, 3), "float32"))
+    status, ctype, payload = _post_npy(binary_door.port, q)
+    assert status == 200 and ctype.startswith("application/json")
+    assert json.loads(payload)["data"]["predictions"] == [3.0]
+
+
+@pytest.mark.parametrize("accept", [
+    "application/x-npy;q=, text/html",     # junk params
+    "*/*, application/x-npy ;foo=bar",
+    "APPLICATION/X-NPY",                   # case-insensitive media type
+])
+def test_binary_door_weird_accept_headers_never_crash(binary_door, accept):
+    import io
+
+    import numpy as np
+
+    q = _npy_bytes(np.ones((1, 3), np.float32))
+    status, ctype, payload = _post_npy(binary_door.port, q, accept=accept)
+    assert status == 200
+    if ctype == "application/x-npy":
+        assert np.load(io.BytesIO(payload),
+                       allow_pickle=False).tolist() == [3.0]
+    else:
+        assert json.loads(payload)["data"]["predictions"] == [3.0]
+
+
+def test_binary_door_ragged_predictions_fall_back_to_json():
+    from rafiki_tpu.predictor.server import PredictorServer
+
+    srv = PredictorServer(
+        _EchoSumPredictor(ragged=True), "raggedapp", auth=False).start()
+    try:
+        import numpy as np
+
+        status, ctype, payload = _post_npy(
+            srv.port, _npy_bytes(np.ones((2, 3), np.float32)),
+            accept="application/x-npy")
+        assert status == 200 and ctype.startswith("application/json")
+        assert json.loads(payload)["data"]["predictions"] == [[1.0],
+                                                              [2.0, 3.0]]
+    finally:
+        srv.stop(drain_timeout_s=0.0)
+
+
+def test_binary_door_json_request_with_npy_accept(binary_door):
+    """Format asymmetry is legal: JSON request, binary response."""
+    import io
+
+    import numpy as np
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{binary_door.port}/predict",
+        data=json.dumps({"queries": [[1.0, 2.0]]}).encode(), method="POST",
+        headers={"Content-Type": "application/json",
+                 "Accept": "application/x-npy"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+        assert r.headers.get("Content-Type") == "application/x-npy"
+        out = np.load(io.BytesIO(r.read()), allow_pickle=False)
+    assert out.tolist() == [3.0]
+
+
 @pytest.mark.parametrize("bad_knob", [float("nan"), 0.0, -5.0])
 def test_read_bounded_body_broken_knob_falls_back(bad_knob):
     """A broken size knob must fall back, not reject everything:

@@ -1,0 +1,552 @@
+"""Binary wire codec (cache/wire.py) and its serving-plane integration:
+round-trip properties, malformed-frame rejection, mixed-version interop
+over the shm broker, oversized-frame shed typing, and wire-corruption
+chaos drills (a corrupt frame must cost one request its SLO, never a
+worker loop its life)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.cache import wire
+from rafiki_tpu.cache.queue import FrameTooLargeError, QueueFullError
+from rafiki_tpu.native import shm_queue
+from rafiki_tpu.utils import chaos
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip properties
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int8, np.bool_,
+                                   np.uint16, np.complex64])
+@pytest.mark.parametrize("shape", [(), (1,), (7,), (3, 4), (2, 3, 4),
+                                   (2, 1, 3, 2)])
+def test_roundtrip_dtypes_and_ranks(dtype, shape):
+    rng = np.random.default_rng(0)
+    a = (rng.normal(size=shape) * 10).astype(dtype)
+    out = wire.decode(wire.encode({"q": a}))["q"]
+    assert out.dtype == a.dtype and out.shape == a.shape
+    assert np.array_equal(out, a)
+
+
+def test_roundtrip_empty_and_zero_sized():
+    for a in [np.zeros((0,), np.float32), np.zeros((2, 0, 3), np.int8)]:
+        out = wire.decode(wire.encode(a))
+        assert out.shape == a.shape and out.dtype == a.dtype
+
+
+def test_roundtrip_non_contiguous_input():
+    base = np.arange(40, dtype=np.float64).reshape(5, 8)
+    a = base[:, ::2]  # strided view
+    assert not a.flags.c_contiguous
+    out = wire.decode(wire.encode(a))
+    assert np.array_equal(out, a)
+
+
+def test_endianness_header_preserved():
+    a = np.arange(6, dtype=np.float64).astype(">f8")
+    out = wire.decode(wire.encode(a))
+    assert out.dtype.str == ">f8"
+    assert np.array_equal(out.astype("<f8"), a.astype("<f8"))
+
+
+def test_nested_structure_and_scalars():
+    msg = {
+        "ids": ["a", "b"],
+        "deadline": 12.5,
+        "queries": [np.float32(1.5), {"x": np.arange(3, dtype=np.int8)}],
+        "meta": [1, "two", None, True],
+    }
+    out = wire.decode(wire.encode(msg))
+    assert out["ids"] == ["a", "b"] and out["meta"] == [1, "two", None, True]
+    assert float(out["queries"][0]) == 1.5
+    assert np.array_equal(out["queries"][1]["x"], np.arange(3, dtype=np.int8))
+
+
+def test_zero_copy_views_are_read_only():
+    a = np.arange(8, dtype=np.float32)
+    out = wire.decode(wire.encode(a))
+    assert not out.flags.writeable  # zero-copy view into the frame
+
+
+def test_hostile_sentinel_keys_cannot_forge_arrays():
+    # a JSON client could send a dict that LOOKS like the codec's array
+    # placeholder; it must round-trip as data, never decode as an array
+    msg = {"\x00nd": 0, "inner": {"\x00esc": {"k": 1}}}
+    assert wire.decode(wire.encode(msg)) == msg
+
+
+def test_non_array_payload_rides_json_escape_hatch():
+    msg = {"queries": [{"text": "hello"}, {"text": "world"}]}
+    frame = wire.encode(msg)
+    assert wire.is_frame(frame)
+    assert wire.decode(frame) == msg
+
+
+def test_decode_any_sniffs_legacy_json():
+    assert wire.decode_any(b'{"id": "x", "query": [1, 2]}') == {
+        "id": "x", "query": [1, 2]}
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_any(b"\xff\xfenot json not frame")
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda f: f[:3],                                   # shorter than magic
+    lambda f: f[:9],                                   # truncated header len
+    lambda f: f[:len(f) // 2],                         # truncated payload
+    lambda f: b"\xabRWF" + bytes([99]) + f[5:],        # unknown version
+    lambda f: f[:10] + b"\xff" * 8 + f[18:],           # garbled header JSON
+    lambda f: f[:6] + (2 ** 31 - 1).to_bytes(4, "little") + f[10:],  # huge H
+])
+def test_malformed_frames_raise_wire_format_error(mutate):
+    frame = wire.encode({"q": np.arange(32, dtype=np.float32)})
+    bad = mutate(frame)
+    with pytest.raises(wire.WireFormatError):
+        wire.decode(bad)
+
+
+def test_array_extent_out_of_range_rejected():
+    # hand-craft a frame whose table points past the payload
+    header = json.dumps(
+        {"b": {"\x00nd": 0}, "a": [["<f4", [1024], 0, 4096]]}).encode()
+    frame = (wire.MAGIC + bytes([wire.VERSION, 0])
+             + len(header).to_bytes(4, "little") + header + b"\x00" * 16)
+    with pytest.raises(wire.WireFormatError):
+        wire.decode(frame)
+
+
+@pytest.mark.parametrize("shape,nbytes", [
+    ([2 ** 32, 2 ** 32], 0),   # int64 product wraps to 0
+    ([2 ** 63, 2], 0),         # wraps negative in fixed-width arithmetic
+    ([-4], 16),                # negative dimension
+])
+def test_hostile_shape_arithmetic_is_typed(shape, nbytes):
+    """Overflow-crafted array tables must raise WireFormatError — the
+    one exception pop loops absorb — never a bare numpy ValueError that
+    would kill a worker/listener thread."""
+    header = json.dumps(
+        {"b": {"\x00nd": 0}, "a": [["<f4", shape, 0, nbytes]]}).encode()
+    frame = (wire.MAGIC + bytes([wire.VERSION, 0])
+             + len(header).to_bytes(4, "little") + header + b"\x00" * 32)
+    with pytest.raises(wire.WireFormatError):
+        wire.decode(frame)
+
+
+def test_fuzzed_byte_flips_never_escape_wire_format_error():
+    rng = np.random.default_rng(7)
+    frame = bytearray(wire.encode(
+        {"ids": ["a"], "qarr": rng.normal(size=(1, 64)).astype(np.float32)}))
+    for _ in range(300):
+        bad = bytearray(frame)
+        for _ in range(rng.integers(1, 6)):
+            bad[rng.integers(0, len(bad))] ^= int(rng.integers(1, 256))
+        try:
+            wire.decode(bytes(bad))
+        except wire.WireFormatError:
+            pass  # the ONLY acceptable failure type
+
+
+# ---------------------------------------------------------------------------
+# shm broker integration (needs the native toolchain)
+# ---------------------------------------------------------------------------
+
+needs_native = pytest.mark.skipif(
+    not shm_queue.available(), reason="no native toolchain")
+
+
+def _echo_worker(wq, rounds=200):
+    def loop():
+        for _ in range(rounds):
+            batch = wq.take_batch(max_size=16, deadline_s=0.0,
+                                  wait_timeout_s=0.1)
+            if batch is None:
+                return
+            for handle, query in batch:
+                handle.set_result(
+                    np.asarray(query, dtype=np.float32).sum().item()
+                    if not isinstance(query, dict) else {"echo": query})
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+@needs_native
+def test_shm_binary_frames_end_to_end():
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("jobw", "w1")
+        t = _echo_worker(wq)
+        proxy = broker.get_worker_queues("jobw")["w1"]
+        rows = [np.full((8,), float(i), np.float32) for i in range(5)]
+        futs = proxy.submit_many(rows)
+        got = [f.result(timeout=10.0) for f in futs]
+        assert got == [pytest.approx(8.0 * i) for i in range(5)]
+        t.join(timeout=5)
+    finally:
+        broker.close()
+
+
+@needs_native
+def test_mixed_version_interop_json_submitter_binary_worker(monkeypatch):
+    """A JSON-framing submitter (RAFIKI_WIRE_BINARY=0 — the stand-in for
+    an old-version peer) against a binary-capable worker still completes
+    predictions, and vice versa: responses echo the request's framing,
+    so a JSON submitter's listener only ever sees JSON."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("jobm", "w1")
+        t = _echo_worker(wq)
+        proxy = broker.get_worker_queues("jobm")["w1"]
+        # leg 1: binary submitter
+        fut = proxy.submit(np.ones((4,), np.float32))
+        assert fut.result(timeout=10.0) == pytest.approx(4.0)
+        # leg 2: JSON-framing submitter against the same binary worker —
+        # BOTH payload shapes (the ndarray one regressed once: a JSON-
+        # framed stack must not masquerade as a binary qarr)
+        monkeypatch.setenv("RAFIKI_WIRE_BINARY", "0")
+        fut = proxy.submit({"n": 1})
+        assert fut.result(timeout=10.0) == {"echo": {"n": 1}}
+        fut = proxy.submit(np.full((4,), 2.0, np.float32))
+        assert fut.result(timeout=10.0) == pytest.approx(8.0)
+        t.join(timeout=5)
+    finally:
+        monkeypatch.delenv("RAFIKI_WIRE_BINARY", raising=False)
+        broker.close()
+
+
+@needs_native
+def test_legacy_per_query_messages_still_served():
+    """The pre-codec wire format — one {"id", "query"} JSON message per
+    query, pushed raw — must still be decoded and answered (in JSON) by
+    a current worker: that IS the old-submitter interop path. Raw rings,
+    no broker: a broker listener on the response ring would race this
+    test's pop."""
+    from rafiki_tpu.cache.shm_broker import ShmWorkerQueue
+
+    qq = shm_queue.ShmMessageQueue(shm_queue.make_queue_name("legq"))
+    rq = shm_queue.ShmMessageQueue(shm_queue.make_queue_name("legr"))
+    try:
+        wq = ShmWorkerQueue(qq, rq)
+        qq.push(json.dumps({"id": "legacy1", "query": {"n": 7}}).encode())
+        batch = wq.take_batch(max_size=8, deadline_s=0.0, wait_timeout_s=2.0)
+        assert len(batch) == 1
+        for handle, query in batch:
+            handle.set_result({"echo": query})
+        raw = rq.pop(timeout_s=5.0)
+        assert raw is not None
+        assert not wire.is_frame(raw)  # JSON in -> JSON out
+        assert json.loads(raw) == {
+            "id": "legacy1", "result": {"echo": {"n": 7}}}
+    finally:
+        qq.destroy()
+        rq.destroy()
+
+
+@needs_native
+def test_oversized_frame_is_typed_and_non_retryable():
+    """An over-ring-capacity request maps to FrameTooLargeError (a
+    permanent, 413-class refusal), NOT the retryable QueueFullError, and
+    releases its depth reservation so the replica is not poisoned."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker(queue_capacity=1 << 14)  # 16 KiB ring
+    try:
+        broker.register_worker("jobo", "w1")
+        proxy = broker.get_worker_queues("jobo")["w1"]
+        big = np.zeros((1 << 15,), np.float32)  # 128 KiB frame
+        with pytest.raises(FrameTooLargeError):
+            proxy.submit_many([big])
+        assert proxy.depth() == 0  # reservation released
+        # and the queue still serves normal traffic afterwards
+        wq_proxy_ok = proxy.submit(np.ones((4,), np.float32))
+        wq = broker.get_worker_queues("jobo")["w1"]
+        assert wq is not None and wq_proxy_ok is not None
+    finally:
+        broker.close()
+
+
+@needs_native
+def test_oversized_frame_maps_to_413_at_the_door():
+    """FrameTooLargeError is ValueError-shaped but must reach the door
+    as its own 413, distinct from the 429 shed contract."""
+    import urllib.request
+
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+    from rafiki_tpu.predictor.predictor import Predictor
+    from rafiki_tpu.predictor.server import PredictorServer
+
+    broker = ShmBroker(queue_capacity=1 << 14)
+    server = None
+    try:
+        broker.register_worker("jobd", "w1")
+        predictor = Predictor("jobd", broker, task=None)
+        server = PredictorServer(predictor, "doorapp", auth=False).start()
+        import io
+
+        import numpy as _np
+
+        buf = io.BytesIO()
+        _np.save(buf, _np.zeros((2, 1 << 14), _np.float32),
+                 allow_pickle=False)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/predict", data=buf.getvalue(),
+            method="POST", headers={"Content-Type": "application/x-npy"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 413
+        assert b"ring" in ei.value.read().lower()
+    finally:
+        if server is not None:
+            server.stop(drain_timeout_s=0.0)
+        broker.close()
+
+
+@needs_native
+@pytest.mark.chaos
+def test_corrupt_query_frame_is_typed_error_never_a_crash():
+    """RAFIKI_CHAOS site=wire: a garbled query frame costs the request a
+    typed TimeoutError at its SLO; the worker loop survives and serves
+    the NEXT request fine."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker, _qname
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("jobc", "w1")
+        t = _echo_worker(wq)
+        qname = _qname(broker.prefix, "q", "jobc", "w1")
+        chaos.install(chaos.parse_rules(
+            f"site=wire;action=corrupt;match={qname};times=1"))
+        proxy = broker.get_worker_queues("jobc")["w1"]
+        fut = proxy.submit(np.ones((4,), np.float32))
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=0.7)
+        # worker survived the corrupt frame: the next request is served
+        fut2 = proxy.submit(np.full((4,), 2.0, np.float32))
+        assert fut2.result(timeout=10.0) == pytest.approx(8.0)
+        assert wq.stats()["wire_errors"] == 1
+        t.join(timeout=5)
+    finally:
+        chaos.clear()
+        broker.close()
+
+
+@needs_native
+@pytest.mark.chaos
+def test_corrupt_response_frame_is_absorbed_by_listener():
+    """Corruption on the RESPONSE ring: the listener drops the frame and
+    keeps running; the request resolves with its typed SLO timeout and
+    later responses still resolve."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker, _qname
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("jobr", "w1")
+        t = _echo_worker(wq)
+        rname = _qname(broker.prefix, "r", "jobr")
+        chaos.install(chaos.parse_rules(
+            f"site=wire;action=corrupt;match={rname};times=1"))
+        proxy = broker.get_worker_queues("jobr")["w1"]
+        fut = proxy.submit(np.ones((4,), np.float32))
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=0.7)
+        fut2 = proxy.submit(np.full((4,), 3.0, np.float32))
+        assert fut2.result(timeout=10.0) == pytest.approx(12.0)
+        assert broker.wire_errors == 1
+        t.join(timeout=5)
+    finally:
+        chaos.clear()
+        broker.close()
+
+
+@needs_native
+def test_ring_capacity_env_knob_and_high_water(monkeypatch):
+    """RAFIKI_SHM_RING_BYTES sizes new rings; used_bytes_hw records the
+    push-side occupancy high-water mark in queue stats."""
+    monkeypatch.setenv("RAFIKI_SHM_RING_BYTES", str(1 << 15))
+    q = shm_queue.ShmMessageQueue(shm_queue.make_queue_name("whw"))
+    try:
+        assert q.capacity == 1 << 15
+        assert q.stats()["used_bytes_hw"] == 0
+        q.push(b"x" * 1000)
+        q.push(b"y" * 3000)
+        hw = q.stats()["used_bytes_hw"]
+        assert hw >= 4000  # both messages resident at the second push
+        q.pop(timeout_s=1.0)
+        q.pop(timeout_s=1.0)
+        assert q.stats()["used_bytes"] == 0
+        assert q.stats()["used_bytes_hw"] == hw  # the mark is sticky
+    finally:
+        q.destroy()
+
+
+def test_decodable_but_malformed_query_fields_are_typed():
+    """A frame that decodes cleanly but carries hostile field types
+    (non-numeric deadline, non-string ids) must raise WireFormatError —
+    the one exception the worker loop absorbs — never a stray
+    ValueError/TypeError that would kill the replica."""
+    from rafiki_tpu.cache.shm_broker import _decode_query_frame
+
+    bad_frames = [
+        {"id": "x", "query": 1, "deadline": "soon"},
+        {"id": 7, "query": 1},
+        {"ids": ["a", 3], "queries": [1, 2]},
+        {"ids": "ab", "queries": [1, 2]},
+        {"ids": ["a"], "qarr": 5},
+        {"ids": ["a"], "queries": {"0": 1}},
+    ]
+    for msg in bad_frames:
+        with pytest.raises(wire.WireFormatError):
+            _decode_query_frame(json.dumps(msg).encode())
+    # and a JSON-framed qarr (nested lists) is legal: rows stay rows
+    entries, _ = _decode_query_frame(json.dumps(
+        {"ids": ["a", "b"], "qarr": [[1.0], [2.0]]}).encode())
+    assert [q for _, q, _ in entries] == [[1.0], [2.0]]
+
+
+@needs_native
+def test_decodable_but_malformed_response_frames_are_typed():
+    """Same contract on the response listener: results-as-dict,
+    non-string ids etc. must be the typed WireFormatError the listener
+    absorbs, or one bad message kills the job's listener thread."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker()
+    try:
+        for msg in [
+            {"ids": ["a"], "results": {"0": 1}},
+            {"ids": [3], "results": [1]},
+            {"ids": ["a"], "results": [1], "errors": "nope"},
+            {"id": 9, "result": 1},
+            {"ids": ["a"]},
+            [1, 2, 3],
+        ]:
+            with pytest.raises(wire.WireFormatError):
+                broker._resolve_response("jobz", msg)
+    finally:
+        broker.close()
+
+
+@needs_native
+def test_short_prediction_batch_delivers_partials_and_types_the_rest():
+    """A model that returns fewer predictions than queries must still
+    deliver the computed ones and fail the unmatched futures with a
+    typed error IMMEDIATELY — the per-frame response flush only fires
+    once every id resolves, so a dropped future would strand the whole
+    request (computed results included) until the SLO."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+    from rafiki_tpu.worker.inference import _resolve_batch
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("jobs", "w1")
+
+        def short_worker():
+            batch = wq.take_batch(max_size=8, deadline_s=0.1,
+                                  wait_timeout_s=5.0)
+            futures = [f for f, _ in batch]
+            # buggy model: one prediction for a 3-query batch
+            _resolve_batch(futures, [42.0], "svc")
+
+        t = threading.Thread(target=short_worker, daemon=True)
+        t.start()
+        proxy = broker.get_worker_queues("jobs")["w1"]
+        futs = proxy.submit_many([1, 2, 3])
+        assert futs[0].result(timeout=10.0) == 42.0  # delivered, not stranded
+        for fut in futs[1:]:
+            with pytest.raises(RuntimeError, match="1 predictions for 3"):
+                fut.result(timeout=10.0)
+        t.join(timeout=5)
+    finally:
+        broker.close()
+
+
+@needs_native
+def test_owner_side_ring_high_water_reaches_healthz():
+    """The query ring is pushed OWNER-side, so its used_bytes_hw sizing
+    signal must be readable where it is measured: Predictor.queue_stats
+    -> the serving door's /healthz `queues` section."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+    from rafiki_tpu.predictor.predictor import Predictor
+
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("jobh", "w1")
+        t = _echo_worker(wq)
+        proxy = broker.get_worker_queues("jobh")["w1"]
+        proxy.submit(np.ones((64,), np.float32)).result(timeout=10.0)
+        stats = Predictor("jobh", broker, task=None).queue_stats()
+        assert stats["w1"]["ring_used_bytes_hw"] > 0
+        assert stats["w1"]["ring_capacity"] > 0
+        t.join(timeout=5)
+    finally:
+        broker.close()
+
+
+def test_chaos_corrupt_rule_validation():
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_rules("site=agent;action=corrupt")
+    rules = chaos.parse_rules("site=wire;action=corrupt;times=2")
+    assert rules[0].site == chaos.SITE_WIRE
+
+
+# ---------------------------------------------------------------------------
+# fleet relay negotiation: binary only after the peer advertises it
+# ---------------------------------------------------------------------------
+
+def test_relay_stays_json_for_peer_without_wire_advertisement():
+    """An agent whose /healthz does NOT advertise wire_versions (an
+    old version) must keep receiving JSON relay bodies — the probe, not
+    hope, decides the format."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from rafiki_tpu.cache.fleet import HttpWorkerQueue
+    from rafiki_tpu.utils.agent_http import reset_breaker
+
+    seen = {"ctypes": []}
+
+    class OldAgent(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = _json.dumps({"host": "old", "status": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            raw = self.rfile.read(
+                int(self.headers.get("Content-Length") or 0))
+            seen["ctypes"].append(self.headers.get("Content-Type"))
+            queries = _json.loads(raw)["queries"]  # JSON or the test fails
+            body = _json.dumps(
+                {"predictions": [q for q in queries]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), OldAgent)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    reset_breaker(addr)
+    q = HttpWorkerQueue(addr, "jobx", "w1")
+    try:
+        fut = q.submit(np.ones((4,), np.float32))
+        # jsonutil framing: the ndarray went over as float text
+        assert fut.result(timeout=10.0) == [1.0, 1.0, 1.0, 1.0]
+        assert seen["ctypes"] == ["application/json"]
+    finally:
+        q.close()
+        httpd.shutdown()
+        httpd.server_close()
