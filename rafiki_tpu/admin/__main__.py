@@ -44,8 +44,12 @@ def main() -> int:
     port = int(os.environ.get("RAFIKI_ADMIN_PORT", "3000"))
     server = AdminServer(admin, host=host, port=port).start()
     placement = type(admin.placement).__name__
+    rec = admin.recovery_status()
+    rec_note = ("" if rec.get("state") == "ready" and not rec.get("scanned")
+                else f", recovery={rec.get('state')}")
     print(f"rafiki_tpu admin on http://{host}:{server.port} "
-          f"(db={admin.db.path}, placement={placement})", flush=True)
+          f"(db={admin.db.path}, placement={placement}{rec_note})",
+          flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -62,7 +62,16 @@ class Admin:
         db: Optional[Database] = None,
         placement: Optional[LocalPlacementManager] = None,
         params_dir: Optional[str] = None,
+        recover: bool = True,
     ):
+        """``recover`` (default on) makes boot idempotent on an existing
+        store: non-terminal jobs/services left by a crashed admin are
+        reconciled against what is actually running — adopt / reschedule /
+        fence / error (admin/recovery.py; docs/failure-model.md
+        "Control-plane faults"). The snapshot is taken synchronously here
+        (state created after this constructor is never touched); the
+        reconciliation itself runs off-thread behind a ``recovering ->
+        ready`` state the HTTP doors gate on."""
         self.db = db or Database()
         self.advisor_store = AdvisorStore()
         # predict hot path: (user, app, version) -> (ts, Predictor); the
@@ -114,6 +123,14 @@ class Admin:
                 db=self.db,
                 broker=self.broker,
                 on_status=self._on_service_status,
+                # admin-embedded engine: TRAIN children outlive an admin
+                # crash so boot reconciliation can adopt them by pid
+                # (worker/bootstrap.py orphan watchdog; admin/recovery.py).
+                # NOT in hosts mode: there this engine is only a fallback,
+                # recovery adopts via agents and deliberately never by
+                # local pid — a surviving child would just double-run its
+                # rescheduled service id.
+                orphan_survivable=(placement_mode != "hosts"),
             )
             if placement_mode == "hosts":
                 # multi-host: train AND inference go to per-host agents
@@ -153,6 +170,52 @@ class Admin:
             params_dir=params_dir,
         )
         self._seed_superadmin()
+        # -- control-plane crash recovery (admin/recovery.py) -------------
+        self._recovery: Dict[str, Any] = {"state": "ready"}
+        self._recovery_thread: Optional[threading.Thread] = None
+        self._recovery_runner = None
+        if recover:
+            from rafiki_tpu.admin.recovery import ControlPlaneRecovery
+
+            rec = ControlPlaneRecovery(self)
+            # the scan runs HERE, synchronously: the to-reconcile set is
+            # frozen before the constructor returns, so jobs created on
+            # this fresh admin can never race the reconciler
+            snapshot = rec.snapshot()
+            if rec.needed(snapshot):
+                self._recovery = {"state": "recovering",
+                                  "started_at": time.time()}
+                self._recovery_runner = rec
+                self._recovery_thread = threading.Thread(
+                    target=self._run_recovery, args=(rec, snapshot),
+                    name="admin-recovery", daemon=True)
+                self._recovery_thread.start()
+            else:
+                self._recovery = rec.empty_report()
+
+    def _run_recovery(self, rec, snapshot) -> None:
+        try:
+            # run() absorbs reconcile failures into the report (state
+            # `ready`, failed=True, persisted for doctor) — the doors
+            # must open either way
+            self._recovery = rec.run(snapshot)
+        except Exception:
+            # belt for a bug in run() itself: never leave the doors 503ing
+            logger.exception("control-plane recovery failed")
+            self._recovery = {**rec.report, "state": "ready",
+                              "failed": True}
+
+    def recovery_status(self) -> Dict[str, Any]:
+        """The boot-reconciliation state/report (``recovering`` while the
+        off-thread pass runs; the HTTP doors 503 until ``ready``)."""
+        return dict(self._recovery)
+
+    def recovery_public(self) -> Dict[str, Any]:
+        """The unauthenticated slice of the recovery state: just enough
+        for a credential-less client to wait out a restarting admin. The
+        full report (counts, per-service reasons, agent addresses) stays
+        behind the admin-rights GET /fleet/health."""
+        return {"state": self._recovery.get("state", "ready")}
 
     # -- users ---------------------------------------------------------------
 
@@ -545,8 +608,12 @@ class Admin:
         trial = self.db.get_trial(trial_id)
         if trial is None or not trial.get("params_file_path"):
             raise InvalidRequestError(f"No params for trial {trial_id}")
-        with open(trial["params_file_path"], "rb") as f:
-            return f.read()
+        from rafiki_tpu.sdk.artifact import read_artifact
+
+        # verified read: a damaged params file surfaces as the typed
+        # ArtifactCorruptError (a clean error at the door) — the raw
+        # payload handed to clients stays plain msgpack either way
+        return read_artifact(trial["params_file_path"])
 
     @staticmethod
     def _trial_view(trial: Dict) -> Dict:
@@ -840,6 +907,10 @@ class Admin:
             "agents": agents,
             "agents_down": down,
             "chaos_active": _chaos.enabled(),
+            # boot-reconciliation outcome (admin/recovery.py): state is
+            # `recovering` while the off-thread pass runs — the HTTP
+            # doors 503 until it reads `ready`
+            "recovery": self.recovery_status(),
             "serving": {
                 "jobs": jobs,
                 "admission": self._predict_admission.stats(),
@@ -861,10 +932,12 @@ class Admin:
         ):
             self.services.stop_train_services(job["id"])
             self.db.mark_train_job_as_stopped(job["id"])
-        # sweep any stragglers (e.g. services of already-errored jobs)
-        for svc in self.db.get_services():
-            if svc["status"] in ("STARTED", "DEPLOYING", "RUNNING"):
-                self.services._destroy_service(svc["id"], wait=False)
+        # sweep any stragglers (e.g. services of already-errored jobs) —
+        # the status filter runs in SQL against idx_service_status, not
+        # as a full-table python sweep
+        for svc in self.db.get_services(
+                statuses=["STARTED", "DEPLOYING", "RUNNING"]):
+            self.services._destroy_service(svc["id"], wait=False)
 
     # -- events ------------------------------------------------------------------
 
@@ -935,6 +1008,13 @@ class Admin:
                     self._drop_predict_routes(iworker["inference_job_id"])
 
     def shutdown(self) -> None:
+        # a reconcile racing a shutdown would resurrect services the stop
+        # below is about to tear down: signal it to ABORT (it checks at
+        # every loop top and inside retry backoffs), then join it out
+        if self._recovery_runner is not None:
+            self._recovery_runner.abort()
+        if self._recovery_thread is not None:
+            self._recovery_thread.join(timeout=30)
         self.stop_all_jobs()
         if hasattr(self.placement, "stop_all"):
             self.placement.stop_all()

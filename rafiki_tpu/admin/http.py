@@ -33,6 +33,7 @@ from rafiki_tpu.predictor.admission import (
     ServerOverloadedError,
     retry_after_headers,
 )
+from rafiki_tpu.sdk.artifact import ArtifactCorruptError
 from rafiki_tpu.sdk.model import InvalidModelClassError
 from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
 from rafiki_tpu.utils.reqfields import LowLatencyHandler, read_bounded_body
@@ -160,8 +161,13 @@ class AdminServer:
             return (method, re.compile(f"^{pattern}$"), allowed, fn)
 
         return [
+            # recovery STATE rides the public root so any client can wait
+            # out a restarting admin without credentials (the full report
+            # — ids, agent addresses, failure reasons — needs the
+            # admin-rights /fleet/health)
             r("GET", "/", "public", lambda au, m, b, q: {
-                "name": "rafiki_tpu admin", "status": "ok"}),
+                "name": "rafiki_tpu admin", "status": "ok",
+                "recovery": A.recovery_public()}),
             r("POST", "/tokens", "public", lambda au, m, b, q: A.authenticate_user(
                 _field(b, "email"), _field(b, "password"))),
             # users
@@ -303,6 +309,31 @@ class AdminServer:
             if method == "GET" and path == "/web":
                 self._serve_web(handler)
                 return
+            # boot gate: while the control plane reconciles a crashed
+            # predecessor's state (admin/recovery.py), every route that
+            # could read or mutate half-reconciled state sheds with 503 +
+            # Retry-After. Allowed through: the public root (carries the
+            # recovery state), login, the fleet-health view, worker
+            # events (agents keep forwarding statuses DURING recovery),
+            # and the advisor routes — surviving train workers the
+            # reconcile is adopting keep proposing/reporting mid-trial,
+            # and the advisor store is fresh in-memory state, not part of
+            # what is being reconciled.
+            state = self.admin.recovery_status()
+            if state.get("state") == "recovering" and not (
+                    path == "/" or path == "/tokens"
+                    or path == "/fleet/health"
+                    or path.startswith("/event/")
+                    or path.startswith("/advisors")):
+                self._respond(
+                    handler, 503,
+                    {"error": "admin is recovering (boot reconciliation "
+                              "in progress); retry shortly",
+                     # state only: most gated routes are pre-auth, and
+                     # the full report carries internal ids/addresses
+                     "recovery": self.admin.recovery_public()},
+                    headers={"Retry-After": "1"})
+                return
             query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             body: Dict[str, Any] = {}
             raw, berr = read_bounded_body(
@@ -346,6 +377,10 @@ class AdminServer:
             # friends from inside Admin stay genuine 500s instead of being
             # masked as client errors with internal text echoed back
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
+        except ArtifactCorruptError as e:
+            # a damaged on-disk artifact (params/checkpoint): the client
+            # gets the typed error cleanly, never a deserialize traceback
+            self._respond(handler, 500, {"error": f"{type(e).__name__}: {e}"})
         except FrameTooLargeError as e:
             # the request's wire frame exceeds the shm ring: permanent for
             # this payload — 413, never the retryable 429

@@ -414,6 +414,92 @@ class ServicesManager:
             self._db.mark_inference_job_as_errored(inference_job_id)
             raise
 
+    # -- control-plane crash recovery (admin/recovery.py) --------------------
+
+    def adopt_inference_job(self, inference_job_id: str) -> Optional[Predictor]:
+        """Rebuild the in-process serving head for an inference job whose
+        replicas survived an admin restart: a fresh Predictor over the
+        worker queues the recovery pass already re-registered with the
+        broker, plus a rebound PredictorServer when the deployment uses
+        per-job ports. predict() then answers WITHOUT a redeploy; the
+        predict-route cache repopulates lazily on first use."""
+        inf = self._db.get_inference_job(inference_job_id)
+        if inf is None:
+            return None
+        train_job = self._db.get_train_job(inf["train_job_id"])
+        if train_job is None:
+            return None
+        budget = inf.get("budget") or {}
+        fused = bool(budget.get(BudgetType.ENSEMBLE_FUSED, 0))
+        group = f"fused:{inference_job_id}" if fused else None
+        worker_trials = {
+            w["service_id"]: (group or w["trial_id"])
+            for w in self._db.get_workers_of_inference_job(inference_job_id)
+        }
+        predictor = Predictor(
+            inference_job_id, self._broker, train_job["task"],
+            worker_trials=worker_trials,
+        )
+        with self._lock:
+            self._predictors[inference_job_id] = predictor
+            # idempotency: recovery retries this method on transient
+            # store faults — a server bound by an earlier attempt must be
+            # closed, not leaked as a stale listener
+            stale_psrv = self._predict_servers.pop(inference_job_id, None)
+        if stale_psrv is not None:
+            stale_psrv.stop(drain_timeout_s=0.0)
+        psid = inf.get("predictor_service_id")
+        if config.PREDICTOR_PORTS:
+            from rafiki_tpu.predictor.server import PredictorServer
+
+            psrv = PredictorServer(
+                predictor, train_job["app"],
+                host=config.PREDICTOR_HOST).start()
+            with self._lock:
+                self._predict_servers[inference_job_id] = psrv
+            if psid:
+                # the dedicated door moved with the new admin process:
+                # republish its host:port
+                self._db.update_service_host_port(psid, psrv.host, psrv.port)
+        if psid:
+            # the predictor head lives again — in THIS process
+            self._db.mark_service_as_running(psid)
+        self._db.mark_inference_job_as_running(inference_job_id)
+        return predictor
+
+    def restart_train_worker(self, service_id: str, sub_train_job_id: str,
+                             n_chips: int = 0) -> bool:
+        """Relaunch a train executor under its EXISTING service id after
+        a control-plane restart on a single-host placement (the executor
+        threads died with the old admin process). The stale-RUNNING-trial
+        resume in worker/train.py then re-runs exactly the trials the
+        dead executor left behind. Best-effort chips: a busy grant must
+        downgrade the executor, not error the job a second time."""
+        worker = TrainWorker(
+            sub_train_job_id,
+            self._db,
+            self._advisors,
+            send_event=self._send_event,
+            params_dir=self._params_dir,
+        )
+        try:
+            ctx = self._placement.create_service(
+                service_id, ServiceType.TRAIN, worker.start,
+                n_chips=n_chips,
+                best_effort_chips=True,
+                extra={"sub_train_job_id": sub_train_job_id},
+            )
+        except Exception:
+            logger.exception("restarting train worker %s failed",
+                             service_id[:8])
+            return False
+        try:
+            self._db.update_service_chips(service_id, ctx.chips)
+        except Exception:
+            logger.exception("chip bookkeeping failed for restarted %s",
+                             service_id[:8])
+        return True
+
     def get_predictor(self, inference_job_id: str) -> Optional[Predictor]:
         with self._lock:
             return self._predictors.get(inference_job_id)

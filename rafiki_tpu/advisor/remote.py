@@ -8,14 +8,62 @@ that API to the in-process `AdvisorStore` interface the TrainWorker consumes
 — so parallel worker *processes* of one sub-train-job still coordinate
 through the single shared GP (the fix for reference train.py:213's
 uncoordinated parallel HPO carries over to multi-process placement).
+
+Control-plane crash tolerance: the admin may die and restart UNDER a
+running worker (docs/failure-model.md "Control-plane faults" — the worker
+is exactly what boot reconciliation adopts). Advisor calls therefore ride
+out transport failures and the recovering-503 with bounded backoff
+(``RAFIKI_ADVISOR_RETRY_S``, default 60 s; 0 disables) instead of
+erroring the executor on the first connection-refused.
 """
 
 from __future__ import annotations
 
+import logging
+import os
+import random
+import time
 from typing import Any, Dict, Optional
 
-from rafiki_tpu.client.client import Client
+import requests
+
+from rafiki_tpu.client.client import AdminRecoveringError, Client
 from rafiki_tpu.sdk.knob import serialize_knob_config
+
+logger = logging.getLogger(__name__)
+
+
+def _retry_window_s() -> float:
+    return float(os.environ.get("RAFIKI_ADVISOR_RETRY_S", "60"))
+
+
+def _ride_out(fn, what: str):
+    """Run one advisor API call, riding out a dead/restarting admin:
+    transport failures and the recovering 503 retry with jittered backoff
+    until the window closes, then the last error propagates (the worker's
+    own crash handling takes over).
+
+    Retrying the mutating calls is a deliberate tradeoff: a request whose
+    response was lost AFTER the admin applied it re-applies on retry. A
+    duplicate GP observation is tolerable noise (worker/train.py makes
+    the same call on its replay path), and ASHA rung reports are
+    idempotent per (trial, rung) (advisor/asha.py records each rung
+    once) — whereas NOT retrying kills the executor on the first
+    connection blip, which is the failure this wrapper exists to stop."""
+    deadline = time.monotonic() + _retry_window_s()
+    delay = 0.2
+    while True:
+        try:
+            return fn()
+        except (requests.RequestException, AdminRecoveringError) as e:
+            if time.monotonic() >= deadline:
+                raise
+            logger.warning(
+                "advisor call %s failed (%s: %s); admin may be "
+                "restarting — retrying for up to RAFIKI_ADVISOR_RETRY_S",
+                what, type(e).__name__, e)
+            time.sleep(delay * random.uniform(0.5, 1.5))
+            delay = min(delay * 2, 5.0)
 
 
 class _RemoteAdvisor:
@@ -26,7 +74,10 @@ class _RemoteAdvisor:
         self._id = advisor_id
 
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
-        self._client.feedback_knobs(self._id, knobs, float(score))
+        _ride_out(
+            lambda: self._client.feedback_knobs(self._id, knobs,
+                                                float(score)),
+            "feedback")
 
 
 class RemoteAdvisorStore:
@@ -38,28 +89,39 @@ class RemoteAdvisorStore:
 
     def create_advisor(self, knob_config: Dict[str, Any],
                        advisor_id: Optional[str] = None) -> str:
-        return self._client.create_advisor(
-            serialize_knob_config(knob_config), advisor_id=advisor_id)
+        return _ride_out(
+            lambda: self._client.create_advisor(
+                serialize_knob_config(knob_config), advisor_id=advisor_id),
+            "create_advisor")
 
     def propose(self, advisor_id: str) -> Dict[str, Any]:
-        return self._client.propose_knobs(advisor_id)
+        return _ride_out(
+            lambda: self._client.propose_knobs(advisor_id), "propose")
 
     def feedback(self, advisor_id: str, knobs: Dict[str, Any],
                  score: float) -> Dict[str, Any]:
-        return self._client.feedback_knobs(advisor_id, knobs, float(score))
+        return _ride_out(
+            lambda: self._client.feedback_knobs(advisor_id, knobs,
+                                                float(score)),
+            "feedback")
 
     def get(self, advisor_id: str) -> _RemoteAdvisor:
         return _RemoteAdvisor(self._client, advisor_id)
 
     def replay_feedback(self, advisor_id: str, items) -> bool:
-        return self._client.replay_advisor_feedback(advisor_id, items)
+        return _ride_out(
+            lambda: self._client.replay_advisor_feedback(advisor_id, items),
+            "replay_feedback")
 
     def report_rung(self, advisor_id: str, trial_id: str, resource: int,
                     value: float, min_resource: int = 1, eta: int = 3,
                     mode: str = "min") -> bool:
-        return self._client.report_rung(
-            advisor_id, trial_id, resource, value,
-            min_resource=min_resource, eta=eta, mode=mode)
+        return _ride_out(
+            lambda: self._client.report_rung(
+                advisor_id, trial_id, resource, value,
+                min_resource=min_resource, eta=eta, mode=mode),
+            "report_rung")
 
     def delete_advisor(self, advisor_id: str) -> None:
+        # teardown is best-effort: never worth stalling a stop on
         self._client.delete_advisor(advisor_id)

@@ -24,6 +24,13 @@ class RafikiError(Exception):
     pass
 
 
+class AdminRecoveringError(RafikiError):
+    """The admin answered 503 because its boot reconciliation (control-
+    plane crash recovery) is still running. Retryable: poll
+    :meth:`Client.wait_until_admin_ready` or just retry after the
+    ``Retry-After`` interval."""
+
+
 class Client:
     def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000):
         self._base = f"http://{admin_host}:{admin_port}"
@@ -67,6 +74,12 @@ class Client:
         except ValueError:
             raise RafikiError(f"Bad response ({resp.status_code}): {resp.text}")
         if resp.status_code != 200:
+            if resp.status_code == 503 and isinstance(payload, dict) \
+                    and "recovery" in payload:
+                # the admin restarted and is still reconciling its store
+                # (admin/recovery.py): typed, so callers can wait it out
+                raise AdminRecoveringError(
+                    payload.get("error", "admin is recovering"))
             raise RafikiError(payload.get("error", f"HTTP {resp.status_code}"))
         return payload.get("data")
 
@@ -381,6 +394,36 @@ class Client:
         self._call("DELETE", f"/advisors/{advisor_id}")
 
     # -- misc --------------------------------------------------------------------
+
+    def get_fleet_health(self) -> Dict[str, Any]:
+        """Operator view: per-agent heartbeat/breaker state, the serving
+        overload picture, and the boot-reconciliation report (admin-rights
+        token required; GET /fleet/health)."""
+        return self._call("GET", "/fleet/health")
+
+    def wait_until_admin_ready(self, timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Block until a (re)starting admin finishes its boot
+        reconciliation (recovery state `ready` on the public root) —
+        no credentials needed, so deploy scripts can gate on it before
+        logging in. Returns the public recovery state ({"state": ...});
+        the full report lives behind :meth:`get_fleet_health`."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            try:
+                data = self._call("GET", "/")
+                rec = (data or {}).get("recovery") or {"state": "ready"}
+                if rec.get("state") != "recovering":
+                    return rec
+            except (RafikiError, requests.RequestException):
+                # not up yet (connection refused while the socket rebinds)
+                # or transient — keep polling
+                pass
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"admin still recovering after {timeout_s:.0f}s")
+            _time.sleep(0.1)
 
     def send_event(self, name: str, **payload: Any) -> None:
         self._call("POST", f"/event/{name}", payload)

@@ -133,6 +133,25 @@ _DYNAMIC_PATHS = {
     "PREDICT_HEDGE_SUPPRESS_DEPTH": lambda: _env_int(
         "RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH", PREDICT_MAX_BATCH_SIZE),
     "PREDICT_DRAIN_S": lambda: _env_float("RAFIKI_PREDICT_DRAIN_S", 5.0),
+    # -- control-plane crash recovery (docs/failure-model.md, "Control-
+    # plane faults"). A fresh Admin on an existing store reconciles the
+    # DB against what is actually running before opening its doors:
+    #   RAFIKI_RECOVER_ADOPT=1            0 = never adopt surviving
+    #                                     workers on restart; they are
+    #                                     fenced (stopped) and train
+    #                                     services rescheduled instead
+    #                                     (doctor WARNs while set)
+    #   RAFIKI_RECOVER_PROBE_TIMEOUT_S=5  per-agent inventory probe budget
+    #   RAFIKI_RECOVER_RETRY_MAX=4        metadata-store retries during
+    #                                     reconcile (bounded, jittered)
+    #   RAFIKI_RECOVER_RETRY_BACKOFF_S=0.2  backoff base for those retries
+    "RECOVER_ADOPT": lambda: os.environ.get(
+        "RAFIKI_RECOVER_ADOPT", "1") != "0",
+    "RECOVER_PROBE_TIMEOUT_S": lambda: _env_float(
+        "RAFIKI_RECOVER_PROBE_TIMEOUT_S", 5.0),
+    "RECOVER_RETRY_MAX": lambda: _env_int("RAFIKI_RECOVER_RETRY_MAX", 4),
+    "RECOVER_RETRY_BACKOFF_S": lambda: _env_float(
+        "RAFIKI_RECOVER_RETRY_BACKOFF_S", 0.2),
 }
 
 

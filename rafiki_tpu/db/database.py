@@ -35,6 +35,13 @@ from rafiki_tpu.constants import (
     TrainJobStatus,
     TrialStatus,
 )
+from rafiki_tpu.utils import chaos
+
+
+class MetadataStoreChaosError(RuntimeError):
+    """Chaos-injected transient store failure (RAFIKI_CHAOS site=db) —
+    the drillable stand-in for a flaky/contended metadata store during
+    control-plane recovery (docs/failure-model.md)."""
 
 # NOTE: tables are ordered so every REFERENCES target exists before its
 # referrer — PostgreSQL validates foreign keys at CREATE TABLE time
@@ -88,9 +95,11 @@ CREATE TABLE IF NOT EXISTS service (
     chips TEXT NOT NULL DEFAULT '[]',
     host TEXT,
     port INTEGER,
+    pid INTEGER,
     datetime_started REAL NOT NULL,
     datetime_stopped REAL
 );
+CREATE INDEX IF NOT EXISTS idx_service_status ON service(status);
 CREATE TABLE IF NOT EXISTS trial (
     id TEXT PRIMARY KEY,
     sub_train_job_id TEXT NOT NULL REFERENCES sub_train_job(id),
@@ -309,6 +318,11 @@ class Database:
     _MIGRATIONS = (
         # r5: inference jobs gained a serving budget (CHIPS_PER_WORKER)
         "ALTER TABLE inference_job ADD COLUMN budget TEXT",
+        # r6 (control-plane recovery): worker-process pid, so a restarted
+        # admin can adopt (or fence) surviving local children, plus an
+        # index backing the recovery scan's status predicate
+        "ALTER TABLE service ADD COLUMN pid INTEGER",
+        "CREATE INDEX IF NOT EXISTS idx_service_status ON service(status)",
     )
 
     def _migrate(self) -> None:
@@ -344,16 +358,34 @@ class Database:
 
     # -- low-level helpers -------------------------------------------------
 
+    @staticmethod
+    def _chaos(sql: str) -> None:
+        """RAFIKI_CHAOS site=db: deterministic transient-store faults,
+        injected before the statement reaches the backend (match =
+        the SQL text). `delay` models a slow store; `error`/`drop` raise
+        the typed transient failure callers retry on."""
+        rule = chaos.hit(chaos.SITE_DB, sql)
+        if rule is None:
+            return
+        if rule.action == chaos.ACTION_DELAY:
+            chaos.sleep_for(rule)
+            return
+        raise MetadataStoreChaosError(
+            f"chaos-injected metadata-store fault on {sql.split(None, 1)[0]}")
+
     def _exec(self, sql: str, args: tuple = ()) -> None:
+        self._chaos(sql)
         with self._lock:
             self._b.execute(sql, args)
 
     def _one(self, sql: str, args: tuple = ()) -> Optional[Dict[str, Any]]:
+        self._chaos(sql)
         with self._lock:
             row = self._b.execute(sql, args).fetchone()
         return self._b.to_dict(row) if row else None
 
     def _all(self, sql: str, args: tuple = ()) -> List[Dict[str, Any]]:
+        self._chaos(sql)
         with self._lock:
             rows = self._b.execute(sql, args).fetchall()
         return [self._b.to_dict(r) for r in rows]
@@ -928,14 +960,66 @@ class Database:
             s["chips"] = json.loads(s["chips"])
         return s
 
-    def get_services(self, status: Optional[str] = None) -> List[Dict]:
-        if status:
+    def get_services(self, status: Optional[str] = None,
+                     statuses: Optional[List[str]] = None) -> List[Dict]:
+        """Services, optionally filtered by one ``status`` or a
+        ``statuses`` list — the filter runs in SQL (against
+        idx_service_status), not as an O(N) python sweep at call sites."""
+        if statuses:
+            marks = ",".join("?" * len(statuses))
+            rows = self._all(
+                f"SELECT * FROM service WHERE status IN ({marks})",
+                tuple(statuses))
+        elif status:
             rows = self._all("SELECT * FROM service WHERE status=?", (status,))
         else:
             rows = self._all("SELECT * FROM service")
         for s in rows:
             s["chips"] = json.loads(s["chips"])
         return rows
+
+    def get_non_terminal_services(self) -> List[Dict]:
+        """The control-plane recovery scan, as ONE query: every service
+        row not yet terminal, joined to its job linkage — train worker
+        (sub_train_job_id / train_job_id / train_job_status), inference
+        worker (inference_job_id / trial_id / inference_job_status), and
+        predictor head (predictor_job_id / predictor_job_status) — so a
+        restarted admin never does per-service round trips while deciding
+        adopt vs reschedule vs fence (docs/failure-model.md)."""
+        live = (ServiceStatus.STARTED, ServiceStatus.DEPLOYING,
+                ServiceStatus.RUNNING)
+        marks = ",".join("?" * len(live))
+        rows = self._all(
+            "SELECT s.*,"
+            " tw.sub_train_job_id AS sub_train_job_id,"
+            " st.train_job_id AS train_job_id,"
+            " tj.status AS train_job_status,"
+            " iw.inference_job_id AS inference_job_id,"
+            " iw.trial_id AS trial_id,"
+            " ij.status AS inference_job_status,"
+            " pj.id AS predictor_job_id,"
+            " pj.status AS predictor_job_status"
+            " FROM service s"
+            " LEFT JOIN train_job_worker tw ON tw.service_id = s.id"
+            " LEFT JOIN sub_train_job st ON st.id = tw.sub_train_job_id"
+            " LEFT JOIN train_job tj ON tj.id = st.train_job_id"
+            " LEFT JOIN inference_job_worker iw ON iw.service_id = s.id"
+            " LEFT JOIN inference_job ij ON ij.id = iw.inference_job_id"
+            " LEFT JOIN inference_job pj ON pj.predictor_service_id = s.id"
+            f" WHERE s.status IN ({marks})",
+            live,
+        )
+        for s in rows:
+            s["chips"] = json.loads(s["chips"])
+        return rows
+
+    def update_service_pid(self, service_id: str,
+                           pid: Optional[int]) -> None:
+        """Record the worker process backing a service (process
+        placement), so a restarted control plane can adopt — or fence — a
+        child that survived it."""
+        self._exec(
+            "UPDATE service SET pid=? WHERE id=?", (pid, service_id))
 
     def update_service_chips(self, service_id: str, chips: List[int]) -> None:
         self._exec(

@@ -189,6 +189,87 @@ def check_overload_knobs() -> Check:
     return ("overload control", PASS, detail)
 
 
+def check_recovery() -> Check:
+    """Control-plane crash recovery (docs/failure-model.md): flag
+    non-terminal jobs with zero live services — the signature of a dead
+    admin that has not been restarted to reconcile them — report the last
+    reconcile outcome/duration, and WARN when the RAFIKI_RECOVER_* knobs
+    disable adoption (restarts will fence surviving workers instead)."""
+    from rafiki_tpu import config
+
+    notes = []
+    if not config.RECOVER_ADOPT:
+        notes.append("RAFIKI_RECOVER_ADOPT=0: restarts FENCE surviving "
+                     "workers instead of adopting them")
+    # last reconcile outcome, persisted by admin/recovery.py
+    last = None
+    try:
+        from rafiki_tpu.admin.recovery import report_path
+
+        with open(report_path()) as f:
+            last = json.load(f)
+    except (OSError, ValueError):
+        pass
+    failed = bool(last and last.get("failed"))
+    if last is not None:
+        notes.append(
+            f"last reconcile{' ABORTED' if failed else ''}: "
+            f"{last.get('duration_s', '?')}s — "
+            f"{last.get('adopted', 0)} adopted, "
+            f"{last.get('rescheduled', 0)} rescheduled, "
+            f"{last.get('fenced', 0)} fenced, "
+            f"{last.get('errored', 0)} errored"
+            + (f" ({last.get('error')})" if failed else ""))
+    target = str(config.DB_PATH)
+    orphaned = 0
+    is_url = target.startswith(("postgresql://", "postgres://"))
+    if is_url or os.path.exists(target):
+        try:
+            from rafiki_tpu.db.database import Database
+
+            import time as _time
+
+            # only jobs older than a deploy takes: a LIVE admin mid-deploy
+            # legitimately has a STARTED job whose worker rows don't exist
+            # yet, and that must not read as "restart your healthy admin"
+            min_age_s = 120.0
+            now = _time.time()
+            db = Database(target)
+            try:
+                jobs = db.get_train_jobs_by_statuses(
+                    ["STARTED", "RUNNING"])
+                inf_jobs = db.get_inference_jobs_by_statuses(
+                    ["STARTED", "RUNNING"])
+                live_services = {
+                    s["id"] for s in db.get_services(
+                        statuses=["STARTED", "DEPLOYING", "RUNNING"])}
+                for j in jobs + inf_jobs:
+                    if now - (j.get("datetime_started") or now) < min_age_s:
+                        continue
+                    get_workers = (
+                        db.get_workers_of_train_job
+                        if "app" in j else db.get_workers_of_inference_job)
+                    sids = {w["service_id"] for w in get_workers(j["id"])}
+                    if not (sids & live_services):
+                        orphaned += 1
+            finally:
+                db.close()
+        except Exception as e:
+            return ("crash recovery", WARN,
+                    f"could not scan {target}: {type(e).__name__}: {e}")
+    if orphaned:
+        notes.insert(0, f"{orphaned} non-terminal job(s) with ZERO live "
+                        "services — orphaned by a dead admin; restarting "
+                        "the admin reconciles them (adopt/reschedule/"
+                        "fence)")
+        return ("crash recovery", WARN, "; ".join(notes))
+    if failed or not config.RECOVER_ADOPT:
+        return ("crash recovery", WARN, "; ".join(notes))
+    return ("crash recovery", PASS,
+            "; ".join(notes) if notes else
+            "no orphaned jobs; adoption enabled")
+
+
 def check_agents() -> Check:
     from rafiki_tpu.utils.agent_http import AgentHTTPError, call_agent
 
@@ -256,7 +337,8 @@ def check_agents() -> Check:
 
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
-    check_chaos, check_overload_knobs, check_agents, check_backend,
+    check_chaos, check_overload_knobs, check_recovery, check_agents,
+    check_backend,
 ]
 
 

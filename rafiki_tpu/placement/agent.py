@@ -11,7 +11,10 @@ now standing behind a small HTTP API the admin's
 HostAgentPlacementManager (placement/hosts.py) drives:
 
     GET  /healthz              liveness
-    GET  /inventory            {host, total_chips, free_chips, n_services}
+    GET  /inventory            {host, total_chips, free_chips, n_services,
+                                services: [{service_id, service_type,
+                                status, chips, pid}]} — the running-set a
+                                restarted admin reconciles against
     POST /services             {service_id, service_type, n_chips,
                                 best_effort_chips, extra} -> {chips}
     POST /services/<id>/stop   {wait} -> {}
@@ -186,11 +189,17 @@ class AgentServer:
 
             if method == "GET" and path == "/inventory":
                 alloc = self.engine.allocator
+                # `services` enumerates what is ACTUALLY running on this
+                # host — the ground truth a restarted admin reconciles
+                # the metadata store against (adopt / reschedule / fence;
+                # docs/failure-model.md "Control-plane faults")
+                list_fn = getattr(self.engine, "list_services", None)
                 return self._respond(handler, 200, {
                     "host": self.hostname,
                     "total_chips": alloc.total_chips,
                     "free_chips": alloc.free_chips,
                     "n_services": len(self.engine._runners),
+                    "services": list_fn() if callable(list_fn) else [],
                 })
             if method == "POST" and path == "/services":
                 stype = body.get("service_type")

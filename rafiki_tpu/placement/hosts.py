@@ -390,12 +390,7 @@ class HostAgentPlacementManager(PlacementManager):
                 "extra": dict(extra or {}),
             }
             self._inventory_at = 0.0  # free-chip counts changed
-            if (self.db is not None and self._monitor is None
-                    and not self._closed.is_set()):
-                self._monitor = threading.Thread(
-                    target=self._monitor_loop, name="hosts-status-monitor",
-                    daemon=True)
-                self._monitor.start()
+            self._maybe_start_monitor_locked()
         logger.info("placed %s on agent %s (chips=%s)",
                     service_id[:8], addr, chips)
         return ServiceContext(
@@ -405,6 +400,162 @@ class HostAgentPlacementManager(PlacementManager):
             stop_event=threading.Event(),
             extra=dict(extra or {}),
         )
+
+    def _maybe_start_monitor_locked(self) -> None:
+        """Start the store-status monitor on first tracked service (must
+        hold ``self._lock``)."""
+        if (self.db is not None and self._monitor is None
+                and not self._closed.is_set()):
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="hosts-status-monitor",
+                daemon=True)
+            self._monitor.start()
+
+    # -- control-plane crash recovery (admin/recovery.py) ------------------
+
+    def probe_inventories(
+        self, timeout_s: Optional[float] = None
+    ) -> Dict[str, Optional[Dict[str, Any]]]:
+        """One bounded /inventory probe per registered agent: the
+        running-set the restart reconciliation diffs the store against.
+        Unreachable agents map to None (their services are dead-host
+        candidates: reschedule or error). Probes fan out concurrently —
+        the boot reconcile (and the 503'd HTTP doors behind it) must pay
+        ~one probe timeout for a partially-dead fleet, not one per dead
+        agent."""
+        if timeout_s is None:
+            timeout_s = float(config.RECOVER_PROBE_TIMEOUT_S)
+
+        def probe(item):
+            addr, handle = item
+            try:
+                return addr, call_agent(addr, "GET", "/inventory",
+                                        key=handle.key, timeout_s=timeout_s)
+            except Exception as e:
+                logger.warning("recovery probe of agent %s failed: %s",
+                               addr, e)
+                return addr, None
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        items = list(self.agents.items())
+        with ThreadPoolExecutor(
+                max_workers=max(1, min(len(items), 16)),
+                thread_name_prefix="recover-probe") as pool:
+            return dict(pool.map(probe, items))
+
+    def adopt_service(
+        self,
+        service_id: str,
+        addr: str,
+        service_type: str,
+        n_chips: int = 0,
+        extra: Optional[Dict[str, Any]] = None,
+        best_effort_chips: bool = False,
+    ) -> bool:
+        """Record a service ALREADY running on agent ``addr`` (admin
+        restart reconciliation) as if this manager had placed it: the
+        heartbeat failover, rejoin fencing, and store-status monitor then
+        cover it like any placed service. Inference workers get their
+        admin-side relay queue re-registered so the predictor fan-out
+        reaches them again without a redeploy."""
+        if addr not in self.agents:
+            return False
+        extra = dict(extra or {})
+        job_id = extra.get("inference_job_id")
+        if (service_type == ServiceType.INFERENCE and job_id
+                and self.broker is not None
+                and hasattr(self.broker, "register_remote_worker")):
+            self.broker.register_remote_worker(
+                job_id, service_id, addr, key=self.agents[addr].key)
+        with self._lock:
+            self._placed[service_id] = addr
+            if service_type == ServiceType.INFERENCE and job_id:
+                self._placed_jobs[service_id] = job_id
+            self._placed_specs[service_id] = {
+                "service_type": service_type,
+                "n_chips": n_chips,
+                "best_effort_chips": best_effort_chips,
+                "extra": extra,
+            }
+            self._inventory_at = 0.0
+            self._maybe_start_monitor_locked()
+        logger.info("adopted service %s on agent %s (control-plane "
+                    "restart)", service_id[:8], addr)
+        return True
+
+    def reschedule_service(
+        self,
+        service_id: str,
+        service_type: str,
+        n_chips: int = 0,
+        extra: Optional[Dict[str, Any]] = None,
+        best_effort_chips: bool = False,
+        exclude=(),
+    ) -> bool:
+        """Replay a service whose host died while the control plane was
+        down through the PR-1 failover path: least-loaded surviving
+        agent, SAME service id (so the replacement train worker resumes
+        its stale RUNNING trials). ``exclude`` lists agents that must not
+        receive the replay — above all the probe-unreachable ones: an
+        UNKNOWN-state agent that merely answered slowly may STILL be
+        running the old executor, and re-placing the same id onto it
+        would double-run the service (and the quarantine fence would
+        later kill the legitimate replacement)."""
+        return self._reschedule(
+            service_id,
+            {
+                "service_type": service_type,
+                "n_chips": n_chips,
+                "best_effort_chips": best_effort_chips,
+                "extra": dict(extra or {}),
+            },
+            dead="<admin-restart>",
+            exclude=exclude,
+        )
+
+    def quarantine_on_rejoin(self, addrs, service_id: str) -> None:
+        """Record that ``service_id`` was (or is about to be) re-placed
+        while these agents were unreachable (boot-reconciliation probe
+        failure): if one of them turns out to be alive — a slow probe,
+        not a crash — and is still running the old executor, the rejoin
+        fence stops it there, so one service id never keeps two live
+        executors. An agent ALREADY back UP is fenced immediately: its
+        UNKNOWN->UP fence sweep may have run before this record existed,
+        and it will not run again while the agent stays UP."""
+        fence_now = []
+        with self._lock:
+            for addr in addrs:
+                if addr not in self.agents:
+                    continue
+                h = self._health.get(addr)
+                if h is not None and h["state"] == AgentHealth.UP:
+                    fence_now.append(addr)
+                else:
+                    self._stripped.setdefault(addr, []).append(service_id)
+        for addr in fence_now:
+            self.fence_service(service_id, addr)
+
+    def fence_service(self, service_id: str, addr: str,
+                      wait: bool = False) -> bool:
+        """Stop an orphan on ``addr`` — a service still running whose job
+        was stopped/errored while the admin was down (same split-brain
+        rule as the rejoin fence: one service id, one live executor).
+        ``wait=True`` blocks until the executor actually exited — required
+        when the SAME service id is about to be re-placed (reschedule
+        after a disabled adoption), or the old and new executor would
+        briefly run concurrently."""
+        if addr not in self.agents:
+            return False
+        try:
+            self.agents[addr].stop_service(service_id, wait=wait)
+        except (AgentUnreachableError, InsufficientChipsError) as e:
+            logger.warning("could not fence orphan %s on %s (%s)",
+                           service_id[:8], addr, e)
+            return False
+        logger.warning("fenced orphan service %s on agent %s "
+                       "(control-plane restart)", service_id[:8], addr)
+        return True
 
     def destroy_service(self, service_id: str, wait: bool = True) -> None:
         with self._lock:
@@ -496,6 +647,7 @@ class HostAgentPlacementManager(PlacementManager):
     def _note_heartbeat(self, addr: str, alive: bool,
                         err: Optional[str]) -> None:
         went_down = came_up = False
+        was_down = False
         with self._lock:
             h = self._health.get(addr)
             if h is None:
@@ -505,7 +657,13 @@ class HostAgentPlacementManager(PlacementManager):
                 h["last_ok"] = time.monotonic()
                 h["last_error"] = err
                 if h["state"] != AgentHealth.UP:
-                    came_up = h["state"] == AgentHealth.DOWN
+                    # ANY transition into UP runs the rejoin fence — a
+                    # host that was unreachable only during this admin's
+                    # boot reconciliation enters as UNKNOWN->UP, and its
+                    # quarantined (re-placed) service ids must be fenced
+                    # exactly like a DOWN->UP rejoin
+                    was_down = h["state"] == AgentHealth.DOWN
+                    came_up = True
                     h["state"] = AgentHealth.UP
                     self._inventory_at = 0.0  # re-include immediately
             else:
@@ -520,7 +678,9 @@ class HostAgentPlacementManager(PlacementManager):
         # not stall failure detection for the other agents
         if came_up:
             reset_breaker(addr)
-            logger.warning("agent %s recovered; rejoining the fleet", addr)
+            if was_down:
+                logger.warning("agent %s recovered; rejoining the fleet",
+                               addr)
             threading.Thread(target=self._fence_rejoined, args=(addr,),
                              name=f"fence-{addr}", daemon=True).start()
         if went_down:
@@ -596,15 +756,17 @@ class HostAgentPlacementManager(PlacementManager):
             self._mark_errored(sid)
 
     def _reschedule(self, service_id: str, spec: Dict[str, Any],
-                    dead: str) -> bool:
+                    dead: str, exclude=()) -> bool:
         """Replay a dead host's train executor through the least-loaded
-        placement path, excluding every DOWN agent. The service keeps its
-        id, so the replacement worker's crash recovery resumes the trials
-        the dead one left RUNNING (worker/train.py)."""
+        placement path, excluding every DOWN agent (plus ``exclude``).
+        The service keeps its id, so the replacement worker's crash
+        recovery resumes the trials the dead one left RUNNING
+        (worker/train.py)."""
         with self._lock:
             tried = {a for a, h in self._health.items()
                      if h["state"] == AgentHealth.DOWN}
         tried.add(dead)
+        tried.update(exclude)
         while True:
             before = len(tried)
             try:

@@ -79,6 +79,14 @@ class ChipAllocator:
                     self._free.append(c)
             self._free.sort()
 
+    def claim(self, chips: List[int]) -> None:
+        """Mark SPECIFIC chip indices busy (control-plane recovery: an
+        adopted worker already holds its grant — the fresh allocator must
+        not hand those chips to anyone else). Indices not in this host's
+        inventory, or already busy, are ignored."""
+        with self._lock:
+            self._free = [c for c in self._free if c not in set(chips)]
+
 
 @dataclass
 class ServiceContext:
@@ -258,6 +266,28 @@ class LocalPlacementManager(PlacementManager):
             runner.thread.join(timeout=30)
         # chip release happens in the runner's exit hook, once the thread is
         # actually off the devices
+
+    def list_services(self) -> List[Dict[str, Any]]:
+        """Enumerate this host's LIVE executors — the inventory a
+        restarted control plane reconciles the store against
+        (placement/agent.py GET /inventory; docs/failure-model.md
+        "Control-plane faults"). Finished runners (their terminal rows
+        are already in the store) are not part of the running-set."""
+        with self._lock:
+            runners = dict(self._runners)
+        return [
+            {
+                "service_id": sid,
+                "service_type": r.ctx.service_type,
+                "status": "RUNNING",
+                "chips": list(r.ctx.chips),
+                # inventory schema parity with the process engine: thread
+                # executors have no pid of their own
+                "pid": None,
+            }
+            for sid, r in runners.items()
+            if r.thread.is_alive()
+        ]
 
     def stop_all(self) -> None:
         with self._lock:

@@ -92,6 +92,15 @@ class _ProcRunner:
             while not self.ctx.stop_event.is_set():
                 with self._proc_lock:
                     self.proc = self._spawn()
+                # record the child's pid so a restarted control plane can
+                # adopt (or fence) it; refreshed on every restart
+                if mgr.db is not None:
+                    try:
+                        mgr.db.update_service_pid(
+                            self.ctx.service_id, self.proc.pid)
+                    except Exception:
+                        logger.exception("pid record failed for %s",
+                                         self.ctx.service_id)
                 rc = self._wait_current()
                 if self.ctx.stop_event.is_set() or rc == 0:
                     break
@@ -160,6 +169,126 @@ class _ProcRunner:
                              self.ctx.service_id)
 
 
+def _pid_is_worker(pid: Optional[int],
+                   service_id: Optional[str] = None) -> bool:
+    """Is ``pid`` an alive rafiki worker bootstrap — and, when
+    ``service_id`` is given, THE bootstrap of that exact service? Guards
+    against pid reuse two ways: the cmdline must be a worker bootstrap,
+    and the child's environment must carry the matching
+    ``RAFIKI_SERVICE_ID`` (a recycled pid belonging to a *different*
+    service's worker must never be adopted or signalled)."""
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+    except (OSError, ProcessLookupError):
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            if b"rafiki_tpu.worker.bootstrap" not in f.read():
+                return False
+        if service_id is not None:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env_blob = f.read()
+            return (b"RAFIKI_SERVICE_ID=" + service_id.encode()
+                    ) in env_blob.split(b"\0")
+        return True
+    except OSError:
+        # no /proc (or unreadable): cannot verify — treat as not ours
+        return False
+
+
+def terminate_worker_pid(pid: int, service_id: str,
+                         grace_s: float) -> None:
+    """Identity-pinned kill escalation for a non-child worker process:
+    SIGTERM, bounded wait for exit, then SIGKILL — re-verifying
+    `_pid_is_worker(pid, service_id)` before EVERY signal so a recycled
+    pid is never touched. ``grace_s <= 0`` means fire-and-forget SIGTERM
+    (no SIGKILL escalation: the child deserves its clean store write).
+    The single copy of this escalation; the adopted-child watcher and
+    the recovery fence both use it."""
+    if not _pid_is_worker(pid, service_id=service_id):
+        return
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except (OSError, ProcessLookupError):
+        return
+    if grace_s <= 0:
+        return
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if not _pid_is_worker(pid, service_id=service_id):
+            return
+        time.sleep(0.1)
+    if _pid_is_worker(pid, service_id=service_id):
+        logger.warning("worker %s (pid %d) ignored SIGTERM; killing",
+                       service_id[:8], pid)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+
+
+class _AdoptedRunner:
+    """Watcher over a child that SURVIVED a control-plane restart (the
+    bootstrap's start_new_session keeps workers alive when the admin
+    dies). Mirrors _ProcRunner's contract — stop_event -> SIGTERM ->
+    SIGKILL, terminal status reported through on_status (the child's
+    self-written DB row wins) — without owning a Popen handle."""
+
+    def __init__(self, manager: "ProcessPlacementManager",
+                 ctx: ServiceContext, pid: int):
+        self.manager = manager
+        self.ctx = ctx
+        self.pid = pid
+        self.proc = None  # list_services reads .proc on spawned runners
+        self.thread = threading.Thread(
+            target=self._run, name=f"adopted-svc-{ctx.service_id[:8]}",
+            daemon=True)
+
+    def _alive(self) -> bool:
+        # identity-verified, not just kill(pid, 0): this runner cannot
+        # reap its non-child, so the pid CAN be recycled under it — a
+        # recycled pid (different process) must read as "our worker is
+        # gone", and must never be signalled
+        return _pid_is_worker(self.pid, service_id=self.ctx.service_id)
+
+    def _run(self) -> None:
+        mgr = self.manager
+        try:
+            while self._alive():
+                if self.ctx.stop_event.wait(0.5):
+                    self._terminate()
+                    break
+            # the child writes its own terminal row; rc is unknowable
+            # here, so default to STOPPED and let the row override
+            self._report_final()
+        finally:
+            mgr._on_runner_exit(self.ctx)
+
+    def _terminate(self) -> None:
+        terminate_worker_pid(self.pid, self.ctx.service_id,
+                             self.manager.stop_grace_s)
+
+    def _report_final(self) -> None:
+        mgr = self.manager
+        final = ServiceStatus.STOPPED
+        try:
+            if mgr.db is not None:
+                svc = mgr.db.get_service(self.ctx.service_id)
+                if svc is not None and svc["status"] in (
+                        ServiceStatus.STOPPED, ServiceStatus.ERRORED):
+                    final = svc["status"]
+                elif not self.ctx.stop_event.is_set():
+                    # died on its own without writing (SIGKILL): backstop
+                    final = ServiceStatus.ERRORED
+            if mgr.on_status:
+                mgr.on_status(self.ctx.service_id, final)
+        except Exception:
+            logger.exception("final status report failed for adopted %s",
+                             self.ctx.service_id)
+
+
 class ProcessPlacementManager(PlacementManager):
     """Places services as child processes on this host.
 
@@ -180,7 +309,16 @@ class ProcessPlacementManager(PlacementManager):
         on_status: Optional[StatusFn] = None,
         max_restarts: int = 3,
         stop_grace_s: float = 15.0,
+        orphan_survivable: bool = False,
     ):
+        """``orphan_survivable``: set by an ADMIN-embedded engine (single-
+        host process placement) so its TRAIN children outlive a control-
+        plane crash and can be adopted by pid on restart (the orphan
+        watchdog then exits on a terminal store row instead of on
+        reparenting — worker/bootstrap.py). Agent-embedded engines keep
+        the default: an agent's death is a HOST failure, and its children
+        must die fast so the PR-1 reschedule never double-runs a service
+        id."""
         self.db = db
         self.broker = broker
         self.admin_addr = admin_addr
@@ -188,6 +326,7 @@ class ProcessPlacementManager(PlacementManager):
         self.on_status = on_status
         self.max_restarts = max_restarts
         self.stop_grace_s = stop_grace_s
+        self.orphan_survivable = orphan_survivable
         self._lock = threading.Lock()
         self._runners: Dict[str, _ProcRunner] = {}
         # runners detached by destroy_service(wait=False) whose children
@@ -242,6 +381,55 @@ class ProcessPlacementManager(PlacementManager):
             self._runners[service_id] = runner
         runner.thread.start()
         return ctx
+
+    def adopt_pid(self, service_id: str, service_type: str, pid: int,
+                  extra: Optional[Dict[str, Any]] = None,
+                  chips: Optional[List[int]] = None) -> bool:
+        """Adopt a worker child that survived a control-plane restart
+        (its service row carries the pid): verify it is alive AND one of
+        ours, reclaim its chip grant, and watch it exactly like a spawned
+        child — destroy_service/stop_all SIGTERM it, its exit fires
+        on_status with the row it wrote itself. Returns False when the
+        pid is gone or unverifiable (caller respawns or errors)."""
+        if not _pid_is_worker(pid, service_id=service_id):
+            return False
+        chips = list(chips or [])
+        self.allocator.claim(chips)
+        ctx = ServiceContext(
+            service_id=service_id,
+            service_type=service_type,
+            chips=chips,
+            stop_event=threading.Event(),
+            extra=dict(extra or {}),
+        )
+        runner = _AdoptedRunner(self, ctx, pid)
+        with self._lock:
+            self._runners[service_id] = runner
+        runner.thread.start()
+        logger.info("adopted surviving worker %s (pid %d)",
+                    service_id[:8], pid)
+        return True
+
+    def list_services(self) -> List[Dict[str, Any]]:
+        """This host's LIVE executors, for the restart-reconciliation
+        inventory (placement/agent.py GET /inventory). Finished runners
+        already wrote their terminal rows and are not running-set."""
+        with self._lock:
+            runners = dict(self._runners)
+        out = []
+        for sid, r in runners.items():
+            if not r.thread.is_alive():
+                continue
+            proc = getattr(r, "proc", None)
+            out.append({
+                "service_id": sid,
+                "service_type": r.ctx.service_type,
+                "status": "RUNNING",
+                "chips": list(r.ctx.chips),
+                "pid": (proc.pid if proc is not None
+                        else getattr(r, "pid", None)),
+            })
+        return out
 
     def destroy_service(self, service_id: str, wait: bool = True) -> None:
         with self._lock:
@@ -309,6 +497,12 @@ class ProcessPlacementManager(PlacementManager):
             env["RAFIKI_ADMIN_ADDR"] = f"{self.admin_addr[0]}:{self.admin_addr[1]}"
         if ctx.service_type == ServiceType.TRAIN:
             env["RAFIKI_SUB_TRAIN_JOB_ID"] = ctx.extra["sub_train_job_id"]
+            if self.orphan_survivable:
+                # control-plane crash recovery: this TRAIN child should
+                # outlive its admin parent and be adopted by pid on
+                # restart (INFERENCE children never survive — their shm
+                # data plane dies with the parent)
+                env["RAFIKI_ORPHAN_SURVIVE"] = "1"
         elif ctx.service_type == ServiceType.INFERENCE:
             env["RAFIKI_INFERENCE_JOB_ID"] = ctx.extra["inference_job_id"]
             env["RAFIKI_TRIAL_ID"] = ctx.extra["trial_id"]

@@ -57,6 +57,11 @@ class PredictorServer:
         self.port = port
         self.auth = auth
         self.admission = AdmissionController()
+        #: epoch seconds of the listener bind — a restarted admin rebinds
+        #: an ADOPTED job's door on a fresh port (control-plane recovery),
+        #: and a monitor that sees started_at jump knows the door moved
+        #: (rather than silently aiming at the dead process's port)
+        self.started_at: Optional[float] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._stop_lock = threading.Lock()
@@ -81,6 +86,7 @@ class PredictorServer:
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
+        self.started_at = time.time()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name=f"predictor-{self.app}")
@@ -147,6 +153,7 @@ class PredictorServer:
         payload: Dict[str, Any] = {
             "app": self.app,
             "status": status,
+            "started_at": self.started_at,
             "workers": len(depths),
             "queue_depths": depths,
             "admission": self.admission.stats(),

@@ -169,8 +169,11 @@ def restore_checkpoint_host(path: str, params: Any, opt_state: Any,
     parse would double restore time and host memory."""
     from flax import serialization
 
-    with open(path, "rb") as f:
-        blob = f.read()
+    from rafiki_tpu.sdk.artifact import read_artifact
+
+    # verified read: checksummed checkpoints raise the typed
+    # ArtifactCorruptError on damage; pre-checksum files pass through
+    blob = read_artifact(path)
     target = {"params": params, "opt_state": opt_state,
               "state": state if state is not None else {}, "epoch": 0}
     try:
@@ -418,10 +421,22 @@ class DataParallelTrainer:
         batch_size = min(self.round_batch(batch_size), fit_cap or self.n_data)
         start_epoch = 0
         if checkpoint_path and os.path.exists(checkpoint_path):
-            params, opt_state, state, start_epoch = self._restore_checkpoint(
-                checkpoint_path, params, opt_state, state)
-            logger.info("resuming fit from %s at epoch %d",
-                        checkpoint_path, start_epoch)
+            try:
+                params, opt_state, state, start_epoch = (
+                    self._restore_checkpoint(
+                        checkpoint_path, params, opt_state, state))
+                logger.info("resuming fit from %s at epoch %d",
+                            checkpoint_path, start_epoch)
+            except Exception:
+                # corrupt/unreadable checkpoint (failed checksum, torn
+                # legacy file): warn and train from scratch — losing the
+                # saved epochs beats crashing the whole trial over a
+                # damaged cache of them
+                logger.warning(
+                    "checkpoint %s is corrupt or unreadable; restarting "
+                    "the trial from scratch", checkpoint_path,
+                    exc_info=True)
+                start_epoch = 0
         if scan_epoch is None:
             env = os.environ.get("RAFIKI_SCAN_EPOCH", "auto").lower()
             if env in ("0", "off", "false"):
@@ -514,10 +529,12 @@ class DataParallelTrainer:
             "state": _to_host(state) if state is not None else {},
             "epoch": next_epoch,
         })
-        tmp = f"{path}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, path)  # atomic: readers never see a torn file
+        from rafiki_tpu.sdk.artifact import write_artifact
+
+        # atomic (tmp + fsync + rename) AND checksummed: a resumed fit
+        # must be able to TELL a bit-rotten checkpoint from a valid one
+        # and fall back to a fresh start instead of crashing the trial
+        write_artifact(path, blob)
 
     def _restore_checkpoint(self, path: str, params: Any, opt_state: Any,
                             state: Any = None) -> Tuple[Any, Any, Any, int]:

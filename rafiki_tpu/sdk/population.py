@@ -201,10 +201,20 @@ class PopulationTrainer:
                              self.n_data), fit_cap)
         start_epoch = 0
         if checkpoint_path and os.path.exists(checkpoint_path):
-            params, opt_state, _, start_epoch = (
-                self._restore_checkpoint(checkpoint_path, params, opt_state))
-            logger.info("resuming population fit from %s at epoch %d",
-                        checkpoint_path, start_epoch)
+            try:
+                params, opt_state, _, start_epoch = (
+                    self._restore_checkpoint(
+                        checkpoint_path, params, opt_state))
+                logger.info("resuming population fit from %s at epoch %d",
+                            checkpoint_path, start_epoch)
+            except Exception:
+                # same contract as DataParallelTrainer.fit: a corrupt
+                # checkpoint costs the saved epochs, never the trial
+                logger.warning(
+                    "population checkpoint %s is corrupt or unreadable; "
+                    "restarting from scratch", checkpoint_path,
+                    exc_info=True)
+                start_epoch = 0
         # cross-fit device cache, same rationale as DataParallelTrainer.fit:
         # HPO trials of one job pass the same (memoized) host arrays, and
         # this trainer persists via cached_trainer — upload once

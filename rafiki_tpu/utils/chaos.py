@@ -21,9 +21,11 @@ Fields:
 
     site     where to inject: ``call_agent`` (admin-side transport),
              ``agent`` (host agent server), ``worker`` (inference
-             serve loop — overload drills: slow/stalled replicas), or
+             serve loop — overload drills: slow/stalled replicas),
              ``wire`` (shm frames popped off the serving rings, before
-             decode — corruption drills). Required.
+             decode — corruption drills), or ``db`` (metadata-store
+             statements — transient store-failure drills for
+             control-plane recovery). Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
              ``delay_s`` then proceed — a slow replica), ``error``
@@ -74,6 +76,13 @@ SITE_WORKER = "worker"
 # request's SLO timeout), never a worker-loop crash. Target string is
 # the shm queue name, so `match` can pick the query vs response ring.
 SITE_WIRE = "wire"
+# metadata store (db/database.py): every statement the DAL issues asks
+# this site first; target string is the SQL text, so `match` can pick a
+# table ("FROM service") or verb ("UPDATE"). `error` raises a typed
+# transient store failure, `delay` models a slow/contended store — the
+# drill that proves control-plane recovery retries with bounded jittered
+# backoff instead of aborting reconciliation (docs/failure-model.md).
+SITE_DB = "db"
 
 ACTION_DROP = "drop"
 ACTION_DELAY = "delay"
@@ -100,7 +109,7 @@ class ChaosRule:
 
     def __post_init__(self) -> None:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
-                             SITE_WIRE):
+                             SITE_WIRE, SITE_DB):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
                                ACTION_CORRUPT):
@@ -193,7 +202,19 @@ class ChaosController:
 
     def hit(self, site: str, target: str) -> Optional[ChaosRule]:
         """Record one request at ``site`` against every rule; return the
-        first rule whose schedule fires, else None."""
+        first rule whose schedule fires, else None.
+
+        Fast path without the lock when chaos is provably inactive (no
+        installed rules, no rules loaded, env unset): every metadata-store
+        statement and every popped shm frame asks this function — they
+        must not all contend on one process-global mutex to learn that
+        nothing is injected. The unlocked reads are benign: a racing
+        install/env-set is picked up by the next call."""
+        if (not self._installed and not self._rules and not self._env_value
+                and not os.environ.get(ENV_VAR)):
+            # (a truthy cached _env_value means the env was JUST unset:
+            # fall through once so the locked refresh resets the cache)
+            return None
         with self._lock:
             self._refresh_env_locked()
             for rule in self._rules:

@@ -83,14 +83,42 @@ def main() -> int:
     # not linger — an orphaned serving worker with a torn-down data plane
     # spins forever and, on a small host, starves everything else. Detected
     # by reparenting (PPID becomes init).
+    #
+    # Control-plane crash recovery (RAFIKI_ORPHAN_SURVIVE=1, set by an
+    # ADMIN-embedded engine for TRAIN children only): the parent dying is
+    # an admin crash, and THIS worker is the thing recovery adopts by pid
+    # — so instead of stopping on reparent, keep working and watch the
+    # shared store: exit only when the service row goes terminal (a
+    # restarted admin fenced or stopped us, or we finished on our own).
+    # Agent-spawned children never get the flag: an agent's death is a
+    # host failure and the PR-1 reschedule must never find the old
+    # executor still running.
     parent0 = os.getppid()
+    survivable = (os.environ.get("RAFIKI_ORPHAN_SURVIVE") == "1"
+                  and service_type == ServiceType.TRAIN)
 
     def watch_parent():
+        orphaned = False
         while not stop_event.wait(2.0):
-            if os.getppid() != parent0:
-                logger.warning("parent %d died; stopping", parent0)
-                stop_event.set()
-                return
+            if not orphaned and os.getppid() != parent0:
+                if not survivable:
+                    logger.warning("parent %d died; stopping", parent0)
+                    stop_event.set()
+                    return
+                orphaned = True
+                logger.warning(
+                    "parent %d died; surviving for control-plane recovery "
+                    "(will stop when the store says so)", parent0)
+            if orphaned:
+                try:
+                    svc = db.get_service(service_id)
+                except Exception:
+                    continue  # store hiccup: keep working
+                if svc is None or svc["status"] in ("STOPPED", "ERRORED"):
+                    logger.warning("service row is terminal while "
+                                   "orphaned; stopping")
+                    stop_event.set()
+                    return
 
     threading.Thread(target=watch_parent, name="orphan-watchdog",
                      daemon=True).start()
@@ -139,7 +167,15 @@ def _run_train(ctx, db, admin_client) -> None:
         advisors = RemoteAdvisorStore(admin_client)
 
         def send_event(name, payload):
-            admin_client.send_event(name, **payload)
+            # best-effort: events are advisory (job refresh also rides
+            # the service-status rows) — an admin that happens to be
+            # down/restarting at this moment must not error a worker
+            # that just finished its work
+            try:
+                admin_client.send_event(name, **payload)
+            except Exception as e:
+                logger.warning("event %s could not reach the admin "
+                               "(%s); continuing", name, e)
     else:
         # no admin API reachable: process-local advisor (the reference's
         # uncoordinated-parallel-HPO behavior, reference train.py:213)

@@ -277,8 +277,12 @@ class InferenceWorker:
         from rafiki_tpu.sdk.sandbox import sandbox_enabled
 
         prefix = ensure_dependencies(model_row.get("dependencies"))
-        with open(trial["params_file_path"], "rb") as f:
-            params_bytes = f.read()
+        from rafiki_tpu.sdk.artifact import read_artifact
+
+        # verified read: a truncated/bit-rotten params file raises the
+        # typed ArtifactCorruptError here — the deploy path surfaces it as
+        # a clean ServiceDeploymentError instead of a msgpack traceback
+        params_bytes = read_artifact(trial["params_file_path"])
         if sandbox_enabled():
             # serving isolation parity with the trial path: the uploaded
             # template answers batches from a locked-down child; this

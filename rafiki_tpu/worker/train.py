@@ -35,6 +35,7 @@ from rafiki_tpu.db.database import Database
 from rafiki_tpu.parallel.mesh import set_device_grant
 from rafiki_tpu.placement.manager import ServiceContext
 from rafiki_tpu.sdk.jax_backend import enable_persistent_compile_cache
+from rafiki_tpu.sdk.artifact import write_artifact
 from rafiki_tpu.sdk.log import ModelLogger, StopTrialEarly
 from rafiki_tpu.sdk.model import load_model_class
 from rafiki_tpu.sdk.params import dump_params
@@ -368,9 +369,10 @@ class TrainWorker:
             with tracer.span("persist_params"):
                 params_path = os.path.join(
                     self._params_dir, f"{trial_id}.params")
-                with open(params_path, "wb") as f:
-                    f.write(params_bytes)
-                os.chmod(params_path, 0o600)
+                # atomic + checksummed (sdk/artifact.py): a crash mid-write
+                # or later bit rot surfaces as a typed ArtifactCorruptError
+                # at download/deploy, never a deserialize traceback
+                write_artifact(params_path, params_bytes, mode=0o600)
             import shutil
 
             shutil.rmtree(jail, ignore_errors=True)
@@ -446,8 +448,10 @@ class TrainWorker:
             with tracer.span("persist_params"):
                 params_path = os.path.join(
                     self._params_dir, f"{trial_id}.params")
-                with open(params_path, "wb") as f:
-                    f.write(dump_params(model.dump_parameters()))
+                # atomic + checksummed (sdk/artifact.py) — see the
+                # sandboxed persist path for the rationale
+                write_artifact(params_path,
+                               dump_params(model.dump_parameters()))
             # the trial is complete: its mid-trial checkpoint is dead weight
             self._cleanup_ckpt(trial_id)
             return score, params_path

@@ -66,6 +66,23 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                   ring_used_bytes_hw in serving stats
 #                                   (oversized frames shed as typed 413)
 
+# Control-plane crash recovery (docs/failure-model.md, "Control-plane
+# faults"). A restarted admin reconciles the store against what is
+# actually running: adopt surviving workers, reschedule dead-host train
+# services, fence orphans. Doors answer 503 + Retry-After while the
+# boot reconciliation runs:
+#   RAFIKI_RECOVER_ADOPT=1              0 = fence (stop) surviving
+#                                       workers instead of adopting them
+#                                       on restart (doctor WARNs)
+#   RAFIKI_RECOVER_PROBE_TIMEOUT_S=5    per-agent /inventory probe budget
+#   RAFIKI_RECOVER_RETRY_MAX=4          metadata-store retries during
+#                                       reconcile (jittered backoff)
+#   RAFIKI_RECOVER_RETRY_BACKOFF_S=0.2  backoff base for those retries
+#   RAFIKI_ADVISOR_RETRY_S=60           worker-side: advisor API calls
+#                                       ride out a dead/restarting admin
+#                                       this long before erroring the
+#                                       executor (0 = fail fast)
+
 # Fleet health (docs/failure-model.md). Safe defaults — tune only for
 # failover drills or unusual networks:
 #   RAFIKI_AGENT_HEARTBEAT_S=5          /healthz probe interval (0 = off)
@@ -77,8 +94,9 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #   RAFIKI_AGENT_BREAKER_COOLDOWN_S=5   fail-fast window before half-open
 # Deterministic fault injection — MUST stay off outside drills/tests
 # (sites: call_agent, agent, worker — stalls/slows serving replicas for
-# overload drills — and wire, whose `corrupt` action garbles shm frames
-# for codec-corruption drills):
+# overload drills — wire, whose `corrupt` action garbles shm frames for
+# codec-corruption drills, and db, which fails/delays metadata-store
+# statements for control-plane recovery drills):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 

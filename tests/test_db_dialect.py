@@ -130,8 +130,11 @@ def _drive_every_dal_method(db: Database) -> None:
     db.get_service(svc["id"])
     db.get_services()
     db.get_services(status="STARTED")
+    db.get_services(statuses=["STARTED", "RUNNING"])
+    db.get_non_terminal_services()
     db.update_service_chips(svc["id"], [0, 1])
     db.update_service_host_port(svc["id"], "h", 1234)
+    db.update_service_pid(svc["id"], 4321)
     db.mark_service_as_deploying(svc["id"])
     db.mark_service_as_running(svc["id"])
 

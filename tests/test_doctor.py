@@ -58,6 +58,55 @@ def test_down_agents_reported(tmp_path, monkeypatch, capsys):
     assert "DOWN (no /healthz answer)" in out
 
 
+def test_recovery_check_flags_orphaned_jobs(tmp_path, monkeypatch, capsys):
+    """A non-terminal job whose services are all terminal is the
+    signature of a dead, never-restarted admin — doctor must say so."""
+    from rafiki_tpu.constants import ServiceType, UserType
+    from rafiki_tpu.db.database import Database
+
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    db = Database(str(tmp_path / "rafiki.sqlite3"))
+    user = db.create_user("u@x", "h", UserType.APP_DEVELOPER)
+    model = db.create_model(user["id"], "m", "T", b"", "M", {}, "PRIVATE")
+    tj = db.create_train_job(user["id"], "app", 1, "T", "u://t", "u://e", {})
+    db.mark_train_job_as_running(tj["id"])
+    sub = db.create_sub_train_job(tj["id"], model["id"])
+    svc = db.create_service(ServiceType.TRAIN)
+    db.create_train_job_worker(svc["id"], sub["id"])
+    db.mark_service_as_errored(svc["id"])  # worker died; admin never saw
+    # backdate past the deploy-in-progress grace: a FRESH job with no
+    # workers yet is a live admin mid-deploy, not an orphan
+    import time
+
+    db._exec("UPDATE train_job SET datetime_started=? WHERE id=?",
+             (time.time() - 600, tj["id"]))
+    db.close()
+    name, status, detail = doctor.check_recovery()
+    assert status == doctor.WARN
+    assert "orphaned by a dead admin" in detail
+
+
+def test_recovery_check_warns_when_adoption_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    monkeypatch.setenv("RAFIKI_RECOVER_ADOPT", "0")
+    name, status, detail = doctor.check_recovery()
+    assert status == doctor.WARN
+    assert "FENCE" in detail
+
+
+def test_recovery_check_reports_last_reconcile(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    from rafiki_tpu.admin import recovery as rec
+
+    os.makedirs(os.path.dirname(rec.report_path()), exist_ok=True)
+    with open(rec.report_path(), "w") as f:
+        json.dump({"state": "ready", "duration_s": 1.25, "adopted": 3,
+                   "rescheduled": 1, "fenced": 0, "errored": 0}, f)
+    name, status, detail = doctor.check_recovery()
+    assert status == doctor.PASS
+    assert "3 adopted" in detail and "1.25" in detail
+
+
 def test_crashing_check_is_contained(monkeypatch, tmp_path, capsys):
     monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
 

@@ -283,8 +283,11 @@ def test_fit_checkpoint_interrupted_epoch_boundary(tmp_path):
                 checkpoint_path=ckpt, checkpoint_every_epochs=2)
     from flax import serialization
 
-    with open(ckpt, "rb") as f:
-        blob = serialization.msgpack_restore(f.read())
+    from rafiki_tpu.sdk.artifact import read_artifact
+
+    # checkpoints are framed on disk now (atomic + checksummed,
+    # sdk/artifact.py); the payload inside is the same msgpack state dict
+    blob = serialization.msgpack_restore(read_artifact(ckpt))
     assert blob["epoch"] == 3  # final epoch always checkpointed
 
 

@@ -1,0 +1,671 @@
+"""Control-plane crash recovery (ISSUE 4; docs/failure-model.md
+"Control-plane faults"): a fresh Admin on an existing store must
+reconcile the DB against what is actually running — adopt surviving
+serving replicas (predict() answers WITHOUT a redeploy), reschedule
+train services whose hosts died (same id -> stale-trial resume), fence
+orphans of jobs stopped while the admin was down, and terminal-ize
+everything unrecoverable. All tier-1: the "hosts" are real AgentServer
+HTTP processes-worth of surface backed by thread engines in THIS test
+process, so they survive the Admin object being dropped while staying
+CPU-fast.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.advisor.advisor import AdvisorStore
+from rafiki_tpu.cache.queue import InProcessBroker
+from rafiki_tpu.constants import ServiceType, TrialStatus, UserType
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.placement.hosts import HostAgentPlacementManager
+from rafiki_tpu.placement.agent import AgentServer
+from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.agent_http import call_agent, reset_breaker
+from rafiki_tpu.worker.inference import InferenceWorker
+from rafiki_tpu.worker.train import TrainWorker
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "fake_model.py")
+TEST_KEY = "restart-drill-key"
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    chaos.clear()
+    reset_breaker()
+    yield
+    chaos.clear()
+    reset_breaker()
+
+
+class _ThreadEngine:
+    """A host agent's placement engine, with the workers on threads in
+    this process instead of child processes: the same declarative
+    create_service/list_services surface ProcessPlacementManager gives
+    the AgentServer (placement/agent.py), built from the same payloads
+    worker/bootstrap.py would read — so the agent 'keeps running' when
+    the Admin object is dropped, which is the whole restart drill."""
+
+    def __init__(self, db, chips):
+        self.db = db
+        self.broker = InProcessBroker()
+        self.advisors = AdvisorStore()
+        self._local = LocalPlacementManager(
+            allocator=ChipAllocator(chips), on_status=self._on_status)
+        self.allocator = self._local.allocator
+
+    def _on_status(self, sid, status):
+        # the agent-side store writes (placement/agent.py
+        # _admin_status_forwarder) — terminal rows land even with no admin
+        if status == "RUNNING":
+            self.db.mark_service_as_running(sid)
+        elif status == "STOPPED":
+            self.db.mark_service_as_stopped(sid)
+        elif status == "ERRORED":
+            self.db.mark_service_as_errored(sid)
+
+    @property
+    def _runners(self):
+        return self._local._runners
+
+    def list_services(self):
+        return self._local.list_services()
+
+    def create_service(self, service_id, service_type, n_chips=0,
+                       best_effort_chips=False, extra=None):
+        extra = dict(extra or {})
+        if service_type == ServiceType.TRAIN:
+            worker = TrainWorker(extra["sub_train_job_id"], self.db,
+                                 self.advisors)
+        else:
+            worker = InferenceWorker(
+                extra["inference_job_id"], extra["trial_id"], self.db,
+                self.broker, trial_ids=extra.get("trial_ids"))
+        return self._local.create_service(
+            service_id, service_type, worker.start, n_chips=n_chips,
+            extra=extra, best_effort_chips=best_effort_chips)
+
+    def destroy_service(self, service_id, wait=True):
+        self._local.destroy_service(service_id, wait=wait)
+
+    def stop_all(self):
+        self._local.stop_all()
+
+
+def _spawn_host(db, chips):
+    engine = _ThreadEngine(db, chips)
+    server = AgentServer(engine, key=TEST_KEY).start()
+    return engine, server, f"127.0.0.1:{server.port}"
+
+
+def _placement(agents, db):
+    # heartbeats off: these drills drive recovery deterministically, and
+    # a "crashed" admin's leftover monitor must not keep probing
+    return HostAgentPlacementManager(
+        agents, db=db, key=TEST_KEY, heartbeat_interval_s=0)
+
+
+def _wait_ready(admin, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if admin.recovery_status()["state"] != "recovering":
+            return admin.recovery_status()
+        time.sleep(0.02)
+    pytest.fail(f"admin never reached ready: {admin.recovery_status()}")
+
+
+def _wait_for(cond, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def _crash(admin):
+    """Simulate an admin process crash: nothing is stopped or drained —
+    the object (and its placement bookkeeping) is simply abandoned. Its
+    background pollers are silenced so they can't fight the successor
+    over the shared store, and any dedicated predictor listeners close
+    the way a dead process's sockets would."""
+    admin.placement._closed.set()
+    for psrv in list(admin.services._predict_servers.values()):
+        psrv.stop(drain_timeout_s=0.0)
+
+
+def _seed_app(admin, uid, app, trials=2):
+    with open(FIXTURE, "rb") as f:
+        admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION", f.read(),
+                           "FakeModel")
+    admin.create_train_job(
+        uid, app, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": trials, "CHIP_COUNT": 2})
+    return admin.wait_until_train_job_stopped(uid, app, timeout_s=60)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: the restart drill
+# ---------------------------------------------------------------------------
+
+
+def test_restart_adopts_serving_replicas_without_redeploy(tmp_workdir):
+    """Acceptance: drop the Admin mid-serve (agents keep running); a
+    fresh Admin on the same DB reaches ready with ADOPTED replicas
+    answering predict() — no redeploy — and zero non-terminal rows left
+    without live backing."""
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    e1, s1, a1 = _spawn_host(db, [0, 1])
+    e2, s2, a2 = _spawn_host(db, [2, 3])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([a1, a2], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        job = _seed_app(admin1, uid, "restartserve")
+        assert job["status"] == "STOPPED"
+        admin1.create_inference_job(uid, "restartserve")
+        assert len(admin1.predict(uid, "restartserve", [[1.0]])) == 1
+        inf = db.get_inference_jobs_by_statuses(["RUNNING"])[0]
+        sids_before = sorted(
+            w["service_id"]
+            for w in db.get_workers_of_inference_job(inf["id"]))
+        assert sids_before
+        # the extended inventory enumerates the running services
+        inv = call_agent(a1, "GET", "/inventory", key=TEST_KEY, timeout_s=5)
+        assert {e["service_id"] for e in inv["services"]} <= set(
+            s["id"] for s in db.get_services())
+        assert all(e["status"] == "RUNNING" for e in inv["services"])
+
+        _crash(admin1)
+
+        admin2 = Admin(db=db, placement=_placement([a1, a2], db),
+                       params_dir=str(tmp_workdir / "params"))
+        report = _wait_ready(admin2)
+        assert report["adopted"] >= len(sids_before)
+        assert report["errored"] == 0
+
+        # the job is still RUNNING on the SAME services — no redeploy
+        assert db.get_inference_job(inf["id"])["status"] == "RUNNING"
+        assert sorted(
+            w["service_id"]
+            for w in db.get_workers_of_inference_job(inf["id"])
+        ) == sids_before
+        assert set(admin2.placement.placements()) >= set(sids_before)
+
+        # adopted replicas answer predict() through the fresh admin
+        preds = admin2.predict(uid, "restartserve", [[1.0], [2.0]])
+        assert len(preds) == 2
+        for p in preds:
+            assert pytest.approx(p) == [0.5, 0.5]
+
+        # acceptance: every non-terminal row is backed by a live executor
+        backed = set(admin2.placement.placements())
+        inf_fresh = db.get_inference_job(inf["id"])
+        for svc in db.get_services(
+                statuses=["STARTED", "DEPLOYING", "RUNNING"]):
+            assert (svc["id"] in backed
+                    or svc["id"] == inf_fresh.get("predictor_service_id")), \
+                f"unbacked non-terminal service {svc}"
+
+        # the report is surfaced via fleet health and persisted for doctor
+        assert admin2.get_fleet_health()["recovery"]["state"] == "ready"
+        with open(tmp_workdir / "logs" / "recovery.json") as f:
+            assert json.load(f)["adopted"] >= len(sids_before)
+
+        admin2.stop_all_jobs()
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        for srv, eng in ((s1, e1), (s2, e2)):
+            srv.stop()
+        db.close()
+
+
+def test_restart_rebinds_dedicated_predictor_port(tmp_workdir, monkeypatch):
+    """RAFIKI_PREDICTOR_PORTS=1: an adopted job's dedicated serving door
+    is rebound in the fresh admin, the new host:port republished in the
+    store, and the door answers predict with the adopted replicas."""
+    import requests
+
+    from rafiki_tpu.utils.auth import generate_token
+
+    monkeypatch.setenv("RAFIKI_PREDICTOR_PORTS", "1")
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin1, uid, "portapp")
+        admin1.create_inference_job(uid, "portapp")
+        job1 = admin1.get_inference_job(uid, "portapp")
+        assert job1["predictor_port"]
+        _crash(admin1)
+
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        _wait_ready(admin2)
+        job2 = admin2.get_inference_job(uid, "portapp")
+        assert job2["predictor_port"]  # republished by the adoption
+        token = generate_token({"user_id": uid, "user_type": "SUPERADMIN"})
+        url = (f"http://{job2['predictor_host']}:{job2['predictor_port']}")
+        r = requests.post(url + "/predict",
+                          json={"queries": [[3.0]]},
+                          headers={"Authorization": f"Bearer {token}"})
+        assert r.status_code == 200
+        assert len(r.json()["data"]["predictions"]) == 1
+        # the rebound door advertises its own birth time on /healthz, so
+        # monitors can tell an adopted door from the dead admin's
+        h = requests.get(url + "/healthz").json()
+        assert h["status"] == "ok" and h["started_at"] is not None
+        assert h["workers"] >= 1
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
+
+
+def test_restart_reschedules_dead_host_train_service(tmp_workdir):
+    """Acceptance: a train service whose host died while the admin was
+    down is rescheduled onto a survivor UNDER THE SAME SERVICE ID, so the
+    replacement worker resumes the stale RUNNING trial
+    (test_worker_resume semantics), and the job completes."""
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin = None
+    try:
+        user = db.create_user("u@x", "h", UserType.APP_DEVELOPER)
+        with open(FIXTURE, "rb") as f:
+            model = db.create_model(
+                user["id"], "fake", "IMAGE_CLASSIFICATION", f.read(),
+                "FakeModel", {"numpy": None}, "PUBLIC")
+        tj = db.create_train_job(
+            user["id"], "app", 1, "IMAGE_CLASSIFICATION", "uri://t",
+            "uri://e", {"MODEL_TRIAL_COUNT": 2})
+        db.mark_train_job_as_running(tj["id"])
+        sub = db.create_sub_train_job(tj["id"], model["id"])
+        # the dead host's executor: a RUNNING service row placed nowhere
+        svc = db.create_service(ServiceType.TRAIN, chips=[0])
+        db.mark_service_as_running(svc["id"])
+        db.create_train_job_worker(svc["id"], sub["id"])
+        stale = db.create_trial(
+            sub["id"], model["id"],
+            {"int_knob": 4, "float_knob": 0.01, "cat_knob": "b",
+             "fixed_knob": "fixed"},
+            worker_id=svc["id"])
+
+        admin = Admin(db=db, placement=_placement([addr], db),
+                      params_dir=str(tmp_workdir / "params"))
+        report = _wait_ready(admin)
+        assert report["rescheduled"] == 1
+        assert admin.placement.placements()[svc["id"]] == addr
+
+        assert _wait_for(lambda: db.get_train_job(tj["id"])["status"]
+                         == "STOPPED", timeout_s=60)
+        resumed = db.get_trial(stale["id"])
+        assert resumed["status"] == TrialStatus.COMPLETED
+        assert resumed["score"] is not None
+        # the resumed trial consumed a budget slot: exactly 2 trials
+        assert len(db.get_trials_of_sub_train_job(sub["id"])) == 2
+    finally:
+        if admin is not None:
+            admin.shutdown()
+        server.stop()
+        db.close()
+
+
+def test_restart_fences_orphans_of_jobs_stopped_while_down(tmp_workdir):
+    """Orphan fence: serving replicas still running on an agent whose job
+    went STOPPED while the admin was down are stopped on the agent and
+    their rows closed."""
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin1, uid, "fenceapp")
+        admin1.create_inference_job(uid, "fenceapp")
+        inf = db.get_inference_jobs_by_statuses(["RUNNING"])[0]
+        sids = [w["service_id"]
+                for w in db.get_workers_of_inference_job(inf["id"])]
+        assert engine.list_services()
+
+        _crash(admin1)
+        # "the operator stopped the job while the admin was down"
+        db.mark_inference_job_as_stopped(inf["id"])
+
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        report = _wait_ready(admin2)
+        assert report["fenced"] >= len(sids)
+        # the agent's executors are gone and every row is terminal
+        assert _wait_for(lambda: not engine.list_services())
+        for sid in sids:
+            assert db.get_service(sid)["status"] == "STOPPED"
+        assert admin2.placement.placements() == {}
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
+
+
+def test_failed_fence_leaves_row_non_terminal(tmp_workdir):
+    """If the fence call cannot reach the agent, the orphan's row must
+    stay non-terminal — closing it would hide a still-running executor
+    from doctor and from every future reconcile."""
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin1, uid, "badfence")
+        admin1.create_inference_job(uid, "badfence")
+        inf = db.get_inference_jobs_by_statuses(["RUNNING"])[0]
+        sids = [w["service_id"]
+                for w in db.get_workers_of_inference_job(inf["id"])]
+        _crash(admin1)
+        db.mark_inference_job_as_stopped(inf["id"])
+        # every stop call to the agent drops on the wire — the inventory
+        # probe (a GET) still answers, so recovery sees the orphans but
+        # cannot fence them
+        chaos.install([chaos.ChaosRule(site="call_agent", action="drop",
+                                       match="/stop")])
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        report = _wait_ready(admin2)
+        assert report["fenced"] == 0
+        assert any("could not fence" in r for r in report["reasons"])
+        # rows stay non-terminal: the orphan is still visible
+        for sid in sids:
+            assert db.get_service(sid)["status"] == "RUNNING"
+        assert engine.list_services()  # executors untouched
+    finally:
+        chaos.clear()
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
+
+
+def test_recover_adopt_disabled_fences_instead(tmp_workdir, monkeypatch):
+    """RAFIKI_RECOVER_ADOPT=0: surviving serving replicas are fenced,
+    never adopted, and the orphaned job reaches a terminal status."""
+    monkeypatch.setenv("RAFIKI_RECOVER_ADOPT", "0")
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin1, uid, "noadopt")
+        admin1.create_inference_job(uid, "noadopt")
+        inf = db.get_inference_jobs_by_statuses(["RUNNING"])[0]
+        _crash(admin1)
+
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        report = _wait_ready(admin2)
+        assert report["adopted"] == 0
+        assert report["fenced"] > 0
+        assert any("RAFIKI_RECOVER_ADOPT=0" in r for r in report["reasons"])
+        # nothing survives unmanaged: job terminal, no live rows
+        assert _wait_for(lambda: db.get_inference_job(inf["id"])["status"]
+                         in ("STOPPED", "ERRORED"))
+        assert _wait_for(lambda: not engine.list_services())
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: transient metadata-store failures during reconcile (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_retries_through_transient_db_chaos(tmp_workdir,
+                                                     monkeypatch):
+    monkeypatch.setenv("RAFIKI_RECOVER_RETRY_BACKOFF_S", "0.01")
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    user = db.create_user("u@x", "h", UserType.APP_DEVELOPER)
+    with open(FIXTURE, "rb") as f:
+        model = db.create_model(
+            user["id"], "fake", "IMAGE_CLASSIFICATION", f.read(),
+            "FakeModel", {"numpy": None}, "PUBLIC")
+    tj = db.create_train_job(
+        user["id"], "app", 1, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        {"MODEL_TRIAL_COUNT": 1})
+    db.mark_train_job_as_running(tj["id"])
+    sub = db.create_sub_train_job(tj["id"], model["id"])
+    svc = db.create_service(ServiceType.TRAIN)
+    db.mark_service_as_running(svc["id"])
+    db.create_train_job_worker(svc["id"], sub["id"])
+    db.create_trial(sub["id"], model["id"],
+                    {"int_knob": 4, "float_knob": 0.01, "cat_knob": "b",
+                     "fixed_knob": "fixed"}, worker_id=svc["id"])
+    # the first two statements touching the service table fail — the
+    # recovery scan must retry with backoff, not abort reconciliation
+    chaos.install([chaos.ChaosRule(site="db", action="error",
+                                   match="FROM service", times=2)])
+    admin = Admin(db=db, params_dir=str(tmp_workdir / "params"))
+    try:
+        report = _wait_ready(admin)
+        assert report["db_retries"] >= 2
+        assert report["state"] == "ready"
+        assert report["rescheduled"] == 1
+        assert _wait_for(lambda: db.get_train_job(tj["id"])["status"]
+                         == "STOPPED", timeout_s=60)
+    finally:
+        admin.shutdown()
+        db.close()
+
+
+def test_aborted_reconcile_is_visible_in_report_and_on_disk(tmp_workdir):
+    """A reconcile that dies mid-pass must say so — in memory AND in the
+    persisted report doctor reads — never present partial counts as a
+    clean pass."""
+    from rafiki_tpu import doctor
+    from rafiki_tpu.admin.recovery import ControlPlaneRecovery
+
+    admin = Admin(db=Database(":memory:"), recover=False,
+                  params_dir=str(tmp_workdir / "params"))
+    try:
+        rec = ControlPlaneRecovery(admin)
+        rec._reconcile = lambda snap: (_ for _ in ()).throw(
+            RuntimeError("store exploded mid-pass"))
+        report = rec.run({"services": [], "train_jobs": [],
+                          "inference_jobs": []})
+        assert report["state"] == "ready"  # doors still open
+        assert report["failed"] is True
+        assert "store exploded" in report["error"]
+        with open(tmp_workdir / "logs" / "recovery.json") as f:
+            persisted = json.load(f)
+        assert persisted["failed"] is True
+        name, status, detail = doctor.check_recovery()
+        assert status == doctor.WARN
+        assert "ABORTED" in detail
+    finally:
+        admin.shutdown()
+
+
+def test_db_chaos_error_and_delay_semantics():
+    from rafiki_tpu.db.database import MetadataStoreChaosError
+
+    db = Database(":memory:")
+    try:
+        chaos.install([chaos.ChaosRule(site="db", action="error",
+                                       match="FROM service", times=1)])
+        with pytest.raises(MetadataStoreChaosError):
+            db.get_services()
+        assert db.get_services() == []  # rule spent; store healthy again
+        chaos.install([chaos.ChaosRule(site="db", action="delay",
+                                       delay_s=0.05, times=1)])
+        t0 = time.monotonic()
+        db.get_services()
+        assert time.monotonic() - t0 >= 0.05
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# the recovering -> ready HTTP gate
+# ---------------------------------------------------------------------------
+
+
+def test_http_doors_shed_503_while_recovering(tmp_workdir):
+    import requests
+
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import AdminRecoveringError, Client
+
+    admin = Admin(db=Database(":memory:"),
+                  params_dir=str(tmp_workdir / "params"))
+    server = AdminServer(admin).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        client = Client(admin_port=server.port)
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        # force the recovering state (the reconcile thread owns it in
+        # real boots; the gate only reads it)
+        admin._recovery = {"state": "recovering", "started_at": time.time()}
+        r = requests.get(base + "/train_jobs",
+                         headers={"Authorization": f"Bearer {client._token}"})
+        assert r.status_code == 503
+        assert r.headers.get("Retry-After") == "1"
+        assert r.json()["recovery"]["state"] == "recovering"
+        with pytest.raises(AdminRecoveringError):
+            client.get_train_jobs()
+        # allowed while recovering: root (carries the state), login,
+        # fleet health, events
+        root = requests.get(base + "/").json()["data"]
+        assert root["recovery"]["state"] == "recovering"
+        assert requests.post(
+            base + "/tokens",
+            json={"email": config.SUPERADMIN_EMAIL,
+                  "password": config.SUPERADMIN_PASSWORD}).status_code == 200
+        assert client.get_fleet_health()["recovery"]["state"] == "recovering"
+        client.send_event("train_job_worker_stopped",
+                          train_job_id="nonexistent")
+        # flip to ready: the waiter unblocks and routes answer again
+        admin._recovery = {"state": "ready"}
+        assert client.wait_until_admin_ready(
+            timeout_s=5)["state"] == "ready"
+        assert client.get_train_jobs() == []
+    finally:
+        server.stop()
+        admin.shutdown()
+
+
+def test_adoption_rebuilds_advisor_session_with_replayed_scores(tmp_workdir):
+    """An adopted train worker's advisor session (id = its sub-train-job)
+    died with the old admin; recovery rebuilds it seeded with the
+    completed trials, so the worker's next proposal lands instead of
+    erroring the adopted executor."""
+    from rafiki_tpu.admin.recovery import ControlPlaneRecovery
+
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    user = db.create_user("u@x", "h", UserType.APP_DEVELOPER)
+    with open(FIXTURE, "rb") as f:
+        model = db.create_model(
+            user["id"], "fake", "IMAGE_CLASSIFICATION", f.read(),
+            "FakeModel", {"numpy": None}, "PUBLIC")
+    tj = db.create_train_job(
+        user["id"], "app", 1, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        {"MODEL_TRIAL_COUNT": 8})
+    sub = db.create_sub_train_job(tj["id"], model["id"])
+    knobs = {"int_knob": 4, "float_knob": 0.01, "cat_knob": "a",
+             "fixed_knob": "fixed"}
+    for score in (0.3, 0.8):
+        t = db.create_trial(sub["id"], model["id"], knobs)
+        db.mark_trial_as_complete(t["id"], score, None)
+    admin = Admin(db=db, recover=False,
+                  params_dir=str(tmp_workdir / "params"))
+    try:
+        rec = ControlPlaneRecovery(admin)
+        rec._restore_advisor(sub["id"])
+        advisor = admin.advisor_store.get(sub["id"])  # session exists again
+        assert len(advisor.history) == 2  # the completed scores replayed
+        assert admin.advisor_store.propose(sub["id"])  # proposals work
+        # idempotent: a second restore (another adopted replica of the
+        # same sub-job) must not double-feed
+        rec._restored_advisors.clear()
+        rec._restore_advisor(sub["id"])
+        assert len(admin.advisor_store.get(sub["id"]).history) == 2
+    finally:
+        admin.shutdown()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# pid adoption (single-host process placement)
+# ---------------------------------------------------------------------------
+
+
+def test_process_manager_adopts_verified_pid_and_fences_on_stop(tmp_path):
+    import subprocess
+    import sys
+
+    from rafiki_tpu.placement.process import (
+        ProcessPlacementManager,
+        _pid_is_worker,
+    )
+
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    svc = db.create_service(ServiceType.TRAIN)
+    # a stand-in surviving child: sleeps forever, carries the worker
+    # bootstrap marker on its cmdline AND this service's id in its env —
+    # both are what pid verification pins identity to
+    child_env = dict(os.environ)
+    child_env["RAFIKI_SERVICE_ID"] = svc["id"]
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)",
+         "rafiki_tpu.worker.bootstrap"], env=child_env)
+    try:
+        assert _pid_is_worker(child.pid)
+        assert _pid_is_worker(child.pid, service_id=svc["id"])
+        # a recycled pid running SOME OTHER service's worker is refused
+        assert not _pid_is_worker(child.pid, service_id="someone-else")
+        assert not _pid_is_worker(os.getpid())  # not a worker bootstrap
+        mgr = ProcessPlacementManager(
+            db=db, allocator=ChipAllocator([0, 1]), stop_grace_s=2.0)
+        assert mgr.adopt_pid(svc["id"], ServiceType.TRAIN, child.pid,
+                             extra={"sub_train_job_id": "sub"}, chips=[1])
+        # the adopted grant is claimed, and the inventory lists it
+        assert mgr.allocator.free_chips == 1
+        listed = mgr.list_services()
+        assert [s["service_id"] for s in listed] == [svc["id"]]
+        assert listed[0]["pid"] == child.pid
+        # destroy -> SIGTERM the adopted child; chips released
+        mgr.destroy_service(svc["id"], wait=True)
+        assert child.wait(timeout=10) is not None
+        assert mgr.allocator.free_chips == 2
+        # a dead/foreign pid is never adopted
+        assert not mgr.adopt_pid(svc["id"], ServiceType.TRAIN, child.pid,
+                                 extra={}, chips=[])
+    finally:
+        if child.poll() is None:
+            child.kill()
+        db.close()
