@@ -47,7 +47,8 @@ from rafiki_tpu.utils.auth import (
     hash_password,
     verify_password,
 )
-from rafiki_tpu.worker.train import EVENT_BUDGET_REACHED
+from rafiki_tpu.worker.train import (EVENT_BUDGET_REACHED,
+                                     EVENT_TRIAL_FAULT_LIMIT)
 
 logger = logging.getLogger(__name__)
 
@@ -501,6 +502,10 @@ class Admin:
             "app_version": job["app_version"],
             "task": job["task"],
             "status": job["status"],
+            # trial fault taxonomy: why an ERRORED job errored (e.g.
+            # fail-fast on a broken template) — None for healthy jobs
+            "fault_kind": job.get("fault_kind"),
+            "error_reason": job.get("error_reason"),
             "budget": job["budget"],
             "train_dataset_uri": job["train_dataset_uri"],
             "test_dataset_uri": job["test_dataset_uri"],
@@ -624,6 +629,15 @@ class Admin:
             "knobs": trial["knobs"],
             "score": trial["score"],
             "status": trial["status"],
+            # fault taxonomy (worker/faults.py): how many infra-class
+            # re-runs the trial absorbed, plus the typed kind +
+            # truncated traceback of its LAST fault (terminal for
+            # ERRORED trials; the absorbed transient for COMPLETED ones
+            # with attempt > 0) — diagnosing a failure never requires
+            # scraping worker logs
+            "attempt": trial.get("attempt", 0),
+            "fault_kind": trial.get("fault_kind"),
+            "fault_detail": trial.get("fault_detail"),
             "datetime_started": trial["datetime_started"],
             "datetime_stopped": trial["datetime_stopped"],
         }
@@ -902,6 +916,23 @@ class Admin:
         with self._predict_route_lock:
             for sid, s in self._remote_serving_stats.items():
                 workers.setdefault(sid, {}).update(s)
+        # training-plane fault picture (docs/failure-model.md,
+        # "Training-plane faults"): per-job fault-kind counters and
+        # absorbed retries from the STORE (covers every placement mode),
+        # plus in-process worker counters (quarantined signatures,
+        # re-proposals, feedback drops) from worker/faults.py
+        from rafiki_tpu.constants import TrainJobStatus as _TJS
+        from rafiki_tpu.worker.faults import training_stats as _tstats
+
+        train_jobs: Dict[str, Any] = {}
+        try:
+            summary = self.db.get_trial_fault_summary_of_live_jobs()
+            for j in self.db.get_train_jobs_by_statuses(
+                    [_TJS.STARTED, _TJS.RUNNING]):
+                entry = summary.get(j["id"], {"faults": {}, "retries": 0})
+                train_jobs[j["id"]] = {"status": j["status"], **entry}
+        except Exception:
+            logger.exception("fleet-health training scan failed")
         return {
             "placement": type(self.placement).__name__,
             "agents": agents,
@@ -915,6 +946,10 @@ class Admin:
                 "jobs": jobs,
                 "admission": self._predict_admission.stats(),
                 "workers": workers,
+            },
+            "training": {
+                "jobs": train_jobs,
+                "workers": _tstats(),
             },
         }
 
@@ -951,6 +986,19 @@ class Admin:
                 # discarding their work, reference admin.py:607). Nothing to
                 # kill — just fold the exit into job status.
                 self.services.refresh_train_job_status(payload["train_job_id"])
+            elif name == EVENT_TRIAL_FAULT_LIMIT:
+                # Job fail-fast (trial fault taxonomy): a worker hit
+                # RAFIKI_TRIAL_FAULT_LIMIT consecutive user-class trial
+                # faults — the template is broken, so the job errors NOW
+                # with the typed reason instead of grinding its budget.
+                # The worker already marked the row (works headless);
+                # the guarded transition makes this a no-op then. Tear
+                # down sibling workers — they are failing the same way.
+                self.db.mark_train_job_as_errored(
+                    payload["train_job_id"],
+                    payload.get("fault_kind"),
+                    payload.get("reason"))
+                self.services.stop_train_services(payload["train_job_id"])
             elif name in ("train_job_worker_started", "train_job_worker_stopped"):
                 self.services.refresh_train_job_status(payload["train_job_id"])
             elif name == "service_status":

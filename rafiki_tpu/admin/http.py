@@ -252,11 +252,23 @@ class AdminServer:
             r("POST", r"/advisors/(?P<aid>[^/]+)/feedback", _ANY,
                 lambda au, m, b, q: {"knobs": A.advisor_store.feedback(
                     m["aid"], _field(b, "knobs"), _field(b, "score"))}),
+            # scoreless-failure signal (trial fault taxonomy): the GP
+            # steers away from the region; trial_id lets the session's
+            # ASHA scheduler forget the dead trial's rung records
+            r("POST", r"/advisors/(?P<aid>[^/]+)/infeasible", _ANY,
+                lambda au, m, b, q: {
+                    "infeasible": A.advisor_store.feedback_infeasible(
+                        m["aid"], _field(b, "knobs"),
+                        kind=b.get("kind", "USER"),
+                        trial_id=b.get("trial_id"))}),
             r("POST", r"/advisors/(?P<aid>[^/]+)/replay", _ANY,
                 lambda au, m, b, q: {"replayed": A.advisor_store.replay_feedback(
                     m["aid"],
                     [(_field(i, "knobs"), _field(i, "score"))
-                     for i in _field(b, "items")])}),
+                     for i in _field(b, "items")],
+                    infeasible=[
+                        (_field(i, "knobs"), i.get("kind", "USER"))
+                        for i in b.get("infeasible") or []])}),
             # ASHA rung report (early stopping; advisor/asha.py)
             r("POST", r"/advisors/(?P<aid>[^/]+)/report_rung", _ANY,
                 lambda au, m, b, q: {"keep": A.advisor_store.report_rung(

@@ -501,16 +501,29 @@ class ControlPlaneRecovery:
             store = self.admin.advisor_store
             store.create_advisor(clazz.get_knob_config(),
                                  advisor_id=sub_train_job_id)
+            from rafiki_tpu.worker.faults import is_infeasible_row
+
+            trials = self.db.get_trials_of_sub_train_job(sub_train_job_id)
             scored = [
                 (t["knobs"], t["score"])
-                for t in self.db.get_trials_of_sub_train_job(
-                    sub_train_job_id)
+                for t in trials
                 if t["status"] == TrialStatus.COMPLETED
                 and t["score"] is not None
             ]
-            if scored and store.replay_feedback(sub_train_job_id, scored):
-                logger.info("advisor %s rebuilt with %d replayed trials",
-                            sub_train_job_id[:8], len(scored))
+            # poison faults ride the replay too (trial fault taxonomy):
+            # the rebuilt GP must also remember which regions crash,
+            # not just which scored
+            infeasible = [
+                (t["knobs"], t["fault_kind"])
+                for t in trials
+                if is_infeasible_row(t)
+            ]
+            if (scored or infeasible) and store.replay_feedback(
+                    sub_train_job_id, scored, infeasible=infeasible):
+                logger.info(
+                    "advisor %s rebuilt with %d replayed + %d "
+                    "infeasible trials", sub_train_job_id[:8],
+                    len(scored), len(infeasible))
         except Exception as e:
             logger.exception("advisor restore failed for %s",
                              sub_train_job_id)

@@ -57,9 +57,21 @@ class BaseAdvisor:
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
         raise NotImplementedError
 
+    def feedback_infeasible(self, knobs: Dict[str, Any],
+                            kind: str = "USER") -> None:
+        """The trial at ``knobs`` failed WITHOUT a usable score (trial
+        fault taxonomy: USER crash, TIMEOUT, INVALID_SCORE). Optional
+        signal — the base implementation ignores it, so advisor types
+        that can't use it stay valid; advisors that can (the GP) steer
+        their proposal distribution away from the region."""
+
     @property
     def observation_count(self) -> int:
         raise NotImplementedError
+
+    @property
+    def infeasible_count(self) -> int:
+        return 0
 
 
 class Advisor(BaseAdvisor):
@@ -89,6 +101,12 @@ class Advisor(BaseAdvisor):
         with self._lock:
             self._opt.observe(u, float(score))
 
+    def feedback_infeasible(self, knobs: Dict[str, Any],
+                            kind: str = "USER") -> None:
+        u = knobs_to_unit(self.knob_config, knobs)
+        with self._lock:
+            self._opt.mark_infeasible(u)
+
     @property
     def history(self) -> List[Tuple[np.ndarray, float]]:
         return list(zip(self._opt.observed_X, self._opt.observed_y))
@@ -96,6 +114,10 @@ class Advisor(BaseAdvisor):
     @property
     def observation_count(self) -> int:
         return len(self._opt.observed_y)
+
+    @property
+    def infeasible_count(self) -> int:
+        return len(self._opt.infeasible_X)
 
 
 class RandomAdvisor(BaseAdvisor):
@@ -113,9 +135,18 @@ class RandomAdvisor(BaseAdvisor):
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
         self._n_observed += 1
 
+    def feedback_infeasible(self, knobs: Dict[str, Any],
+                            kind: str = "USER") -> None:
+        # random search has no model to steer; count for observability
+        self._n_infeasible = getattr(self, "_n_infeasible", 0) + 1
+
     @property
     def observation_count(self) -> int:
         return self._n_observed
+
+    @property
+    def infeasible_count(self) -> int:
+        return getattr(self, "_n_infeasible", 0)
 
 
 class AdvisorStore:
@@ -159,8 +190,33 @@ class AdvisorStore:
         advisor.feedback(knobs, score)
         return advisor.propose()
 
+    def feedback_infeasible(
+        self,
+        advisor_id: str,
+        knobs: Dict[str, Any],
+        kind: str = "USER",
+        trial_id: Optional[str] = None,
+    ) -> int:
+        """Record a scoreless failure at ``knobs`` (trial fault taxonomy
+        USER/TIMEOUT/INVALID_SCORE): the advisor steers its proposals
+        away, and — when ``trial_id`` is given — the session's ASHA
+        scheduler forgets the trial's rung records so a crashed trial's
+        partial metrics can't set promotion bars for healthy ones.
+        Returns the session's infeasible count (observability)."""
+        advisor = self.get(advisor_id)
+        advisor.feedback_infeasible(knobs, kind)
+        if trial_id is not None:
+            with self._lock:
+                sched = self._schedulers.get(advisor_id)
+            if sched is not None:
+                sched.forget(trial_id)
+        return advisor.infeasible_count
+
     def replay_feedback(
-        self, advisor_id: str, items: List[Tuple[Dict[str, Any], float]]
+        self,
+        advisor_id: str,
+        items: List[Tuple[Dict[str, Any], float]],
+        infeasible: Optional[List[Tuple[Dict[str, Any], str]]] = None,
     ) -> bool:
         """Seed a FRESH advisor session with already-scored (knobs, score)
         pairs — how a restarted worker rebuilds the GP from the completed
@@ -170,15 +226,26 @@ class AdvisorStore:
         double-feed the optimizer. (Workers also feed back BEFORE marking a
         trial COMPLETED, so a trial visible as COMPLETED implies its score
         is already in a surviving session — the guard and that ordering
-        together close the double-feed window.)"""
+        together close the double-feed window.)
+
+        ``infeasible`` — (knobs, fault_kind) pairs from USER/TIMEOUT/
+        INVALID_SCORE-errored trials — rides the same guard: a fresh
+        session relearns which regions crash, not just which scored."""
         with self._lock:
             advisor = self._advisors.get(advisor_id)
             if advisor is None:
                 raise KeyError(f"No such advisor: {advisor_id}")
-            if advisor.observation_count > 0:
+            # infeasible points count toward "not fresh" too: a session
+            # that survived with ONLY infeasible history (every early
+            # trial crashed) must not re-accumulate duplicates on each
+            # worker restart of a crash-looping job
+            if advisor.observation_count > 0 \
+                    or getattr(advisor, "infeasible_count", 0) > 0:
                 return False
             for knobs, score in items:
                 advisor.feedback(knobs, float(score))
+            for knobs, kind in infeasible or []:
+                advisor.feedback_infeasible(knobs, str(kind))
             return True
 
     def report_rung(self, advisor_id: str, trial_id: str, resource: int,

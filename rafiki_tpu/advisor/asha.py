@@ -45,7 +45,10 @@ class AshaScheduler:
         self.eta = int(eta)
         self.mode = mode
         self._lock = threading.Lock()
-        self._rungs: Dict[int, List[float]] = {}   # rung resource -> values
+        # rung resource -> {trial_id: value}; keyed by trial so a trial
+        # that later ERRORS can be forgotten (its partial metrics must
+        # not set promotion bars for healthy trials — see forget())
+        self._rungs: Dict[int, Dict[str, float]] = {}
         self._recorded: Dict[str, set] = {}        # trial -> rungs recorded
 
     def _rungs_reached(self, resource: int) -> List[int]:
@@ -83,12 +86,23 @@ class AshaScheduler:
                 # bias the rung with a later-epoch value, so skip — a rung
                 # population holds only values measured AT its resource
                 return True
-            values = self._rungs.setdefault(rung, [])
-            values.append(value)
+            values = self._rungs.setdefault(rung, {})
+            values[trial_id] = value
             if len(values) < self.eta:
                 return True  # not enough evidence at this rung yet
-            ranked = sorted(values, reverse=(self.mode == "max"))
+            ranked = sorted(values.values(), reverse=(self.mode == "max"))
             top_k = max(int(math.ceil(len(ranked) / self.eta)), 1)
             threshold = ranked[top_k - 1]
             return (value <= threshold if self.mode == "min"
                     else value >= threshold)
+
+    def forget(self, trial_id: str) -> None:
+        """Erase a trial's rung records (trial fault taxonomy: the trial
+        ERRORED after reporting — a USER crash or invalid score). Its
+        recorded values may be garbage from a template already failing,
+        and a dead trial must not occupy top-1/eta slots that kill
+        healthy fresh trials competing at the same rungs."""
+        with self._lock:
+            for values in self._rungs.values():
+                values.pop(trial_id, None)
+            self._recorded.pop(trial_id, None)

@@ -112,6 +112,17 @@ class BayesOpt:
         self.observed_X: List[np.ndarray] = []
         self.observed_y: List[float] = []
         self.pending_X: List[np.ndarray] = []
+        # points that FAILED without a score (crashing template, timeout,
+        # NaN evaluate — the trial fault taxonomy's infeasible kinds):
+        # fantasized below the observed minimum so EI steers away from
+        # the region instead of re-proposing it (Vizier-style infeasible
+        # handling, Golovin et al. 2017). DEDUPLICATED on a quantized
+        # grid and capped: quarantine re-proposals and restart replays
+        # feed near-identical points repeatedly, and thousands of
+        # clustered penalty rows would bloat the O(n^3) fit and wreck
+        # kernel conditioning without adding information.
+        self.infeasible_X: List[np.ndarray] = []
+        self._infeasible_cells: set = set()
 
     @property
     def n_warmup(self) -> int:
@@ -127,12 +138,25 @@ class BayesOpt:
         if self.dims == 0:
             return np.zeros(0)
         if len(self.observed_X) < self.n_warmup:
-            x = self.rng.random(self.dims)
+            x = self._warmup_draw()
         else:
             X = np.array(self.observed_X)
             y = np.array(self.observed_y)
+            # the constant-liar level for in-flight points comes from the
+            # OBSERVED minimum, taken before the penalty rows join y —
+            # a sibling's pending point is "probably mediocre", not
+            # "probably crashes"
+            lie = float(y.min())
+            if self.infeasible_X:
+                # penalty fantasies: infeasible points enter the fit at
+                # one spread below the observed minimum — low enough
+                # that EI never chases the region, finite enough that
+                # the GP stays well-conditioned
+                bad = lie - (float(y.std()) or 1.0)
+                X = np.vstack([X, np.array(self.infeasible_X)])
+                y = np.concatenate(
+                    [y, np.full(len(self.infeasible_X), bad)])
             if self.pending_X:
-                lie = float(y.min())
                 X = np.vstack([X, np.array(self.pending_X)])
                 y = np.concatenate([y, np.full(len(self.pending_X), lie)])
             gp = GaussianProcess()
@@ -151,8 +175,45 @@ class BayesOpt:
             self.mark_pending(x)
         return x
 
+    def _warmup_draw(self) -> np.ndarray:
+        """Random warmup point; with infeasible history, the draw is the
+        candidate FARTHEST from any infeasible point among a small pool —
+        warmup must not keep landing in a known-crashing basin while the
+        GP has too little data to learn it."""
+        if not self.infeasible_X:
+            return self.rng.random(self.dims)
+        cand = self.rng.random((16, self.dims))
+        inf = np.array(self.infeasible_X)
+        d_min = np.sqrt(
+            ((cand[:, None, :] - inf[None, :, :]) ** 2).sum(-1)).min(1)
+        return cand[int(np.argmax(d_min))]
+
     def mark_pending(self, x: np.ndarray) -> None:
         self.pending_X.append(np.asarray(x, dtype=np.float64))
+
+    INFEASIBLE_GRID = 16   # dedup resolution per dimension
+    INFEASIBLE_CAP = 512   # hard bound; beyond it the oldest drop
+
+    def mark_infeasible(self, x: np.ndarray) -> None:
+        """Record a point that failed without a usable score. Retires
+        the matching pending fantasy like ``observe`` does — the trial
+        is finished, just not scored. A point in an already-penalized
+        grid cell still retires its fantasy but adds no new row."""
+        x = np.asarray(x, dtype=np.float64)
+        if self.pending_X:
+            d = [float(((p - x) ** 2).sum()) for p in self.pending_X]
+            self.pending_X.pop(int(np.argmin(d)))
+        cell = tuple(np.round(x * self.INFEASIBLE_GRID).astype(int)
+                     .tolist())
+        if cell in self._infeasible_cells:
+            return
+        self._infeasible_cells.add(cell)
+        self.infeasible_X.append(x)
+        if len(self.infeasible_X) > self.INFEASIBLE_CAP:
+            old = self.infeasible_X.pop(0)
+            self._infeasible_cells.discard(
+                tuple(np.round(old * self.INFEASIBLE_GRID).astype(int)
+                      .tolist()))
 
     def observe(self, x: np.ndarray, y: float) -> None:
         x = np.asarray(x, dtype=np.float64)

@@ -67,7 +67,7 @@ def _ride_out(fn, what: str):
 
 
 class _RemoteAdvisor:
-    """Duck-types BaseAdvisor for the one call TrainWorker makes on it."""
+    """Duck-types BaseAdvisor for the calls TrainWorker makes on it."""
 
     def __init__(self, client: Client, advisor_id: str):
         self._client = client
@@ -78,6 +78,13 @@ class _RemoteAdvisor:
             lambda: self._client.feedback_knobs(self._id, knobs,
                                                 float(score)),
             "feedback")
+
+    def feedback_infeasible(self, knobs: Dict[str, Any],
+                            kind: str = "USER") -> None:
+        _ride_out(
+            lambda: self._client.feedback_infeasible_knobs(
+                self._id, knobs, kind=kind),
+            "feedback_infeasible")
 
 
 class RemoteAdvisorStore:
@@ -108,9 +115,23 @@ class RemoteAdvisorStore:
     def get(self, advisor_id: str) -> _RemoteAdvisor:
         return _RemoteAdvisor(self._client, advisor_id)
 
-    def replay_feedback(self, advisor_id: str, items) -> bool:
+    def feedback_infeasible(self, advisor_id: str, knobs: Dict[str, Any],
+                            kind: str = "USER",
+                            trial_id: Optional[str] = None) -> int:
+        """Scoreless-failure signal (trial fault taxonomy) over the
+        admin API — same ride-out semantics as feedback: re-applying on
+        a lost response adds one duplicate penalty point, which the GP
+        tolerates."""
         return _ride_out(
-            lambda: self._client.replay_advisor_feedback(advisor_id, items),
+            lambda: self._client.feedback_infeasible_knobs(
+                advisor_id, knobs, kind=kind, trial_id=trial_id),
+            "feedback_infeasible")
+
+    def replay_feedback(self, advisor_id: str, items,
+                        infeasible=None) -> bool:
+        return _ride_out(
+            lambda: self._client.replay_advisor_feedback(
+                advisor_id, items, infeasible=infeasible),
             "replay_feedback")
 
     def report_rung(self, advisor_id: str, trial_id: str, resource: int,

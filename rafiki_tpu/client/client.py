@@ -358,15 +358,33 @@ class Client:
     def propose_knobs(self, advisor_id: str) -> Dict[str, Any]:
         return self._call("POST", f"/advisors/{advisor_id}/propose")["knobs"]
 
-    def replay_advisor_feedback(self, advisor_id: str, items) -> bool:
+    def replay_advisor_feedback(self, advisor_id: str, items,
+                                infeasible=None) -> bool:
         """Seed a fresh advisor session with already-scored (knobs, score)
-        pairs; no-op (False) if the session already has observations."""
+        pairs; no-op (False) if the session already has observations.
+        ``infeasible`` — (knobs, fault_kind) pairs of scoreless failures
+        — rides the same empty-only guard."""
         out = self._call(
             "POST",
             f"/advisors/{advisor_id}/replay",
-            {"items": [{"knobs": k, "score": s} for k, s in items]},
+            {"items": [{"knobs": k, "score": s} for k, s in items],
+             "infeasible": [{"knobs": k, "kind": kind}
+                            for k, kind in infeasible or []]},
         )
         return bool(out["replayed"])
+
+    def feedback_infeasible_knobs(
+        self, advisor_id: str, knobs: Dict[str, Any], kind: str = "USER",
+        trial_id: Optional[str] = None,
+    ) -> int:
+        """Tell the advisor the trial at ``knobs`` failed without a
+        usable score (fault taxonomy kind USER/TIMEOUT/INVALID_SCORE);
+        proposals steer away. Returns the session's infeasible count."""
+        return int(self._call(
+            "POST",
+            f"/advisors/{advisor_id}/infeasible",
+            {"knobs": knobs, "kind": kind, "trial_id": trial_id},
+        )["infeasible"])
 
     def feedback_knobs(
         self, advisor_id: str, knobs: Dict[str, Any], score: float

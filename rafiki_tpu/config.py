@@ -145,6 +145,37 @@ _DYNAMIC_PATHS = {
     #   RAFIKI_RECOVER_RETRY_MAX=4        metadata-store retries during
     #                                     reconcile (bounded, jittered)
     #   RAFIKI_RECOVER_RETRY_BACKOFF_S=0.2  backoff base for those retries
+    # -- training-plane trial fault tolerance (docs/failure-model.md,
+    # "Training-plane faults"). Lazy so tests/operators retune a live
+    # worker's NEXT trial without re-importing:
+    #   RAFIKI_TRIAL_RETRY_MAX=2        infra-class faults (INFRA/MEM/
+    #                                   STALL) re-run under the same
+    #                                   trial id up to this many times
+    #                                   (0 = every fault burns budget;
+    #                                   doctor WARNs)
+    #   RAFIKI_TRIAL_RETRY_BACKOFF_S=0.5  backoff base for those
+    #                                   re-runs (exponential, jittered)
+    #   RAFIKI_TRIAL_QUARANTINE_K=3     user-class faults on near-
+    #                                   identical knobs before that
+    #                                   signature is quarantined
+    #   RAFIKI_TRIAL_REPROPOSE_MAX=8    proposals rejected per slot for
+    #                                   matching a quarantined signature
+    #                                   before the worker accepts one
+    #   RAFIKI_TRIAL_FAULT_LIMIT=5      consecutive user-class faults on
+    #                                   DISTINCT knobs that error the
+    #                                   whole job early (0 disables)
+    #   RAFIKI_PENDING_FEEDBACK_MAX=256 cap on queued advisor feedback
+    #                                   awaiting retry (drop-oldest)
+    # (RAFIKI_TRIAL_STALL_S lives in sdk/sandbox.py: the no-frame
+    # deadline on sandbox children.)
+    "TRIAL_RETRY_MAX": lambda: _env_int("RAFIKI_TRIAL_RETRY_MAX", 2),
+    "TRIAL_RETRY_BACKOFF_S": lambda: _env_float(
+        "RAFIKI_TRIAL_RETRY_BACKOFF_S", 0.5),
+    "TRIAL_QUARANTINE_K": lambda: _env_int("RAFIKI_TRIAL_QUARANTINE_K", 3),
+    "TRIAL_REPROPOSE_MAX": lambda: _env_int("RAFIKI_TRIAL_REPROPOSE_MAX", 8),
+    "TRIAL_FAULT_LIMIT": lambda: _env_int("RAFIKI_TRIAL_FAULT_LIMIT", 5),
+    "PENDING_FEEDBACK_MAX": lambda: _env_int(
+        "RAFIKI_PENDING_FEEDBACK_MAX", 256),
     "RECOVER_ADOPT": lambda: os.environ.get(
         "RAFIKI_RECOVER_ADOPT", "1") != "0",
     "RECOVER_PROBE_TIMEOUT_S": lambda: _env_float(

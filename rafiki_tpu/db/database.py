@@ -77,6 +77,8 @@ CREATE TABLE IF NOT EXISTS train_job (
     test_dataset_uri TEXT NOT NULL,
     budget TEXT NOT NULL,
     status TEXT NOT NULL,
+    fault_kind TEXT,
+    error_reason TEXT,
     datetime_started REAL NOT NULL,
     datetime_stopped REAL,
     UNIQUE (app, app_version, user_id)
@@ -109,6 +111,9 @@ CREATE TABLE IF NOT EXISTS trial (
     score REAL,
     status TEXT NOT NULL,
     params_file_path TEXT,
+    attempt INTEGER NOT NULL DEFAULT 0,
+    fault_kind TEXT,
+    fault_detail TEXT,
     datetime_started REAL NOT NULL,
     datetime_stopped REAL
 );
@@ -323,6 +328,13 @@ class Database:
         # index backing the recovery scan's status predicate
         "ALTER TABLE service ADD COLUMN pid INTEGER",
         "CREATE INDEX IF NOT EXISTS idx_service_status ON service(status)",
+        # r7 (trial fault taxonomy): why a trial/job failed, queryable —
+        # attempt counts infra-class re-runs under the same trial id
+        "ALTER TABLE trial ADD COLUMN attempt INTEGER NOT NULL DEFAULT 0",
+        "ALTER TABLE trial ADD COLUMN fault_kind TEXT",
+        "ALTER TABLE trial ADD COLUMN fault_detail TEXT",
+        "ALTER TABLE train_job ADD COLUMN fault_kind TEXT",
+        "ALTER TABLE train_job ADD COLUMN error_reason TEXT",
     )
 
     def _migrate(self) -> None:
@@ -573,12 +585,24 @@ class Database:
             ),
         )
 
-    def mark_train_job_as_errored(self, train_job_id: str) -> None:
+    def mark_train_job_as_errored(
+        self,
+        train_job_id: str,
+        fault_kind: Optional[str] = None,
+        error_reason: Optional[str] = None,
+    ) -> None:
+        """Error a job with a typed, recorded reason (trial fault
+        taxonomy): ``fault_kind`` is the dominant trial fault class that
+        killed it (e.g. USER for a poison template failing fast) and
+        ``error_reason`` the operator-readable sentence. Both are None
+        for legacy callers — the guarded transition is unchanged."""
         self._exec(
-            "UPDATE train_job SET status=?, datetime_stopped=? WHERE id=?"
-            " AND status IN (?,?)",
+            "UPDATE train_job SET status=?, fault_kind=?, error_reason=?,"
+            " datetime_stopped=? WHERE id=? AND status IN (?,?)",
             (
                 TrainJobStatus.ERRORED,
+                fault_kind,
+                error_reason,
                 time.time(),
                 train_job_id,
                 TrainJobStatus.STARTED,
@@ -784,11 +808,83 @@ class Database:
             (TrialStatus.COMPLETED, score, params_file_path, time.time(), trial_id),
         )
 
-    def mark_trial_as_errored(self, trial_id: str) -> None:
+    def mark_trial_as_errored(
+        self,
+        trial_id: str,
+        fault_kind: Optional[str] = None,
+        fault_detail: Optional[str] = None,
+    ) -> None:
+        """Terminal failure with its taxonomy kind and truncated
+        traceback recorded on the row — diagnosing a failed trial must
+        not require scraping worker logs (worker/faults.py)."""
         self._exec(
-            "UPDATE trial SET status=?, datetime_stopped=? WHERE id=?",
-            (TrialStatus.ERRORED, time.time(), trial_id),
+            "UPDATE trial SET status=?, fault_kind=?, fault_detail=?,"
+            " datetime_stopped=? WHERE id=?",
+            (TrialStatus.ERRORED, fault_kind, fault_detail, time.time(),
+             trial_id),
         )
+
+    def record_trial_fault(
+        self, trial_id: str, fault_kind: str, fault_detail: Optional[str]
+    ) -> int:
+        """An infra-class fault the worker is about to RETRY: bump the
+        attempt counter and record the latest fault kind/detail, but
+        keep the trial RUNNING (same id, same knobs, same budget slot).
+        Returns the new attempt number."""
+        self._exec(
+            "UPDATE trial SET attempt=attempt+1, fault_kind=?,"
+            " fault_detail=? WHERE id=?",
+            (fault_kind, fault_detail, trial_id),
+        )
+        row = self._one("SELECT attempt FROM trial WHERE id=?", (trial_id,))
+        return int(row["attempt"]) if row else 0
+
+    def get_trial_fault_counts_of_train_job(
+        self, train_job_id: str
+    ) -> Dict[str, int]:
+        """fault_kind -> count across the job's ERRORED trials (doctor).
+        Only terminal failures count as faults here — COMPLETED/RUNNING
+        rows keep the kind of a transient fault they absorbed for
+        per-trial observability, but a healthy job must not read as
+        faulted in aggregate (its absorbed re-runs show as retries)."""
+        rows = self._all(
+            "SELECT t.fault_kind AS k, COUNT(*) AS c FROM trial t"
+            " JOIN sub_train_job s ON t.sub_train_job_id = s.id"
+            " WHERE s.train_job_id=? AND t.fault_kind IS NOT NULL"
+            " AND t.status=?"
+            " GROUP BY t.fault_kind",
+            (train_job_id, TrialStatus.ERRORED),
+        )
+        return {r["k"]: int(r["c"]) for r in rows}
+
+    def get_trial_fault_summary_of_live_jobs(self) -> Dict[str, Dict]:
+        """One grouped query for the fleet-health "training" section:
+        train_job_id -> {"faults": {kind: count}, "retries": total}
+        across every STARTED/RUNNING train job — never a per-job query
+        fan-out inside the health handler. ``faults`` counts only
+        ERRORED rows (terminal failures); absorbed transient re-runs —
+        on any row, whatever its current status — aggregate into
+        ``retries``."""
+        rows = self._all(
+            "SELECT s.train_job_id AS jid, t.fault_kind AS k,"
+            " t.status AS st, COUNT(*) AS c,"
+            " COALESCE(SUM(t.attempt), 0) AS a"
+            " FROM trial t"
+            " JOIN sub_train_job s ON t.sub_train_job_id = s.id"
+            " JOIN train_job j ON s.train_job_id = j.id"
+            " WHERE j.status IN (?,?)"
+            " GROUP BY s.train_job_id, t.fault_kind, t.status",
+            (TrainJobStatus.STARTED, TrainJobStatus.RUNNING),
+        )
+        out: Dict[str, Dict] = {}
+        for r in rows:
+            entry = out.setdefault(r["jid"], {"faults": {}, "retries": 0})
+            if r["k"] is not None and r["st"] == TrialStatus.ERRORED:
+                entry["faults"][r["k"]] = \
+                    entry["faults"].get(r["k"], 0) + int(r["c"])
+            entry["retries"] += int(r["a"])
+        return out
+
 
     def mark_trial_as_terminated(self, trial_id: str) -> None:
         self._exec(

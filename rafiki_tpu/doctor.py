@@ -270,6 +270,81 @@ def check_recovery() -> Check:
             "no orphaned jobs; adoption enabled")
 
 
+def check_trial_faults() -> Check:
+    """Training-plane fault tolerance (docs/failure-model.md,
+    "Training-plane faults"): WARN when infra-retry is disabled
+    (RAFIKI_TRIAL_RETRY_MAX=0 — every transient fault burns a budget
+    slot), when a live job's recent trials are mostly ERRORED (the
+    signature of a broken template or a sick host), and list poison-knob
+    signatures with enough recorded user-class faults to be quarantined
+    (grouped by exact knob JSON here — the store scan has no knob
+    config, so this is the conservative subset of the worker's
+    unit-cube quarantine)."""
+    from rafiki_tpu import config
+
+    notes = []
+    retry_disabled = int(config.TRIAL_RETRY_MAX) <= 0
+    if retry_disabled:
+        notes.append("RAFIKI_TRIAL_RETRY_MAX=0: transient INFRA/MEM/"
+                     "STALL faults will NOT be retried — each burns a "
+                     "budget slot")
+    target = str(config.DB_PATH)
+    is_url = target.startswith(("postgresql://", "postgres://"))
+    hot_jobs = 0
+    quarantined = []
+    if is_url or os.path.exists(target):
+        try:
+            import time as _time
+
+            from rafiki_tpu.db.database import Database
+            from rafiki_tpu.worker.faults import quarantined_signatures
+
+            recent_s = 3600.0
+            now = _time.time()
+            db = Database(target)
+            try:
+                for j in db.get_train_jobs_by_statuses(
+                        ["STARTED", "RUNNING"]):
+                    trials = db.get_trials_of_train_job(j["id"])
+                    recent = [t for t in trials
+                              if now - (t.get("datetime_started") or now)
+                              < recent_s]
+                    errored = [t for t in recent
+                               if t["status"] == "ERRORED"]
+                    if len(recent) >= 3 and \
+                            len(errored) / len(recent) > 0.5:
+                        hot_jobs += 1
+                        kinds = db.get_trial_fault_counts_of_train_job(
+                            j["id"])
+                        notes.append(
+                            f"job {j['id'][:8]}: {len(errored)}/"
+                            f"{len(recent)} recent trials ERRORED "
+                            f"(fault kinds: {kinds or 'unrecorded'})")
+                    q = quarantined_signatures(
+                        trials, None,
+                        int(config.TRIAL_QUARANTINE_K))
+                    quarantined.extend(
+                        f"job {j['id'][:8]}: {sig} x{n}"
+                        for sig, n in q.items())
+            finally:
+                db.close()
+        except Exception as e:
+            return ("trial faults", WARN,
+                    f"could not scan {target}: {type(e).__name__}: {e}")
+    if quarantined:
+        notes.append("quarantined knob signatures: "
+                     + "; ".join(quarantined[:5])
+                     + (" …" if len(quarantined) > 5 else ""))
+    if hot_jobs or retry_disabled:
+        return ("trial faults", WARN, "; ".join(notes))
+    detail = (f"retry up to {int(config.TRIAL_RETRY_MAX)} per trial, "
+              f"quarantine at {int(config.TRIAL_QUARANTINE_K)} faults, "
+              f"job fail-fast at {int(config.TRIAL_FAULT_LIMIT) or 'off'}")
+    if quarantined:
+        return ("trial faults", PASS, detail + "; " + notes[-1])
+    return ("trial faults", PASS, detail)
+
+
 def check_agents() -> Check:
     from rafiki_tpu.utils.agent_http import AgentHTTPError, call_agent
 
@@ -337,8 +412,8 @@ def check_agents() -> Check:
 
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
-    check_chaos, check_overload_knobs, check_recovery, check_agents,
-    check_backend,
+    check_chaos, check_overload_knobs, check_recovery,
+    check_trial_faults, check_agents, check_backend,
 ]
 
 

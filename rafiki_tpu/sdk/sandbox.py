@@ -73,6 +73,7 @@ import base64
 import json
 import logging
 import os
+import signal
 import stat
 import subprocess
 import sys
@@ -100,7 +101,60 @@ ENV_ALLOWLIST = ("PATH", "TMPDIR", "TZ", "RAFIKI_CHIP_GRANT",
 
 class SandboxError(Exception):
     """The sandboxed trial failed (model error, limit hit, or protocol
-    breakdown); carries the child-side traceback when there is one."""
+    breakdown); carries the child-side traceback when there is one.
+
+    Subclasses carry a ``kind`` from the trial fault taxonomy
+    (worker/faults.py — plain strings here so the sdk layer stays
+    import-free of the worker layer): the worker's retry/quarantine
+    machinery branches on it instead of parsing messages."""
+
+    kind = "INFRA"
+
+
+class SandboxInfraError(SandboxError):
+    """The platform failed the child: spawn failure, protocol breakdown,
+    killed by an unexplained signal. Retryable (same trial id)."""
+
+    kind = "INFRA"
+
+
+class SandboxMemError(SandboxError):
+    """The child breached its memory envelope: RLIMIT_AS MemoryError
+    from model code, or SIGKILL while RAFIKI_SANDBOX_MEM_MB was
+    active (kernel/OOM enforcement)."""
+
+    kind = "MEM"
+
+
+class SandboxUserError(SandboxError):
+    """Model code raised (an ``err`` frame from sandbox_child with
+    where=model). Terminal: the knobs are infeasible, not the infra."""
+
+    kind = "USER"
+
+
+class SandboxStallError(SandboxError):
+    """The child went mute before its first frame for
+    RAFIKI_TRIAL_STALL_S and the no-frame watchdog killed its process
+    group (wedged import, dead TPU tunnel). Retryable."""
+
+    kind = "STALL"
+
+
+class SandboxTimeoutError(SandboxError):
+    """The trial blew through its TRIAL_TIMEOUT_S budget and ignored
+    the STOP verdict (a mute runaway); the watchdog terminated it."""
+
+    kind = "TIMEOUT"
+
+
+def stall_deadline_s() -> float:
+    """RAFIKI_TRIAL_STALL_S: how long a sandbox child may produce NO
+    frame at all before the watchdog kills it (0 disables). Armed only
+    until the first frame — once the template has spoken, mid-training
+    silence is legitimate (an epoch can take longer than any sane stall
+    deadline) and TRIAL_TIMEOUT_S owns runaways."""
+    return float(os.environ.get("RAFIKI_TRIAL_STALL_S", "600"))
 
 
 def sandbox_enabled() -> bool:
@@ -180,25 +234,44 @@ def _child_env(jail_dir: str) -> Dict[str, str]:
 
 def _ensure_traversal(path: str, read: bool = False) -> None:
     """Give the dropped child directory-traversal (execute) bits on
-    ``path`` and every ancestor this uid owns — group AND other x, since
-    the child may run with gid 0 (KEEP_GID0 mode) or an anonymous gid.
-    ``read=True`` additionally grants read on ``path`` itself (package
-    roots need listing for import; ancestors never do). Never touches
-    files we don't own; every widening is LOGGED (advisor r4: these are
+    ``path`` and every ancestor this process may widen — group AND
+    other x, since the child may run with gid 0 (KEEP_GID0 mode) or an
+    anonymous gid. ``read=True`` additionally grants read on ``path``
+    itself (package roots need listing for import; ancestors never do).
+
+    An unprivileged worker never touches files it doesn't own; a ROOT
+    worker (the only case where uid drops — and therefore traversal
+    grants — matter at all) additionally widens non-owned directories,
+    but with the *execute bit only*, never read: a repo checkout under
+    e.g. a /root whose directory is owned by some provisioning uid
+    would otherwise make EVERY sandboxed trial fail at import with a
+    spawn-class fault, while an x-only grant exposes nothing listable —
+    reaching a file still requires knowing its path and passing its own
+    mode bits. On a multi-user host where even that is unacceptable
+    (an o+x'd home directory persists after the worker exits),
+    ``RAFIKI_SANDBOX_WIDEN_NONOWNED=0`` restores the strict owner-only
+    rule — the operator then pre-grants traversal along the repo path
+    themselves. Every widening is LOGGED (advisor r4: these are
     system-visible side effects — e.g. /root gains o+x so the jailed
-    uid can reach /root/repo — and operators must be able to see them)."""
+    uid can reach /root/repo — and operators must be able to see
+    them)."""
     travers = stat.S_IXGRP | stat.S_IXOTH
     p = os.path.abspath(path)
     want = travers | (stat.S_IRGRP | stat.S_IROTH if read else 0)
+    is_root = (os.geteuid() == 0 and os.environ.get(
+        "RAFIKI_SANDBOX_WIDEN_NONOWNED", "1") != "0")
     while True:
         try:
             st = os.stat(p)
-            if st.st_uid == os.getuid() and (st.st_mode & want) != want:
-                os.chmod(p, st.st_mode | want)
+            owned = st.st_uid == os.getuid()
+            # non-owned dirs (root only): traversal x, never read bits
+            eff = want if owned else (want & travers if is_root else 0)
+            if eff and (st.st_mode & eff) != eff:
+                os.chmod(p, st.st_mode | eff)
                 logger.info(
                     "sandbox: widened %s %o -> %o (traversal grant for "
                     "jailed uids)", p, stat.S_IMODE(st.st_mode),
-                    stat.S_IMODE(st.st_mode | want))
+                    stat.S_IMODE(st.st_mode | eff))
         except OSError:
             pass
         parent = os.path.dirname(p)
@@ -347,13 +420,18 @@ def _spawn_child(jail_dir: str, extra_pythonpath: Optional[str]):
     # along the repo path (e.g. /root is 0700 by default) and listing on
     # the package root itself (import's FileFinder lists it)
     _ensure_traversal(_REPO_ROOT, read=True)
-    # NOT start_new_session: the child must die with the worker's process
-    # group (a stopped/killed worker may never reach explicit teardown)
+    # start_new_session: the child leads its OWN process group, so a
+    # kill (stall/timeout watchdog, teardown) reaches every process the
+    # template forked — a daemonized grandchild must not outlive its
+    # trial holding a chip grant. The cost is that a SIGKILLed worker no
+    # longer takes the child down via shared process group; the
+    # explicit teardown paths (finally blocks here, placement destroy)
+    # and the jail's resource limits bound that window.
     proc = subprocess.Popen(
         [sys.executable, "-m", "rafiki_tpu.sdk.sandbox_child"],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True,
-        env=env, cwd=jail_dir,
+        env=env, cwd=jail_dir, start_new_session=True,
     )
     stderr_chunks: list = []
 
@@ -369,6 +447,43 @@ def _spawn_child(jail_dir: str, extra_pythonpath: Optional[str]):
     drain = threading.Thread(target=_drain_stderr, daemon=True)
     drain.start()
     return proc, stderr_chunks, drain
+
+
+def _signal_group(proc, sig: int) -> None:
+    """Deliver ``sig`` to the child's whole process group (it leads its
+    own session — see _spawn_child), falling back to the process itself
+    when the group is already gone or unsignalable."""
+    try:
+        os.killpg(proc.pid, sig)
+        return
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+    try:
+        proc.send_signal(sig)
+    except (ProcessLookupError, OSError):
+        pass
+
+
+def _reap_child_group(proc, grace_s: float = 10.0) -> None:
+    """Teardown contract: TERM the group, wait, KILL the group, and
+    sweep the group once more after the direct child is reaped so a
+    forked grandchild can't outlive the trial."""
+    if proc.poll() is None:
+        _signal_group(proc, signal.SIGTERM)
+        try:
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            _signal_group(proc, signal.SIGKILL)
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+    # final sweep: the group may still hold the template's forked
+    # grandchildren even though the leader is reaped
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
 
 
 def run_trial_sandboxed(
@@ -416,21 +531,67 @@ def run_trial_sandboxed(
 
     result: Dict[str, Any] = {}
     rc: Optional[int] = None
+    first_frame = threading.Event()
+    stalled = threading.Event()
+    timed_out = threading.Event()
     # Runaway guard the in-process path can't have: a template that never
     # logs cannot be stopped at a METRICS decision point, so past the
     # trial deadline the child gets a STOP (in case it logs soon), then a
-    # grace period, then SIGTERM — the frame loop below unblocks on EOF.
+    # grace period, then SIGTERM to its whole group — and, one more
+    # grace period later, SIGKILL: an untrusted template may install
+    # SIG_IGN for SIGTERM, and without the hard escalation the parent
+    # would block on child frames forever, the exact hang class the
+    # watchdogs exist to eliminate. The frame loop below unblocks on
+    # EOF and the exit is classified TIMEOUT.
     watchdogs = []
+
+    def _timeout_kill(sig: int) -> None:
+        timed_out.set()
+        _signal_group(proc, sig)
+
     if timeout_s:
-        watchdogs = [threading.Timer(timeout_s, send_stop),
-                     threading.Timer(timeout_s + 60.0, proc.terminate)]
+        watchdogs = [
+            threading.Timer(timeout_s, send_stop),
+            threading.Timer(timeout_s + 60.0, _timeout_kill,
+                            args=(signal.SIGTERM,)),
+            threading.Timer(timeout_s + 120.0, _timeout_kill,
+                            args=(signal.SIGKILL,)),
+        ]
         for w in watchdogs:
             w.daemon = True
             w.start()
+
+    # Stall watchdog (RAFIKI_TRIAL_STALL_S): without it the parent
+    # blocks on child frames INDEFINITELY when the child goes mute
+    # before its first line — a wedged import or dead TPU tunnel held
+    # the executor forever. Armed only until the first frame arrives;
+    # a template that has spoken is governed by TRIAL_TIMEOUT_S.
+    stall_s = stall_deadline_s()
+
+    def _stall_monitor() -> None:
+        if first_frame.wait(timeout=stall_s):
+            return
+        if proc.poll() is None and not result:
+            stalled.set()
+            logger.warning(
+                "sandbox child produced no frame within %.0fs "
+                "(RAFIKI_TRIAL_STALL_S); killing its process group",
+                stall_s)
+            _signal_group(proc, signal.SIGKILL)
+
+    if stall_s > 0:
+        threading.Thread(target=_stall_monitor, daemon=True).start()
     try:
-        proc.stdin.write(json.dumps(setup) + "\n")
-        proc.stdin.flush()
+        try:
+            proc.stdin.write(json.dumps(setup) + "\n")
+            proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            # spawn/interpreter-init failure: the child died before it
+            # could read its setup line — the platform's fault
+            raise SandboxInfraError(
+                f"sandbox child died before setup ({e!r})")
         for raw in proc.stdout:
+            first_frame.set()
             try:
                 frame = json.loads(raw)
             except json.JSONDecodeError:
@@ -470,14 +631,12 @@ def run_trial_sandboxed(
     finally:
         for w in watchdogs:
             w.cancel()
-        if proc.poll() is None:
-            # the untrusted child is NOT abandoned on teardown (unlike
-            # backend-probe children, it can hold a chip grant)
-            proc.terminate()
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        first_frame.set()  # disarm the stall monitor on every exit path
+        # the untrusted child is NOT abandoned on teardown (unlike
+        # backend-probe children, it can hold a chip grant) — and its
+        # whole process group goes with it, so forked grandchildren
+        # can't outlive the trial
+        _reap_child_group(proc)
         for s in (proc.stdin, proc.stdout, proc.stderr):
             try:
                 s.close()
@@ -487,11 +646,47 @@ def run_trial_sandboxed(
     if result.get("t") == "done":
         return float(result["score"]), base64.b64decode(result["params_b64"])
     if result.get("t") == "err":
-        raise SandboxError(
-            f"{result.get('error')}\n--- child traceback ---\n"
-            f"{result.get('traceback', '')}")
+        detail = (f"{result.get('error')}\n--- child traceback ---\n"
+                  f"{result.get('traceback', '')}")
+        # the child says WHO failed: model code (where=model, default
+        # for old children) vs the harness itself (e.g. lockdown)
+        if result.get("where", "model") != "model":
+            raise SandboxInfraError(detail)
+        if result.get("error_type") == "MemoryError":
+            # RLIMIT_AS enforcement surfaces as MemoryError inside the
+            # template — the memory envelope, not the template's logic
+            raise SandboxMemError(detail)
+        raise SandboxUserError(detail)
+    # frameless death: classify HOW the child died (exit code vs
+    # signal, which watchdog fired) instead of a generic string
     stderr_tail = "".join(stderr_chunks)[-2000:]
-    raise SandboxError(
+    if stalled.is_set():
+        raise SandboxStallError(
+            f"sandbox child produced no frame within "
+            f"{stall_s:.0f}s (RAFIKI_TRIAL_STALL_S) and was killed; "
+            f"stderr tail:\n{stderr_tail}")
+    if timed_out.is_set():
+        raise SandboxTimeoutError(
+            f"trial exceeded its {timeout_s:.0f}s budget "
+            f"(TRIAL_TIMEOUT_S) and ignored the STOP verdict; child "
+            f"killed; stderr tail:\n{stderr_tail}")
+    if rc is not None and rc < 0:
+        try:
+            signame = signal.Signals(-rc).name
+        except ValueError:
+            signame = f"signal {-rc}"
+        if -rc == signal.SIGKILL and int(setup.get("mem_mb") or 0) > 0:
+            # SIGKILL under an active memory cap is the kernel/OOM
+            # enforcement path (rss breach that never surfaced as a
+            # python MemoryError)
+            raise SandboxMemError(
+                f"sandbox child SIGKILLed with RAFIKI_SANDBOX_MEM_MB="
+                f"{setup['mem_mb']} active (rss breach); stderr tail:\n"
+                f"{stderr_tail}")
+        raise SandboxInfraError(
+            f"sandbox child killed by {signame} without a result "
+            f"frame; stderr tail:\n{stderr_tail}")
+    raise SandboxInfraError(
         f"sandbox child exited rc={rc} without a result frame; "
         f"stderr tail:\n{stderr_tail}")
 
@@ -647,11 +842,11 @@ class SandboxedModelServer:
         try:
             self._proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            self._proc.terminate()
-            try:
-                self._proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                self._proc.kill()
+            pass
+        # group teardown (incl. the post-reap sweep): a template that
+        # forked inside the serve child must not keep answering — or
+        # holding chips — after its service stops
+        _reap_child_group(self._proc, grace_s=5.0)
         for s in (self._proc.stdin, self._proc.stdout, self._proc.stderr):
             try:
                 s.close()

@@ -147,7 +147,10 @@ def main() -> int:
     try:
         _lockdown(setup)
     except Exception:
+        # where=lockdown: the HARNESS failed, not the template — the
+        # parent classifies this INFRA (retryable), never USER
         _emit({"t": "err", "error": "sandbox lockdown failed",
+               "where": "lockdown",
                "traceback": traceback.format_exc()})
         return 3
 
@@ -193,7 +196,11 @@ def main() -> int:
         _emit({"t": "done", "score": score, "params_b64": params_b64})
         return 0
     except Exception as e:
+        # error_type lets the parent map the failure into the fault
+        # taxonomy (MemoryError -> MEM, everything else -> USER)
+        # without parsing the message
         _emit({"t": "err", "error": f"{type(e).__name__}: {e}",
+               "where": "model", "error_type": type(e).__name__,
                "traceback": traceback.format_exc()[-4000:]})
         return 1
 

@@ -23,15 +23,19 @@ Fields:
              ``agent`` (host agent server), ``worker`` (inference
              serve loop — overload drills: slow/stalled replicas),
              ``wire`` (shm frames popped off the serving rings, before
-             decode — corruption drills), or ``db`` (metadata-store
+             decode — corruption drills), ``db`` (metadata-store
              statements — transient store-failure drills for
-             control-plane recovery). Required.
+             control-plane recovery), or ``trial`` (the trial-run
+             chokepoint in the train worker — fault-taxonomy drills).
+             Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
              ``delay_s`` then proceed — a slow replica), ``error``
-             (HTTP ``code``; at site=worker the batch fails), or
-             ``corrupt`` (site=wire only: truncate/garble the raw frame
-             bytes). Required.
+             (HTTP ``code``; at site=worker the batch fails; at
+             site=trial a typed transient INFRA fault), ``corrupt``
+             (site=wire only: truncate/garble the raw frame bytes), or
+             ``oom`` (site=trial only: raise MemoryError — the MEM-class
+             drill). Required.
     match    substring filter on the target ("addr path" client-side,
              request path server-side). Empty matches everything.
     after    skip the first N matching requests (default 0).
@@ -83,11 +87,20 @@ SITE_WIRE = "wire"
 # drill that proves control-plane recovery retries with bounded jittered
 # backoff instead of aborting reconciliation (docs/failure-model.md).
 SITE_DB = "db"
+# trial-run chokepoint (worker/train.py _execute_trial): one ask per
+# trial ATTEMPT, target "{sub_train_job_id} {trial_id}". `error` raises
+# a typed transient fault the taxonomy classifies INFRA (the
+# bounded-retry drill: the trial re-runs under the same id without
+# burning a budget slot), `oom` raises MemoryError (classified MEM),
+# `delay` models a slow trial start — docs/failure-model.md
+# "Training-plane faults".
+SITE_TRIAL = "trial"
 
 ACTION_DROP = "drop"
 ACTION_DELAY = "delay"
 ACTION_ERROR = "error"
 ACTION_CORRUPT = "corrupt"
+ACTION_OOM = "oom"
 
 
 class ChaosSpecError(ValueError):
@@ -109,15 +122,19 @@ class ChaosRule:
 
     def __post_init__(self) -> None:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
-                             SITE_WIRE, SITE_DB):
+                             SITE_WIRE, SITE_DB, SITE_TRIAL):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
-                               ACTION_CORRUPT):
+                               ACTION_CORRUPT, ACTION_OOM):
             raise ChaosSpecError(f"unknown chaos action {self.action!r}")
         if self.action == ACTION_CORRUPT and self.site != SITE_WIRE:
             raise ChaosSpecError(
                 "chaos action 'corrupt' only applies at site=wire "
                 "(raw frame bytes)")
+        if self.action == ACTION_OOM and self.site != SITE_TRIAL:
+            raise ChaosSpecError(
+                "chaos action 'oom' only applies at site=trial "
+                "(trial-run chokepoint)")
         if self.every < 1:
             raise ChaosSpecError("chaos 'every' must be >= 1")
 

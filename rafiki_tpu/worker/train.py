@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import time
 import traceback
 from typing import Any, Callable, Dict, Optional
@@ -39,13 +40,24 @@ from rafiki_tpu.sdk.artifact import write_artifact
 from rafiki_tpu.sdk.log import ModelLogger, StopTrialEarly
 from rafiki_tpu.sdk.model import load_model_class
 from rafiki_tpu.sdk.params import dump_params
+from rafiki_tpu.utils import chaos
 from rafiki_tpu.utils.trace import Tracer, jax_profile
+from rafiki_tpu.worker import faults
+from rafiki_tpu.worker.faults import FaultKind, TrialChaosError, validate_score
 
 logger = logging.getLogger(__name__)
 
 # Event name the worker sends when its sub-train-job exhausts its budget
 # (reference train.py:198-205).
 EVENT_BUDGET_REACHED = "sub_train_job_budget_reached"
+
+# Sent when the job fail-fast tripped: RAFIKI_TRIAL_FAULT_LIMIT
+# consecutive user-class trial faults — the template is broken, and
+# grinding the remaining budget through it would only produce more
+# ERRORED rows. Payload: train_job_id, sub_train_job_id, fault_kind,
+# reason. The admin marks the job ERRORED (with the reason on the row)
+# and tears down its services.
+EVENT_TRIAL_FAULT_LIMIT = "sub_train_job_fault_limit"
 
 EventFn = Callable[[str, Dict[str, Any]], None]
 
@@ -66,8 +78,20 @@ class TrainWorker:
         self._advisors = advisor_store
         self._send_event = send_event or (lambda name, payload: None)
         self._params_dir = params_dir or config.PARAMS_DIR
-        # observations whose advisor feedback failed, awaiting retry
+        # observations whose advisor feedback failed, awaiting retry —
+        # BOUNDED (RAFIKI_PENDING_FEEDBACK_MAX, drop-oldest): an advisor
+        # unreachable for hours must not grow this without limit
         self._pending_feedback: list = []
+        self._feedback_drop_warned = False
+        # trial fault tolerance (worker/faults.py): poison-knob
+        # quarantine and the consecutive user-fault streak. The
+        # signature counts are rebuilt from trial rows at startup, so
+        # quarantine survives worker restarts; the streak is in-memory
+        # on purpose — a restart is fresh evidence-gathering.
+        self._knob_config = None
+        self._quarantine: set = set()
+        self._user_fault_sigs: Dict[str, int] = {}
+        self._fault_streak = 0
 
     def start(self, ctx: ServiceContext) -> None:
         """The trial loop; returns when budget is reached or stop is set."""
@@ -136,20 +160,48 @@ class TrainWorker:
 
         all_trials = self._db.get_trials_of_sub_train_job(self._sub_id)
 
+        # Fault-tolerance state rebuild: poison-knob signatures with
+        # enough recorded user-class faults are quarantined from the
+        # first proposal of this incarnation — a restart must not spend
+        # fresh budget re-learning which region crashes.
+        self._knob_config = knob_config
+        self._user_fault_sigs = faults.poison_signature_counts(
+            all_trials, knob_config)
+        k = max(int(config.TRIAL_QUARANTINE_K), 1)
+        self._quarantine = {s for s, n in self._user_fault_sigs.items()
+                            if n >= k}
+        if self._quarantine:
+            faults.record_quarantine(self._sub_id, self._quarantine)
+            logger.warning("%d poison-knob signature(s) quarantined from "
+                           "recorded trial faults", len(self._quarantine))
+
         # Crash recovery, part 1: if the advisor session is fresh (its
         # process died too — in-process store, or an admin restart), rebuild
         # the GP from the completed trials already in the store; otherwise
         # the remaining budget would be proposed from the prior as if no
         # trial had ever run. Atomic + empty-only on the store side, so
-        # concurrently restarted siblings can't double-feed.
+        # concurrently restarted siblings can't double-feed. Infeasible
+        # observations (USER/TIMEOUT/INVALID_SCORE-errored trials) ride
+        # the same replay so the GP also relearns which regions crash.
         scored = [(t["knobs"], t["score"]) for t in all_trials
                   if t["status"] == TrialStatus.COMPLETED
                   and t["score"] is not None]
-        if scored:
+        infeasible = [(t["knobs"], t["fault_kind"]) for t in all_trials
+                      if faults.is_infeasible_row(t)]
+        if scored or infeasible:
             try:
-                if self._advisors.replay_feedback(advisor_id, scored):
-                    logger.info("replayed %d completed trials into advisor %s",
-                                len(scored), advisor_id)
+                if self._advisors.replay_feedback(advisor_id, scored,
+                                                  infeasible=infeasible):
+                    logger.info("replayed %d completed + %d infeasible "
+                                "trials into advisor %s", len(scored),
+                                len(infeasible), advisor_id)
+            except TypeError:
+                # an advisor store predating the infeasible signal
+                try:
+                    self._advisors.replay_feedback(advisor_id, scored)
+                except Exception:
+                    logger.warning("advisor replay failed; proposals start "
+                                   "from the prior", exc_info=True)
             except Exception:
                 logger.warning("advisor replay failed; proposals start from "
                                "the prior", exc_info=True)
@@ -178,7 +230,9 @@ class TrainWorker:
             logger.info("resuming stale trial %s after worker restart",
                         stale["id"])
             if not self._execute_trial(ctx, clazz, job, advisor_id,
-                                       stale["id"], stale["knobs"]):
+                                       stale["id"], stale["knobs"],
+                                       start_attempt=int(
+                                           stale.get("attempt") or 0)):
                 return
 
         while not ctx.stopping:
@@ -197,7 +251,7 @@ class TrainWorker:
                     except Exception:
                         logger.warning("pending feedback retry failed; "
                                        "proposing without it", exc_info=True)
-                    knobs = self._advisors.propose(advisor_id)
+                    knobs = self._propose_clear_of_quarantine(advisor_id)
                 trial = self._db.reserve_trial(
                     self._sub_id, model["id"], knobs,
                     worker_id=ctx.service_id, max_trials=max_trials,
@@ -217,52 +271,212 @@ class TrainWorker:
                 return
 
     def _execute_trial(self, ctx, clazz, job, advisor_id: str,
-                       trial_id: str, knobs, tracer=None) -> bool:
+                       trial_id: str, knobs, tracer=None,
+                       start_attempt: int = 0) -> bool:
         """Run one trial end to end: per-trial logger + stop-check wiring,
         train/evaluate/persist, and terminal bookkeeping. Shared by the
         stale-resume path and the main loop. Returns False when the worker
-        is stopping (the trial was marked TERMINATED) so the caller exits
-        its loop; an ERRORED trial returns True — it consumed its budget
-        slot and the executor survives a bad knob combination (the
-        reference instead exited the worker, reference train.py:122-132)."""
+        is exiting its loop — stopping (trial TERMINATED) or job
+        fail-fast (RAFIKI_TRIAL_FAULT_LIMIT tripped).
+
+        Failures run through the fault taxonomy (worker/faults.py):
+        infra-class kinds (INFRA/MEM/STALL) re-run under the SAME trial
+        id with jittered backoff up to RAFIKI_TRIAL_RETRY_MAX — no extra
+        budget slot is consumed (the row is reused), and a template that
+        keeps a checkpoint resumes mid-trial. User-class kinds
+        (USER/TIMEOUT/INVALID_SCORE) are terminal: the trial is ERRORED
+        with its kind + truncated traceback on the row, the budget slot
+        is consumed (as before), and the advisor receives an infeasible
+        observation so the proposal distribution steers away (the
+        reference instead exited the worker, reference train.py:122-132,
+        and this repo previously told the advisor nothing)."""
         trial_logger = ModelLogger()
         trial_logger.set_sink(
             lambda line, _tid=trial_id: self._db.add_trial_log(_tid, line))
-        self._install_stop_check(trial_logger, advisor_id, trial_id)
         tracer = tracer or Tracer(trial_id)
-        try:
-            score, params_path = self._run_trial(
-                clazz, knobs, job, trial_id, trial_logger, tracer)
-            # feedback BEFORE mark-complete: a sibling restarting in between
-            # sees COMPLETED only once the observation is in the GP, so its
-            # empty-only replay can't double-feed (the reverse window
-            # re-runs the trial at worst — a duplicate noisy observation,
-            # which the GP tolerates). A feedback failure must not cost the
-            # finished trial its result — _feedback_best_effort queues it.
-            # A stop signal that lands after the work finished does NOT
-            # discard the result: the score and params exist, persisting
-            # them is free, and only the loop exits early.
-            self._feedback_best_effort(advisor_id, knobs, score)
-            self._db.mark_trial_as_complete(trial_id, score, params_path)
-            if ctx.stopping:
-                return False
-        except Exception:
-            if ctx.stopping:
-                self._db.mark_trial_as_terminated(trial_id)
+        retry_max = max(int(config.TRIAL_RETRY_MAX), 0)
+        attempt = max(int(start_attempt), 0)
+        while True:
+            # fresh stop-check per attempt: the TRIAL_TIMEOUT_S clock
+            # measures THIS run of the template, not the sum of retries
+            self._install_stop_check(trial_logger, advisor_id, trial_id)
+            try:
+                self._chaos_trial(trial_id)
+                score, params_path = self._run_trial(
+                    clazz, knobs, job, trial_id, trial_logger, tracer)
+                # feedback BEFORE mark-complete: a sibling restarting in
+                # between sees COMPLETED only once the observation is in
+                # the GP, so its empty-only replay can't double-feed (the
+                # reverse window re-runs the trial at worst — a duplicate
+                # noisy observation, which the GP tolerates). A feedback
+                # failure must not cost the finished trial its result —
+                # _feedback_best_effort queues it. A stop signal that
+                # lands after the work finished does NOT discard the
+                # result: the score and params exist, persisting them is
+                # free, and only the loop exits early.
+                self._feedback_best_effort(advisor_id, knobs, score)
+                self._db.mark_trial_as_complete(trial_id, score, params_path)
+                self._fault_streak = 0
+                faults.record_counter(self._sub_id,
+                                      "consecutive_user_faults", 0,
+                                      absolute=True)
+                return not ctx.stopping
+            except Exception as e:
+                if ctx.stopping:
+                    self._db.mark_trial_as_terminated(trial_id)
+                    self._cleanup_ckpt(trial_id)
+                    return False
+                kind, detail = faults.classify_failure(e)
+                logger.error("trial %s fault %s (attempt %d):\n%s",
+                             trial_id, kind, attempt, detail)
+                if kind in faults.RETRYABLE_KINDS and attempt < retry_max:
+                    # same trial id, same knobs, same budget slot; the
+                    # attempt counter lives on the ROW, so the bound
+                    # holds across worker restarts too
+                    attempt = self._db.record_trial_fault(
+                        trial_id, kind, detail)
+                    faults.record_fault(self._sub_id, kind, retried=True)
+                    trial_logger.set_stop_check(None)
+                    self._retry_backoff(ctx, attempt)
+                    if ctx.stopping:
+                        self._db.mark_trial_as_terminated(trial_id)
+                        self._cleanup_ckpt(trial_id)
+                        return False
+                    logger.info("retrying trial %s (attempt %d/%d) after "
+                                "%s fault", trial_id, attempt, retry_max,
+                                kind)
+                    continue
+                self._db.mark_trial_as_errored(trial_id, kind, detail)
                 self._cleanup_ckpt(trial_id)
-                return False
-            logger.error("trial %s errored:\n%s", trial_id,
-                         traceback.format_exc())
-            self._db.mark_trial_as_errored(trial_id)
-            self._cleanup_ckpt(trial_id)
-        return True
+                faults.record_fault(self._sub_id, kind)
+                if kind in faults.INFEASIBLE_KINDS or \
+                        kind == FaultKind.MEM:
+                    # terminal MEM (retries exhausted) is knob-driven
+                    # too — steer the advisor away and count toward
+                    # quarantine; only user-class kinds march the job
+                    # fail-fast streak (repeated MEM on distinct knobs
+                    # reads as host pressure, not a broken template)
+                    self._feedback_infeasible_best_effort(
+                        advisor_id, knobs, kind, trial_id=trial_id)
+                    if not self._note_user_fault(
+                            job, trial_id, knobs, kind,
+                            streak=kind in faults.INFEASIBLE_KINDS):
+                        return False  # job fail-fast: exit the loop
+                return True
+
+    def _chaos_trial(self, trial_id: str) -> None:
+        """RAFIKI_CHAOS site=trial: the drillable fault chokepoint —
+        every retry/classification path is exercisable in CPU tier-1
+        tests without a real flaky host (docs/failure-model.md)."""
+        rule = chaos.hit(chaos.SITE_TRIAL, f"{self._sub_id} {trial_id}")
+        if rule is None:
+            return
+        if rule.action == chaos.ACTION_DELAY:
+            chaos.sleep_for(rule)
+            return
+        if rule.action == chaos.ACTION_OOM:
+            raise MemoryError("chaos-injected trial OOM (site=trial)")
+        raise TrialChaosError(
+            "chaos-injected transient trial fault (site=trial)")
+
+    def _retry_backoff(self, ctx, attempt: int) -> None:
+        """Exponential backoff with full jitter before an infra-retry
+        (uniform in [0, min(base * 2^(n-1), 30 s)] — the cap bounds the
+        realized sleep, not just the pre-jitter value), responsive to
+        the stop signal (waits on the stop event, never a blind
+        sleep)."""
+        base = max(float(config.TRIAL_RETRY_BACKOFF_S), 0.0)
+        ceiling = min(base * (2 ** max(attempt - 1, 0)), 30.0)
+        if ceiling > 0:
+            ctx.stop_event.wait(random.uniform(0, ceiling))
+
+    def _note_user_fault(self, job, trial_id: str, knobs,
+                         kind: str, streak: bool = True) -> bool:
+        """Poison-knob quarantine + job fail-fast bookkeeping after a
+        terminal poison fault. ``streak=False`` (terminal MEM) counts
+        toward quarantine only, never the fail-fast streak. Returns
+        False when the consecutive-fault limit tripped and the job was
+        errored (the caller exits)."""
+        sig = faults.knob_signature(self._knob_config, knobs)
+        self._user_fault_sigs[sig] = self._user_fault_sigs.get(sig, 0) + 1
+        k = max(int(config.TRIAL_QUARANTINE_K), 1)
+        if (self._user_fault_sigs[sig] >= k
+                and sig not in self._quarantine):
+            self._quarantine.add(sig)
+            faults.record_quarantine(self._sub_id, [sig])
+            logger.warning(
+                "knob signature %s quarantined after %d poison faults "
+                "(RAFIKI_TRIAL_QUARANTINE_K=%d); matching proposals "
+                "will be re-proposed", sig,
+                self._user_fault_sigs[sig], k)
+        if not streak:
+            return True
+        self._fault_streak += 1
+        faults.record_counter(self._sub_id, "consecutive_user_faults",
+                              self._fault_streak, absolute=True)
+        limit = int(config.TRIAL_FAULT_LIMIT)
+        if limit <= 0 or self._fault_streak < limit:
+            return True
+        reason = (
+            f"{self._fault_streak} consecutive user-class trial faults "
+            f"(RAFIKI_TRIAL_FAULT_LIMIT={limit}); last: {kind} on trial "
+            f"{trial_id} — template broken at every proposed knob "
+            f"combination, failing the job early instead of burning the "
+            f"remaining budget")
+        logger.error("train job %s fail-fast: %s", job["id"], reason)
+        # record the typed reason directly (works headless), then tell
+        # the admin so it tears down sibling workers; the guarded
+        # transition makes the double-mark harmless
+        self._db.mark_train_job_as_errored(job["id"], FaultKind.USER,
+                                           reason)
+        self._send_event(EVENT_TRIAL_FAULT_LIMIT, {
+            "train_job_id": job["id"],
+            "sub_train_job_id": self._sub_id,
+            "fault_kind": FaultKind.USER,
+            "reason": reason,
+        })
+        return False
+
+    def _propose_clear_of_quarantine(self, advisor_id: str):
+        """Propose knobs, re-proposing (bounded) while the draw matches
+        a quarantined poison signature. Each rejection ALSO feeds the
+        advisor an infeasible observation at the rejected point, so the
+        GP's penalty mass grows until the region stops being proposed —
+        the loop converges instead of fighting the optimizer forever.
+        After RAFIKI_TRIAL_REPROPOSE_MAX rejections the last draw is
+        accepted (with a warning): a mostly-quarantined search space
+        must degrade to slow progress, never to a spinning worker."""
+        knobs = self._advisors.propose(advisor_id)
+        if not self._quarantine:
+            return knobs
+        limit = max(int(config.TRIAL_REPROPOSE_MAX), 0)
+        for rejections in range(limit + 1):
+            sig = faults.knob_signature(self._knob_config, knobs)
+            if sig not in self._quarantine:
+                return knobs
+            if rejections == limit:
+                break  # this draw IS quarantined and the budget is out
+            faults.record_counter(self._sub_id, "reproposals")
+            logger.info("proposal matches quarantined signature %s; "
+                        "re-proposing", sig)
+            self._feedback_infeasible_best_effort(advisor_id, knobs,
+                                                  FaultKind.USER)
+            knobs = self._advisors.propose(advisor_id)
+        logger.warning(
+            "proposal still quarantined after %d re-proposals "
+            "(RAFIKI_TRIAL_REPROPOSE_MAX); accepting it — most of the "
+            "search space may be poisoned", limit)
+        return knobs
 
     def _feedback_best_effort(self, advisor_id: str, knobs, score) -> None:
         """Feed a trial score to the advisor, never letting an advisor
         failure destroy the trial result: the caller marks the trial
         COMPLETED right after. A failed observation is queued and retried
         before each later proposal (_retry_pending_feedback) — it cannot be
-        recovered by replay_feedback, which only seeds *empty* sessions."""
+        recovered by replay_feedback, which only seeds *empty* sessions.
+        The queue is bounded (RAFIKI_PENDING_FEEDBACK_MAX, drop-oldest):
+        an advisor unreachable for a whole shift must cost observations,
+        not memory."""
         try:
             self._retry_pending_feedback(advisor_id)
             self._advisors.get(advisor_id).feedback(knobs, score)
@@ -271,6 +485,36 @@ class TrainWorker:
             logger.warning(
                 "advisor feedback failed for %s (queued for retry):\n%s",
                 advisor_id, traceback.format_exc())
+            cap = max(int(config.PENDING_FEEDBACK_MAX), 1)
+            if len(self._pending_feedback) > cap:
+                dropped = len(self._pending_feedback) - cap
+                del self._pending_feedback[:dropped]
+                faults.record_counter(self._sub_id, "feedback_dropped",
+                                      dropped)
+                if not self._feedback_drop_warned:
+                    self._feedback_drop_warned = True
+                    logger.warning(
+                        "pending advisor feedback exceeded "
+                        "RAFIKI_PENDING_FEEDBACK_MAX=%d; dropping oldest "
+                        "observations (warning once; drops counted in "
+                        "training stats)", cap)
+
+    def _feedback_infeasible_best_effort(self, advisor_id: str, knobs,
+                                         kind: str,
+                                         trial_id: Optional[str] = None
+                                         ) -> None:
+        """Best-effort infeasible signal: penalty points are advisory —
+        a failure to deliver one is logged and DROPPED (never queued:
+        unlike scores, losing one costs a little steering, not an
+        observation). Tolerates advisor stores predating the signal."""
+        fi = getattr(self._advisors, "feedback_infeasible", None)
+        if fi is None:
+            return
+        try:
+            fi(advisor_id, knobs, kind=kind, trial_id=trial_id)
+        except Exception:
+            logger.warning("infeasible feedback for %s dropped",
+                           advisor_id, exc_info=True)
 
     def _install_stop_check(self, trial_logger: ModelLogger,
                             advisor_id: str, trial_id: str) -> None:
@@ -366,13 +610,24 @@ class TrainWorker:
                     timeout_s=getattr(self, "_trial_timeout_s", None),
                     extra_pythonpath=getattr(self, "_deps_prefix", None),
                 )
+            # NaN/inf survives the child's float() cast and the JSON
+            # pipe — gate it here so it becomes a typed INVALID_SCORE
+            # fault, never a poisoned GP observation
+            score = validate_score(score)
             with tracer.span("persist_params"):
                 params_path = os.path.join(
                     self._params_dir, f"{trial_id}.params")
                 # atomic + checksummed (sdk/artifact.py): a crash mid-write
                 # or later bit rot surfaces as a typed ArtifactCorruptError
                 # at download/deploy, never a deserialize traceback
-                write_artifact(params_path, params_bytes, mode=0o600)
+                try:
+                    write_artifact(params_path, params_bytes, mode=0o600)
+                except OSError as e:
+                    # trusted-side I/O (full disk, yanked volume) — the
+                    # platform's fault, never the template's knobs
+                    raise faults.TrialFault(
+                        f"params persist failed: {e}",
+                        kind=FaultKind.INFRA) from e
             import shutil
 
             shutil.rmtree(jail, ignore_errors=True)
@@ -444,14 +699,22 @@ class TrainWorker:
             # must not re-raise
             trial_logger.set_stop_check(None)
             with tracer.span("evaluate"):
-                score = float(model.evaluate(job["test_dataset_uri"]))
+                # typed INVALID_SCORE fault for NaN/inf/non-numeric —
+                # previously only ASHA's rung check looked at finiteness
+                score = validate_score(model.evaluate(job["test_dataset_uri"]))
             with tracer.span("persist_params"):
                 params_path = os.path.join(
                     self._params_dir, f"{trial_id}.params")
                 # atomic + checksummed (sdk/artifact.py) — see the
-                # sandboxed persist path for the rationale
-                write_artifact(params_path,
-                               dump_params(model.dump_parameters()))
+                # sandboxed persist path for the rationale; trusted-side
+                # I/O failures (full disk) are typed INFRA, not USER
+                params_bytes = dump_params(model.dump_parameters())
+                try:
+                    write_artifact(params_path, params_bytes)
+                except OSError as e:
+                    raise faults.TrialFault(
+                        f"params persist failed: {e}",
+                        kind=FaultKind.INFRA) from e
             # the trial is complete: its mid-trial checkpoint is dead weight
             self._cleanup_ckpt(trial_id)
             return score, params_path

@@ -92,11 +92,44 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #   RAFIKI_AGENT_RETRY_BACKOFF_S=0.1    backoff base (exponential + jitter)
 #   RAFIKI_AGENT_BREAKER_THRESHOLD=3    transport failures to open a circuit
 #   RAFIKI_AGENT_BREAKER_COOLDOWN_S=5   fail-fast window before half-open
+# Training-plane trial fault tolerance (docs/failure-model.md,
+# "Training-plane faults"). Defaults are production-sane:
+#   RAFIKI_TRIAL_RETRY_MAX=2            infra-class faults (INFRA/MEM/STALL)
+#                                       re-run the SAME trial id this many
+#                                       times before it errors; retries never
+#                                       consume an extra budget slot (0 = off;
+#                                       doctor WARNs)
+#   RAFIKI_TRIAL_RETRY_BACKOFF_S=0.5    backoff base between re-runs
+#                                       (exponential + full jitter, cap 30 s)
+#   RAFIKI_TRIAL_STALL_S=600            sandbox child mute (NO frame at all)
+#                                       for this long -> its process group is
+#                                       killed and the trial classifies STALL
+#                                       (0 = no stall watchdog; raise it for
+#                                       templates that legitimately stay
+#                                       silent through a long setup)
+#   RAFIKI_SANDBOX_WIDEN_NONOWNED=1     0 = a root worker never chmods o+x
+#                                       onto ancestor dirs it doesn't own to
+#                                       make the repo importable by jailed
+#                                       uids (multi-user hosts; pre-grant
+#                                       traversal yourself)
+#   RAFIKI_TRIAL_QUARANTINE_K=3         user-class faults on near-identical
+#                                       knobs before that signature is
+#                                       quarantined (proposals re-proposed)
+#   RAFIKI_TRIAL_REPROPOSE_MAX=8        bounded re-proposal loop per slot
+#   RAFIKI_TRIAL_FAULT_LIMIT=5          consecutive user-class faults that
+#                                       error the whole job early with a typed
+#                                       reason on the job row (0 = never)
+#   RAFIKI_PENDING_FEEDBACK_MAX=256     queued advisor observations awaiting
+#                                       retry; beyond it the oldest drop (one
+#                                       warning; counted in training stats)
+
 # Deterministic fault injection — MUST stay off outside drills/tests
 # (sites: call_agent, agent, worker — stalls/slows serving replicas for
 # overload drills — wire, whose `corrupt` action garbles shm frames for
-# codec-corruption drills, and db, which fails/delays metadata-store
-# statements for control-plane recovery drills):
+# codec-corruption drills, db, which fails/delays metadata-store
+# statements for control-plane recovery drills, and trial, which
+# errors/delays/OOMs the trial-run chokepoint for fault-taxonomy
+# drills):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 

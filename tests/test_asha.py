@@ -162,8 +162,8 @@ def test_late_first_report_does_not_backfill_lower_rungs():
     # unbeatable bar for healthy fresh trials
     s = AshaScheduler(min_resource=1, eta=3)
     assert s.report("resumed", 9, 0.001)  # records ONLY at rung 9
-    assert s._rungs.get(1) is None or s._rungs[1] == []
-    assert s._rungs[9] == [0.001]
+    assert not s._rungs.get(1)
+    assert list(s._rungs[9].values()) == [0.001]
     # fresh trials at rung 1 compete among themselves, not against 0.001
     assert s.report("f1", 1, 0.5)
     assert s.report("f2", 1, 0.6)

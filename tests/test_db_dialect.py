@@ -170,7 +170,10 @@ def _drive_every_dal_method(db: Database) -> None:
 
     # error/terminal transitions on fresh rows so every UPDATE fires
     t2 = db.create_trial(stj["id"], m["id"], {"lr": 0.4})
-    db.mark_trial_as_errored(t2["id"])
+    db.record_trial_fault(t2["id"], "INFRA", "chaos drill")
+    db.mark_trial_as_errored(t2["id"], "USER", "Boom: template raised")
+    db.get_trial_fault_counts_of_train_job(tj["id"])
+    db.get_trial_fault_summary_of_live_jobs()
     t3 = db.create_trial(stj["id"], m["id"], {"lr": 0.5})
     db.mark_trial_as_terminated(t3["id"])
     db.mark_train_job_as_stopped(tj["id"])
