@@ -386,14 +386,17 @@ def _shm_binary_client_proc(port: int, n_reqs: int, query_floats: int,
 
 
 def bench_shm_binary_serving(n_clients: int = 4,
-                             query_floats: int = 3072) -> dict:
+                             query_floats: int = 3072,
+                             prefix: str = "serving_shm_binary") -> dict:
     """End-to-end binary serving over the SHM data plane: 4 closed-loop
     client processes drive a real PredictorServer -> Predictor ->
     ShmBroker -> worker pipeline with binary requests AND binary
     responses (`serving_shm_binary_*`). The worker serves a real matmul
     so the number includes model-shaped work, but the pipeline is
     deliberately deployment-free: this phase isolates the wire/transport
-    stack that the tentpole binary codec changed, on every hop."""
+    stack that the tentpole binary codec changed, on every hop.
+    ``prefix`` parametrizes the result keys so the telemetry-overhead
+    guard can re-run the phase with the registry disabled."""
     import multiprocessing as mp
     import threading as _threading
 
@@ -465,23 +468,75 @@ def bench_shm_binary_serving(n_clients: int = 4,
             p.join(timeout=30)
         stop.set()
         lat = np.array(sorted(latencies)) * 1000.0
-        return {
-            "serving_shm_binary_clients": n_clients,
-            "serving_shm_binary_requests": int(len(lat)),
-            "serving_shm_binary_errors": errors,
-            "serving_shm_binary_req_s": (
+        out = {
+            f"{prefix}_clients": n_clients,
+            f"{prefix}_requests": int(len(lat)),
+            f"{prefix}_errors": errors,
+            f"{prefix}_req_s": (
                 round(len(lat) / wall, 1) if wall > 0 else 0.0),
-            "serving_shm_binary_p50_ms": (
+            f"{prefix}_p50_ms": (
                 round(float(np.percentile(lat, 50)), 2) if len(lat)
                 else None),
-            "serving_shm_binary_p99_ms": (
+            f"{prefix}_p99_ms": (
                 round(float(np.percentile(lat, 99)), 2) if len(lat)
                 else None),
         }
+        # server-side percentiles straight off the door's histogram —
+        # real percentiles in the BENCH record, not client-sampled ones
+        out.update(_door_hist_percentiles("predictor:shmbench", prefix))
+        return out
     finally:
         if server is not None:
             server.stop(drain_timeout_s=0.0)
         broker.close()
+
+
+def _door_hist_percentiles(door: str, prefix: str) -> dict:
+    """p50/p95/p99 (ms) from the serving door's OWN latency histogram
+    (rafiki_request_seconds{door=...}, utils/metrics.py) — the
+    server-side percentiles the telemetry plane exists for, reported
+    alongside the client-observed ones. Bucket-resolution estimates
+    (log-2 ladder), so read them as ceilings."""
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    h = REGISTRY.get("rafiki_request_seconds")
+    if h is None:
+        return {}
+    child = h.children().get((door,))
+    if child is None:
+        return {}
+    out = {}
+    for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        v = child.quantile(q)
+        if v is not None:
+            out[f"{prefix}_hist_{name}_ms"] = round(v * 1000.0, 2)
+    return out
+
+
+def bench_telemetry_overhead(enabled_req_s) -> dict:
+    """Hot-path overhead guard: re-run the shm-binary serving phase with
+    the telemetry plane OFF (RAFIKI_METRICS=0, sampling 0) and report the
+    req/s delta against the enabled run — the budget is <=2%."""
+    saved = {k: os.environ.get(k)
+             for k in ("RAFIKI_METRICS", "RAFIKI_TRACE_SAMPLE")}
+    os.environ["RAFIKI_METRICS"] = "0"
+    os.environ["RAFIKI_TRACE_SAMPLE"] = "0"
+    try:
+        off = bench_shm_binary_serving(prefix="serving_shm_binary_notel")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    # drop hist keys: with the registry disabled the door histogram only
+    # carries the ENABLED run's samples — reporting them here would lie
+    out = {k: v for k, v in off.items() if "_hist_" not in k}
+    off_req_s = off.get("serving_shm_binary_notel_req_s")
+    if enabled_req_s and off_req_s:
+        out["telemetry_overhead_pct"] = round(
+            (off_req_s - enabled_req_s) / off_req_s * 100.0, 2)
+    return out
 
 
 def _wait_chips_free(admin, timeout_s: float = 30.0) -> None:
@@ -664,6 +719,12 @@ def main():
                     server.port, "benchapp", query, direct=True))
                 serving.update(bench_serving_concurrent(
                     server.port, "benchapp", query, direct=True, binary=True))
+                # server-side percentiles from the doors' own histograms
+                # (rafiki_request_seconds; covers everything the phases
+                # above pushed through each door)
+                serving.update(_door_hist_percentiles("admin", "serving"))
+                serving.update(_door_hist_percentiles(
+                    "predictor:benchapp", "serving_direct"))
                 admin.stop_inference_job(uid, "benchapp")
 
             # ---- fused ensemble: both-trials-one-dispatch delta --------
@@ -747,7 +808,18 @@ def main():
                         available as _shm_ok)
 
                     if _shm_ok():
-                        serving.update(bench_shm_binary_serving())
+                        # telemetry ON (metrics + a real sampling rate):
+                        # the number the overhead guard holds accountable
+                        os.environ["RAFIKI_TRACE_SAMPLE"] = "0.05"
+                        try:
+                            serving.update(bench_shm_binary_serving())
+                        finally:
+                            os.environ.pop("RAFIKI_TRACE_SAMPLE", None)
+                        # guard phase: same pipeline, registry + tracing
+                        # disabled — req/s delta is the hot-path cost of
+                        # the telemetry plane (budget <= 2%)
+                        serving.update(bench_telemetry_overhead(
+                            serving.get("serving_shm_binary_req_s")))
                     else:
                         serving["serving_shm_binary_error"] = \
                             "native shmqueue unavailable"

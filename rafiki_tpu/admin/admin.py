@@ -95,7 +95,10 @@ class Admin:
         # the whole door — it protects this process, not one job
         from rafiki_tpu.predictor.admission import AdmissionController
 
-        self._predict_admission = AdmissionController()
+        # door="admin": the /predict/<app> route's registry metrics
+        # (admitted/shed counters + request-latency histogram) are
+        # labeled apart from the per-job dedicated ports
+        self._predict_admission = AdmissionController(door="admin")
         # RAFIKI_BROKER=shm selects the native cross-process data
         # plane (cache/shm_broker.py); default is in-process.
         # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to

@@ -321,6 +321,16 @@ class AdminServer:
             if method == "GET" and path == "/web":
                 self._serve_web(handler)
                 return
+            if method == "GET" and path == "/metrics":
+                # Prometheus text exposition (utils/metrics.py — one
+                # rendering shared with the agent and predictor doors).
+                # Public like the reference scraper contract, and exempt
+                # from the recovery gate: a reconciling admin's metrics
+                # are exactly what an operator wants to watch.
+                from rafiki_tpu.utils.metrics import serve_http
+
+                serve_http(handler, parsed.query)
+                return
             # boot gate: while the control plane reconciles a crashed
             # predecessor's state (admin/recovery.py), every route that
             # could read or mutate half-reconciled state sheds with 503 +

@@ -326,6 +326,25 @@ class ServicesManager:
         # becomes n_replicas fused workers instead of a fleet per trial.
         fused = bool(budget.get(BudgetType.ENSEMBLE_FUSED, 0))
         if fused:
+            from rafiki_tpu.sdk.sandbox import sandbox_enabled
+
+            if sandbox_enabled():
+                # ADVICE r5: fused serving would co-locate one JAX
+                # sandbox CHILD PROCESS per trial on a single worker's
+                # chip grant — N children contending for the same
+                # devices is unsupported (and co-residency is the whole
+                # point of fusing). Refuse with a typed deploy error
+                # instead of failing at worker startup; the per-trial
+                # fleet works fine under the sandbox.
+                self._db.mark_inference_job_as_errored(inference_job_id)
+                raise ServiceDeploymentError(
+                    "budget ENSEMBLE_FUSED is unsupported with "
+                    "RAFIKI_SANDBOX=1: fused serving co-locates every "
+                    "best trial in one worker process, but sandboxed "
+                    "models run as separate child processes that would "
+                    "contend for the worker's chip grant — drop "
+                    "ENSEMBLE_FUSED (per-trial fleet) or disable the "
+                    "sandbox for this deployment")
             if alloc is not None:
                 n_replicas = max(1, min(
                     config.INFERENCE_WORKER_REPLICAS_PER_TRIAL,

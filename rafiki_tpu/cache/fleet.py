@@ -83,6 +83,16 @@ class HttpWorkerQueue:
         self._inflight = 0  # queries inside the current relay round-trip
         self._expired = 0
         self._rejected = 0
+        # registry mirrors of the relay queue's shed counters — same
+        # process-wide aggregates the local WorkerQueue feeds
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._m_expired = REGISTRY.counter(
+            "rafiki_queue_expired_total",
+            "queries dropped past their deadline in a worker queue")
+        self._m_rejected = REGISTRY.counter(
+            "rafiki_queue_rejected_total",
+            "queries refused by a bounded worker queue's depth cap")
         self._closed = False
         self._thread = threading.Thread(
             target=self._sender, daemon=True,
@@ -106,13 +116,17 @@ class HttpWorkerQueue:
         return self.submit_many([query], deadline=deadline)[0]
 
     def submit_many(self, queries: List[Any],
-                    deadline: Optional[float] = None) -> List[QueryFuture]:
+                    deadline: Optional[float] = None,
+                    trace=None) -> List[QueryFuture]:
         """Atomic enqueue of one request's queries (one lock, one wake-up)
         so the sender relays them as one HTTP batch instead of racing the
         sender thread into a singleton first batch. Bounded exactly like
         the local WorkerQueue (RAFIKI_PREDICT_QUEUE_DEPTH counts pending +
         in-flight): a stalled host must shed here, admin-side, not grow an
-        unbounded relay backlog."""
+        unbounded relay backlog. A sampled request's ``trace`` rides its
+        futures; the sender forwards the context in the relay body and
+        grafts the remote spans back (placement/agent.py answers
+        ``trace_spans``)."""
         with self._cond:
             if self._closed:
                 futs = [QueryFuture() for _ in queries]
@@ -123,9 +137,14 @@ class HttpWorkerQueue:
             queued = len(self._pending) + self._inflight
             if cap > 0 and queued + len(queries) > cap:
                 self._rejected += len(queries)
+                self._m_rejected.inc(len(queries))
                 raise QueueFullError(
                     f"relay queue to {self._addr} full ({queued}/{cap})")
             futs = [QueryFuture() for _ in queries]
+            if trace is not None:
+                trace.mark_submitted()
+                for fut in futs:
+                    fut.trace = trace
             self._pending.extend(
                 (fut, q, deadline) for fut, q in zip(futs, queries))
             self._cond.notify()
@@ -149,6 +168,7 @@ class HttpWorkerQueue:
                         # expired while waiting for the sender: don't spend
                         # a relay slot (and remote model time) on it
                         self._expired += 1
+                        self._m_expired.inc()
                         fut.set_error(TimeoutError(
                             "query expired in the relay queue before send"))
                         continue
@@ -157,8 +177,13 @@ class HttpWorkerQueue:
             if not batch:
                 continue
             futures = [f for f, _ in batch]
+            # one relay call may coalesce several requests; at most ONE
+            # trace context rides it (the first sampled entry's — hop
+            # tracing is a sampling of the flow, not an audit log)
+            trace = next((f.trace for f in futures
+                          if getattr(f, "trace", None) is not None), None)
             try:
-                preds = self._relay([q for _, q in batch])
+                preds = self._relay([q for _, q in batch], trace=trace)
                 if len(preds) != len(futures):
                     raise RuntimeError(
                         f"relay returned {len(preds)} predictions for "
@@ -176,19 +201,22 @@ class HttpWorkerQueue:
         """One lazy /healthz probe decides whether this relay may ship
         binary wire frames; unknown/unreachable peers stay on JSON and
         the probe retries on a later relay (the flag is only cached once
-        an answer arrives)."""
+        an answer arrives). Any overlap with our SUPPORTED_VERSIONS
+        qualifies — traceless relay frames are emitted as v1, so a v1-only
+        peer (pre-trace build) keeps its binary hop."""
         if not wire.binary_enabled():
             return False
         if self._wire_ok is None:
             try:
                 h = call_agent(self._addr, "GET", "/healthz",
                                timeout_s=min(self._timeout_s, 5.0))
-                self._wire_ok = wire.VERSION in (h.get("wire_versions") or [])
+                advertised = set(h.get("wire_versions") or [])
+                self._wire_ok = bool(advertised & wire.SUPPORTED_VERSIONS)
             except Exception:
                 return False
         return bool(self._wire_ok)
 
-    def _relay(self, queries: List[Any]) -> List[Any]:
+    def _relay(self, queries: List[Any], trace=None) -> List[Any]:
         binary = self._wire_supported()
         q_payload: Any = queries
         if binary:
@@ -197,14 +225,27 @@ class HttpWorkerQueue:
             stacked = wire.stack_batch(queries)
             if stacked is not None:
                 q_payload = stacked
+        body = {"queries": q_payload, "timeout_s": self._worker_timeout_s}
+        if trace is not None:
+            # the context rides the BODY (plain JSON-able dict), not the
+            # frame header — an old agent ignores the unknown key and
+            # still serves the relay, the mixed-version contract
+            body["trace"] = trace.ctx.to_wire()
         try:
             out = call_agent(
                 self._addr, "POST",
                 f"/predict_relay/{self._job_id}/{self._worker_id}",
-                body={"queries": q_payload,
-                      "timeout_s": self._worker_timeout_s},
+                body=body,
                 key=self._key, timeout_s=self._timeout_s,
                 wire_frames=binary)
+            if trace is not None and isinstance(out, dict) \
+                    and out.get("trace_spans") is not None:
+                # remote offsets are relative to the AGENT's submit time;
+                # re-anchoring at our submit folds the relay transit into
+                # the first remote span's offset — same host-order, ~one
+                # RTT of skew, fine for a latency breakdown
+                trace.add_wire_spans(out["trace_spans"],
+                                     anchor=trace.t_submit)
             return list(out["predictions"])
         except AgentHTTPError as e:
             raise RuntimeError(f"relay {self._addr}: {e.message}") from None

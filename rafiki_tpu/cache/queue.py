@@ -48,14 +48,20 @@ class FrameTooLargeError(ValueError):
 
 
 class QueryFuture:
-    """A pending prediction for one query."""
+    """A pending prediction for one query.
 
-    __slots__ = ("_event", "_value", "_error")
+    ``trace`` carries the request's RequestTrace (utils/trace.py) when
+    the request is sampled — in-process workers read it off the future to
+    record batch-assembly/forward spans straight into the door's span
+    tree; it is None for unsampled traffic."""
+
+    __slots__ = ("_event", "_value", "_error", "trace")
 
     def __init__(self) -> None:
         self._event = threading.Event()
         self._value: Any = None
         self._error: Optional[BaseException] = None
+        self.trace = None
 
     def set_result(self, value: Any) -> None:
         self._value = value
@@ -106,6 +112,17 @@ class WorkerQueue:
         self._max_depth = max_depth
         self._expired = 0   # dropped by take_batch past their deadline
         self._rejected = 0  # refused by the depth cap
+        # process-wide registry mirrors of the per-queue counters above
+        # (/healthz keeps the per-queue ints; /metrics carries the
+        # aggregate — same increment sites, so the two cannot drift)
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._m_expired = REGISTRY.counter(
+            "rafiki_queue_expired_total",
+            "queries dropped past their deadline in a worker queue")
+        self._m_rejected = REGISTRY.counter(
+            "rafiki_queue_rejected_total",
+            "queries refused by a bounded worker queue's depth cap")
 
     def _cap(self) -> int:
         if self._max_depth is not None:
@@ -130,7 +147,8 @@ class WorkerQueue:
         return self.submit_many([query], deadline=deadline)[0]
 
     def submit_many(self, queries: List[Any],
-                    deadline: Optional[float] = None) -> List[QueryFuture]:
+                    deadline: Optional[float] = None,
+                    trace=None) -> List[QueryFuture]:
         """Enqueue a whole request's queries atomically (one lock, one
         wake-up). A per-query submit loop can lose a race with the worker:
         it wakes after the first item, serves a singleton batch, and the
@@ -139,7 +157,8 @@ class WorkerQueue:
         keeps one request one batch. ``deadline`` is the request's absolute
         ``time.monotonic()`` deadline; atomicity also means the depth cap
         admits or rejects the request as a unit (no half-enqueued
-        requests)."""
+        requests). ``trace`` (a sampled request's RequestTrace) rides the
+        futures so the worker records its spans into the door's tree."""
         with self._cond:
             if self._closed:
                 futs = [QueryFuture() for _ in queries]
@@ -149,10 +168,15 @@ class WorkerQueue:
             cap = self._cap()
             if cap > 0 and len(self._items) + len(queries) > cap:
                 self._rejected += len(queries)
+                self._m_rejected.inc(len(queries))
                 raise QueueFullError(
                     f"worker queue full ({len(self._items)}/{cap} queued; "
                     f"refusing {len(queries)} more)")
             futs = [QueryFuture() for _ in queries]
+            if trace is not None:
+                trace.mark_submitted()
+                for fut in futs:
+                    fut.trace = trace
             self._items.extend(
                 (fut, q, deadline) for fut, q in zip(futs, queries))
             self._cond.notify()
@@ -169,9 +193,12 @@ class WorkerQueue:
             fut, query, deadline = self._items.pop(0)
             if deadline is not None and now >= deadline:
                 self._expired += 1
+                self._m_expired.inc()
                 fut.set_error(TimeoutError(
                     "query expired in the worker queue before dispatch"))
                 continue
+            if fut.trace is not None:
+                fut.trace.mark_dequeued(now)
             batch.append((fut, query))
             n -= 1
 

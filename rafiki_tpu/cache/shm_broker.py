@@ -61,12 +61,16 @@ def _qname(prefix: str, *parts: str) -> str:
 
 
 def _encode_query_frame(ids: List[str], queries: List[Any],
-                        deadline: Optional[float]) -> bytes:
+                        deadline: Optional[float],
+                        trace_meta: Optional[Dict[str, Any]] = None) -> bytes:
     """One frame for a whole submit_many request (binary unless
     RAFIKI_WIRE_BINARY=0). Homogeneous ndarray queries stack into ONE
     contiguous array (single header entry, single memcpy) — the common
     shape for the binary HTTP door, whose ``list(arr)`` rows share dtype
-    and shape by construction."""
+    and shape by construction. ``trace_meta`` (a sampled request's wire
+    context + submit timestamp) rides the v2 frame header; under JSON
+    framing it rides the message's ``_trace`` key instead so the
+    kill-switch path keeps its traces too."""
     msg: Dict[str, Any] = {"ids": ids}
     if deadline is not None:
         msg["deadline"] = deadline
@@ -78,19 +82,26 @@ def _encode_query_frame(ids: List[str], queries: List[Any],
         msg["qarr"] = stacked
     else:
         msg["queries"] = queries
-    return wire.dumps(msg)
+        if trace_meta is not None and not wire.binary_enabled():
+            msg["_trace"] = trace_meta
+    return wire.dumps(msg, trace=trace_meta)
 
 
 def _decode_query_frame(raw: bytes) -> Tuple[
-        List[Tuple[str, Any, Optional[float]]], bool]:
+        List[Tuple[str, Any, Optional[float]]], bool,
+        Optional[Dict[str, Any]]]:
     """One popped query message -> ([(qid, query, deadline), ...],
-    arrived_binary). Accepts the batched binary frame, the batched JSON
-    frame (RAFIKI_WIRE_BINARY=0 submitter), and the legacy per-query
+    arrived_binary, trace_meta_or_None). Accepts the batched binary
+    frame (v1 or trace-carrying v2), the batched JSON frame
+    (RAFIKI_WIRE_BINARY=0 submitter), and the legacy per-query
     ``{"id", "query"}`` message. Raises WireFormatError on garbage."""
     binary = wire.is_frame(raw)
-    msg = wire.decode_any(raw)
+    msg, meta = wire.decode_any_meta(raw)
     if not isinstance(msg, dict):
         raise wire.WireFormatError("query frame is not an object")
+    trace_meta = meta.get("trace") or msg.get("_trace")
+    if not isinstance(trace_meta, dict):
+        trace_meta = None
     try:
         # the frame decoded, but every field is still untrusted input:
         # ids must be strings (dict keys downstream) and the deadline a
@@ -103,7 +114,7 @@ def _decode_query_frame(raw: bytes) -> Tuple[
         if "id" in msg:  # legacy single-query message
             if not isinstance(msg["id"], str):
                 raise wire.WireFormatError("query id is not a string")
-            return [(msg["id"], msg["query"], deadline)], binary
+            return [(msg["id"], msg["query"], deadline)], binary, trace_meta
         ids = msg["ids"]
         if (not isinstance(ids, list)
                 or not all(isinstance(i, str) for i in ids)):
@@ -123,7 +134,8 @@ def _decode_query_frame(raw: bytes) -> Tuple[
         if not isinstance(queries, (list, np.ndarray)) \
                 or len(queries) != len(ids):
             raise wire.WireFormatError("queries/ids length mismatch")
-        return [(qid, q, deadline) for qid, q in zip(ids, queries)], binary
+        return ([(qid, q, deadline) for qid, q in zip(ids, queries)],
+                binary, trace_meta)
     except (KeyError, TypeError, ValueError) as e:
         if isinstance(e, wire.WireFormatError):
             raise
@@ -141,16 +153,51 @@ class _FrameResponder:
     expiry), so a response frame is written exactly once per request.
     Transport backpressure (full response ring, broker mid-close) must
     not crash the serving worker loop — the predictor's SLO timeout
-    covers a dropped response frame."""
+    covers a dropped response frame.
 
-    __slots__ = ("_rq", "_ids", "_binary", "_lock", "_out")
+    For a SAMPLED request (the query frame carried trace metadata) the
+    responder also collects worker-side spans — queue_wait, codec_decode,
+    batch_assembly, model_forward — as ``[name, offset_s, duration_s]``
+    triples relative to the submitter's ``ts`` and ships them home in the
+    response frame's metadata, where the broker listener grafts them onto
+    the door's span tree. Legacy JSON responses drop the spans (old
+    listeners can't read them) but still serve the request."""
 
-    def __init__(self, rq: ShmMessageQueue, ids: List[str], binary: bool):
+    __slots__ = ("_rq", "_ids", "_binary", "_lock", "_out",
+                 "trace_meta", "_spans")
+
+    def __init__(self, rq: ShmMessageQueue, ids: List[str], binary: bool,
+                 trace_meta: Optional[Dict[str, Any]] = None):
         self._rq = rq
         self._ids = ids
         self._binary = binary
         self._lock = threading.Lock()
         self._out: Dict[str, Tuple[str, Any]] = {}
+        self.trace_meta = trace_meta if (
+            isinstance(trace_meta, dict) and trace_meta.get("s")) else None
+        self._spans: List[List[Any]] = []
+
+    @property
+    def anchor(self) -> Optional[float]:
+        """The submitter's monotonic submit timestamp (same host, same
+        CLOCK_MONOTONIC) — worker span offsets are measured against it."""
+        if self.trace_meta is None:
+            return None
+        try:
+            return float(self.trace_meta.get("ts"))
+        except (TypeError, ValueError):
+            return None
+
+    def add_span(self, name: str, start: float, end: float) -> None:
+        """Record one worker-side span (monotonic interval). No-op for
+        unsampled frames so the hot path pays one None check."""
+        anchor = self.anchor
+        if anchor is None:
+            return
+        with self._lock:
+            self._spans.append(
+                [name, round(start - anchor, 6),
+                 round(max(end - start, 0.0), 6)])
 
     def resolve(self, qid: str, kind: str, value: Any) -> None:
         with self._lock:
@@ -176,7 +223,12 @@ class _FrameResponder:
                 msg: Dict[str, Any] = {"ids": self._ids, "results": results}
                 if errors:
                     msg["errors"] = errors
-                self._rq.push(wire.encode(msg))
+                trace_out = None
+                if self.trace_meta is not None:
+                    with self._lock:
+                        trace_out = {"id": self.trace_meta.get("id"),
+                                     "spans": list(self._spans)}
+                self._rq.push(wire.encode(msg, trace=trace_out))
             else:
                 # legacy listener compatibility: per-id JSON messages
                 for qid in self._ids:
@@ -213,6 +265,14 @@ class ShmWorkerQueue:
             self._responder = responder
             self._id = qid
 
+        @property
+        def trace(self):
+            """Span sink for the worker loop (duck-typed with
+            QueryFuture.trace): the frame's responder when this query's
+            request is sampled, else None."""
+            r = self._responder
+            return r if r.trace_meta is not None else None
+
         def set_result(self, value: Any) -> None:
             self._responder.resolve(self._id, "result", value)
 
@@ -223,6 +283,14 @@ class ShmWorkerQueue:
         self._qq = query_q
         self._rq = response_q
         self._wire_errors = 0  # undecodable frames dropped (see stats())
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._m_wire_errors = REGISTRY.counter(
+            "rafiki_wire_errors_total",
+            "undecodable wire frames dropped (query + response sides)")
+        self._m_expired = REGISTRY.counter(
+            "rafiki_queue_expired_total",
+            "queries dropped past their deadline in a worker queue")
 
     @classmethod
     def attach(cls, prefix: str, inference_job_id: str,
@@ -249,25 +317,33 @@ class ShmWorkerQueue:
                                       rr["used_bytes_hw"]),
         }
 
-    def _pop_decoded(self, timeout_s: float) -> Optional[
-            Tuple[List[Tuple[str, Any, Optional[float]]], bool]]:
+    def _pop_decoded(self, timeout_s: float) -> Optional[Tuple[
+            List[Tuple[str, Any, Optional[float]]], bool,
+            Optional[Dict[str, Any]], float, float]]:
         """Pop + decode one query frame, absorbing corruption: a frame
         that fails to decode is counted and reported as an EMPTY frame
         (([], ...)) — the submitter's SLO timeout covers its queries; the
-        worker loop must keep serving. None means ring timeout."""
+        worker loop must keep serving. None means ring timeout. The last
+        two elements are the monotonic instant decoding started and its
+        duration — the codec_decode span of a sampled frame, at its REAL
+        interval (queue_wait ends where it begins)."""
         raw = self._qq.pop(timeout_s=timeout_s)
         if raw is None:
             return None
         rule = chaos.hit(chaos.SITE_WIRE, self._qq.name)
         if rule is not None and rule.action == chaos.ACTION_CORRUPT:
             raw = chaos.corrupt_bytes(raw, rule)
+        t_pop = time.monotonic()
         try:
-            return _decode_query_frame(raw)
+            entries, binary, trace_meta = _decode_query_frame(raw)
+            return (entries, binary, trace_meta, t_pop,
+                    time.monotonic() - t_pop)
         except wire.WireFormatError as e:
             self._wire_errors += 1
+            self._m_wire_errors.inc()
             logger.error("dropping undecodable query frame on %s: %s",
                          self._qq.name, e)
-            return [], False
+            return [], False, None, t_pop, 0.0
 
     def take_batch(self, max_size: int, deadline_s: float,
                    wait_timeout_s: float = 0.5
@@ -309,11 +385,20 @@ class ShmWorkerQueue:
             n_entries += len(nxt[0])
         out: List[Tuple[ShmWorkerQueue.ResponseHandle, Any]] = []
         now = time.monotonic()
-        for entries, binary in groups:
+        for entries, binary, trace_meta, t_pop, decode_s in groups:
             if not entries:
                 continue  # corrupt frame already absorbed
             responder = _FrameResponder(
-                self._rq, [qid for qid, _, _ in entries], binary)
+                self._rq, [qid for qid, _, _ in entries], binary,
+                trace_meta=trace_meta)
+            anchor = responder.anchor
+            if anchor is not None:
+                # worker-side half of the sampled request's span tree:
+                # queue_wait (submit ts -> this frame's pop, both on the
+                # host's shared CLOCK_MONOTONIC) then the decode at its
+                # actual interval — the phases tile, they don't overlap
+                responder.add_span("queue_wait", anchor, t_pop)
+                responder.add_span("codec_decode", t_pop, t_pop + decode_s)
             for qid, query, deadline in entries:
                 handle = self.ResponseHandle(responder, qid)
                 # overload control: a query whose request deadline passed
@@ -322,6 +407,7 @@ class ShmWorkerQueue:
                 # submitter's absolute deadline is directly comparable in
                 # this worker process
                 if deadline is not None and now >= deadline:
+                    self._m_expired.inc()
                     handle.set_error(TimeoutError(
                         "query expired in the shm queue before dispatch"))
                     continue
@@ -369,7 +455,8 @@ class _SubmitProxy:
         return self.submit_many([query], deadline=deadline)[0]
 
     def submit_many(self, queries: List[Any],
-                    deadline: Optional[float] = None) -> List[QueryFuture]:
+                    deadline: Optional[float] = None,
+                    trace=None) -> List[QueryFuture]:
         """One wire frame per request (cache/wire.py): the whole request
         travels as a single binary message and lands as one worker batch
         by construction. The depth-cap check is all-or-nothing per
@@ -379,18 +466,28 @@ class _SubmitProxy:
         Push failures keep the shed contract typed: a full ring maps to
         the retryable :class:`QueueFullError`, an oversized frame to the
         permanent :class:`FrameTooLargeError` (413 at the doors — split
-        the request or raise RAFIKI_SHM_RING_BYTES)."""
+        the request or raise RAFIKI_SHM_RING_BYTES).
+
+        A sampled request's ``trace`` context crosses the ring in the
+        frame metadata; the worker's spans come home in the response
+        frame and the broker listener grafts them onto ``trace``."""
         self._broker._reserve_capacity(
             self._job_id, self._worker_id, len(queries))
         ids = [uuid.uuid4().hex for _ in queries]
         futs = [QueryFuture() for _ in queries]
+        trace_meta = None
+        if trace is not None:
+            trace.mark_submitted()
+            trace_meta = {**trace.ctx.to_wire(), "ts": trace.t_submit}
         for qid, fut in zip(ids, futs):
             # absolute monotonic deadline; comparable worker-side because
             # both processes share the host's CLOCK_MONOTONIC
             self._broker._register_pending(
-                self._job_id, self._worker_id, qid, fut, deadline)
+                self._job_id, self._worker_id, qid, fut, deadline,
+                trace=trace)
         try:
-            self._qq.push(_encode_query_frame(ids, queries, deadline))
+            self._qq.push(_encode_query_frame(ids, queries, deadline,
+                                              trace_meta=trace_meta))
         except BaseException as e:
             for qid in ids:
                 self._broker._pop_pending(self._job_id, qid)
@@ -432,6 +529,16 @@ class ShmBroker(Broker):
         self._graveyard: List[ShmMessageQueue] = []
         self.wire_errors = 0  # undecodable response frames dropped
         self._closed = False
+        # registry mirrors of the owner-side shed/expiry counters — the
+        # shm twin of WorkerQueue's (utils/metrics.py)
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._m_rejected = REGISTRY.counter(
+            "rafiki_queue_rejected_total",
+            "queries refused by a bounded worker queue's depth cap")
+        self._m_expired = REGISTRY.counter(
+            "rafiki_queue_expired_total",
+            "queries dropped past their deadline in a worker queue")
 
     # -- Broker interface --------------------------------------------------
 
@@ -484,7 +591,7 @@ class ShmBroker(Broker):
 
     def _register_pending(self, job_id: str, worker_id: str, qid: str,
                           fut: QueryFuture,
-                          deadline: Optional[float]) -> None:
+                          deadline: Optional[float], trace=None) -> None:
         """Record one reserved query's future (the outstanding count was
         already taken by _reserve_capacity — registering must NOT count
         again). Expiry gets a grace period past the request deadline (or
@@ -497,16 +604,21 @@ class ShmBroker(Broker):
                   else time.monotonic() + config.PREDICT_TIMEOUT_S) + 30.0
         with self._lock:
             self._pending.setdefault(job_id, {})[qid] = (
-                fut, worker_id, expiry)
+                fut, worker_id, expiry, trace)
 
     def _pop_pending(self, job_id: str, qid: str) -> Optional[QueryFuture]:
+        fut, _ = self._pop_pending_traced(job_id, qid)
+        return fut
+
+    def _pop_pending_traced(self, job_id: str, qid: str):
+        """(future, trace) for one pending id — (None, None) if unknown."""
         with self._lock:
             entry = self._pending.get(job_id, {}).pop(qid, None)
             if entry is None:
-                return None
-            fut, worker_id, _ = entry
+                return None, None
+            fut, worker_id, _, trace = entry
             self._dec_outstanding_locked(job_id, worker_id)
-            return fut
+            return fut, trace
 
     def _dec_outstanding_locked(self, job_id: str, worker_id: str) -> None:
         key = (job_id, worker_id)
@@ -525,10 +637,11 @@ class ShmBroker(Broker):
         deadline — a permanent-429 lockout."""
         now = time.monotonic()
         job_pending = self._pending.get(job_id, {})
-        for qid, (_, wid, expiry) in list(job_pending.items()):
+        for qid, (_, wid, expiry, _trace) in list(job_pending.items()):
             if wid == worker_id and now >= expiry:
                 job_pending.pop(qid)
                 self._dec_outstanding_locked(job_id, wid)
+                self._m_expired.inc()
 
     def _outstanding_count(self, job_id: str, worker_id: str) -> int:
         with self._lock:
@@ -551,16 +664,24 @@ class ShmBroker(Broker):
                 self._prune_expired_locked(job_id, worker_id)
             queued = self._outstanding.get(key, 0)
             if cap > 0 and queued + n > cap:
+                self._m_rejected.inc(n)
                 raise QueueFullError(
                     f"shm worker {worker_id} full "
                     f"({queued}/{cap} outstanding)")
             self._outstanding[key] = queued + n
 
-    def _resolve_response(self, job_id: str, msg: Any) -> None:
+    def _resolve_response(self, job_id: str, msg: Any,
+                          meta: Optional[Dict[str, Any]] = None) -> None:
         """Resolve futures for one decoded response message — batched
-        frame ({"ids", "results", "errors"}) or legacy per-id JSON."""
+        frame ({"ids", "results", "errors"}) or legacy per-id JSON.
+        ``meta`` may carry the worker's trace spans for a sampled
+        request; they are grafted onto the request's RequestTrace before
+        its futures resolve (the door reads the tree after gather)."""
         if not isinstance(msg, dict):
             raise wire.WireFormatError("response frame is not an object")
+        trace_meta = (meta or {}).get("trace")
+        wire_spans = (trace_meta.get("spans")
+                      if isinstance(trace_meta, dict) else None)
         if "id" in msg:  # legacy single-response message
             if not isinstance(msg["id"], str):
                 raise wire.WireFormatError("response id is not a string")
@@ -591,9 +712,14 @@ class ShmBroker(Broker):
             raise wire.WireFormatError(
                 f"malformed response frame: {e}") from e
         for i, qid in enumerate(ids):
-            fut = self._pop_pending(job_id, qid)
+            fut, trace = self._pop_pending_traced(job_id, qid)
             if fut is None:
                 continue
+            if wire_spans is not None and trace is not None:
+                # one graft per response frame (a request's futures share
+                # the trace; spans are offsets against ITS submit time)
+                trace.add_wire_spans(wire_spans, anchor=trace.t_submit)
+                wire_spans = None
             err = errors.get(str(i))
             if err is not None:
                 fut.set_error(RuntimeError(err))
@@ -615,13 +741,20 @@ class ShmBroker(Broker):
             if rule is not None and rule.action == chaos.ACTION_CORRUPT:
                 raw = chaos.corrupt_bytes(raw, rule)
             try:
-                self._resolve_response(job_id, wire.decode_any(raw))
+                body, meta = wire.decode_any_meta(raw)
+                self._resolve_response(job_id, body, meta)
             except wire.WireFormatError as e:
                 # a corrupt response frame is absorbed here: its pending
                 # futures keep waiting and resolve with the request's own
                 # (typed) TimeoutError at the SLO — the listener thread
                 # must outlive any single bad message
                 self.wire_errors += 1
+                from rafiki_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "rafiki_wire_errors_total",
+                    "undecodable wire frames dropped (query + response "
+                    "sides)").inc()
                 logger.error("dropping undecodable response frame on %s: %s",
                              job_id, e)
                 continue
@@ -649,7 +782,7 @@ class ShmBroker(Broker):
                 rq.destroy()
             self._response_qs.clear()
             for pend in self._pending.values():
-                for fut, _, _ in pend.values():
+                for fut, _, _, _ in pend.values():
                     fut.set_error(RuntimeError("broker closed"))
             self._pending.clear()
             self._outstanding.clear()

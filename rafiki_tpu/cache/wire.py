@@ -54,7 +54,16 @@ import numpy as np
 from rafiki_tpu.utils.jsonutil import json_default
 
 MAGIC = b"\xabRWF"
-VERSION = 1
+# v1: header {"b": body, "a": array table}. v2 adds an OPTIONAL "t" key —
+# request-trace metadata (utils/trace.py) riding the frame so a sampled
+# predict's context crosses the shm hop without touching the body.
+# Interop contract: encoders emit v1 whenever no trace metadata is
+# attached (bit-identical to the old framing, so old receivers keep
+# decoding) and v2 only for sampled requests; decoders accept both.
+# Fleet-relay peers advertise SUPPORTED_VERSIONS on /healthz and the
+# sender picks the intersection (cache/fleet.py).
+VERSION = 2
+SUPPORTED_VERSIONS = frozenset({1, 2})
 _ALIGN = 16
 # HTTP Content-Type for frames on the fleet relay (placement/agent.py
 # negotiates it via the /healthz "wire_versions" advertisement)
@@ -133,10 +142,15 @@ def _restore_arrays(obj: Any, views: List[np.ndarray]) -> Any:
     return obj
 
 
-def encode(obj: Any) -> bytes:
+def encode(obj: Any, trace: Any = None) -> bytes:
     """One binary frame for ``obj`` (any JSON-able structure, ndarrays
     at any depth). Raises TypeError for non-JSON, non-array leaves —
-    same contract as the JSON wire convention it replaces."""
+    same contract as the JSON wire convention it replaces.
+
+    ``trace`` (a JSON-able dict, utils/trace.py wire shape) rides the v2
+    frame header's "t" key; without it the frame is emitted as v1, byte
+    identical to the pre-trace codec, so unsampled traffic stays
+    decodable by old peers."""
     arrays: List[np.ndarray] = []
     body = _strip_arrays(obj, arrays)
     table = []
@@ -145,9 +159,13 @@ def encode(obj: Any) -> bytes:
         off += _pad16(off)
         table.append([a.dtype.str, list(a.shape), off, a.nbytes])
         off += a.nbytes
-    header = json.dumps({"b": body, "a": table},
-                        default=json_default).encode()
-    pieces = [MAGIC, bytes([VERSION, 0]),
+    hdr: dict = {"b": body, "a": table}
+    version = 1
+    if trace is not None:
+        hdr["t"] = trace
+        version = VERSION
+    header = json.dumps(hdr, default=json_default).encode()
+    pieces = [MAGIC, bytes([version, 0]),
               len(header).to_bytes(4, "little"), header,
               b"\x00" * _pad16(len(MAGIC) + 2 + 4 + len(header))]
     pos = 0
@@ -168,11 +186,18 @@ def decode(raw: bytes) -> Any:
     """Decode one frame. Array leaves come back as **read-only
     zero-copy views** into ``raw`` (they keep the frame alive); callers
     that mutate must copy."""
+    return decode_meta(raw)[0]
+
+
+def decode_meta(raw: bytes) -> tuple:
+    """Like :func:`decode` but returns ``(body, meta)`` where ``meta`` is
+    the frame-level metadata dict — ``{"trace": ...}`` for a v2 frame
+    carrying request-trace context, ``{}`` otherwise."""
     if not is_frame(raw):
         raise WireFormatError("not a wire frame (bad magic)")
     if len(raw) < 10:
         raise WireFormatError("truncated frame header")
-    if raw[4] != VERSION:
+    if raw[4] not in SUPPORTED_VERSIONS:
         raise WireFormatError(f"unsupported wire version {raw[4]}")
     hlen = int.from_bytes(raw[6:10], "little")
     if 10 + hlen > len(raw):
@@ -182,6 +207,9 @@ def decode(raw: bytes) -> Any:
         body, table = header["b"], header["a"]
     except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
         raise WireFormatError(f"garbled frame header: {e}") from e
+    meta = {}
+    if isinstance(header, dict) and "t" in header:
+        meta["trace"] = header["t"]
     payload_start = 10 + hlen + _pad16(10 + hlen)
     payload = memoryview(raw)[payload_start:]
     views: List[np.ndarray] = []
@@ -212,26 +240,33 @@ def decode(raw: bytes) -> Any:
                 payload[off:off + nbytes], dtype=dt).reshape(shape))
         except ValueError as e:  # belt-and-braces: numpy's own refusals
             raise WireFormatError(f"bad array extent: {e}") from e
-    return _restore_arrays(body, views)
+    return _restore_arrays(body, views), meta
 
 
 def decode_any(raw: bytes) -> Any:
     """The receiver-side sniff: binary frame -> :func:`decode`; anything
     else is parsed as JSON (the legacy framing). This single entry point
     is what makes every receive end mixed-version tolerant."""
+    return decode_any_meta(raw)[0]
+
+
+def decode_any_meta(raw: bytes) -> tuple:
+    """Sniffing twin of :func:`decode_meta`: ``(body, meta)`` for frames,
+    ``(json.loads(raw), {})`` for legacy JSON."""
     if is_frame(raw):
-        return decode(raw)
+        return decode_meta(raw)
     try:
-        return json.loads(raw)
+        return json.loads(raw), {}
     except (ValueError, UnicodeDecodeError) as e:
         raise WireFormatError(f"neither wire frame nor JSON: {e}") from e
 
 
-def dumps(obj: Any) -> bytes:
+def dumps(obj: Any, trace: Any = None) -> bytes:
     """Sender-side entry point: binary frame, or the legacy JSON framing
-    when RAFIKI_WIRE_BINARY=0."""
+    when RAFIKI_WIRE_BINARY=0 (trace metadata rides only the binary
+    frame header — the JSON escape hatch predates it)."""
     if binary_enabled():
-        return encode(obj)
+        return encode(obj, trace=trace)
     return json.dumps(obj, default=json_default).encode()
 
 

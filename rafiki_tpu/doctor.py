@@ -345,6 +345,83 @@ def check_trial_faults() -> Check:
     return ("trial faults", PASS, detail)
 
 
+def check_observability() -> Check:
+    """Telemetry plane (docs/observability.md): the registry must render
+    parseable exposition, RAFIKI_TRACE_SAMPLE must be a sane rate, and
+    the slow-request exemplar log must not be growing past its rotation
+    cap. When RAFIKI_AGENTS is set, each agent's GET /metrics is probed —
+    the scrape endpoint an autoscaler/dashboard will sit on."""
+    from rafiki_tpu import config
+    from rafiki_tpu.utils import trace as rtrace
+    from rafiki_tpu.utils.metrics import (
+        REGISTRY, metrics_enabled, parse_prometheus)
+
+    notes = []
+    warn = False
+    if not metrics_enabled():
+        warn = True
+        notes.append("RAFIKI_METRICS=0: registry writes are no-ops — "
+                     "/metrics will expose zeros")
+    raw_rate = os.environ.get("RAFIKI_TRACE_SAMPLE", "")
+    if raw_rate:
+        try:
+            r = float(raw_rate)
+            if not 0.0 <= r <= 1.0:
+                warn = True
+                notes.append(f"RAFIKI_TRACE_SAMPLE={raw_rate} outside "
+                             "[0, 1] — clamped, probably a typo")
+            elif r >= 0.5 and rtrace.slow_threshold_s() <= 0:
+                warn = True
+                notes.append(
+                    f"RAFIKI_TRACE_SAMPLE={r:g} with RAFIKI_TRACE_SLOW_MS "
+                    "unset dumps an exemplar for (nearly) EVERY request — "
+                    "set a slow threshold for production traffic")
+        except ValueError:
+            warn = True
+            notes.append(f"RAFIKI_TRACE_SAMPLE={raw_rate!r} unparseable — "
+                         "tracing is OFF")
+    try:
+        parse_prometheus(REGISTRY.render())
+        n_metrics = len(REGISTRY.names())
+    except Exception as e:
+        return ("observability", FAIL,
+                f"registry exposition does not parse: {e}")
+    try:
+        path = rtrace.exemplar_path()
+        if os.path.exists(path):
+            mb = os.path.getsize(path) / (1 << 20)
+            cap = rtrace.exemplar_max_mb()
+            if mb > cap * 1.5:
+                warn = True
+                notes.append(
+                    f"exemplar log {path} at {mb:.0f} MB, past its "
+                    f"{cap:g} MB rotation cap — rotation is not keeping "
+                    "up (RAFIKI_TRACE_EXEMPLAR_MAX_MB)")
+            else:
+                notes.append(f"exemplar log {mb:.1f} MB / {cap:g} MB cap")
+    except OSError:
+        pass
+    agents = [a.strip() for a in os.environ.get(
+        "RAFIKI_AGENTS", "").split(",") if a.strip()]
+    unreachable = []
+    for addr in agents:
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    f"http://{addr}/metrics", timeout=5) as resp:
+                parse_prometheus(resp.read().decode())
+        except Exception:
+            unreachable.append(addr)
+    if unreachable:
+        warn = True
+        notes.append(f"agent /metrics unreachable: {unreachable}")
+    rate = rtrace.sample_rate()
+    detail = (f"{n_metrics} metric families registered, trace sampling "
+              f"{rate:g}" + ("; " + "; ".join(notes) if notes else ""))
+    return ("observability", WARN if warn else PASS, detail)
+
+
 def check_agents() -> Check:
     from rafiki_tpu.utils.agent_http import AgentHTTPError, call_agent
 
@@ -413,7 +490,7 @@ def check_agents() -> Check:
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
     check_chaos, check_overload_knobs, check_recovery,
-    check_trial_faults, check_agents, check_backend,
+    check_trial_faults, check_observability, check_agents, check_backend,
 ]
 
 

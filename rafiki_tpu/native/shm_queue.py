@@ -127,7 +127,10 @@ class ShmMessageQueue:
             rc = self._lib.shmq_push(self._h, payload, len(payload),
                                      int(timeout_s * 1000))
             if rc == 0:
-                used = int(self._lib.shmq_used(self._h))
+                # a fast consumer may pop the message before shmq_used is
+                # sampled; the ring still momentarily held it, so the
+                # high-water is floored at this message's size
+                used = max(int(self._lib.shmq_used(self._h)), len(payload))
                 if used > self.used_bytes_hw:
                     self.used_bytes_hw = used
         finally:

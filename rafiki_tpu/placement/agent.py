@@ -141,13 +141,23 @@ class AgentServer:
                 chaos.sleep_for(rule)
             if method == "GET" and path == "/healthz":
                 # liveness stays unauthenticated (monitors/doctor probes).
-                # wire_versions advertises the binary codec this agent
-                # decodes — the admin-side relay (cache/fleet.py) probes
-                # it once before shipping binary frames, so an old agent
-                # keeps receiving JSON
+                # wire_versions advertises the binary codec versions this
+                # agent decodes — the admin-side relay (cache/fleet.py)
+                # probes it once before shipping binary frames, so an old
+                # agent keeps receiving JSON
                 return self._respond(handler, 200, {
                     "host": self.hostname, "status": "ok",
-                    "wire_versions": [wire.VERSION]})
+                    "wire_versions": sorted(wire.SUPPORTED_VERSIONS)})
+            if method == "GET" and path == "/metrics":
+                # Prometheus exposition stays unauthenticated like
+                # /healthz: counters/gauges only, standard scraper
+                # contract (utils/metrics.py holds the one copy of the
+                # response path shared by all three doors)
+                from rafiki_tpu.utils.metrics import serve_http
+
+                serve_http(handler,
+                           (handler.path.split("?", 1) + [""])[1])
+                return
             if self.key:
                 import hmac
 
@@ -282,12 +292,23 @@ class AgentServer:
             return self._respond(handler, 400, {"error": terr})
         deadline = _time.monotonic() + timeout_s
         from rafiki_tpu.cache.queue import QueueFullError
+        from rafiki_tpu.utils import trace as rtrace
 
+        # cross-host trace hop: the admin-side relay forwards the sampled
+        # request's context in the body; this agent collects its local
+        # half of the span tree (queue wait + worker phases over ITS shm
+        # hop) and ships the spans home in the response. Old relays send
+        # no "trace" key; old agents ignored it — both directions serve.
+        rt = None
+        ctx = rtrace.TraceContext.from_wire(body.get("trace"))
+        if ctx is not None and ctx.sampled:
+            rt = rtrace.RequestTrace(ctx)
         try:
             # the relayed deadline rides into the host-local queue, so a
             # stalled remote worker drops expired relayed queries exactly
             # like local ones
-            futures = queue.submit_many(queries, deadline=deadline)
+            futures = queue.submit_many(queries, deadline=deadline,
+                                        trace=rt)
         except QueueFullError as e:
             # bounded queue refused: shed with the standard retryable code
             # — the admin-side predictor treats the failed relay as a
@@ -305,9 +326,15 @@ class AgentServer:
         except Exception as e:
             return self._respond(handler, 502, {
                 "error": f"worker {worker_id}: {type(e).__name__}: {e}"})
+        payload: Dict[str, Any] = {"predictions": preds}
+        if rt is not None:
+            # offsets relative to this agent's submit time; the relay
+            # re-anchors them at its own (cache/fleet.py _relay)
+            anchor = rt.t_submit if rt.t_submit is not None else rt.t0
+            payload["trace_spans"] = rt.wire_spans(anchor)
         if binary:
-            return self._respond_frame(handler, {"predictions": preds})
-        self._respond(handler, 200, {"predictions": preds})
+            return self._respond_frame(handler, payload)
+        self._respond(handler, 200, payload)
 
     @staticmethod
     def _respond(handler, code: int, payload: Dict[str, Any]) -> None:

@@ -60,9 +60,17 @@ def retry_after_headers(e: Exception) -> Dict[str, str]:
 class AdmissionController:
     """One per serving door. Thread-safe; all operations are O(1) and
     lock-held for nanoseconds — this gate must stay cheap precisely when
-    the server is busiest."""
+    the server is busiest.
 
-    def __init__(self, max_inflight: Optional[int] = None) -> None:
+    ``door`` labels this controller's registry metrics (utils/metrics.py):
+    per-door admitted/shed counters, an in-flight gauge, the EWMA-wait
+    gauge, and the ``rafiki_request_seconds`` latency histogram fed by
+    :meth:`observe` — the source of the bench's door-side p50/p95/p99.
+    The JSON ``stats()`` shape is unchanged (per-controller ints,
+    incremented at the same sites as the registry mirrors)."""
+
+    def __init__(self, max_inflight: Optional[int] = None,
+                 door: str = "predictor") -> None:
         #: None defers to RAFIKI_PREDICT_MAX_INFLIGHT lazily per admit
         self._max_inflight = max_inflight
         self._lock = threading.Lock()
@@ -74,6 +82,37 @@ class AdmissionController:
         # estimation; 0.0 until the first observation (estimate disabled —
         # never shed on a guess)
         self._ewma_query_s = 0.0
+        self.door = door
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._m_admitted = REGISTRY.counter(
+            "rafiki_admission_admitted_total",
+            "requests admitted through a serving door", ("door",)
+        ).labels(door)
+        shed = REGISTRY.counter(
+            "rafiki_admission_shed_total",
+            "requests shed at a serving door (reason: capacity=503, "
+            "deadline=429)", ("door", "reason"))
+        self._m_shed_capacity = shed.labels(door, "capacity")
+        self._m_shed_deadline = shed.labels(door, "deadline")
+        self._g_inflight = REGISTRY.gauge(
+            "rafiki_admission_inflight",
+            "requests currently in flight behind a serving door",
+            ("door",)).labels(door)
+        self._g_ewma = REGISTRY.gauge(
+            "rafiki_admission_ewma_query_seconds",
+            "EWMA of per-query service seconds (the wait-estimation "
+            "unit)", ("door",)).labels(door)
+        self._h_request = REGISTRY.histogram(
+            "rafiki_request_seconds",
+            "end-to-end served-request latency at a serving door",
+            ("door",)).labels(door)
+        # autoscaler-grade ring series (~1 s resolution, bounded window).
+        # One ring per door: the admin door and every per-app predictor
+        # door live in one process, and a shared ring would clobber their
+        # samples into one interleaved series no control loop could read.
+        self._ring_shed = REGISTRY.ring(f"shed_rate:{door}")
+        self._ring_wait = REGISTRY.ring(f"ewma_wait_s:{door}")
 
     def _cap(self) -> int:
         if self._max_inflight is not None:
@@ -96,6 +135,8 @@ class AdmissionController:
             cap = self._cap()
             if cap > 0 and self._inflight >= cap:
                 self._shed_capacity += 1
+                self._m_shed_capacity.inc()
+                self._ring_shed.add()
                 raise ServerOverloadedError(
                     f"serving door at capacity ({self._inflight}/{cap} "
                     f"in flight)",
@@ -104,21 +145,27 @@ class AdmissionController:
                         if backlog_depth and self._ewma_query_s > 0 else 0.0)
             if est_wait > timeout_s > 0:
                 self._shed_deadline += 1
+                self._m_shed_deadline.inc()
+                self._ring_shed.add()
                 raise DeadlineUnmeetableError(
                     f"estimated queue wait {est_wait:.2f}s exceeds the "
                     f"request deadline {timeout_s:.2f}s",
                     retry_after_s=math.ceil(est_wait))
             self._inflight += 1
             self._admitted += 1
+            self._m_admitted.inc()
+            self._g_inflight.inc()
 
     def release(self) -> None:
         with self._lock:
             self._inflight = max(self._inflight - 1, 0)
+            self._g_inflight.set(self._inflight)
 
     # -- feedback + observability ------------------------------------------
 
     def observe(self, latency_s: float, n_queries: int) -> None:
-        """Feed one served request's latency back into the wait model."""
+        """Feed one served request's latency back into the wait model,
+        the door's latency histogram, and the EWMA-wait ring series."""
         if n_queries <= 0 or latency_s < 0:
             return
         per_query = latency_s / n_queries
@@ -127,6 +174,10 @@ class AdmissionController:
                 self._ewma_query_s = per_query
             else:
                 self._ewma_query_s += 0.2 * (per_query - self._ewma_query_s)
+            ewma = self._ewma_query_s
+        self._h_request.observe(latency_s)
+        self._g_ewma.set(ewma)
+        self._ring_wait.record(ewma)
 
     @property
     def inflight(self) -> int:

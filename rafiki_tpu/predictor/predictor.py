@@ -55,10 +55,22 @@ class Predictor:
             "trials_shed": 0,        # trials dropped: every replica full
             "requests_shed": 0,      # whole requests refused (all full)
         }
+        # registry mirrors, labeled by job (utils/metrics.py) — same
+        # increment site as the JSON counters so the views cannot drift
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._m_overload = {
+            key: REGISTRY.counter(
+                f"rafiki_predictor_{key}_total",
+                f"predictor overload counter: {key}", ("job",)
+            ).labels(inference_job_id)
+            for key in self._overload
+        }
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._ol_lock:
             self._overload[key] += n
+        self._m_overload[key].inc(n)
 
     def overload_stats(self) -> Dict[str, int]:
         with self._ol_lock:
@@ -110,10 +122,14 @@ class Predictor:
         return self.predict_batch([query], timeout_s)[0]
 
     def predict_batch(
-        self, queries: List[Any], timeout_s: Optional[float] = None
+        self, queries: List[Any], timeout_s: Optional[float] = None,
+        trace=None,
     ) -> List[Any]:
         """One replica per trial answers each request (round-robin with
-        failover); the ensemble is across trials."""
+        failover); the ensemble is across trials. ``trace`` (a sampled
+        request's RequestTrace) rides the FIRST submit of each trial so
+        worker-side spans land in the door's span tree; hedge batches are
+        duplicate work and stay untraced."""
         timeout_s = timeout_s if timeout_s is not None else config.PREDICT_TIMEOUT_S
         deadline = time.monotonic() + timeout_s
         queues = self._broker.get_worker_queues(self._job_id)
@@ -145,8 +161,11 @@ class Predictor:
         for trial, order in list(orders.items()):
             for k, wid in enumerate(order):
                 try:
+                    # trace kwarg only when sampled — unsampled traffic
+                    # keeps the pre-trace call shape for queue fakes
                     inflight[trial] = queues[wid].submit_many(
-                        queries, deadline=deadline)
+                        queries, deadline=deadline,
+                        **({"trace": trace} if trace is not None else {}))
                 except QueueFullError:
                     continue
                 orders[trial] = order[k:] + order[:k]

@@ -56,7 +56,10 @@ class PredictorServer:
         self.host = host
         self.port = port
         self.auth = auth
-        self.admission = AdmissionController()
+        # door label feeds the registry (admitted/shed counters + the
+        # rafiki_request_seconds histogram the bench reads percentiles
+        # from); the JSON stats() in /healthz stay per-door as before
+        self.admission = AdmissionController(door=f"predictor:{app}")
         #: epoch seconds of the listener bind — a restarted admin rebinds
         #: an ADOPTED job's door on a fresh port (control-plane recovery),
         #: and a monitor that sees started_at jump knows the door moved
@@ -76,8 +79,11 @@ class PredictorServer:
             timeout = 300
 
             def do_GET(self):
-                if self.path.split("?", 1)[0].rstrip("/") == "/healthz":
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/healthz":
                     server._healthz(self)
+                elif path == "/metrics":
+                    server._metrics(self)
                 else:
                     server._respond(self, 404, {"error": "no such route"})
 
@@ -248,20 +254,37 @@ class PredictorServer:
                        if ctype == "application/x-npy" else "timeout_s"))
             if terr:
                 return self._respond(handler, 400, {"error": terr})
+            # request tracing (utils/trace.py): honor an incoming
+            # X-Rafiki-Trace header's sampling bit or draw against
+            # RAFIKI_TRACE_SAMPLE; the unsampled path costs one header
+            # read. The context rides queue entries / wire frames / the
+            # fleet relay so one sampled request yields one span tree
+            # door -> worker -> door.
+            from rafiki_tpu.utils import trace as rtrace
+
+            rt = rtrace.start_trace(
+                handler.headers.get(rtrace.TRACE_HEADER))
             # admission: claim an in-flight slot AND prove the backlog
             # leaves room to answer inside this request's own deadline —
             # shed here costs microseconds; admitting a doomed request
             # costs model time
             backlog_fn = getattr(self.predictor, "backlog_depth", None)
             backlog = backlog_fn() if callable(backlog_fn) else None
+            t_adm = time.monotonic()
             self.admission.admit(timeout_s, backlog_depth=backlog)
             t0 = time.monotonic()
+            if rt is not None:
+                rt.add_span("admission_wait", t_adm, t0)
             try:
+                # trace kwarg only when sampled: unsampled traffic keeps
+                # the pre-trace call shape (duck-typed predictor fakes)
                 preds = self.predictor.predict_batch(
-                    queries, timeout_s=timeout_s)
+                    queries, timeout_s=timeout_s,
+                    **({"trace": rt} if rt is not None else {}))
             finally:
                 self.admission.release()
-            self.admission.observe(time.monotonic() - t0, len(queries))
+            e2e_s = time.monotonic() - t0
+            self.admission.observe(e2e_s, len(queries))
             # Accept negotiation: a client that asked for
             # application/x-npy gets the predictions back as ONE binary
             # .npy body — the response-leg mirror of the binary request
@@ -269,6 +292,8 @@ class PredictorServer:
             # on an end-to-end binary predict). Ragged/non-numeric
             # predictions fall back to JSON; the client sniffs the
             # response Content-Type either way.
+            trace_headers = ({rtrace.TRACE_HEADER: rt.ctx.to_header()}
+                             if rt is not None else None)
             if self._accepts_npy(handler):
                 import io
 
@@ -282,9 +307,16 @@ class PredictorServer:
                 if arr is not None and arr.dtype != object:
                     buf = io.BytesIO()
                     _np.save(buf, arr, allow_pickle=False)
-                    return self._respond_bytes(
-                        handler, 200, buf.getvalue(), "application/x-npy")
-            self._respond(handler, 200, {"data": {"predictions": preds}})
+                    t_resp = time.monotonic()
+                    self._respond_bytes(
+                        handler, 200, buf.getvalue(), "application/x-npy",
+                        headers=trace_headers)
+                    self._finish_trace(rt, t0, t_resp)
+                    return
+            t_resp = time.monotonic()
+            self._respond(handler, 200, {"data": {"predictions": preds}},
+                          headers=trace_headers)
+            self._finish_trace(rt, t0, t_resp)
         except UnauthorizedError as e:
             self._respond(handler, 401, {"error": str(e)})
         except json.JSONDecodeError as e:
@@ -311,6 +343,41 @@ class PredictorServer:
             logger.exception("predict failed on dedicated port for %s",
                              self.app)
             self._respond(handler, 500, {"error": "internal server error"})
+
+    def _metrics(self, handler: BaseHTTPRequestHandler) -> None:
+        """GET /metrics: Prometheus text exposition of the process
+        registry (?format=json for the JSON snapshot + ring series).
+        Unauthenticated like /healthz — counters only, standard scraper
+        contract."""
+        from rafiki_tpu.utils.metrics import serve_http
+
+        serve_http(handler, (handler.path.split("?", 1) + [""])[1])
+
+    def _finish_trace(self, rt, t0: float, t_resp: float) -> None:
+        """Close out a sampled request: the respond span, per-phase
+        latency histograms, and — past RAFIKI_TRACE_SLOW_MS — a JSON-lines
+        exemplar under LOGS_DIR. Never raises (telemetry must not fail a
+        request that was already served)."""
+        if rt is None:
+            return
+        try:
+            from rafiki_tpu.utils import trace as rtrace
+            from rafiki_tpu.utils.metrics import REGISTRY
+
+            now = time.monotonic()
+            rt.add_span("respond", t_resp, now)
+            phase_h = REGISTRY.histogram(
+                "rafiki_phase_seconds",
+                "per-phase latency of sampled predict requests",
+                ("phase",))
+            for name, secs in rt.phase_durations().items():
+                phase_h.labels(name).observe(secs)
+            e2e_s = now - t0
+            if e2e_s >= rtrace.slow_threshold_s():
+                rtrace.record_exemplar(rt, e2e_s,
+                                       door=f"predictor:{self.app}")
+        except Exception:
+            logger.debug("trace finish failed", exc_info=True)
 
     @staticmethod
     def _accepts_npy(handler) -> bool:
@@ -341,9 +408,12 @@ class PredictorServer:
 
     @staticmethod
     def _respond_bytes(handler, code: int, data: bytes,
-                       content_type: str) -> None:
+                       content_type: str,
+                       headers: Optional[Dict[str, str]] = None) -> None:
         handler.send_response(code)
         handler.send_header("Content-Type", content_type)
         handler.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
         handler.wfile.write(data)

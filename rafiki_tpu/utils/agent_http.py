@@ -261,6 +261,11 @@ def call_agent(
             raise
         except AgentTransportError as e:
             last = e
+            from rafiki_tpu.utils.metrics import REGISTRY
+
+            REGISTRY.counter(
+                "rafiki_agent_transport_failures_total",
+                "agent calls that failed at the transport layer").inc()
             if breaker is not None:
                 breaker.record_failure()
                 if attempt + 1 < attempts and not breaker.allow():

@@ -1,0 +1,471 @@
+"""Process-local metrics registry with Prometheus text exposition.
+
+PRs 1-5 grew rich *local* signals — queue depth/expired/shed counters,
+admission EWMA wait, hedge counters, ``TRAINING_STATS`` — but they lived
+in ad-hoc dicts scattered across ``/healthz`` payloads: no common naming,
+no histograms, no way to scrape them with standard tooling. This module
+is the unified store those signals migrate into:
+
+- **Counter / Gauge / Histogram** with labels, each child guarded by its
+  own tiny lock (an ``inc`` is one lock + one add — the hot serving path
+  must not convoy on a registry-global lock);
+- **fixed-log-bucket histograms** so latency percentiles (p50/p95/p99)
+  come from the serving door itself, not from client-side sampling;
+- **Prometheus text exposition** (format 0.0.4) served at ``GET
+  /metrics`` on all three HTTP doors (admin, agent, dedicated
+  predictor port);
+- a **bounded ring-buffer time series** per named series at ~1 s
+  resolution (``RAFIKI_METRICS_RING_S`` seconds of history) for the
+  handful of autoscaler-grade signals — queue depth, shed rate, EWMA
+  wait — that a control loop wants as a short series, not a scalar.
+
+The registry is process-local by design: in-process/thread placements
+surface everything through the admin door; separate worker processes
+keep their own registries (their counters still reach the admin through
+the existing SERVING_STATS event relay). ``RAFIKI_METRICS=0`` turns every
+write into a no-op — the kill switch the bench overhead guard measures
+against.
+
+Metric names are a STABLE contract (docs/observability.md carries the
+catalog; tests/test_metrics.py snapshots them — renames fail the test).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# default histogram buckets: log ladder from 100 us to ~200 s (factor 2)
+# — wide enough for a sub-ms codec phase and a 30 s SLO miss in one
+# histogram, coarse enough that a snapshot stays small
+_DEFAULT_BUCKETS = tuple(1e-4 * (2.0 ** i) for i in range(22))
+
+
+def metrics_enabled() -> bool:
+    """RAFIKI_METRICS=0 turns every registry write into a no-op (the
+    overhead kill switch; resolved per call like the other lazy knobs so
+    tests and the bench guard phase can flip it at runtime)."""
+    return os.environ.get("RAFIKI_METRICS", "1") not in ("0", "false")
+
+
+def ring_window_s() -> int:
+    try:
+        return max(int(os.environ.get("RAFIKI_METRICS_RING_S", "300")), 10)
+    except ValueError:
+        return 300
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Child:
+    """One (metric, label-values) cell. Own lock: hot-path increments
+    from different label sets never contend."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _CounterChild(_Child):
+    __slots__ = ()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not metrics_enabled():
+            return
+        with self._lock:
+            self._value += amount
+
+
+class _GaugeChild(_Child):
+    __slots__ = ()
+
+    def set(self, value: float) -> None:
+        if not metrics_enabled():
+            return
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not metrics_enabled():
+            return
+        with self._lock:
+            self._value += amount
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Tuple[float, ...]) -> None:
+        self._lock = threading.Lock()
+        self._buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        if not metrics_enabled():
+            return
+        v = float(value)
+        if not math.isfinite(v):
+            return
+        # linear scan beats bisect at this bucket count for small values
+        # (latencies land in the first few buckets); fall through to +Inf
+        idx = len(self._buckets)
+        for i, b in enumerate(self._buckets):
+            if v <= b:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, cum_counts = 0, []
+        for c in counts:
+            cum += c
+            cum_counts.append(cum)
+        return {
+            "count": total,
+            "sum": round(s, 9),
+            "buckets": [[_fmt(b), cum_counts[i]]
+                        for i, b in enumerate(self._buckets)]
+                       + [["+Inf", cum_counts[-1]]],
+        }
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-resolution quantile estimate (the bucket's upper bound
+        whose cumulative count first reaches rank q) — what the bench
+        reports as door-histogram p50/p95/p99."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total <= 0:
+            return None
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                return (self._buckets[i] if i < len(self._buckets)
+                        else self._buckets[-1] * 2)
+        return self._buckets[-1] * 2
+
+    def value(self) -> float:  # uniform snapshot interface
+        with self._lock:
+            return float(self._count)
+
+
+class _Metric:
+    """Base: a named family of children keyed by label values."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 label_names: Sequence[str] = ()) -> None:
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values: Any):
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} label "
+                f"value(s) {self.label_names}, got {len(key)}")
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._make_child())
+        return child
+
+    def children(self) -> Dict[Tuple[str, ...], Any]:
+        with self._lock:
+            return dict(self._children)
+
+    def _label_str(self, key: Tuple[str, ...]) -> str:
+        if not key:
+            return ""
+        pairs = ",".join(
+            f'{n}="{_escape_label(v)}"'
+            for n, v in zip(self.label_names, key))
+        return "{" + pairs + "}"
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key, child in sorted(self.children().items()):
+            lines.append(f"{self.name}{self._label_str(key)} "
+                         f"{_fmt(child.value())}")
+        return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self) -> _CounterChild:
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def value(self, *label_values: Any) -> float:
+        return self.labels(*label_values).value()
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _make_child(self) -> _GaugeChild:
+        return _GaugeChild()
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def value(self, *label_values: Any) -> float:
+        return self.labels(*label_values).value()
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str,
+                 label_names: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None) -> None:
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets)) if buckets else _DEFAULT_BUCKETS
+
+    def _make_child(self) -> _HistogramChild:
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        for key, child in sorted(self.children().items()):
+            snap = child.snapshot()
+            base = self._label_str(key)
+            for le, cum in snap["buckets"]:
+                if base:
+                    lbl = base[:-1] + f',le="{le}"' + "}"
+                else:
+                    lbl = '{le="' + le + '"}'
+                lines.append(f"{self.name}_bucket{lbl} {cum}")
+            lines.append(f"{self.name}_sum{base} {_fmt(snap['sum'])}")
+            lines.append(f"{self.name}_count{base} {snap['count']}")
+        return lines
+
+
+class Ring:
+    """Bounded ~1 s-resolution time series: one slot per wall-clock
+    second over a ``ring_window_s()`` window, last-write-wins within a
+    second (``record``) or summed within a second (``add`` — shed *rates*
+    want per-second sums, depth *levels* want the latest sample).
+    O(window) memory, O(1) writes — safe to feed from the serving path."""
+
+    __slots__ = ("_lock", "_slots", "_t", "_v")
+
+    def __init__(self, slots: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._slots = slots or ring_window_s()
+        self._t = [0] * self._slots
+        self._v = [0.0] * self._slots
+
+    def record(self, value: float) -> None:
+        if not metrics_enabled():
+            return
+        s = int(time.time())
+        i = s % self._slots
+        with self._lock:
+            self._t[i] = s
+            self._v[i] = float(value)
+
+    def add(self, value: float = 1.0) -> None:
+        if not metrics_enabled():
+            return
+        s = int(time.time())
+        i = s % self._slots
+        with self._lock:
+            if self._t[i] != s:
+                self._t[i] = s
+                self._v[i] = 0.0
+            self._v[i] += float(value)
+
+    def series(self) -> List[List[float]]:
+        """Valid (epoch_second, value) samples within the window, oldest
+        first — the autoscaler-facing view."""
+        now = int(time.time())
+        with self._lock:
+            pairs = [(t, v) for t, v in zip(self._t, self._v)
+                     if t and now - t < self._slots]
+        return [[t, v] for t, v in sorted(pairs)]
+
+
+class Registry:
+    """Get-or-create metric store. Creation is idempotent by name so
+    module-level callers can't race; re-declaring a name with a different
+    type or label set raises — names are a stable contract."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._rings: Dict[str, Ring] = {}
+
+    def _get_or_create(self, cls, name: str, help_text: str,
+                       label_names: Sequence[str], **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) \
+                        or m.label_names != tuple(label_names):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(m).__name__}{m.label_names}")
+                return m
+            m = cls(name, help_text, label_names, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_text: str = "",
+                label_names: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, label_names)
+
+    def gauge(self, name: str, help_text: str = "",
+              label_names: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, label_names)
+
+    def histogram(self, name: str, help_text: str = "",
+                  label_names: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help_text, label_names, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def ring(self, name: str) -> Ring:
+        with self._lock:
+            r = self._rings.get(name)
+            if r is None:
+                r = self._rings[name] = Ring()
+            return r
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def render(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every metric."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON view: scalar metrics flattened to {name{labels}: value},
+        histograms to their bucket snapshots, plus every ring series —
+        the machine-friendly twin of the Prometheus text (``GET
+        /metrics?format=json``)."""
+        out: Dict[str, Any] = {"metrics": {}, "rings": {}}
+        with self._lock:
+            metrics = dict(self._metrics)
+            rings = dict(self._rings)
+        for name, m in sorted(metrics.items()):
+            for key, child in sorted(m.children().items()):
+                label = name + m._label_str(key)
+                if isinstance(m, Histogram):
+                    out["metrics"][label] = child.snapshot()
+                else:
+                    out["metrics"][label] = child.value()
+        for name, r in sorted(rings.items()):
+            out["rings"][name] = r.series()
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric and ring (test isolation only — live callers
+        hold child references that survive a reset but stop rendering)."""
+        with self._lock:
+            self._metrics.clear()
+            self._rings.clear()
+
+
+#: THE process registry — every subsystem registers here so all three
+#: HTTP doors expose one coherent catalog.
+REGISTRY = Registry()
+
+
+def http_payload(fmt: str = "text") -> Tuple[bytes, str]:
+    """Body + Content-Type for a GET /metrics response — the ONE copy of
+    the exposition logic shared by the admin, agent, and predictor doors.
+    ``fmt="json"`` returns the snapshot (including ring series) instead
+    of Prometheus text."""
+    if fmt == "json":
+        return (json.dumps(REGISTRY.snapshot()).encode(),
+                "application/json")
+    return REGISTRY.render().encode(), PROMETHEUS_CONTENT_TYPE
+
+
+def serve_http(handler, query: str = "") -> None:
+    """Answer one GET /metrics on a BaseHTTPRequestHandler — the single
+    response path all three doors share (``?format=json`` selects the
+    snapshot + ring series)."""
+    data, ctype = http_payload(
+        "json" if "format=json" in (query or "") else "text")
+    handler.send_response(200)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Tiny exposition parser (tests + doctor): {'name{labels}': value}.
+    Not a full PromQL client — just enough to verify the text is
+    well-formed and read sample values back."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, value = line.rsplit(" ", 1)
+            out[key] = float(value)
+        except ValueError as e:
+            raise ValueError(f"unparseable exposition line {line!r}") from e
+    return out

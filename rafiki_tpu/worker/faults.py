@@ -296,27 +296,55 @@ def record_fault(sub_id: str, kind: str, retried: bool = False) -> None:
     """Terminal faults land in the per-kind counters; absorbed
     (retried) transients count ONLY as retries — same split as the
     store-side fault summary, so the two /fleet/health views agree on
-    what "faulted" means."""
+    what "faulted" means. The registry mirrors (utils/metrics.py) carry
+    the same split process-wide, labeled by fault kind."""
+    from rafiki_tpu.utils.metrics import REGISTRY
+
     with _STATS_LOCK:
         s = _stats_entry(sub_id)
         if retried:
             s["retries"] += 1
         else:
             s["faults"][kind] = s["faults"].get(kind, 0) + 1
+    if retried:
+        REGISTRY.counter(
+            "rafiki_training_retries_total",
+            "infra-class trial faults absorbed by same-id retry").inc()
+    else:
+        REGISTRY.counter(
+            "rafiki_training_faults_total",
+            "terminal trial faults by taxonomy kind", ("kind",)
+        ).labels(kind).inc()
 
 
 def record_quarantine(sub_id: str, signatures: Iterable[str]) -> None:
+    from rafiki_tpu.utils.metrics import REGISTRY
+
     with _STATS_LOCK:
         s = _stats_entry(sub_id)
         merged = set(s["quarantined"]) | set(signatures)
         s["quarantined"] = sorted(merged)
+        total = sum(len(v.get("quarantined", ()))
+                    for v in TRAINING_STATS.values())
+    REGISTRY.gauge(
+        "rafiki_training_quarantined_signatures",
+        "poison-knob signatures currently quarantined in this process"
+    ).set(total)
 
 
 def record_counter(sub_id: str, counter: str, value: int = 1,
                    absolute: bool = False) -> None:
+    from rafiki_tpu.utils.metrics import REGISTRY
+
     with _STATS_LOCK:
         s = _stats_entry(sub_id)
         s[counter] = value if absolute else s.get(counter, 0) + value
+    if not absolute:
+        # process-wide counter twin (reproposals, feedback_dropped, ...)
+        REGISTRY.counter(
+            "rafiki_training_counter_total",
+            "training-plane worker counters", ("counter",)
+        ).labels(counter).inc(value)
 
 
 def reset_stats(sub_id: Optional[str] = None) -> None:

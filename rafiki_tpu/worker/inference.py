@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional
 
@@ -44,6 +45,38 @@ _stats_lock = threading.Lock()
 SERVING_STATS: Dict[str, Dict[str, int]] = {}
 
 
+def _metrics():
+    """Registry mirrors of the serving counters (utils/metrics.py) —
+    lazily created so import stays cheap; the JSON SERVING_STATS keeps
+    its shape and the mirrors increment at the same sites."""
+    global _M
+    if _M is None:
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        _M = {
+            "batches": REGISTRY.counter(
+                "rafiki_serving_batches_total",
+                "batches served by inference workers in this process"),
+            "queries": REGISTRY.counter(
+                "rafiki_serving_queries_total",
+                "queries served by inference workers in this process"),
+            "batch_size": REGISTRY.histogram(
+                "rafiki_serving_batch_size",
+                "queries per served batch (continuous-batching occupancy)",
+                buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256]),
+            "depth": REGISTRY.gauge(
+                "rafiki_queue_depth",
+                "current worker-queue depth", ("service",)),
+            "phase": REGISTRY.histogram(
+                "rafiki_worker_phase_seconds",
+                "worker-side phase latency per served batch", ("phase",)),
+        }
+    return _M
+
+
+_M = None
+
+
 def serving_stats() -> Dict[str, Dict[str, int]]:
     """Snapshot of {service_id: {batches, queries, ...}} for this process."""
     with _stats_lock:
@@ -55,6 +88,10 @@ def _record_batch(service_id: str, n_queries: int) -> None:
         s = SERVING_STATS.setdefault(service_id, {"batches": 0, "queries": 0})
         s["batches"] += 1
         s["queries"] += n_queries
+    m = _metrics()
+    m["batches"].inc()
+    m["queries"].inc(n_queries)
+    m["batch_size"].observe(n_queries)
 
 
 def _record_queue(service_id: str, queue) -> None:
@@ -77,6 +114,16 @@ def _record_queue(service_id: str, queue) -> None:
                          ("ring_used_bytes_hw", "ring_used_bytes_hw")):
             if src in q:
                 s[dst] = int(q[src])
+    if "depth" in q:
+        m = _metrics()
+        m["depth"].labels(service_id).set(int(q["depth"]))
+        # autoscaler-grade ring series (~1 s resolution): the depth the
+        # worker observed at this tick. One ring PER service — a shared
+        # ring would interleave last-write-wins samples from every queue
+        # in the process into one meaningless sawtooth.
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.ring(f"queue_depth:{service_id}").record(int(q["depth"]))
 
 
 def _resolve_batch(futures: List[Any], predictions: Any,
@@ -157,7 +204,19 @@ class _FusedEnsembleModel:
         # are separate processes — co-residency is impossible there, so the
         # hook may be absent entirely
         stack_fn = getattr(models[0], "ensemble_stack", None)
-        self._stacked = stack_fn(models) if callable(stack_fn) else None
+        self._stacked = None
+        if callable(stack_fn):
+            try:
+                self._stacked = stack_fn(models)
+            except Exception:
+                # the hook is TEMPLATE code (ADVICE r5): a raising hook —
+                # OOM stacking N param trees, a template bug — must
+                # degrade to sequential serving, not fail worker startup
+                # and roll back the whole inference job
+                logger.exception(
+                    "fused worker: ensemble_stack hook raised; falling "
+                    "back to sequential in-process serving of %d models",
+                    len(models))
         if self._stacked is None and len(models) > 1:
             logger.info(
                 "fused worker: trials do not share a compiled predict; "
@@ -350,9 +409,24 @@ class InferenceWorker:
                 _record_batch(ctx.service_id, len(batch))
                 _record_queue(ctx.service_id, queue)
                 futures = [f for f, _ in batch]
+                # trace sinks for sampled requests in this batch — the
+                # in-process future carries the door's RequestTrace, the
+                # shm handle its frame responder; both accept
+                # add_span(name, start, end). Deduplicated: a request's
+                # entries share one sink.
+                sinks = []
+                for f in futures:
+                    sink = getattr(f, "trace", None)
+                    if sink is not None and all(s is not sink
+                                                for s in sinks):
+                        sinks.append(sink)
+                t_asm = time.monotonic()
                 queries = assembler.assemble(
                     [q for _, q in batch],
                     reusable=getattr(queue, "reusable_batch_ok", False))
+                t_fwd = time.monotonic()
+                for sink in sinks:
+                    sink.add_span("batch_assembly", t_asm, t_fwd)
                 rule = chaos.hit(chaos.SITE_WORKER,
                                  f"{self._job_id}/{ctx.service_id}")
                 if rule is not None:
@@ -377,6 +451,14 @@ class InferenceWorker:
                         continue
                 try:
                     predictions = model.predict(queries)
+                    t_done = time.monotonic()
+                    m = _metrics()
+                    m["phase"].labels("batch_assembly").observe(
+                        t_fwd - t_asm)
+                    m["phase"].labels("model_forward").observe(
+                        t_done - t_fwd)
+                    for sink in sinks:
+                        sink.add_span("model_forward", t_fwd, t_done)
                     _resolve_batch(futures, predictions, ctx.service_id)
                 except Exception as e:
                     logger.error(

@@ -66,6 +66,28 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                   ring_used_bytes_hw in serving stats
 #                                   (oversized frames shed as typed 413)
 
+# Telemetry plane (docs/observability.md). GET /metrics on all three
+# HTTP doors (admin, agent, per-job predictor port) serves Prometheus
+# text; cross-hop request tracing is sampled at the predictor door and
+# rides queue entries / wire frames / the fleet relay:
+#   RAFIKI_METRICS=1                0 = registry writes become no-ops
+#                                   (/metrics exposes zeros; the bench
+#                                   overhead guard measures against this)
+#   RAFIKI_METRICS_RING_S=300       seconds of ~1 s-resolution history in
+#                                   the autoscaler ring series (queue
+#                                   depth, shed rate, EWMA wait)
+#   RAFIKI_TRACE_SAMPLE=0           fraction of predict requests sampled
+#                                   into span trees at the predictor door
+#                                   (0..1; clients can force one request
+#                                   with the X-Rafiki-Trace header)
+#   RAFIKI_TRACE_SLOW_MS=0          sampled requests at least this slow
+#                                   are appended as JSON-lines exemplars
+#                                   to $LOGS_DIR/predict_exemplars.jsonl
+#                                   (0 = every sampled request)
+#   RAFIKI_TRACE_EXEMPLAR_MAX_MB=64 exemplar file size-rotation cap (one
+#                                   .1 generation; doctor WARNs when
+#                                   rotation falls behind)
+
 # Control-plane crash recovery (docs/failure-model.md, "Control-plane
 # faults"). A restarted admin reconciles the store against what is
 # actually running: adopt surviving workers, reschedule dead-host train

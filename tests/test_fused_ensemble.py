@@ -179,3 +179,57 @@ def test_ensemble_stack_requires_shared_bucket(tmp_path):
     m3 = JaxCnn(**{**knobs, "base_channels": 16})
     m3.train(uri)
     assert m1.ensemble_stack([m1, m3]) is None
+
+
+def test_raising_ensemble_stack_hook_falls_back_to_sequential():
+    """ADVICE r5: a template-provided ensemble_stack hook that RAISES
+    (OOM stacking N param trees, a template bug) must degrade to
+    sequential in-process serving — not propagate out of
+    _FusedEnsembleModel.__init__ and fail worker startup (which would
+    roll back the whole inference job)."""
+    from rafiki_tpu.worker.inference import _FusedEnsembleModel
+
+    class RaisingHookModel:
+        def ensemble_stack(self, models):
+            raise MemoryError("stacking N param trees blew the host")
+
+        def predict(self, queries):
+            return [[1.0] for _ in queries]
+
+        def warm_up(self):
+            pass
+
+        def destroy(self):
+            pass
+
+    models = [RaisingHookModel(), RaisingHookModel()]
+    fused = _FusedEnsembleModel(models, "IMAGE_CLASSIFICATION")
+    assert fused.fused_dispatch is False  # fell back, did not raise
+    preds = fused.predict([[0.0], [2.0]])
+    assert len(preds) == 2  # sequential path still serves
+
+
+def test_fused_with_sandbox_refused_at_deploy(admin, monkeypatch):
+    """ADVICE r5: ENSEMBLE_FUSED + RAFIKI_SANDBOX would co-locate one
+    JAX sandbox child per trial on a single worker's chip grant —
+    untested and unsupported. The deploy must refuse with a typed
+    ServiceDeploymentError (and error the job row), not fail at worker
+    startup."""
+    from rafiki_tpu.admin.services import ServiceDeploymentError
+
+    uid = _login(admin)
+    with open(FIXTURE, "rb") as f:
+        admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION", f.read(),
+                           "FakeModel")
+    admin.create_train_job(
+        uid, "sandfused", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 0},
+    )
+    admin.wait_until_train_job_stopped(uid, "sandfused", timeout_s=60)
+    monkeypatch.setenv("RAFIKI_SANDBOX", "1")
+    with pytest.raises(ServiceDeploymentError, match="ENSEMBLE_FUSED"):
+        admin.create_inference_job(uid, "sandfused",
+                                   budget={"ENSEMBLE_FUSED": 1})
+    # the per-trial fleet (no fusion) still deploys under the sandbox
+    # flag in THIS environment only if sandboxing actually works here;
+    # the refusal contract is what this test pins down.

@@ -1,0 +1,542 @@
+"""Telemetry plane: metrics registry, Prometheus exposition on the
+serving doors, and cross-hop request tracing over the binary shm path.
+
+The metric NAME assertions here are a stability contract — a rename is
+an operator-visible breaking change (dashboards, scrape configs) and
+must fail this suite, not slip through a refactor.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rafiki_tpu.cache import wire
+from rafiki_tpu.utils import trace as rtrace
+from rafiki_tpu.utils.metrics import (
+    REGISTRY,
+    Registry,
+    parse_prometheus,
+)
+
+# -- registry unit behavior --------------------------------------------------
+
+
+def test_counter_gauge_basand_labels():
+    r = Registry()
+    c = r.counter("t_total", "help", ("a",))
+    c.labels("x").inc()
+    c.labels("x").inc(2)
+    c.labels("y").inc()
+    assert c.value("x") == 3
+    assert c.value("y") == 1
+    g = r.gauge("t_gauge", "help")
+    g.set(7)
+    assert g.value() == 7
+    # re-declaring with a different type/labels is a contract violation
+    with pytest.raises(ValueError):
+        r.gauge("t_total")
+    with pytest.raises(ValueError):
+        r.counter("t_total", "help", ("a", "b"))
+
+
+def test_histogram_bucket_math_and_quantiles():
+    r = Registry()
+    h = r.histogram("t_seconds", "help", buckets=[0.001, 0.01, 0.1, 1.0])
+    child = h.labels()
+    for v in (0.0005, 0.005, 0.005, 0.05, 0.5, 5.0):
+        child.observe(v)
+    snap = child.snapshot()
+    assert snap["count"] == 6
+    assert abs(snap["sum"] - 5.5605) < 1e-9
+    # cumulative bucket counts: le=0.001 ->1, 0.01 ->3, 0.1 ->4, 1 ->5, inf ->6
+    cums = [n for _, n in snap["buckets"]]
+    assert cums == [1, 3, 4, 5, 6]
+    # quantile estimates land on bucket upper bounds
+    assert child.quantile(0.5) == 0.01
+    assert child.quantile(0.99) == 2.0  # past the last bucket: 2x top
+    # NaN/inf observations are dropped, not corrupting sum
+    child.observe(float("nan"))
+    assert child.snapshot()["count"] == 6
+
+
+def test_exposition_renders_and_parses():
+    r = Registry()
+    r.counter("t_a_total", "a counter", ("k",)).labels('va"l\\ue').inc()
+    r.histogram("t_b_seconds", "a histogram", buckets=[0.1, 1]).observe(0.05)
+    text = r.render()
+    samples = parse_prometheus(text)
+    assert samples['t_a_total{k="va\\"l\\\\ue"}'] == 1
+    assert samples['t_b_seconds_bucket{le="0.1"}'] == 1
+    assert samples['t_b_seconds_count'] == 1
+    assert "# TYPE t_b_seconds histogram" in text
+
+
+def test_ring_series_modes():
+    r = Registry()
+    ring = r.ring("t_ring")
+    ring.record(3)
+    ring.record(5)       # same second: last wins
+    ring2 = r.ring("t_ring2")
+    ring2.add(1)
+    ring2.add(2)         # same second: sums
+    s = ring.series()
+    assert s and s[-1][1] == 5
+    s2 = ring2.series()
+    assert s2 and s2[-1][1] == 3
+
+
+def test_metrics_kill_switch(monkeypatch):
+    r = Registry()
+    c = r.counter("t_off_total", "help")
+    monkeypatch.setenv("RAFIKI_METRICS", "0")
+    c.inc()
+    assert c.value() == 0
+    monkeypatch.delenv("RAFIKI_METRICS")
+    c.inc()
+    assert c.value() == 1
+
+
+# -- wire v2 trace metadata + interop ---------------------------------------
+
+
+def test_traceless_frames_stay_v1_for_old_peers():
+    frame = wire.encode({"ids": ["a"], "qarr": np.ones(4, np.float32)})
+    assert frame[4] == 1  # byte-compatible with the pre-trace codec
+    body, meta = wire.decode_meta(frame)
+    assert meta == {}
+    assert list(body["ids"]) == ["a"]
+
+
+def test_trace_metadata_rides_v2_frame():
+    td = {"id": "abc123", "s": 1, "ts": 12.5}
+    frame = wire.encode({"ids": ["a"], "qarr": np.ones(4, np.float32)},
+                        trace=td)
+    assert frame[4] == wire.VERSION == 2
+    body, meta = wire.decode_meta(frame)
+    assert meta["trace"] == td
+    np.testing.assert_array_equal(body["qarr"], np.ones(4, np.float32))
+    # decode_any_meta sniffs JSON too
+    body2, meta2 = wire.decode_any_meta(b'{"x": 1}')
+    assert body2 == {"x": 1} and meta2 == {}
+
+
+def test_unknown_wire_version_still_rejected():
+    frame = bytearray(wire.encode({"x": 1}))
+    frame[4] = 99
+    with pytest.raises(wire.WireFormatError):
+        wire.decode(bytes(frame))
+
+
+# -- stack helpers -----------------------------------------------------------
+
+
+def _start_shm_stack(trace_sample=None, app="metricsapp"):
+    """A deployment-free PredictorServer -> Predictor -> ShmBroker ->
+    worker-thread pipeline (the bench_shm_binary_serving shape) using the
+    REAL worker serve loop's phase instrumentation."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+    from rafiki_tpu.predictor.predictor import Predictor
+    from rafiki_tpu.predictor.server import PredictorServer
+    from rafiki_tpu.worker.inference import _BatchAssembler
+    from rafiki_tpu import config
+
+    broker = ShmBroker()
+    wq = broker.register_worker("mjob", "w1")
+    assembler = _BatchAssembler()
+    stop = threading.Event()
+
+    def worker_loop():
+        while not stop.is_set():
+            batch = wq.take_batch(max_size=64, deadline_s=0.0,
+                                  wait_timeout_s=0.1)
+            if batch is None:
+                return
+            if not batch:
+                continue
+            futures = [f for f, _ in batch]
+            sinks = []
+            for f in futures:
+                s = getattr(f, "trace", None)
+                if s is not None and all(x is not s for x in sinks):
+                    sinks.append(s)
+            t0 = time.monotonic()
+            qs = assembler.assemble(
+                [q for _, q in batch],
+                reusable=getattr(wq, "reusable_batch_ok", False))
+            t1 = time.monotonic()
+            for s in sinks:
+                s.add_span("batch_assembly", t0, t1)
+            out = np.asarray(qs, dtype=np.float32) * 2.0
+            time.sleep(0.002)  # model-shaped work so spans have width
+            t2 = time.monotonic()
+            for s in sinks:
+                s.add_span("model_forward", t1, t2)
+            for fut, row in zip(futures, out):
+                fut.set_result(row)
+
+    t = threading.Thread(target=worker_loop, daemon=True)
+    t.start()
+    predictor = Predictor("mjob", broker, task=None)
+    server = PredictorServer(predictor, app, auth=False).start()
+
+    def cleanup():
+        stop.set()
+        server.stop(drain_timeout_s=0.0)
+        broker.close()
+
+    return server, cleanup
+
+
+def _binary_predict(port, header=None):
+    q = np.ones((1, 16), dtype=np.float32)
+    buf = io.BytesIO()
+    np.save(buf, q, allow_pickle=False)
+    headers = {"Content-Type": "application/x-npy"}
+    if header:
+        headers[rtrace.TRACE_HEADER] = header
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=buf.getvalue(),
+        headers=headers, method="POST")
+    t0 = time.monotonic()
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = json.loads(r.read())
+        return (time.monotonic() - t0, body,
+                r.headers.get(rtrace.TRACE_HEADER))
+
+
+shm_available = pytest.mark.skipif(
+    not __import__("rafiki_tpu.native.shm_queue",
+                   fromlist=["available"]).available(),
+    reason="native shm queue unavailable")
+
+
+# -- exposition on the serving door + legacy-shape parity --------------------
+
+
+@shm_available
+def test_predictor_door_metrics_match_healthz(tmp_workdir):
+    server, cleanup = _start_shm_stack(app="paritymetrics")
+    try:
+        for _ in range(3):
+            _binary_predict(server.port)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            samples = parse_prometheus(r.read().decode())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=10) as r:
+            healthz = json.loads(r.read())
+
+        # the legacy JSON /healthz admission stats and the registry are
+        # snapshots of the SAME counters (migration contract)
+        adm = healthz["admission"]
+        door = 'door="predictor:paritymetrics"'
+        assert samples[f"rafiki_admission_admitted_total{{{door}}}"] \
+            == adm["admitted"] == 3
+        assert samples[f"rafiki_admission_inflight{{{door}}}"] \
+            == adm["inflight"]
+        assert samples[
+            f'rafiki_admission_shed_total{{{door},reason="capacity"}}'] \
+            == adm["shed_capacity"]
+        assert samples[
+            f'rafiki_admission_shed_total{{{door},reason="deadline"}}'] \
+            == adm["shed_deadline"]
+        ewma = samples[f"rafiki_admission_ewma_query_seconds{{{door}}}"]
+        assert abs(ewma - adm["ewma_query_s"]) < 1e-3
+        # the door's latency histogram carries every served request
+        assert samples[
+            f"rafiki_request_seconds_count{{{door}}}"] == 3
+        # JSON snapshot carries the ring series
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics?format=json",
+                timeout=10) as r:
+            snap = json.loads(r.read())
+        assert "rings" in snap and "metrics" in snap
+    finally:
+        cleanup()
+
+
+@shm_available
+def test_metric_name_stability_snapshot(tmp_workdir, monkeypatch):
+    """Renaming a published metric fails here on purpose: names are an
+    operator contract (dashboards + scrape configs + the autoscaler)."""
+    monkeypatch.setenv("RAFIKI_TRACE_SAMPLE", "1")
+    server, cleanup = _start_shm_stack(app="stability")
+    try:
+        _binary_predict(server.port)
+        names = set(REGISTRY.names())
+    finally:
+        cleanup()
+    expected = {
+        "rafiki_admission_admitted_total",
+        "rafiki_admission_shed_total",
+        "rafiki_admission_inflight",
+        "rafiki_admission_ewma_query_seconds",
+        "rafiki_request_seconds",
+        "rafiki_queue_expired_total",
+        "rafiki_queue_rejected_total",
+        "rafiki_predictor_hedges_total",
+        "rafiki_predictor_hedges_suppressed_total",
+        "rafiki_predictor_trials_shed_total",
+        "rafiki_predictor_requests_shed_total",
+        "rafiki_wire_errors_total",
+        "rafiki_phase_seconds",
+    }
+    missing = expected - names
+    assert not missing, f"published metric names disappeared: {missing}"
+
+
+# -- cross-hop trace drill ---------------------------------------------------
+
+
+@shm_available
+def test_sampled_predict_yields_cross_hop_span_tree(tmp_workdir):
+    """Acceptance drill: a sampled predict over the binary shm path
+    produces ONE span tree spanning door -> worker -> door, with >= 5
+    phases whose durations sum to ~ the observed end-to-end latency."""
+    server, cleanup = _start_shm_stack(app="tracedrill")
+    try:
+        _binary_predict(server.port)  # warm (connection + numpy paths)
+        trace_id = "feedbeef" * 4
+        e2e_s, _, echoed = _binary_predict(server.port,
+                                           header=f"{trace_id};s=1")
+        assert echoed is not None and echoed.startswith(trace_id)
+        # exemplar written under LOGS_DIR (RAFIKI_TRACE_SLOW_MS=0 default)
+        path = rtrace.exemplar_path()
+        deadline = time.monotonic() + 5
+        lines = []
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                lines = [json.loads(ln) for ln in
+                         open(path).read().strip().splitlines()]
+                if any(e["trace_id"] == trace_id for e in lines):
+                    break
+            time.sleep(0.02)
+        ex = next(e for e in lines if e["trace_id"] == trace_id)
+        names = [s["name"] for s in ex["spans"]]
+        # the tree crosses the wire: door-side AND worker-side phases
+        for phase in ("admission_wait", "queue_wait", "codec_decode",
+                      "batch_assembly", "model_forward", "respond"):
+            assert phase in names, (phase, names)
+        assert len(names) >= 5
+        total = sum(s["duration_s"] for s in ex["spans"])
+        # the phases account for the request's wall time (scheduling
+        # wake-ups and HTTP parse own the remainder)
+        assert total <= e2e_s * 1.3
+        assert total >= ex["e2e_s"] * 0.3, (total, ex["e2e_s"], ex)
+    finally:
+        cleanup()
+
+
+@shm_available
+def test_unsampled_request_leaves_no_exemplar(tmp_workdir):
+    server, cleanup = _start_shm_stack(app="unsampled")
+    try:
+        _, _, echoed = _binary_predict(server.port)  # no header, rate 0
+        assert echoed is None
+        assert not os.path.exists(rtrace.exemplar_path())
+    finally:
+        cleanup()
+
+
+@shm_available
+def test_json_framed_submit_still_one_batch_and_served(tmp_workdir,
+                                                       monkeypatch):
+    """Mixed-version interop (ADVICE r5 follow-through): under the
+    RAFIKI_WIRE_BINARY=0 escape hatch the whole request still travels as
+    ONE ring message (one-request-one-batch holds on the JSON shm
+    transport too) and a sampled request is still served."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    monkeypatch.setenv("RAFIKI_WIRE_BINARY", "0")
+    broker = ShmBroker()
+    try:
+        wq = broker.register_worker("jjob", "w1")
+        proxy = broker.get_worker_queues("jjob")["w1"]
+        rt = rtrace.RequestTrace(rtrace.TraceContext("aa11", True))
+        futs = proxy.submit_many([[1.0], [2.0], [3.0]], trace=rt)
+        batch = wq.take_batch(max_size=64, deadline_s=0.0)
+        assert len(batch) == 3  # one frame, one batch
+        for handle, q in batch:
+            handle.set_result(q)
+        assert [f.result(5.0) for f in futs] == [[1.0], [2.0], [3.0]]
+    finally:
+        broker.close()
+
+
+def test_trace_header_parsing_is_hostile_input_safe():
+    assert rtrace.TraceContext.from_header(None) is None
+    assert rtrace.TraceContext.from_header("") is None
+    assert rtrace.TraceContext.from_header("x" * 200) is None
+    assert rtrace.TraceContext.from_header("../../etc;s=1") is None
+    ctx = rtrace.TraceContext.from_header("Abc123;s=0")
+    assert ctx is not None and ctx.sampled is False
+    ctx = rtrace.TraceContext.from_header("abc123")
+    assert ctx is not None and ctx.sampled is True
+
+
+def test_start_trace_sampling(monkeypatch):
+    monkeypatch.delenv("RAFIKI_TRACE_SAMPLE", raising=False)
+    assert rtrace.start_trace(None) is None          # rate 0 default
+    monkeypatch.setenv("RAFIKI_TRACE_SAMPLE", "1")
+    rt = rtrace.start_trace(None)
+    assert rt is not None and rt.ctx.sampled
+    # an incoming unsampled header wins over the local rate
+    assert rtrace.start_trace("abc123;s=0") is None
+    monkeypatch.setenv("RAFIKI_TRACE_SAMPLE", "garbage")
+    assert rtrace.sample_rate() == 0.0
+
+
+def test_exemplar_rotation(tmp_workdir, monkeypatch):
+    monkeypatch.setenv("RAFIKI_TRACE_EXEMPLAR_MAX_MB", "1")
+    path = rtrace.exemplar_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("x" * (1 << 20))
+    rt = rtrace.RequestTrace(rtrace.TraceContext("r0tate"))
+    rt.add_span("x", rt.t0, rt.t0 + 0.1)
+    rtrace.record_exemplar(rt, 0.1, door="t")
+    assert os.path.exists(path + ".1")          # rotated generation
+    assert os.path.getsize(path) < (1 << 19)    # fresh file
+
+
+# -- doctor ------------------------------------------------------------------
+
+
+def test_doctor_observability_check(tmp_workdir, monkeypatch):
+    from rafiki_tpu import doctor
+
+    monkeypatch.delenv("RAFIKI_TRACE_SAMPLE", raising=False)
+    name, status, detail = doctor.check_observability()
+    assert name == "observability" and status == doctor.PASS
+
+    monkeypatch.setenv("RAFIKI_TRACE_SAMPLE", "nonsense")
+    _, status, detail = doctor.check_observability()
+    assert status == doctor.WARN and "unparseable" in detail
+
+    monkeypatch.setenv("RAFIKI_TRACE_SAMPLE", "1")
+    _, status, detail = doctor.check_observability()
+    assert status == doctor.WARN and "EVERY request" in detail
+
+    monkeypatch.setenv("RAFIKI_TRACE_SAMPLE", "0.01")
+    monkeypatch.setenv("RAFIKI_METRICS", "0")
+    _, status, detail = doctor.check_observability()
+    assert status == doctor.WARN and "RAFIKI_METRICS=0" in detail
+
+
+# -- fleet relay hop ---------------------------------------------------------
+
+
+def test_relay_forwards_trace_and_grafts_remote_spans():
+    """cache/fleet.py: a sampled request's context rides the relay body;
+    the returned trace_spans graft onto the door's span tree re-anchored
+    at the relay's submit time. An old agent (no trace_spans in the
+    answer) would simply contribute no spans — same request, served."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from rafiki_tpu.cache.fleet import HttpWorkerQueue
+    from rafiki_tpu.utils.agent_http import reset_breaker
+
+    seen = {}
+
+    class TracingAgent(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"host": "t", "status": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            raw = self.rfile.read(
+                int(self.headers.get("Content-Length") or 0))
+            body = json.loads(raw)
+            seen["trace"] = body.get("trace")
+            out = json.dumps({
+                "predictions": list(body["queries"]),
+                "trace_spans": [["queue_wait", 0.001, 0.004],
+                                ["model_forward", 0.005, 0.010]],
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), TracingAgent)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    reset_breaker(addr)
+    q = HttpWorkerQueue(addr, "rjob", "w1")
+    try:
+        rt = rtrace.RequestTrace(rtrace.TraceContext("re1ay", True))
+        futs = q.submit_many([[1.0]], trace=rt)
+        assert futs[0].result(10.0) == [1.0]
+        assert seen["trace"] == {"id": "re1ay", "s": 1}
+        names = {s.name for s in rt.spans}
+        assert {"queue_wait", "model_forward"} <= names
+    finally:
+        q.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_agent_relay_collects_local_spans(tmp_workdir):
+    """placement/agent.py: a relayed body carrying a trace context makes
+    the agent collect its local half of the span tree and answer
+    trace_spans; a body WITHOUT one answers the legacy shape."""
+    from types import SimpleNamespace
+
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.placement.agent import AgentServer
+    from rafiki_tpu.utils.agent_http import call_agent, reset_breaker
+
+    broker = InProcessBroker()
+    wq = broker.register_worker("ajob", "w1")
+    stop = threading.Event()
+
+    def worker_loop():
+        while not stop.is_set():
+            batch = wq.take_batch(max_size=16, deadline_s=0.0,
+                                  wait_timeout_s=0.1)
+            if batch is None:
+                return
+            for fut, query in batch:
+                sink = getattr(fut, "trace", None)
+                if sink is not None:
+                    now = time.monotonic()
+                    sink.add_span("model_forward", now, now + 0.001)
+                fut.set_result(query)
+
+    threading.Thread(target=worker_loop, daemon=True).start()
+    engine = SimpleNamespace(broker=broker, _runners={},
+                             stop_all=lambda: None)
+    server = AgentServer(engine, allow_insecure=True).start()
+    addr = f"{server.host}:{server.port}"
+    reset_breaker(addr)
+    try:
+        out = call_agent(addr, "POST", "/predict_relay/ajob/w1",
+                         body={"queries": [[2.0]],
+                               "trace": {"id": "abc999", "s": 1}})
+        assert out["predictions"] == [[2.0]]
+        names = [s[0] for s in out["trace_spans"]]
+        assert "queue_wait" in names and "model_forward" in names
+        # no trace key -> legacy response shape (old relay peers)
+        out = call_agent(addr, "POST", "/predict_relay/ajob/w1",
+                         body={"queries": [[3.0]]})
+        assert out["predictions"] == [[3.0]]
+        assert "trace_spans" not in out
+    finally:
+        stop.set()
+        server.stop()

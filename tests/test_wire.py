@@ -406,7 +406,7 @@ def test_decodable_but_malformed_query_fields_are_typed():
         with pytest.raises(wire.WireFormatError):
             _decode_query_frame(json.dumps(msg).encode())
     # and a JSON-framed qarr (nested lists) is legal: rows stay rows
-    entries, _ = _decode_query_frame(json.dumps(
+    entries, _, _trace = _decode_query_frame(json.dumps(
         {"ids": ["a", "b"], "qarr": [[1.0], [2.0]]}).encode())
     assert [q for _, q, _ in entries] == [[1.0], [2.0]]
 
