@@ -98,7 +98,8 @@ class Admin:
         # door="admin": the /predict/<app> route's registry metrics
         # (admitted/shed counters + request-latency histogram) are
         # labeled apart from the per-job dedicated ports
-        self._predict_admission = AdmissionController(door="admin")
+        self._predict_admission = AdmissionController(
+            door="admin", shared_tenants=True)
         # RAFIKI_BROKER=shm selects the native cross-process data
         # plane (cache/shm_broker.py); default is in-process.
         # RAFIKI_PLACEMENT=process *requires* it (worker processes attach to
@@ -165,6 +166,15 @@ class Admin:
             # multi-host placement registers remote serving queues with the
             # FleetBroker when it places inference workers on agents
             self.placement.set_broker(self.broker)
+        # chip-budget arbitration between the serving and training planes
+        # (placement/hosts.py ChipBudgetArbiter): autoscaler scale-ups may
+        # borrow idle trial chips; a train executor that can't allocate
+        # reclaims them, with RAFIKI_AUTOSCALE_TRAIN_FLOOR chips that the
+        # serving plane may never borrow into
+        from rafiki_tpu.placement.hosts import ChipBudgetArbiter
+
+        self.chip_arbiter = ChipBudgetArbiter(
+            getattr(self.placement, "allocator", None))
         self.services = ServicesManager(
             self.db,
             self.placement,
@@ -172,7 +182,17 @@ class Admin:
             self.broker,
             send_event=self.handle_event,
             params_dir=params_dir,
+            arbiter=self.chip_arbiter,
         )
+        # the elastic serving control loop (admin/autoscaler.py). The
+        # instance always exists — /fleet/health carries its section and
+        # the operator scale API goes through the same machinery — but
+        # the loop thread only runs when RAFIKI_AUTOSCALE=1.
+        from rafiki_tpu.admin.autoscaler import Autoscaler
+
+        self.autoscaler = Autoscaler(self)
+        if config.AUTOSCALE:
+            self.autoscaler.start()
         self._seed_superadmin()
         # -- control-plane crash recovery (admin/recovery.py) -------------
         self._recovery: Dict[str, Any] = {"state": "ready"}
@@ -756,6 +776,42 @@ class Admin:
             ],
         }
 
+    def scale_inference_job(
+        self, user_id: str, app: str, app_version: int = -1,
+        delta: int = 1,
+    ) -> Dict:
+        """Operator-facing elastic scaling: add (``delta`` > 0) or
+        gracefully drain (``delta`` < 0) serving replicas of the app's
+        RUNNING inference job without a redeploy — the same primitive the
+        autoscaler drives (admin/services.py scale_inference_job)."""
+        if not delta:
+            raise InvalidRequestError("delta must be a non-zero integer")
+        # sanity bound: each added replica is a synchronous placement +
+        # deploy wait on this HTTP worker — an unbounded delta would tie
+        # the door up for hours mass-creating services
+        limit = max(int(config.AUTOSCALE_MAX_REPLICAS), 8)
+        if abs(int(delta)) > limit:
+            raise InvalidRequestError(
+                f"delta {delta} out of range (|delta| <= {limit}; raise "
+                "RAFIKI_AUTOSCALE_MAX_REPLICAS to scale further)")
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        inf = self.db.get_running_inference_job_of_train_job(job["id"])
+        if inf is None:
+            raise InvalidRequestError("No running inference job")
+        from rafiki_tpu.admin.services import ServiceDeploymentError
+
+        try:
+            report = self.services.scale_inference_job(inf["id"], int(delta))
+        except ServiceDeploymentError as e:
+            raise InvalidRequestError(str(e))
+        return {
+            "inference_job_id": inf["id"],
+            **report,
+            "replicas": len(self.services.live_inference_workers(inf["id"])),
+        }
+
     def stop_inference_job(
         self, user_id: str, app: str, app_version: int = -1
     ) -> Dict:
@@ -815,7 +871,7 @@ class Admin:
             cached = self._predict_route_cache.get(key)
         if cached is not None and now - cached[0] < config.PREDICT_ROUTE_TTL_S:
             try:
-                return self._admitted_predict(cached[1], queries)
+                return self._admitted_predict(cached[1], queries, tenant=app)
             except (QueueFullError, ServerOverloadedError,
                     DeadlineUnmeetableError):
                 # overload shed, not a dead route: re-resolving would only
@@ -852,9 +908,10 @@ class Admin:
             # resurrected by this thread's stale resolution
             if self._predict_route_epoch == epoch:
                 self._predict_route_cache[key] = (now, predictor)
-        return self._admitted_predict(predictor, queries)
+        return self._admitted_predict(predictor, queries, tenant=app)
 
-    def _admitted_predict(self, predictor, queries: List[Any]) -> List[Any]:
+    def _admitted_predict(self, predictor, queries: List[Any],
+                          tenant: Optional[str] = None) -> List[Any]:
         """The admin door's admission wrapper: bounded in-flight +
         estimated-wait shed before the predictor sees the request, and
         latency feedback after (predictor/admission.py)."""
@@ -867,14 +924,18 @@ class Admin:
                 f"per-worker queue cap is {cap} "
                 "(RAFIKI_PREDICT_QUEUE_DEPTH) — split the request")
         backlog_fn = getattr(predictor, "backlog_depth", None)
+        # tenant = the app: the admin door is SHARED across jobs, so this
+        # is where one hot job saturating its weighted fair share gets
+        # 429s while cold jobs keep their latency (RAFIKI_AUTOSCALE_FAIR)
         self._predict_admission.admit(
             config.PREDICT_TIMEOUT_S,
-            backlog_depth=backlog_fn() if callable(backlog_fn) else None)
+            backlog_depth=backlog_fn() if callable(backlog_fn) else None,
+            tenant=tenant, cost=len(queries))
         t0 = time.monotonic()
         try:
             preds = predictor.predict_batch(queries)
         finally:
-            self._predict_admission.release()
+            self._predict_admission.release(tenant=tenant)
         self._predict_admission.observe(time.monotonic() - t0, len(queries))
         return preds
 
@@ -945,9 +1006,16 @@ class Admin:
             # `recovering` while the off-thread pass runs — the HTTP
             # doors 503 until it reads `ready`
             "recovery": self.recovery_status(),
+            # closed-loop overload adaptation (admin/autoscaler.py):
+            # loop state, chip-loan picture, recent scale decisions with
+            # their reason + signal snapshot
+            "autoscaler": self.autoscaler.report(),
             "serving": {
                 "jobs": jobs,
                 "admission": self._predict_admission.stats(),
+                # per-tenant decayed admitted-query charges at this door
+                # (weighted fair admission, RAFIKI_AUTOSCALE_FAIR)
+                "fair_shares": self._predict_admission.fair_shares(),
                 "workers": workers,
             },
             "training": {
@@ -1041,6 +1109,14 @@ class Admin:
             self.db.mark_service_as_stopped(service_id)
         elif status == "ERRORED":
             self.db.mark_service_as_errored(service_id)
+        if status in ("STOPPED", "ERRORED"):
+            # a dying replica's chip loan comes home however it died —
+            # heartbeat-detected host death never reaches the
+            # ServicesManager teardown chokepoint (idempotent pop;
+            # getattr: status events can predate arbiter wiring at boot)
+            arbiter = getattr(self, "chip_arbiter", None)
+            if arbiter is not None:
+                arbiter.note_return(service_id)
         # a train worker stopping may complete its train job
         worker = self.db.get_train_job_worker(service_id)
         if worker is not None and status in ("STOPPED", "ERRORED"):
@@ -1059,6 +1135,10 @@ class Admin:
                     self._drop_predict_routes(iworker["inference_job_id"])
 
     def shutdown(self) -> None:
+        # the autoscaler must stop deciding before services are torn down
+        # — a tick racing the teardown would re-place replicas
+        if getattr(self, "autoscaler", None) is not None:
+            self.autoscaler.stop()
         # a reconcile racing a shutdown would resurrect services the stop
         # below is about to tear down: signal it to ABORT (it checks at
         # every loop top and inside retry backoffs), then join it out

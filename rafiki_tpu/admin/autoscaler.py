@@ -1,0 +1,334 @@
+"""Elastic serving autoscaler: the closed loop over the telemetry plane.
+
+PRs 1-6 gave the serving plane every signal a controller needs — per-door
+shed counters and EWMA-wait rings (predictor/admission.py), per-job shed
+rings and live backlog (predictor/predictor.py), per-service queue-depth
+rings (worker/inference.py) — but replica counts stayed frozen at
+``create_inference_services`` time. This module closes the loop:
+
+- an admin-side **control thread** (``RAFIKI_AUTOSCALE=1``) ticks every
+  ``RAFIKI_AUTOSCALE_INTERVAL_S`` seconds, samples each RUNNING inference
+  job's backlog into its own ring series (``backlog:job:<id>``), reads
+  the job's shed deltas, and decides;
+- **scale up** on sustained overload — shed events past
+  ``RAFIKI_AUTOSCALE_SHED_THRESHOLD`` inside the window, or mean backlog
+  past ``RAFIKI_AUTOSCALE_DEPTH_HIGH`` — bounded by
+  ``RAFIKI_AUTOSCALE_MAX_REPLICAS`` and ``RAFIKI_AUTOSCALE_STEP``;
+- **scale down** on sustained idle — zero shed and backlog never above
+  ``RAFIKI_AUTOSCALE_DEPTH_LOW`` across the whole window — bounded by
+  ``RAFIKI_AUTOSCALE_MIN_REPLICAS``, executed as a graceful drain
+  (admin/services.py ``drain_replicas``: retire from the fan-out, flush
+  the queue, then destroy — zero in-flight requests dropped);
+- **hysteresis + cooldowns** (`DEPTH_LOW` well under `DEPTH_HIGH`;
+  separate up/down cooldowns, down much longer) and the bounded step so
+  the loop can never flap or stampede;
+- **chip-budget arbitration**: a scale-up borrows idle trial chips
+  through the ChipBudgetArbiter (placement/hosts.py) when the training
+  floor allows; training reclaims the loan on demand.
+
+Every decision is a first-class event — reason + signal snapshot —
+kept in a bounded log surfaced via ``GET /fleet/health`` ("autoscaler"
+section) and counted in ``/metrics``
+(``rafiki_autoscale_{up,down}_total``, ``rafiki_autoscale_borrowed_chips``).
+
+Reference analogue: none. The reference's serving fleet was whatever
+``docker service create`` was told at deploy time, forever (reference
+services_manager.py:53-87) — SURVEY §2.10's "inference replica
+parallelism" was a constant, not a controller.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.constants import InferenceJobStatus
+
+logger = logging.getLogger(__name__)
+
+
+class Autoscaler:
+    """One per Admin. The loop thread only runs when ``RAFIKI_AUTOSCALE=1``
+    (or :meth:`start` is called explicitly); a stopped instance still
+    answers :meth:`report` so /fleet/health always has the section."""
+
+    def __init__(self, admin) -> None:
+        self._admin = admin
+        self._services = admin.services
+        self._db = admin.db
+        self._arbiter = getattr(admin, "chip_arbiter", None)
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # per-job controller state: signal history + cooldown bookkeeping
+        # {job_id: {"history": deque[(ts, shed_delta, backlog)],
+        #           "last_shed_total": int, "last_action_ts": float,
+        #           "last_action": str}}
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        #: bounded decision log, newest last (fleet-health "autoscaler")
+        self.events: Deque[Dict[str, Any]] = collections.deque(maxlen=100)
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._registry = REGISTRY
+        self._m_up = REGISTRY.counter(
+            "rafiki_autoscale_up_total",
+            "autoscaler scale-up actions", ("job",))
+        self._m_down = REGISTRY.counter(
+            "rafiki_autoscale_down_total",
+            "autoscaler scale-down actions", ("job",))
+        self._m_ticks = REGISTRY.counter(
+            "rafiki_autoscale_ticks_total",
+            "autoscaler control-loop ticks")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "Autoscaler":
+        if self.running:
+            return self
+        self._closed.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler", daemon=True)
+        self._thread.start()
+        logger.info("autoscaler loop started (interval %.1fs, window "
+                    "%.1fs)", float(config.AUTOSCALE_INTERVAL_S),
+                    float(config.AUTOSCALE_WINDOW_S))
+        return self
+
+    def stop(self) -> None:
+        self._closed.set()
+        t = self._thread
+        if t is not None:
+            # a tick may legitimately sit inside a graceful drain or a
+            # scale-up's deploy wait; cover both windows plus slack so a
+            # surviving tick can't race the teardown that follows stop()
+            t.join(timeout=float(config.AUTOSCALE_DRAIN_S)
+                   + float(config.SERVICE_DEPLOY_TIMEOUT_S) + 10)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._closed.wait(float(config.AUTOSCALE_INTERVAL_S)):
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("autoscaler tick failed")
+
+    # -- the control loop ---------------------------------------------------
+
+    def tick(self) -> List[Dict[str, Any]]:
+        """One decision pass over every live inference job. Public and
+        synchronous so tests (and an operator REPL) can drive the loop
+        deterministically. Returns the decisions taken this tick."""
+        self._m_ticks.inc()
+        now = time.monotonic()
+        actions: List[Dict[str, Any]] = []
+        predictors = self._services.predictors()
+        with self._lock:
+            # forget controller state for jobs that no longer serve
+            for job_id in list(self._jobs):
+                if job_id not in predictors:
+                    del self._jobs[job_id]
+        for job_id, predictor in predictors.items():
+            if self._closed.is_set():
+                break  # shutting down: no new decisions mid-teardown
+            try:
+                action = self._tick_job(job_id, predictor, now)
+            except Exception:
+                logger.exception("autoscaler decision for job %s failed",
+                                 job_id[:8])
+                continue
+            if action is not None:
+                actions.append(action)
+        return actions
+
+    def _job_state(self, job_id: str) -> Dict[str, Any]:
+        with self._lock:
+            st = self._jobs.get(job_id)
+            if st is None:
+                st = self._jobs[job_id] = {
+                    "history": collections.deque(maxlen=512),
+                    "last_shed_total": None,
+                    "last_action_ts": 0.0,
+                    "last_action": None,
+                }
+            return st
+
+    def _shed_total(self, job_id: str, predictor) -> int:
+        """The job's cumulative shed count across every shed site that
+        names it: predictor-level request/trial sheds plus — when the job
+        has a dedicated door — that door's admission sheds."""
+        ov = predictor.overload_stats()
+        total = int(ov.get("requests_shed", 0)) + int(
+            ov.get("trials_shed", 0))
+        psrv = self._services._predict_servers.get(job_id)
+        admission = getattr(psrv, "admission", None)
+        if admission is not None:
+            s = admission.stats()
+            total += int(s.get("shed_capacity", 0))
+            total += int(s.get("shed_deadline", 0))
+            total += int(s.get("shed_fairness", 0))
+        return total
+
+    def _tick_job(self, job_id: str, predictor,
+                  now: float) -> Optional[Dict[str, Any]]:
+        inf = self._db.get_inference_job(job_id)
+        if inf is None or inf["status"] != InferenceJobStatus.RUNNING:
+            return None
+        st = self._job_state(job_id)
+        # -- sample signals ------------------------------------------------
+        try:
+            backlog = int(predictor.backlog_depth())
+        except Exception:
+            backlog = 0
+        # observable twin of the internal history: a bounded ring series
+        # anyone can read off GET /metrics?format=json
+        self._registry.ring(f"backlog:job:{job_id}").record(backlog)
+        shed_total = self._shed_total(job_id, predictor)
+        last = st["last_shed_total"]
+        shed_delta = max(shed_total - last, 0) if last is not None else 0
+        st["last_shed_total"] = shed_total
+        st["history"].append((now, shed_delta, backlog))
+        # -- windowed view -------------------------------------------------
+        window_s = max(float(config.AUTOSCALE_WINDOW_S), 1.0)
+        window = [(t, s, b) for t, s, b in st["history"]
+                  if now - t <= window_s]
+        if not window:
+            return None
+        shed_in_window = sum(s for _, s, _ in window)
+        depths = [b for _, _, b in window]
+        mean_depth = sum(depths) / len(depths)
+        max_depth = max(depths)
+        span_s = now - window[0][0]
+        live = self._services.live_inference_workers(job_id)
+        n_live = len(live)
+        signals = {
+            "shed_in_window": shed_in_window,
+            "mean_backlog": round(mean_depth, 2),
+            "max_backlog": max_depth,
+            "window_span_s": round(span_s, 2),
+            "replicas": n_live,
+        }
+        # -- decide --------------------------------------------------------
+        step = max(int(config.AUTOSCALE_STEP), 1)
+        since_action = now - st["last_action_ts"]
+        overloaded = (
+            shed_in_window >= max(int(config.AUTOSCALE_SHED_THRESHOLD), 1)
+            or mean_depth >= float(config.AUTOSCALE_DEPTH_HIGH))
+        idle = (shed_in_window == 0
+                and max_depth <= float(config.AUTOSCALE_DEPTH_LOW))
+        if overloaded and n_live < int(config.AUTOSCALE_MAX_REPLICAS):
+            if since_action < float(config.AUTOSCALE_COOLDOWN_UP_S):
+                return None
+            step = min(step, int(config.AUTOSCALE_MAX_REPLICAS) - n_live)
+            reason = ("sustained shed" if shed_in_window
+                      >= int(config.AUTOSCALE_SHED_THRESHOLD)
+                      else "sustained backlog depth")
+            return self._act(job_id, st, "scale_up", step, reason,
+                             signals)
+        if idle and n_live > int(config.AUTOSCALE_MIN_REPLICAS):
+            # a scale-down needs the window to actually COVER idle time:
+            # a single fresh sample after a restart must not drain anyone
+            if span_s < window_s * 0.6:
+                return None
+            if since_action < float(config.AUTOSCALE_COOLDOWN_DOWN_S):
+                return None
+            step = min(step, n_live - int(config.AUTOSCALE_MIN_REPLICAS))
+            return self._act(job_id, st, "scale_down", step,
+                             "sustained idle", signals)
+        return None
+
+    def _act(self, job_id: str, st: Dict[str, Any], action: str,
+             step: int, reason: str,
+             signals: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        if self._closed.is_set():
+            return None  # never place or drain after stop() was signalled
+        delta = step if action == "scale_up" else -step
+        try:
+            report = self._services.scale_inference_job(
+                job_id, delta,
+                min_replicas=int(config.AUTOSCALE_MIN_REPLICAS))
+        except Exception as e:
+            logger.warning("autoscaler %s of job %s failed: %s",
+                           action, job_id[:8], e)
+            report = {"error": str(e)}
+        st["last_action_ts"] = time.monotonic()
+        st["last_action"] = action
+        # the headline counters mean "scaling happened" — a failed
+        # attempt is visible as the event's result.error, not a count
+        acted = bool(report.get("added") or report.get("removed"))
+        if acted:
+            if action == "scale_up":
+                self._m_up.labels(job_id).inc()
+            else:
+                self._m_down.labels(job_id).inc()
+            # a fresh capacity level deserves a fresh observation window:
+            # the burst that justified THIS action must not be re-counted
+            # into the next decision (cooldown < window, so without the
+            # reset one resolved burst keeps scaling until MAX_REPLICAS)
+            st["history"].clear()
+        event = {
+            "ts": time.time(),
+            "job_id": job_id,
+            "action": action,
+            "delta": delta,
+            "reason": reason,
+            "signals": signals,
+            "result": report,
+        }
+        # appended under the lock: report() (the /fleet/health thread)
+        # snapshots the deque concurrently, and iterating a deque while
+        # another thread appends raises RuntimeError
+        with self._lock:
+            self.events.append(event)
+        logger.warning("autoscaler %s job %s by %d (%s; signals=%s)",
+                       action, job_id[:8], abs(delta), reason, signals)
+        return event
+
+    # -- observability ------------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        """The fleet-health "autoscaler" section: loop state, config
+        snapshot, chip-loan picture, recent decisions."""
+        with self._lock:
+            jobs = {
+                job_id: {
+                    "last_action": st["last_action"],
+                    "samples": len(st["history"]),
+                }
+                for job_id, st in self._jobs.items()
+            }
+            recent_events = list(self.events)[-20:]
+        arbiter = {}
+        if self._arbiter is not None:
+            total, free = self._arbiter.capacity()
+            arbiter = {
+                "borrowed_chips": self._arbiter.borrowed_chips(),
+                "borrowed_by_service": {
+                    sid[:8]: n
+                    for sid, (_, n) in self._arbiter.borrowed().items()},
+                "train_floor_chips": self._arbiter.floor(),
+                "total_chips": total,
+                "free_chips": free,
+            }
+        return {
+            "enabled": bool(config.AUTOSCALE),
+            "running": self.running,
+            "fair_admission": bool(config.AUTOSCALE_FAIR),
+            "interval_s": float(config.AUTOSCALE_INTERVAL_S),
+            "window_s": float(config.AUTOSCALE_WINDOW_S),
+            "bounds": {
+                "min_replicas": int(config.AUTOSCALE_MIN_REPLICAS),
+                "max_replicas": int(config.AUTOSCALE_MAX_REPLICAS),
+                "step": int(config.AUTOSCALE_STEP),
+            },
+            "jobs": jobs,
+            "chip_budget": arbiter,
+            "events": recent_events,
+        }

@@ -236,6 +236,12 @@ class AdminServer:
             r("POST", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/stop",
                 _APP_DEVS, lambda au, m, b, q: A.stop_inference_job(
                     au["user_id"], m["app"], int(m["v"]))),
+            # elastic serving: add / gracefully drain replicas at runtime
+            # (admin/autoscaler.py drives the same primitive)
+            r("POST", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/scale",
+                _APP_DEVS, lambda au, m, b, q: A.scale_inference_job(
+                    au["user_id"], m["app"], int(m["v"]),
+                    delta=_num_field(b, "delta", int))),
             # serving (the reference exposed this on a separate predictor app,
             # reference predictor/app.py:23-31)
             r("POST", r"/predict/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:

@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import config
 from rafiki_tpu.advisor.advisor import AdvisorStore
@@ -33,7 +33,10 @@ from rafiki_tpu.constants import (
     TrainJobStatus,
 )
 from rafiki_tpu.db.database import Database
-from rafiki_tpu.placement.manager import PlacementManager
+from rafiki_tpu.placement.manager import (
+    InsufficientChipsError,
+    PlacementManager,
+)
 from rafiki_tpu.predictor.predictor import Predictor
 from rafiki_tpu.worker.inference import InferenceWorker
 from rafiki_tpu.worker.train import TrainWorker
@@ -54,7 +57,14 @@ class ServicesManager:
         broker: Broker,
         send_event,
         params_dir: Optional[str] = None,
+        arbiter=None,
     ):
+        """``arbiter`` (placement/hosts.py ChipBudgetArbiter) mediates
+        chip loans between the serving and training planes: autoscaler
+        scale-ups may borrow idle trial chips through it, and a train
+        executor that can't allocate reclaims them (the arbiter's reclaim
+        callback is installed here — reclaim works whether or not the
+        autoscaler loop itself is running)."""
         self._db = db
         self._placement = placement
         self._advisors = advisor_store
@@ -65,6 +75,14 @@ class ServicesManager:
         # inference_job_id -> PredictorServer (config.PREDICTOR_PORTS)
         self._predict_servers: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._arbiter = arbiter
+        if arbiter is not None:
+            arbiter.set_reclaim_callback(self.reclaim_borrowed)
+        # service_ids mid-graceful-drain (elastic scale-down): a second
+        # scale-down landing during a drain must pick OTHER victims (or
+        # no-op) — never double-drain, never double-count
+        self._scale_lock = threading.Lock()
+        self._scale_draining: set = set()
 
     # -- train -------------------------------------------------------------
 
@@ -155,14 +173,31 @@ class ServicesManager:
             send_event=self._send_event,
             params_dir=self._params_dir,
         )
-        try:
-            ctx = self._placement.create_service(
+        def place():
+            return self._placement.create_service(
                 service["id"], ServiceType.TRAIN, worker.start,
                 n_chips=n_chips,
                 # declarative payload so process/remote placements can
                 # launch the worker without the closure
                 extra={"sub_train_job_id": sub_train_job_id},
             )
+
+        try:
+            try:
+                ctx = place()
+            except InsufficientChipsError:
+                # chip-budget arbitration: the chips this trial wants may
+                # be ON LOAN to the serving plane (autoscaler borrow).
+                # Training has priority over borrowed capacity — reclaim
+                # (graceful scale-down of borrowed replicas) and retry
+                # once before giving up.
+                if (self._arbiter is None
+                        or self._arbiter.reclaim_for_training(n_chips) <= 0):
+                    raise
+                logger.info(
+                    "retrying train worker %s after reclaiming borrowed "
+                    "serving chips", service["id"][:8])
+                ctx = place()
         except Exception:
             # the DB rows exist but placement never started the service
             # (e.g. chips busy) — close the row so the rollback in
@@ -534,6 +569,355 @@ class ServicesManager:
             self._destroy_service(w["service_id"], wait=False)
         self._teardown_serving(inference_job_id, errored=False)
 
+    # -- elastic serving (admin/autoscaler.py; docs/failure-model.md
+    # "Overload adaptation") ------------------------------------------------
+
+    def live_inference_workers(self, inference_job_id: str) -> List[Dict]:
+        """The job's live serving replicas: worker rows whose service is
+        non-terminal, annotated with the predictor's replica-group key
+        (trial id, or the fused group). Drain-in-progress replicas are
+        excluded — they no longer take traffic."""
+        inf = self._db.get_inference_job(inference_job_id)
+        fused = bool(((inf or {}).get("budget") or {}).get(
+            BudgetType.ENSEMBLE_FUSED, 0))
+        group_of = (lambda t: f"fused:{inference_job_id}") if fused \
+            else (lambda t: t)
+        with self._scale_lock:
+            draining = set(self._scale_draining)
+        # one status-filtered query (idx_service_status), not a
+        # get_service round trip per worker row — this runs every
+        # autoscaler tick for every job
+        alive = {
+            s["id"]: s
+            for s in self._db.get_services(statuses=[
+                ServiceStatus.STARTED, ServiceStatus.DEPLOYING,
+                ServiceStatus.RUNNING])}
+        out: List[Dict] = []
+        for w in self._db.get_workers_of_inference_job(inference_job_id):
+            if w["service_id"] in draining:
+                continue
+            svc = alive.get(w["service_id"])
+            if svc is not None:
+                out.append({"service_id": w["service_id"],
+                            "trial_id": w["trial_id"],
+                            "group": group_of(w["trial_id"]),
+                            "chips": svc.get("chips") or []})
+        return out
+
+    def scale_inference_job(self, inference_job_id: str, delta: int,
+                            borrow: bool = True,
+                            drain_timeout_s: Optional[float] = None,
+                            min_replicas: int = 1) -> Dict[str, Any]:
+        """Add (``delta`` > 0) or gracefully drain (``delta`` < 0) serving
+        replicas of a RUNNING inference job WITHOUT a redeploy — the live
+        elasticity primitive under the autoscaler and the operator scale
+        API. Returns {added, removed, borrowed_chips, returned_chips}.
+
+        Scale-up places each new replica best-effort: with an exclusive
+        chip grant when ``borrow`` is allowed by the chip arbiter (the
+        loan is recorded for training to reclaim), on shared devices
+        otherwise. Scale-down picks borrowed replicas first, never drops
+        a trial's last replica while other trials keep several, and never
+        goes below ``min_replicas`` live replicas job-wide."""
+        inf = self._db.get_inference_job(inference_job_id)
+        if inf is None or inf["status"] != InferenceJobStatus.RUNNING:
+            raise ServiceDeploymentError(
+                f"inference job {inference_job_id} is not RUNNING")
+        predictor = self.get_predictor(inference_job_id)
+        if predictor is None:
+            raise ServiceDeploymentError(
+                f"inference job {inference_job_id} has no live predictor")
+        report: Dict[str, Any] = {"added": [], "removed": [],
+                                  "borrowed_chips": 0, "returned_chips": 0}
+        if delta > 0:
+            for _ in range(delta):
+                # per-replica isolation mirroring the drain path: a later
+                # failure must not erase the record of replicas (and chip
+                # loans) that DID land
+                try:
+                    sid, borrowed = self._scale_up_one(
+                        inference_job_id, inf, predictor, borrow)
+                except Exception as e:
+                    if not report["added"]:
+                        raise
+                    logger.exception(
+                        "scale-up of job %s stopped after %d replica(s)",
+                        inference_job_id[:8], len(report["added"]))
+                    report["error"] = str(e)
+                    break
+                report["added"].append(sid)
+                report["borrowed_chips"] += borrowed
+        elif delta < 0:
+            victims = self._pick_scale_down_victims(
+                inference_job_id, -delta, min_replicas)
+            freed, removed = self.drain_replicas(
+                inference_job_id, victims, drain_timeout_s=drain_timeout_s)
+            report["removed"] = removed
+            report["returned_chips"] = freed
+        return report
+
+    def _scale_up_one(self, inference_job_id: str, inf: Dict,
+                      predictor, borrow: bool):
+        """Place ONE extra serving replica for the trial group that
+        currently has the fewest live replicas. Returns (service_id,
+        borrowed_chip_count)."""
+        train_job = self._db.get_train_job(inf["train_job_id"])
+        assert train_job is not None
+        budget = inf.get("budget") or {}
+        fused = bool(budget.get(BudgetType.ENSEMBLE_FUSED, 0))
+        chips_per_worker = max(
+            int(budget.get(BudgetType.CHIPS_PER_WORKER, 1)), 1)
+        alloc = getattr(self._placement, "allocator", None)
+        if alloc is not None:
+            max_per_service = getattr(
+                alloc, "max_chips_per_service", alloc.total_chips)
+            if chips_per_worker > max_per_service > 0:
+                chips_per_worker = max_per_service
+        live = self.live_inference_workers(inference_job_id)
+        if fused:
+            best = self._db.get_best_trials_of_train_job(
+                train_job["id"], max_count=config.INFERENCE_MAX_BEST_TRIALS)
+            unit = {"trial_id": best[0]["id"] if best
+                    else (live[0]["trial_id"] if live else None),
+                    "group": f"fused:{inference_job_id}",
+                    "trial_ids": [t["id"] for t in best] or None}
+        else:
+            by_group: Dict[str, int] = {}
+            for w in live:
+                by_group[w["group"]] = by_group.get(w["group"], 0) + 1
+            if not by_group:
+                raise ServiceDeploymentError(
+                    f"inference job {inference_job_id} has no live "
+                    "replicas to model the new one on")
+            group = min(sorted(by_group), key=lambda g: by_group[g])
+            unit = {"trial_id": group, "group": group, "trial_ids": None}
+        if unit["trial_id"] is None:
+            raise ServiceDeploymentError(
+                f"no trial to serve for job {inference_job_id}")
+        # chip loan: exclusive grant only when the arbiter allows it (the
+        # training floor stays intact); otherwise shared devices.
+        # begin_borrow is an atomic check-AND-reserve so two concurrent
+        # scale-ups can't both pass the floor check before either takes
+        # its chips from the allocator
+        want_chips = 0
+        reservation = None
+        if borrow and self._arbiter is not None:
+            reservation = self._arbiter.begin_borrow(chips_per_worker)
+            if reservation is not None:
+                want_chips = chips_per_worker
+        try:
+            service = self._db.create_service(ServiceType.INFERENCE)
+            self._db.create_inference_job_worker(
+                service["id"], inference_job_id, unit["trial_id"])
+            worker = InferenceWorker(
+                inference_job_id, unit["trial_id"], self._db, self._broker,
+                trial_ids=unit["trial_ids"],
+            )
+            try:
+                ctx = self._placement.create_service(
+                    service["id"], ServiceType.INFERENCE, worker.start,
+                    n_chips=want_chips, best_effort_chips=True,
+                    extra={"inference_job_id": inference_job_id,
+                           "trial_id": unit["trial_id"],
+                           **({"trial_ids": unit["trial_ids"]}
+                              if unit["trial_ids"] else {})},
+                )
+            except Exception:
+                self._db.mark_service_as_stopped(service["id"])
+                raise
+            try:
+                self._db.update_service_chips(service["id"], ctx.chips)
+                self._wait_until_services_running([service["id"]])
+            except Exception:
+                self._destroy_service(service["id"], wait=False)
+                raise
+        except Exception:
+            if reservation is not None:
+                self._arbiter.cancel_borrow(reservation)
+            raise
+        borrowed = 0
+        if reservation is not None:
+            if want_chips and ctx.chips:
+                self._arbiter.commit_borrow(
+                    reservation, service["id"], inference_job_id, ctx.chips)
+                borrowed = len(ctx.chips)
+            else:
+                self._arbiter.cancel_borrow(reservation)
+        # replica JOIN: route new requests to it (its queue is already
+        # registered with the broker by the worker's startup)
+        predictor.add_worker(service["id"], unit["group"])
+        logger.info("scaled UP job %s: replica %s for group %s "
+                    "(chips=%s)", inference_job_id[:8], service["id"][:8],
+                    unit["group"][:16], ctx.chips)
+        return service["id"], borrowed
+
+    def _pick_scale_down_victims(self, inference_job_id: str, n: int,
+                                 min_replicas: int) -> List[str]:
+        """Choose up to ``n`` replicas to drain: borrowed-chip replicas
+        first (scale-down returns the loan), then the youngest rows; a
+        trial's LAST replica is only eligible when every other trial is
+        down to one as well (the ensemble must not silently lose a trial
+        while siblings hold spares), and the job never drops below
+        ``min_replicas`` live replicas."""
+        live = self.live_inference_workers(inference_job_id)
+        headroom = len(live) - max(min_replicas, 1)
+        if headroom <= 0:
+            return []
+        n = min(n, headroom)
+        by_group: Dict[str, int] = {}
+        for w in live:
+            by_group[w["group"]] = by_group.get(w["group"], 0) + 1
+        borrowed = set()
+        if self._arbiter is not None:
+            borrowed = set(self._arbiter.borrowed())
+        # youngest-last rows come back last from the store scan; prefer
+        # draining the replicas added most recently
+        ordered = sorted(
+            reversed(live),
+            key=lambda w: 0 if w["service_id"] in borrowed else 1)
+        victims: List[str] = []
+        for w in ordered:
+            if len(victims) >= n:
+                break
+            spare_groups = any(
+                c > 1 for g, c in by_group.items() if g != w["group"])
+            if by_group[w["group"]] <= 1 and spare_groups:
+                continue
+            victims.append(w["service_id"])
+            by_group[w["group"]] -= 1
+        return victims
+
+    def drain_replicas(
+            self, inference_job_id: str, service_ids: List[str],
+            drain_timeout_s: Optional[float] = None,
+    ) -> "tuple[int, List[str]]":
+        """Gracefully remove serving replicas: stop admitting (the
+        predictor retires the replica from its fan-out), flush the worker
+        queue (bounded by ``RAFIKI_AUTOSCALE_DRAIN_S``), then destroy —
+        zero in-flight requests dropped on the happy path, and any
+        straggler that races the final close is re-routed by the
+        predictor's failover machinery. Idempotent: replicas already
+        draining (a second concurrent scale-down) are skipped. Returns
+        ``(borrowed chips returned to the pool, service_ids actually
+        removed)`` — a victim whose drain failed is restored to the
+        fan-out and does NOT count as removed."""
+        if drain_timeout_s is None:
+            drain_timeout_s = float(config.AUTOSCALE_DRAIN_S)
+        with self._scale_lock:
+            mine = [s for s in service_ids if s not in self._scale_draining]
+            self._scale_draining.update(mine)
+        predictor = self.get_predictor(inference_job_id)
+        freed = 0
+        removed: List[str] = []
+        try:
+            for sid in mine:
+                if predictor is not None:
+                    predictor.retire_worker(sid)
+            for sid in mine:
+                # per-victim isolation: one failed drain must not abandon
+                # the OTHER victims retired-but-undestroyed (dead capacity
+                # still counted live, loans never returned)
+                loan = 0
+                if self._arbiter is not None:
+                    # read the loan size up front: _destroy_service (the
+                    # teardown chokepoint inside _drain_one) performs the
+                    # actual note_return
+                    loan = self._arbiter.borrowed().get(sid, ("", 0))[1]
+                try:
+                    self._drain_one(inference_job_id, sid, predictor,
+                                    drain_timeout_s)
+                except Exception:
+                    logger.exception(
+                        "drain of replica %s failed; restoring it to the "
+                        "fan-out", sid[:8])
+                    if predictor is not None:
+                        predictor.unretire_worker(sid)
+                    continue
+                removed.append(sid)
+                freed += loan
+        finally:
+            with self._scale_lock:
+                self._scale_draining.difference_update(mine)
+        return freed, removed
+
+    def _drain_one(self, inference_job_id: str, sid: str, predictor,
+                   drain_timeout_s: float) -> None:
+        queue = self._broker.get_worker_queues(inference_job_id).get(sid)
+        depth_fn = getattr(queue, "depth", None)
+        deadline = time.monotonic() + max(drain_timeout_s, 0.0)
+        zero_reads = 0
+        while callable(depth_fn) and time.monotonic() < deadline:
+            try:
+                depth = depth_fn()
+            except Exception:
+                break
+            if depth <= 0:
+                # consecutive-zero confirmation: a request that snapshotted
+                # its routes before the retire may still land one submit —
+                # give those stragglers a beat to either arrive or finish
+                zero_reads += 1
+                if zero_reads >= 3:
+                    break
+            else:
+                zero_reads = 0
+            time.sleep(0.03)
+        else:
+            if callable(depth_fn):
+                try:
+                    leftover = depth_fn()
+                except Exception:
+                    leftover = -1
+                if leftover:
+                    logger.warning(
+                        "replica %s still has %d queued queries after the "
+                        "%.1fs drain window; destroying anyway (stragglers "
+                        "fail over to siblings)", sid[:8], leftover,
+                        drain_timeout_s)
+        # wait=True: the worker finishes its in-flight batch before the
+        # queue closes, so everything taken is answered
+        self._destroy_service(sid, wait=True)
+        if predictor is not None:
+            predictor.drop_worker(sid)
+        logger.info("scaled DOWN job %s: replica %s drained and destroyed",
+                    inference_job_id[:8], sid[:8])
+
+    def reclaim_borrowed(self, n_chips: int) -> int:
+        """Chip-arbiter reclaim callback: drain borrowed serving replicas
+        until ``n_chips`` came home or the loan book is empty. Training
+        demand outranks borrowed serving capacity by contract — but a
+        reclaim is still a scale-down, so it honors the same guards as
+        any other: never below the job's replica floor, never a trial's
+        last replica while siblings hold spares (a borrowed replica may
+        have BECOME load-bearing if its siblings died since the loan)."""
+        if self._arbiter is None:
+            return 0
+        loans = self._arbiter.borrowed()
+        by_job: Dict[str, List[str]] = {}
+        for sid, (job_id, _) in loans.items():
+            by_job.setdefault(job_id, []).append(sid)
+        min_r = max(int(config.AUTOSCALE_MIN_REPLICAS), 1)
+        freed = 0
+        for job_id, sids in by_job.items():
+            if freed >= n_chips:
+                break
+            try:
+                eligible = [
+                    s for s in self._pick_scale_down_victims(
+                        job_id, len(sids), min_r)
+                    if s in loans]
+            except Exception:
+                logger.exception("reclaim victim pick for job %s failed",
+                                 job_id[:8])
+                continue
+            for sid in eligible:
+                if freed >= n_chips:
+                    break
+                try:
+                    freed += self.drain_replicas(job_id, [sid])[0]
+                except Exception:
+                    logger.exception("reclaim drain of %s failed", sid[:8])
+        return freed
+
     # -- shared --------------------------------------------------------------
 
     def _destroy_service(self, service_id: str, wait: bool = True) -> None:
@@ -542,6 +926,11 @@ class ServicesManager:
         except Exception:
             logger.exception("destroying service %s failed", service_id)
         self._db.mark_service_as_stopped(service_id)
+        # every teardown path funnels here: a destroyed replica's chip
+        # loan comes home no matter WHY it died (job stop, deploy
+        # rollback, drain) — note_return is an idempotent pop
+        if self._arbiter is not None:
+            self._arbiter.note_return(service_id)
 
     def _wait_until_services_running(self, service_ids: List[str]) -> None:
         """Poll the store until all services are RUNNING (reference :279-290)."""

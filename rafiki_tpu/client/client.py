@@ -249,6 +249,19 @@ class Client:
     def stop_inference_job(self, app: str, app_version: int = -1) -> Dict:
         return self._call("POST", f"/inference_jobs/{app}/{app_version}/stop")
 
+    def scale_inference_job(self, app: str, delta: int,
+                            app_version: int = -1) -> Dict:
+        """Elastically add (``delta`` > 0) or gracefully drain
+        (``delta`` < 0) serving replicas of the app's running inference
+        job — no redeploy, in-flight requests complete or re-route. The
+        answer carries the replicas added/removed, chips borrowed from /
+        returned to the training plane, and the new live replica count.
+        (The RAFIKI_AUTOSCALE control loop drives this same primitive
+        automatically; see GET /fleet/health's "autoscaler" section.)"""
+        return self._call(
+            "POST", f"/inference_jobs/{app}/{app_version}/scale",
+            {"delta": int(delta)})
+
     def predict(
         self, app: str, queries: List[Any], app_version: int = -1
     ) -> List[Any]:

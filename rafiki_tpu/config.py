@@ -176,6 +176,76 @@ _DYNAMIC_PATHS = {
     "TRIAL_FAULT_LIMIT": lambda: _env_int("RAFIKI_TRIAL_FAULT_LIMIT", 5),
     "PENDING_FEEDBACK_MAX": lambda: _env_int(
         "RAFIKI_PENDING_FEEDBACK_MAX", 256),
+    # -- elastic serving autoscaler (docs/failure-model.md, "Overload
+    # adaptation"). All knobs resolve lazily so tests and operators can
+    # retune a live control loop; the loop itself is OFF by default —
+    # existing deployments keep their static replica counts:
+    #   RAFIKI_AUTOSCALE=1              start the admin-side control loop
+    #   RAFIKI_AUTOSCALE_INTERVAL_S=2   decision-loop tick interval
+    #   RAFIKI_AUTOSCALE_WINDOW_S=15    signal window a decision looks at
+    #   RAFIKI_AUTOSCALE_SHED_THRESHOLD=3   shed events inside the window
+    #                                   that read "sustained overload"
+    #   RAFIKI_AUTOSCALE_DEPTH_HIGH=8   mean backlog depth that scales up
+    #   RAFIKI_AUTOSCALE_DEPTH_LOW=1    max backlog depth that still
+    #                                   counts as idle (hysteresis: LOW
+    #                                   must sit well under HIGH)
+    #   RAFIKI_AUTOSCALE_MIN_REPLICAS=1 never drain below this many live
+    #                                   replicas per job
+    #   RAFIKI_AUTOSCALE_MAX_REPLICAS=8 never grow past this many
+    #   RAFIKI_AUTOSCALE_STEP=1         replicas per decision (bounded
+    #                                   step — the loop cannot stampede)
+    #   RAFIKI_AUTOSCALE_COOLDOWN_UP_S=5    quiet time after ANY action
+    #                                   before the next scale-up
+    #   RAFIKI_AUTOSCALE_COOLDOWN_DOWN_S=30 ... before the next
+    #                                   scale-down (longer: flapping down
+    #                                   is worse than holding spare
+    #                                   capacity a little while)
+    #   RAFIKI_AUTOSCALE_DRAIN_S=10     bounded graceful-drain window per
+    #                                   removed replica (stop admitting,
+    #                                   flush its queue, then destroy)
+    #   RAFIKI_AUTOSCALE_TRAIN_FLOOR=1  chips the serving plane may never
+    #                                   borrow into: at least this many
+    #                                   chips stay free (or training's)
+    #                                   whatever the surge
+    #   RAFIKI_AUTOSCALE_FAIR=1         per-job weighted fair admission at
+    #                                   shared doors (off by default)
+    #   RAFIKI_AUTOSCALE_FAIR_WINDOW_S=10   half-life of the per-tenant
+    #                                   admitted-query charge decay
+    #   RAFIKI_AUTOSCALE_FAIR_BURST=32  admitted queries a tenant may run
+    #                                   past its fair share before 429s
+    #   RAFIKI_AUTOSCALE_FAIR_WEIGHTS=  "appA=3,appB=1" (unlisted
+    #                                   tenants weigh 1)
+    "AUTOSCALE": lambda: os.environ.get("RAFIKI_AUTOSCALE", "0") == "1",
+    "AUTOSCALE_INTERVAL_S": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_INTERVAL_S", 2.0),
+    "AUTOSCALE_WINDOW_S": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_WINDOW_S", 15.0),
+    "AUTOSCALE_SHED_THRESHOLD": lambda: _env_int(
+        "RAFIKI_AUTOSCALE_SHED_THRESHOLD", 3),
+    "AUTOSCALE_DEPTH_HIGH": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_DEPTH_HIGH", 8.0),
+    "AUTOSCALE_DEPTH_LOW": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_DEPTH_LOW", 1.0),
+    "AUTOSCALE_MIN_REPLICAS": lambda: _env_int(
+        "RAFIKI_AUTOSCALE_MIN_REPLICAS", 1),
+    "AUTOSCALE_MAX_REPLICAS": lambda: _env_int(
+        "RAFIKI_AUTOSCALE_MAX_REPLICAS", 8),
+    "AUTOSCALE_STEP": lambda: _env_int("RAFIKI_AUTOSCALE_STEP", 1),
+    "AUTOSCALE_COOLDOWN_UP_S": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_COOLDOWN_UP_S", 5.0),
+    "AUTOSCALE_COOLDOWN_DOWN_S": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_COOLDOWN_DOWN_S", 30.0),
+    "AUTOSCALE_DRAIN_S": lambda: _env_float("RAFIKI_AUTOSCALE_DRAIN_S", 10.0),
+    "AUTOSCALE_TRAIN_FLOOR": lambda: _env_int(
+        "RAFIKI_AUTOSCALE_TRAIN_FLOOR", 1),
+    "AUTOSCALE_FAIR": lambda: os.environ.get(
+        "RAFIKI_AUTOSCALE_FAIR", "0") == "1",
+    "AUTOSCALE_FAIR_WINDOW_S": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_FAIR_WINDOW_S", 10.0),
+    "AUTOSCALE_FAIR_BURST": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_FAIR_BURST", 32.0),
+    "AUTOSCALE_FAIR_WEIGHTS": lambda: os.environ.get(
+        "RAFIKI_AUTOSCALE_FAIR_WEIGHTS", ""),
     "RECOVER_ADOPT": lambda: os.environ.get(
         "RAFIKI_RECOVER_ADOPT", "1") != "0",
     "RECOVER_PROBE_TIMEOUT_S": lambda: _env_float(

@@ -345,6 +345,102 @@ def check_trial_faults() -> Check:
     return ("trial faults", PASS, detail)
 
 
+def check_autoscaler(total_chips: int = None) -> Check:
+    """Elastic serving autoscaler (docs/failure-model.md "Overload
+    adaptation"): WARN when the serving plane is visibly shedding while
+    the control loop that could fix it is disabled, when the replica
+    bounds are inverted (the loop would be wedged between them), and when
+    the chip-borrow training floor exceeds the fleet's capacity (no
+    borrow could ever be granted — probably a typo'd knob).
+
+    ``total_chips`` injects the fleet capacity when the caller knows it;
+    otherwise it is summed from RAFIKI_AGENTS inventories when set."""
+    from rafiki_tpu import config
+    from rafiki_tpu.utils.metrics import REGISTRY, ring_window_s
+
+    notes = []
+    warn = False
+    enabled = bool(config.AUTOSCALE)
+    min_r = int(config.AUTOSCALE_MIN_REPLICAS)
+    max_r = int(config.AUTOSCALE_MAX_REPLICAS)
+    if min_r > max_r:
+        warn = True
+        notes.append(
+            f"replica bounds INVERTED: RAFIKI_AUTOSCALE_MIN_REPLICAS="
+            f"{min_r} > RAFIKI_AUTOSCALE_MAX_REPLICAS={max_r} — the loop "
+            "can neither grow nor shrink any job")
+    low, high = float(config.AUTOSCALE_DEPTH_LOW), float(
+        config.AUTOSCALE_DEPTH_HIGH)
+    if low >= high:
+        warn = True
+        notes.append(
+            f"no hysteresis: RAFIKI_AUTOSCALE_DEPTH_LOW={low:g} >= "
+            f"DEPTH_HIGH={high:g} — the loop will flap between up and "
+            "down on the same signal")
+    # sustained shed with the loop off: scan the shed-rate ring series
+    # (in-process registry — embedded use — plus the admin door's JSON
+    # snapshot when an admin is reachable)
+    ring_snapshot = {
+        name: series
+        for name, series in REGISTRY.snapshot()["rings"].items()
+        if name.startswith("shed_rate:")}
+    try:
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{config.ADMIN_HOST}:{config.ADMIN_PORT}"
+                "/metrics?format=json", timeout=2) as resp:
+            remote = _json.load(resp).get("rings", {})
+        for name, series in remote.items():
+            if name.startswith("shed_rate:"):
+                ring_snapshot.setdefault(name, series)
+    except Exception:
+        pass  # no admin on this host — in-process rings only
+    shed_doors = sorted(
+        name.split(":", 1)[1]
+        for name, series in ring_snapshot.items()
+        if sum(v for _, v in series) > 0)
+    if shed_doors and not enabled:
+        warn = True
+        notes.append(
+            f"sustained shed observed at {shed_doors} within the last "
+            f"{ring_window_s()}s but RAFIKI_AUTOSCALE is OFF — the fleet "
+            "is turning traffic away that a scale-up could absorb")
+    # chip-borrow floor vs fleet capacity
+    floor = int(config.AUTOSCALE_TRAIN_FLOOR)
+    if total_chips is None:
+        agents = [a.strip() for a in os.environ.get(
+            "RAFIKI_AGENTS", "").split(",") if a.strip()]
+        if agents:
+            from rafiki_tpu.utils.agent_http import call_agent
+
+            total_chips = 0
+            for addr in agents:
+                try:
+                    inv = call_agent(
+                        addr, "GET", "/inventory",
+                        key=os.environ.get("RAFIKI_AGENT_KEY"),
+                        timeout_s=5, use_breaker=False)
+                    total_chips += int(inv.get("total_chips", 0))
+                except Exception:
+                    total_chips = None
+                    break
+    if total_chips is not None and floor > total_chips > 0:
+        warn = True
+        notes.append(
+            f"RAFIKI_AUTOSCALE_TRAIN_FLOOR={floor} exceeds the fleet's "
+            f"{total_chips} chip(s): no serving borrow can ever be "
+            "granted — probably a typo")
+    state = "loop ON" if enabled else "loop off"
+    fair = "fair admission ON" if config.AUTOSCALE_FAIR else \
+        "fair admission off"
+    detail = (f"{state}, {fair}, replicas [{min_r}, {max_r}] step "
+              f"{int(config.AUTOSCALE_STEP)}, train floor {floor} chip(s)"
+              + ("; " + "; ".join(notes) if notes else ""))
+    return ("autoscaler", WARN if warn else PASS, detail)
+
+
 def check_observability() -> Check:
     """Telemetry plane (docs/observability.md): the registry must render
     parseable exposition, RAFIKI_TRACE_SAMPLE must be a sane rate, and
@@ -489,7 +585,7 @@ def check_agents() -> Check:
 
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
-    check_chaos, check_overload_knobs, check_recovery,
+    check_chaos, check_overload_knobs, check_autoscaler, check_recovery,
     check_trial_faults, check_observability, check_agents, check_backend,
 ]
 

@@ -128,12 +128,178 @@ class _FleetInventory:
         )
 
     @property
+    def free_chips(self) -> int:
+        """Fleet-wide free chips — the chip-budget arbiter's borrow
+        headroom signal (same shape ChipAllocator exposes locally)."""
+        return sum(
+            inv.get("free_chips", 0)
+            for _, inv in self._manager._inventories()
+        )
+
+    @property
     def max_chips_per_service(self) -> int:
         return max(
             (inv.get("total_chips", 0)
              for _, inv in self._manager._inventories()),
             default=0,
         )
+
+
+class ChipBudgetArbiter:
+    """Arbitrates the chip budget between the serving and training planes
+    (docs/failure-model.md "Overload adaptation").
+
+    A serving surge may BORROW chips that trials aren't using: the
+    autoscaler places extra replicas with exclusive grants as long as at
+    least ``RAFIKI_AUTOSCALE_TRAIN_FLOOR`` chips remain un-borrowed —
+    the hard floor that guarantees training can never be starved out
+    entirely. When the training plane wants chips back (the next trial's
+    executor can't allocate), :meth:`reclaim_for_training` drains borrowed
+    serving replicas — training has priority over borrowed capacity, so
+    every borrow is a loan, never a transfer.
+
+    Works against any allocator exposing ``total_chips``/``free_chips``
+    (the local :class:`ChipAllocator` or this module's fleet inventory),
+    so single-host and hosts-mode deployments arbitrate identically.
+
+    The loan book is in-memory: across an admin restart, adopted
+    borrowed replicas become ordinary replicas (targeted reclaim can no
+    longer pick them), and their chips return to the pool only when the
+    autoscaler's idle scale-down — or a job stop — eventually drains
+    them. The training floor itself is still enforced for every loan
+    granted after the restart."""
+
+    def __init__(self, allocator=None):
+        self._alloc = allocator
+        self._lock = threading.Lock()
+        # service_id -> (inference_job_id, n_chips) currently on loan
+        self._borrowed: Dict[str, Tuple[str, int]] = {}
+        # token -> n_chips of borrows DECIDED but not yet granted by the
+        # allocator: counted against the floor so concurrent scale-ups
+        # can't both pass the check before either takes its chips
+        self._pending: Dict[object, int] = {}
+        # installed by the autoscaler: callable(n_chips) -> chips actually
+        # returned (drains borrowed replicas via graceful scale-down)
+        self._reclaim_cb = None
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._g_borrowed = REGISTRY.gauge(
+            "rafiki_autoscale_borrowed_chips",
+            "chips the serving plane currently borrows from idle "
+            "training capacity")
+
+    def set_reclaim_callback(self, cb) -> None:
+        self._reclaim_cb = cb
+
+    def floor(self) -> int:
+        return max(int(config.AUTOSCALE_TRAIN_FLOOR), 0)
+
+    def capacity(self) -> Tuple[int, int]:
+        """(total_chips, free_chips) of the arbitrated inventory, (0, 0)
+        when no allocator is wired (chip-less deployments)."""
+        if self._alloc is None:
+            return 0, 0
+        try:
+            return int(self._alloc.total_chips), int(self._alloc.free_chips)
+        except Exception:
+            logger.exception("chip arbiter capacity probe failed")
+            return 0, 0
+
+    def may_borrow(self, n_chips: int) -> bool:
+        """True when lending ``n_chips`` to serving leaves at least the
+        training floor's worth of chips free (pending reservations
+        included). Chips already held by running trials are not counted
+        against the floor — they ARE training's. Advisory view; the
+        atomic check-and-reserve is :meth:`begin_borrow`."""
+        if n_chips <= 0 or self._alloc is None:
+            return False
+        total, free = self.capacity()
+        if total <= 0:
+            return False
+        with self._lock:
+            pending = sum(self._pending.values())
+        return free - pending - n_chips >= self.floor()
+
+    def begin_borrow(self, n_chips: int) -> Optional[object]:
+        """Atomically check the floor AND reserve the intent to borrow
+        ``n_chips`` — two concurrent scale-ups can't both pass the check
+        before either takes its chips from the allocator. Returns an
+        opaque reservation token (pass to :meth:`commit_borrow` /
+        :meth:`cancel_borrow`), or None when the floor refuses."""
+        if n_chips <= 0 or self._alloc is None:
+            return None
+        total, free = self.capacity()
+        if total <= 0:
+            return None
+        with self._lock:
+            if free - sum(self._pending.values()) - n_chips < self.floor():
+                return None
+            token = object()
+            self._pending[token] = n_chips
+            return token
+
+    def commit_borrow(self, token: object, service_id: str,
+                      inference_job_id: str, chips) -> None:
+        """The reserved borrow was granted: move it onto the loan book."""
+        with self._lock:
+            self._pending.pop(token, None)
+        self.note_borrow(service_id, inference_job_id, chips)
+
+    def cancel_borrow(self, token: object) -> None:
+        """The reserved borrow never happened (placement failed or fell
+        back to shared devices): free the reservation."""
+        with self._lock:
+            self._pending.pop(token, None)
+
+    def note_borrow(self, service_id: str, inference_job_id: str,
+                    chips) -> None:
+        n = len(chips) if hasattr(chips, "__len__") else int(chips)
+        if n <= 0:
+            return
+        with self._lock:
+            self._borrowed[service_id] = (inference_job_id, n)
+            self._g_borrowed.set(
+                sum(c for _, c in self._borrowed.values()))
+        logger.info("serving borrowed %d chip(s) for replica %s (job %s)",
+                    n, service_id[:8], inference_job_id[:8])
+
+    def note_return(self, service_id: str) -> int:
+        with self._lock:
+            job_id, n = self._borrowed.pop(service_id, (None, 0))
+            self._g_borrowed.set(
+                sum(c for _, c in self._borrowed.values()))
+        if n:
+            logger.info("serving returned %d borrowed chip(s) with "
+                        "replica %s", n, service_id[:8])
+        return n
+
+    def borrowed(self) -> Dict[str, Tuple[str, int]]:
+        with self._lock:
+            return dict(self._borrowed)
+
+    def borrowed_chips(self) -> int:
+        with self._lock:
+            return sum(n for _, n in self._borrowed.values())
+
+    def reclaim_for_training(self, n_chips: int) -> int:
+        """The training plane demands ``n_chips`` it cannot allocate:
+        drain borrowed serving replicas until that many chips came home
+        (or the loan book is empty). Returns the chips actually freed.
+        Synchronous — the caller retries its allocation right after."""
+        if n_chips <= 0 or self._reclaim_cb is None:
+            return 0
+        if not self.borrowed():
+            return 0
+        try:
+            freed = int(self._reclaim_cb(n_chips) or 0)
+        except Exception:
+            logger.exception("chip reclaim callback failed")
+            return 0
+        if freed:
+            logger.warning(
+                "training reclaimed %d chip(s) from the serving plane "
+                "(%d requested)", freed, n_chips)
+        return freed
 
 
 class HostAgentPlacementManager(PlacementManager):

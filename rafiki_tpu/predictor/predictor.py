@@ -45,6 +45,15 @@ class Predictor:
         self._broker = broker
         self._task = task
         self._worker_trials = dict(worker_trials or {})
+        # elastic serving (admin/autoscaler.py): replicas join and leave
+        # at runtime. _route_lock guards the trial map + the draining set;
+        # predict_batch works on per-request snapshots, so a concurrent
+        # scale action never mutates a request's routing mid-flight.
+        self._route_lock = threading.Lock()
+        # service_ids being gracefully drained: no NEW requests (first
+        # submits or hedges) are routed to them, but their queues stay
+        # open until flushed — zero in-flight requests dropped
+        self._draining: set = set()
         self._rr = itertools.count()
         # overload-control counters (docs/failure-model.md "Overload
         # faults"), surfaced via the per-job /healthz and GET /fleet/health
@@ -66,11 +75,54 @@ class Predictor:
             ).labels(inference_job_id)
             for key in self._overload
         }
+        # per-JOB shed ring (~1 s resolution, utils/metrics.py Ring): the
+        # autoscaler attributes overload to a tenant through this series —
+        # the door-level shed_rate:<door> rings can't split a shared door
+        # by job
+        self._ring_shed = REGISTRY.ring(f"shed_rate:job:{inference_job_id}")
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._ol_lock:
             self._overload[key] += n
         self._m_overload[key].inc(n)
+        if key in ("trials_shed", "requests_shed"):
+            self._ring_shed.add(n)
+
+    # -- elastic replica membership (admin/autoscaler.py) -------------------
+
+    def add_worker(self, worker_id: str, trial_id: str) -> None:
+        """Runtime replica JOIN: route requests to a scaled-up worker the
+        moment its queue registers with the broker."""
+        with self._route_lock:
+            self._worker_trials[worker_id] = trial_id
+            self._draining.discard(worker_id)
+
+    def retire_worker(self, worker_id: str) -> None:
+        """Begin a graceful LEAVE: stop routing new submits (and hedges)
+        to this replica while its queue drains. Idempotent."""
+        with self._route_lock:
+            self._draining.add(worker_id)
+
+    def unretire_worker(self, worker_id: str) -> None:
+        """Abort a LEAVE (a drain that failed mid-way): the replica is
+        still placed and routed, so resume sending it traffic rather than
+        leaving it retired-but-alive forever."""
+        with self._route_lock:
+            self._draining.discard(worker_id)
+
+    def drop_worker(self, worker_id: str) -> None:
+        """Complete a LEAVE after the drain: forget the replica."""
+        with self._route_lock:
+            self._worker_trials.pop(worker_id, None)
+            self._draining.discard(worker_id)
+
+    def draining_workers(self) -> set:
+        with self._route_lock:
+            return set(self._draining)
+
+    def _route_snapshot(self):
+        with self._route_lock:
+            return dict(self._worker_trials), set(self._draining)
 
     def overload_stats(self) -> Dict[str, int]:
         with self._ol_lock:
@@ -111,11 +163,22 @@ class Predictor:
         depths = self.queue_depths()
         if not depths:
             return 0
+        trials, draining = self._route_snapshot()
         groups: Dict[str, List[int]] = {}
         for wid, d in depths.items():
-            if d >= 0:
-                groups.setdefault(
-                    self._worker_trials.get(wid, wid), []).append(d)
+            # draining replicas take no new requests, so their depth is
+            # not part of the wait a NEW request faces — unless they are
+            # all that's left (the predict fan-out falls back the same
+            # way); a queue the trial map doesn't know is a scaled-up
+            # replica still WARMING (its worker registers the queue
+            # before the model loads) and isn't routable yet either
+            if d >= 0 and wid not in draining and (
+                    not trials or wid in trials):
+                groups.setdefault(trials.get(wid, wid), []).append(d)
+        if not groups:
+            for wid, d in depths.items():
+                if d >= 0:
+                    groups.setdefault(trials.get(wid, wid), []).append(d)
         return max((min(ds) for ds in groups.values()), default=0)
 
     def predict(self, query: Any, timeout_s: Optional[float] = None) -> Any:
@@ -137,10 +200,30 @@ class Predictor:
             raise RuntimeError(
                 f"No inference workers registered for job {self._job_id}"
             )
-        # group live workers by trial; unknown workers stand alone
+        # group live workers by trial; with no trial map at all (legacy
+        # standalone jobs) unknown workers stand alone, but when a map
+        # exists an unmapped queue is a scaled-up replica still WARMING
+        # (workers register their queue before the model loads) — routing
+        # to it would park requests behind a model load, so it joins the
+        # fan-out only when add_worker maps it. Draining replicas
+        # (graceful scale-down) are left out of the fan-out so their
+        # queues empty — but if a trial has ONLY draining replicas left,
+        # they still serve it (drain is a routing preference, never a
+        # way to lose a trial from the ensemble).
+        trials, draining = self._route_snapshot()
+        routable = [w for w in queues
+                    if not trials or w in trials] or list(queues)
         groups: Dict[str, List[str]] = {}
-        for wid in queues:
-            groups.setdefault(self._worker_trials.get(wid, wid), []).append(wid)
+        if draining:
+            active = [w for w in routable if w not in draining]
+            for wid in active:
+                groups.setdefault(trials.get(wid, wid), []).append(wid)
+            for wid in routable:
+                if wid in draining and trials.get(wid, wid) not in groups:
+                    groups.setdefault(trials.get(wid, wid), []).append(wid)
+        else:
+            for wid in routable:
+                groups.setdefault(trials.get(wid, wid), []).append(wid)
         rr = next(self._rr)
         trial_predictions: List[Optional[List[Any]]] = []
         # submit the first attempt for every trial up front so replicas of

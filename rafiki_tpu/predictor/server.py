@@ -271,7 +271,11 @@ class PredictorServer:
             backlog_fn = getattr(self.predictor, "backlog_depth", None)
             backlog = backlog_fn() if callable(backlog_fn) else None
             t_adm = time.monotonic()
-            self.admission.admit(timeout_s, backlog_depth=backlog)
+            # tenant/cost feed the weighted-fair gate; on this per-job
+            # door there is one tenant, so the gate is a no-op — the
+            # accounting still shows in /healthz fair_shares
+            self.admission.admit(timeout_s, backlog_depth=backlog,
+                                 tenant=self.app, cost=len(queries))
             t0 = time.monotonic()
             if rt is not None:
                 rt.add_span("admission_wait", t_adm, t0)
@@ -282,7 +286,7 @@ class PredictorServer:
                     queries, timeout_s=timeout_s,
                     **({"trace": rt} if rt is not None else {}))
             finally:
-                self.admission.release()
+                self.admission.release(tenant=self.app)
             e2e_s = time.monotonic() - t0
             self.admission.observe(e2e_s, len(queries))
             # Accept negotiation: a client that asked for

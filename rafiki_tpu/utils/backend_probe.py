@@ -47,6 +47,199 @@ _PROBE_CODE = (
 )
 
 
+def _probe_lock_path() -> str:
+    """One lock file per machine (not per process): concurrent probes —
+    bench retry loops, doctor, several agents booting — would otherwise
+    STACK child interpreters onto an already-wedged tunnel (VERDICT r5:
+    the driver bench fell back to CPU twice with 'backend probe still
+    hung')."""
+    return os.environ.get(
+        "RAFIKI_BACKEND_PROBE_LOCK",
+        os.path.join(tempfile.gettempdir(), "rafiki_backend_probe.lock"))
+
+
+def _probe_stale_s() -> float:
+    """Age past which an abandoned probe child is definitively WEDGED —
+    far beyond any legitimate backend init, so killing it can no longer
+    be the mid-init signal that wedges the tunnel (round-3 postmortem)."""
+    return float(os.environ.get("RAFIKI_BACKEND_PROBE_STALE_S", 600))
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def _read_lock(path: str):
+    """(pid, age_seconds) recorded in a lock file, or None if unreadable
+    (a corrupt/foreign lock is treated as stale once old enough)."""
+    try:
+        with open(path) as f:
+            pid_s, _, ts_s = f.read().strip().partition(" ")
+        return int(pid_s), max(time.time() - float(ts_s), 0.0)
+    except (OSError, ValueError):
+        return None
+
+
+def _lock_is_stale(path: str) -> bool:
+    """A lock is stale when its recorded holder died, or — when the
+    content is unreadable (O_EXCL-create and the pid+ts write are two
+    steps, so a racing reader can catch a live holder's lock still
+    EMPTY) — when the FILE is older than the stale window. A fresh lock
+    is never broken on sight."""
+    info = _read_lock(path)
+    if info is not None:
+        return (not _pid_alive(info[0])) or info[1] > _probe_stale_s()
+    try:
+        return time.time() - os.path.getmtime(path) > _probe_stale_s()
+    except OSError:
+        return False  # vanished: nothing left to break
+
+
+def _break_stale_lock(path: str) -> None:
+    """Unlink a lock judged stale — serialized on a flock guard and
+    RE-judged under it, so two waiters who both saw the same dead holder
+    can't have the second unlink the first one's freshly taken lock."""
+    import fcntl
+
+    guard = path + ".guard"
+    try:
+        g = open(guard, "a")
+    except OSError:
+        return  # no guard possible: leave the lock to time out
+    try:
+        fcntl.flock(g, fcntl.LOCK_EX)
+        if _lock_is_stale(path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    finally:
+        try:
+            fcntl.flock(g, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        g.close()
+
+
+def _acquire_probe_lock(timeout_s: float):
+    """Take the machine-wide probe lock, breaking locks whose holder died
+    or that outlived the stale window. Returns the lock path on success,
+    None when a LIVE probe still holds it at timeout — the caller reports
+    that instead of stacking another child onto the tunnel."""
+    path = _probe_lock_path()
+    deadline = time.monotonic() + max(timeout_s, 0.0)
+    while True:
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            with os.fdopen(fd, "w") as f:
+                f.write(f"{os.getpid()} {time.time()}")
+            return path
+        except FileExistsError:
+            if _lock_is_stale(path):
+                _break_stale_lock(path)
+                if not os.path.exists(path):
+                    continue  # broken: retry the O_EXCL create (fair race)
+                # still there — another waiter re-took it, or a foreign
+                # owner we can't unlink: wait it out instead of spinning
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.25)
+        except OSError:
+            # unwritable tmpdir: probing unlocked beats not probing
+            return path
+
+
+def _release_probe_lock(path: str) -> None:
+    info = _read_lock(path)
+    if info is None or info[0] != os.getpid():
+        # not provably ours: someone broke our stale lock and took over
+        # (an unreadable lock may be the new holder caught mid-write —
+        # the same rule the acquire path lives by)
+        return
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _orphan_ledger_path() -> str:
+    return _probe_lock_path() + ".pids"
+
+
+def _record_orphan(pid: int) -> None:
+    """Remember an abandoned probe child so a LATER probe can clean it up
+    once it is stale (we never signal it young — that is the tunnel-wedge
+    trigger)."""
+    try:
+        with open(_orphan_ledger_path(), "a") as f:
+            f.write(f"{pid} {time.time()}\n")
+    except OSError:
+        pass
+
+
+def _pid_is_probe(pid: int) -> bool:
+    """True when the pid's cmdline still carries the probe marker — the
+    ledger outlives its children, so a recycled pid must never get an
+    unrelated process SIGKILLed (same identity-pin idea as the worker
+    kill path in placement/process.py)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"DEVICE_COUNT" in f.read()
+    except OSError:
+        return False
+
+
+def cleanup_stale_probes() -> int:
+    """Reap probe children abandoned by EARLIER probes: entries older
+    than the stale window whose process still exists AND is still a
+    probe interpreter get SIGKILLed (they are wedged, long past any
+    init), dead or recycled-pid entries are forgotten, young live ones
+    are left alone. Returns the number killed. Called before every new
+    probe so retry loops (bench.py runs the probe twice) never
+    accumulate wedged interpreters."""
+    path = _orphan_ledger_path()
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return 0
+    now = time.time()
+    killed, keep = 0, []
+    for line in lines:
+        try:
+            pid_s, _, ts_s = line.strip().partition(" ")
+            pid, ts = int(pid_s), float(ts_s)
+        except ValueError:
+            continue
+        if not _pid_alive(pid) or not _pid_is_probe(pid):
+            continue
+        if now - ts > _probe_stale_s():
+            try:
+                os.kill(pid, 9)
+                killed += 1
+            except OSError:
+                keep.append(line)
+        else:
+            keep.append(line)
+    try:
+        if keep:
+            with open(path, "w") as f:
+                f.write("\n".join(keep) + "\n")
+        else:
+            os.unlink(path)
+    except OSError:
+        pass
+    return killed
+
+
 def cpu_env(n_devices: int | None = None, base: dict | None = None) -> dict:
     """Child-process environment guaranteed to stay off the TPU tunnel,
     optionally with an ``n_devices``-wide virtual CPU mesh."""
@@ -85,43 +278,67 @@ def probe_device_count(
     A timed-out probe child is ABANDONED, not killed: a signal delivered
     during first backend init is exactly what wedges the tunnel for every
     later process (round-3 postmortem), so the orphan is left to finish or
-    fail on its own — it holds no resources beyond one idle interpreter."""
-    out = tempfile.NamedTemporaryFile(
-        mode="w+", suffix=".probe", delete=False)
+    fail on its own — it holds no resources beyond one idle interpreter.
+    Abandoned pids land in a ledger; the NEXT probe reaps any that are
+    still alive past the stale window (they are wedged, not initializing).
+
+    Concurrent probes serialize on a machine-wide lock file: a wedged
+    tunnel must cost bounded probes one at a time, never a stack of hung
+    interpreters dialing it at once. A probe that cannot get the lock
+    from a live holder within ``timeout_s`` reports that instead of
+    running."""
+    lock = _acquire_probe_lock(timeout_s)
+    if lock is None:
+        info = _read_lock(_probe_lock_path())
+        holder = f" (pid {info[0]})" if info else ""
+        return 0, (
+            "another backend probe%s still holds the probe lock after "
+            "%.0fs — tunnel likely wedged; not stacking another probe"
+            % (holder, timeout_s))
     try:
-        proc = subprocess.Popen(
-            [sys.executable, "-c", _PROBE_CODE],
-            stdout=out, stderr=subprocess.STDOUT,
-            env=dict(os.environ), start_new_session=True,
-        )
-    except OSError as e:
+        # reap earlier probes' wedged orphans BEFORE adding our own
+        # child — under the lock, so the ledger's read-modify-write can
+        # never race another probe's _record_orphan append
+        cleanup_stale_probes()
+        out = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=".probe", delete=False)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _PROBE_CODE],
+                stdout=out, stderr=subprocess.STDOUT,
+                env=dict(os.environ), start_new_session=True,
+            )
+        except OSError as e:
+            out.close()
+            os.unlink(out.name)
+            return 0, f"backend probe failed to launch: {e!r}"
+        deadline = time.monotonic() + timeout_s
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.25)
+        if proc.poll() is None:
+            out.close()  # leave the file for the orphan; tiny, in tmpdir
+            _record_orphan(proc.pid)
+            return 0, (
+                f"backend probe still hung after {timeout_s:.0f}s "
+                f"(abandoned, pid {proc.pid})"
+            )
+        out.seek(0)
+        text = out.read()
         out.close()
         os.unlink(out.name)
-        return 0, f"backend probe failed to launch: {e!r}"
-    deadline = time.monotonic() + timeout_s
-    while proc.poll() is None and time.monotonic() < deadline:
-        time.sleep(0.25)
-    if proc.poll() is None:
-        out.close()  # leave the file for the orphan; tiny, in tmpdir
+        for line in text.splitlines():
+            if line.startswith("DEVICE_COUNT="):
+                try:
+                    return int(line.split("=", 1)[1]), None
+                except ValueError:
+                    break
+        tail = text.strip().splitlines()
         return 0, (
-            f"backend probe still hung after {timeout_s:.0f}s "
-            f"(abandoned, pid {proc.pid})"
+            f"backend probe rc={proc.returncode}: "
+            + (tail[-1] if tail else "no output")
         )
-    out.seek(0)
-    text = out.read()
-    out.close()
-    os.unlink(out.name)
-    for line in text.splitlines():
-        if line.startswith("DEVICE_COUNT="):
-            try:
-                return int(line.split("=", 1)[1]), None
-            except ValueError:
-                break
-    tail = text.strip().splitlines()
-    return 0, (
-        f"backend probe rc={proc.returncode}: "
-        + (tail[-1] if tail else "no output")
-    )
+    finally:
+        _release_probe_lock(lock)
 
 
 @contextmanager

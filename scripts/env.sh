@@ -88,6 +88,56 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                   .1 generation; doctor WARNs when
 #                                   rotation falls behind)
 
+# Elastic serving autoscaler + multi-tenant fair admission
+# (docs/failure-model.md "Overload adaptation"). The control loop is OFF
+# by default — existing deployments keep their static replica counts:
+#   RAFIKI_AUTOSCALE=1                  start the admin-side control loop
+#                                       (scale up on sustained shed /
+#                                       backlog, down on sustained idle)
+#   RAFIKI_AUTOSCALE_INTERVAL_S=2       decision-loop tick interval
+#   RAFIKI_AUTOSCALE_WINDOW_S=15        signal window a decision looks at
+#   RAFIKI_AUTOSCALE_SHED_THRESHOLD=3   shed events inside the window that
+#                                       read "sustained overload"
+#   RAFIKI_AUTOSCALE_DEPTH_HIGH=8       mean backlog depth that scales up
+#   RAFIKI_AUTOSCALE_DEPTH_LOW=1        max backlog that still counts as
+#                                       idle (hysteresis: keep LOW well
+#                                       under HIGH; doctor WARNs)
+#   RAFIKI_AUTOSCALE_MIN_REPLICAS=1     never drain below this (per job)
+#   RAFIKI_AUTOSCALE_MAX_REPLICAS=8     never grow past this
+#   RAFIKI_AUTOSCALE_STEP=1             replicas per decision (bounded
+#                                       step — the loop cannot stampede)
+#   RAFIKI_AUTOSCALE_COOLDOWN_UP_S=5    quiet time before the next up
+#   RAFIKI_AUTOSCALE_COOLDOWN_DOWN_S=30 ... before the next down (longer:
+#                                       flapping down is worse than
+#                                       holding spare capacity a while)
+#   RAFIKI_AUTOSCALE_DRAIN_S=10         bounded graceful-drain window per
+#                                       removed replica (stop admitting,
+#                                       flush its queue, then destroy)
+#   RAFIKI_AUTOSCALE_TRAIN_FLOOR=1      chips serving may never borrow
+#                                       into — the hard floor that keeps
+#                                       training alive through any surge
+#   RAFIKI_AUTOSCALE_FAIR=1             per-job weighted fair admission at
+#                                       shared doors: a hot job past its
+#                                       share 429s, cold jobs keep their
+#                                       latency (off by default)
+#   RAFIKI_AUTOSCALE_FAIR_WINDOW_S=10   half-life of the per-tenant
+#                                       admitted-query charge decay
+#   RAFIKI_AUTOSCALE_FAIR_BURST=32      admitted queries a tenant may run
+#                                       past its fair share before 429s
+#   RAFIKI_AUTOSCALE_FAIR_WEIGHTS=''    "appA=3,appB=1" (unlisted = 1)
+# New /metrics series: rafiki_autoscale_{up,down}_total{job},
+# rafiki_autoscale_ticks_total, rafiki_autoscale_borrowed_chips,
+# rafiki_admission_shed_total{reason="fairness"}, and the ring series
+# backlog:job:<id> + shed_rate:job:<id>. Decisions (reason + signal
+# snapshot) surface under GET /fleet/health "autoscaler".
+
+# TPU backend probe hardening (bench.py / doctor): probes serialize on a
+# machine-wide lockfile so retry loops never stack interpreters onto a
+# wedged libtpu tunnel; abandoned probe children are reaped once stale:
+#   RAFIKI_BACKEND_PROBE_LOCK=/tmp/rafiki_backend_probe.lock
+#   RAFIKI_BACKEND_PROBE_STALE_S=600    age past which an abandoned probe
+#                                       child is wedged-for-sure (killed)
+
 # Control-plane crash recovery (docs/failure-model.md, "Control-plane
 # faults"). A restarted admin reconciles the store against what is
 # actually running: adopt surviving workers, reschedule dead-host train

@@ -63,6 +63,92 @@ def test_probe_timeout_abandons_child():
     assert err and "abandoned" in err
 
 
+def test_probe_lock_live_holder_reports_instead_of_stacking(
+        tmp_path, monkeypatch):
+    """A concurrent probe holding the machine-wide lock (live pid) makes
+    a second probe report the wedge instead of stacking another child
+    interpreter onto the tunnel (VERDICT r5 failure mode)."""
+    import time
+
+    lock = tmp_path / "probe.lock"
+    monkeypatch.setenv("RAFIKI_BACKEND_PROBE_LOCK", str(lock))
+    lock.write_text(f"{os.getpid()} {time.time()}")  # live holder: us
+    t0 = time.monotonic()
+    n, err = probe_device_count(timeout_s=1.0)
+    assert n == 0
+    assert err and "probe lock" in err
+    assert time.monotonic() - t0 < 10  # bounded, no probe child launched
+    assert lock.exists()  # a live holder's lock is never broken
+
+
+def test_probe_breaks_lock_of_dead_holder(tmp_path, monkeypatch):
+    """A lock whose holder pid is gone is stale garbage — broken and
+    probed through, then released."""
+    lock = tmp_path / "probe.lock"
+    monkeypatch.setenv("RAFIKI_BACKEND_PROBE_LOCK", str(lock))
+    # spawn-and-reap a real process so the pid is definitely dead
+    proc = __import__("subprocess").Popen([sys.executable, "-c", "pass"])
+    proc.wait(timeout=30)
+    lock.write_text(f"{proc.pid} 1.0")
+    n, err = probe_device_count(timeout_s=120)
+    assert err is None and n >= 1
+    assert not lock.exists()  # released on the way out
+
+
+def test_probe_breaks_corrupt_lock_once_stale(tmp_path, monkeypatch):
+    import time
+
+    lock = tmp_path / "probe.lock"
+    monkeypatch.setenv("RAFIKI_BACKEND_PROBE_LOCK", str(lock))
+    monkeypatch.setenv("RAFIKI_BACKEND_PROBE_STALE_S", "0")
+    lock.write_text("not-a-pid whatever")  # unreadable -> stale once old
+    n, err = probe_device_count(timeout_s=120)
+    assert err is None and n >= 1
+
+
+def test_cleanup_reaps_only_wedged_orphans(tmp_path, monkeypatch):
+    """Stale-probe cleanup: an abandoned child past the stale window is
+    SIGKILLed (it is wedged, long past any init); a young live one is
+    left alone (killing mid-init is the tunnel-wedge trigger); dead
+    entries are forgotten."""
+    import subprocess
+    import time
+
+    monkeypatch.setenv(
+        "RAFIKI_BACKEND_PROBE_LOCK", str(tmp_path / "probe.lock"))
+    monkeypatch.setenv("RAFIKI_BACKEND_PROBE_STALE_S", "5")
+    # probe-shaped sleepers: cmdline carries the probe marker, the way a
+    # real wedged probe child's does
+    sleeper = [sys.executable, "-c",
+               "import time; time.sleep(600)  # DEVICE_COUNT"]
+    stale = subprocess.Popen(sleeper)
+    young = subprocess.Popen(sleeper)
+    # a live process that is NOT a probe: a ledger pid recycled by the OS
+    recycled = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"])
+    try:
+        ledger = tmp_path / "probe.lock.pids"
+        ledger.write_text(
+            f"{stale.pid} {time.time() - 60}\n"      # wedged: kill
+            f"{young.pid} {time.time()}\n"           # young: spare
+            f"{recycled.pid} {time.time() - 60}\n"   # recycled: forget
+            f"999999999 {time.time() - 60}\n")       # dead: forget
+        killed = backend_probe.cleanup_stale_probes()
+        assert killed == 1
+        assert stale.wait(timeout=10) != 0  # SIGKILLed
+        assert young.poll() is None         # untouched
+        assert recycled.poll() is None      # identity-pinned: untouched
+        kept = ledger.read_text()
+        assert str(young.pid) in kept
+        assert str(stale.pid) not in kept
+        assert str(recycled.pid) not in kept
+    finally:
+        for p in (stale, young, recycled):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
 def test_defer_term_signals_holds_and_redelivers():
     got = []
     prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))

@@ -107,6 +107,52 @@ def test_recovery_check_reports_last_reconcile(tmp_path, monkeypatch):
     assert "3 adopted" in detail and "1.25" in detail
 
 
+def test_autoscaler_check_warns_on_inverted_bounds(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_MIN_REPLICAS", "5")
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_MAX_REPLICAS", "2")
+    name, status, detail = doctor.check_autoscaler(total_chips=8)
+    assert status == doctor.WARN
+    assert "INVERTED" in detail
+
+
+def test_autoscaler_check_warns_when_floor_exceeds_fleet(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_TRAIN_FLOOR", "64")
+    name, status, detail = doctor.check_autoscaler(total_chips=8)
+    assert status == doctor.WARN
+    assert "exceeds" in detail
+    # a sane floor against the same fleet: that clause stays quiet
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_TRAIN_FLOOR", "2")
+    name, status, detail = doctor.check_autoscaler(total_chips=8)
+    assert "exceeds the fleet" not in detail
+
+
+def test_autoscaler_check_warns_on_shed_with_loop_off(tmp_path,
+                                                      monkeypatch):
+    """Sustained shed observed while autoscaling is disabled: the fleet
+    is turning traffic away that a scale-up could absorb — WARN."""
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    monkeypatch.delenv("RAFIKI_AUTOSCALE", raising=False)
+    REGISTRY.ring("shed_rate:doctor-drill-door").add(5)
+    name, status, detail = doctor.check_autoscaler(total_chips=8)
+    assert status == doctor.WARN
+    assert "RAFIKI_AUTOSCALE is OFF" in detail
+    assert "doctor-drill-door" in detail
+
+
+def test_autoscaler_check_warns_without_hysteresis(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_DEPTH_LOW", "8")
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_DEPTH_HIGH", "8")
+    name, status, detail = doctor.check_autoscaler(total_chips=8)
+    assert status == doctor.WARN
+    assert "hysteresis" in detail
+
+
 def test_crashing_check_is_contained(monkeypatch, tmp_path, capsys):
     monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
 

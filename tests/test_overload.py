@@ -337,7 +337,10 @@ def test_shm_worker_drops_expired_entries():
 
 
 def test_admission_inflight_cap_sheds_503():
-    adm = AdmissionController(max_inflight=2)
+    # unique door labels: the EWMA cold-start seed reads the door's
+    # process-global latency histogram, so same-door controllers from
+    # other tests would otherwise leak history into these
+    adm = AdmissionController(max_inflight=2, door="t-inflight-cap")
     adm.admit(10.0)
     adm.admit(10.0)
     with pytest.raises(ServerOverloadedError):
@@ -350,7 +353,8 @@ def test_admission_inflight_cap_sheds_503():
 
 
 def test_admission_estimated_wait_sheds_429_with_retry_after():
-    adm = AdmissionController(max_inflight=0)  # uncapped door
+    adm = AdmissionController(max_inflight=0,  # uncapped door
+                              door="t-est-wait")
     adm.observe(1.0, 1)  # ewma: 1 s per query
     with pytest.raises(DeadlineUnmeetableError) as ei:
         adm.admit(2.0, backlog_depth=5)  # est wait 5s > 2s deadline
@@ -360,13 +364,13 @@ def test_admission_estimated_wait_sheds_429_with_retry_after():
 
 
 def test_admission_never_sheds_on_estimate_without_history():
-    adm = AdmissionController(max_inflight=0)
+    adm = AdmissionController(max_inflight=0, door="t-no-history")
     adm.admit(0.001, backlog_depth=10_000)  # no ewma yet: never a guess-shed
     assert adm.stats()["shed_deadline"] == 0
 
 
 def test_admission_release_pairs_with_observe():
-    adm = AdmissionController(max_inflight=1)
+    adm = AdmissionController(max_inflight=1, door="t-release-observe")
     adm.admit(5.0)
     adm.release()
     adm.observe(0.4, 4)
