@@ -32,6 +32,8 @@ from rafiki_tpu.sdk import (
     FixedKnob,
     FloatKnob,
     IntegerKnob,
+    PopulationSpec,
+    PopulationTrainer,
     cached_trainer,
     classification_accuracy,
     dataset_utils,
@@ -44,6 +46,14 @@ class JaxCnn(BaseModel):
     """Conv -> [Conv-Conv-pool] x num_stages -> GAP -> Dense softmax."""
 
     dependencies = {"jax": None, "optax": None}
+
+    # Vectorized trial execution: the train worker may drain K advisor
+    # proposals and train every one whose ARCHITECTURE knobs match as one
+    # vmapped PopulationTrainer program (train_population below) — only
+    # learning_rate varies per member (it rides the optimizer state via
+    # tunable_optimizer, so the stacked step stays one executable).
+    population_spec = PopulationSpec(dynamic_knobs=("learning_rate",),
+                                     max_members=8)
 
     @staticmethod
     def get_knob_config():
@@ -145,6 +155,56 @@ class JaxCnn(BaseModel):
     def evaluate(self, dataset_uri):
         x, y = self._load(dataset_uri)
         return classification_accuracy(self._trainer, self._params, x, y)
+
+    # -- vectorized trial execution (population_spec above) ----------------
+
+    def _build_pop_trainer(self, n_members):
+        # the member count shapes the stacked program, so it joins the
+        # cache key; lr stays dynamic exactly as in the scalar trainer
+        key = ("JaxCnnPop", self._knobs["num_stages"],
+               self._knobs["base_channels"], self._knobs["image_size"],
+               n_members)
+        return cached_trainer(key, lambda: PopulationTrainer(
+            softmax_classifier_loss(self._apply),
+            tunable_optimizer(optax.adamw, learning_rate=1e-3),
+            predict_fn=lambda p, x: jax.nn.softmax(self._apply(p, x),
+                                                   axis=-1),
+        ))
+
+    def train_population(self, dataset_uri, member_knobs):
+        x, y = self._load(dataset_uri)
+        self._num_classes = int(y.max()) + 1
+        lrs = [float(k["learning_rate"]) for k in member_knobs]
+        self._pop_trainer = self._build_pop_trainer(len(lrs))
+        params, opt_state = self._pop_trainer.init(
+            self._make_init(x.shape[-1], self._num_classes),
+            {"learning_rate": lrs})
+        self.logger.define_plot("Population loss", ["loss"], x_axis="epoch")
+        params, _ = self._pop_trainer.fit(
+            params, opt_state, (x, y),
+            epochs=self._knobs["epochs"],
+            batch_size=self._knobs["batch_size"],
+            log=self.logger.log,
+            # stacked mid-trial checkpoint: the whole batch resumes from
+            # its last epoch after a worker crash, like a scalar trial
+            checkpoint_path=self.checkpoint_path,
+        )
+        self._pop_params = params
+
+    def evaluate_population(self, dataset_uri):
+        x, y = self._load(dataset_uri)
+        return [float(s) for s in self._pop_trainer.member_scores(
+            self._pop_params, x, y)]
+
+    def dump_member_parameters(self, member):
+        # identical format to dump_parameters: each member becomes a
+        # normal trial row, so serving deploys winners unchanged
+        return {
+            "params": jax.tree.map(
+                np.asarray,
+                self._pop_trainer.member_params(self._pop_params, member)),
+            "num_classes": self._num_classes,
+        }
 
     def predict(self, queries):
         from rafiki_tpu import config as rconfig

@@ -81,6 +81,25 @@ def _b64_field(body: Dict[str, Any], name: str) -> bytes:
         raise InvalidRequestError(f"field '{name}' is not valid base64: {e}")
 
 
+def _list_field(body: Dict[str, Any], name: str) -> list:
+    """A required body field that must be a JSON array; anything else is
+    a client error (the fuzz contract: malformed bodies answer 4xx, never
+    a 500 from iterating an int)."""
+    value = _field(body, name)
+    if not isinstance(value, list):
+        raise InvalidRequestError(
+            f"field '{name}' must be a list, got {type(value).__name__}")
+    return value
+
+
+# Door cap on batched advisor proposals: each draw is a GP fit + EI
+# optimization under the session lock, so an unbounded client-supplied k
+# could pin the advisor (and starve every worker sharing it) for hours.
+# Workers clamp far lower (RAFIKI_TRIAL_VMAP_K, PopulationSpec
+# max_members); this bound is the trust boundary's backstop.
+PROPOSE_BATCH_MAX = 64
+
+
 def _knob_config_field(body: Dict[str, Any]):
     """Deserialize a client-supplied knob_config; any malformed shape or
     unknown knob type is a client error, validated here at the route
@@ -255,9 +274,25 @@ class AdminServer:
                     advisor_id=b.get("advisor_id"))}),
             r("POST", r"/advisors/(?P<aid>[^/]+)/propose", _ANY,
                 lambda au, m, b, q: {"knobs": A.advisor_store.propose(m["aid"])}),
+            # batched proposals for vectorized trial execution: K knob
+            # assignments in one call (the GP spreads them via its
+            # pending-point fantasies); old clients keep using /propose
+            r("POST", r"/advisors/(?P<aid>[^/]+)/propose_batch", _ANY,
+                lambda au, m, b, q: {
+                    "knobs_list": A.advisor_store.propose_batch(
+                        m["aid"], max(1, min(_num_field(b, "k", int, 1),
+                                             PROPOSE_BATCH_MAX)))}),
             r("POST", r"/advisors/(?P<aid>[^/]+)/feedback", _ANY,
                 lambda au, m, b, q: {"knobs": A.advisor_store.feedback(
                     m["aid"], _field(b, "knobs"), _field(b, "score"))}),
+            # the batch's return leg: K (knobs, score) observations,
+            # applied member-by-member (each retires its own fantasy)
+            r("POST", r"/advisors/(?P<aid>[^/]+)/feedback_batch", _ANY,
+                lambda au, m, b, q: {
+                    "count": A.advisor_store.feedback_batch(
+                        m["aid"],
+                        [(_field(i, "knobs"), _field(i, "score"))
+                         for i in _list_field(b, "items")])}),
             # scoreless-failure signal (trial fault taxonomy): the GP
             # steers away from the region; trial_id lets the session's
             # ASHA scheduler forget the dead trial's rung records
@@ -271,7 +306,7 @@ class AdminServer:
                 lambda au, m, b, q: {"replayed": A.advisor_store.replay_feedback(
                     m["aid"],
                     [(_field(i, "knobs"), _field(i, "score"))
-                     for i in _field(b, "items")],
+                     for i in _list_field(b, "items")],
                     infeasible=[
                         (_field(i, "knobs"), i.get("kind", "USER"))
                         for i in b.get("infeasible") or []])}),

@@ -54,8 +54,28 @@ class BaseAdvisor:
     def propose(self) -> Dict[str, Any]:
         raise NotImplementedError
 
+    def propose_batch(self, k: int) -> List[Dict[str, Any]]:
+        """K knob assignments to evaluate CONCURRENTLY (the vectorized
+        trial runner drains one batch per vmapped program). The base
+        implementation loops ``propose`` — correct for any advisor type,
+        since each advisor is responsible for making sequential proposals
+        self-avoiding — so subclasses override only to batch more
+        cleverly (the GP spreads the batch via its pending-point
+        fantasies in one lock hold)."""
+        return [self.propose() for _ in range(max(int(k), 1))]
+
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
         raise NotImplementedError
+
+    def feedback_batch(
+        self, items: List[Tuple[Dict[str, Any], float]]) -> int:
+        """Record a batch of (knobs, score) observations — the return leg
+        of ``propose_batch``. Applied member-by-member (each observation
+        retires its own pending fantasy); returns how many were
+        applied."""
+        for knobs, score in items:
+            self.feedback(knobs, float(score))
+        return len(items)
 
     def feedback_infeasible(self, knobs: Dict[str, Any],
                             kind: str = "USER") -> None:
@@ -89,12 +109,26 @@ class Advisor(BaseAdvisor):
 
     def propose(self) -> Dict[str, Any]:
         with self._lock:
-            u = self._opt.suggest(register_pending=False)
-            knobs = knobs_from_unit(self.knob_config, u)
-            # register the *quantized* point (integer/categorical knobs round
-            # to a grid) so feedback's re-encoding retires it by value
-            self._opt.mark_pending(knobs_to_unit(self.knob_config, knobs))
+            return self._propose_locked()
+
+    def _propose_locked(self) -> Dict[str, Any]:
+        u = self._opt.suggest(register_pending=False)
+        knobs = knobs_from_unit(self.knob_config, u)
+        # register the *quantized* point (integer/categorical knobs round
+        # to a grid) so feedback's re-encoding retires it by value
+        self._opt.mark_pending(knobs_to_unit(self.knob_config, knobs))
         return _jsonify(knobs)
+
+    def propose_batch(self, k: int) -> List[Dict[str, Any]]:
+        """K proposals under ONE lock hold, spread by the constant-liar
+        fantasy machinery: each draw registers its quantized point as
+        pending, so the next draw's EI already sees it fantasized at the
+        observed minimum and explores elsewhere (the same mechanism that
+        spreads concurrent workers, and that PR 5 extended to infeasible
+        points). One lock hold keeps a concurrent sibling worker from
+        interleaving draws into the middle of this batch."""
+        with self._lock:
+            return [self._propose_locked() for _ in range(max(int(k), 1))]
 
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
         u = knobs_to_unit(self.knob_config, knobs)
@@ -131,6 +165,13 @@ class RandomAdvisor(BaseAdvisor):
 
     def propose(self) -> Dict[str, Any]:
         return _jsonify(knobs_from_unit(self.knob_config, self._rng.random(self._dims)))
+
+    def propose_batch(self, k: int) -> List[Dict[str, Any]]:
+        # one rng draw for the whole batch (random search needs no
+        # spreading machinery — uniform draws are already independent)
+        u = self._rng.random((max(int(k), 1), self._dims))
+        return [_jsonify(knobs_from_unit(self.knob_config, row))
+                for row in u]
 
     def feedback(self, knobs: Dict[str, Any], score: float) -> None:
         self._n_observed += 1
@@ -181,6 +222,32 @@ class AdvisorStore:
 
     def propose(self, advisor_id: str) -> Dict[str, Any]:
         return self.get(advisor_id).propose()
+
+    def propose_batch(self, advisor_id: str, k: int) -> List[Dict[str, Any]]:
+        """K concurrent proposals (the vectorized trial runner's drain).
+        Advisors predating the batch API fall back to K single proposals
+        — old advisor types keep working behind a new store."""
+        advisor = self.get(advisor_id)
+        fn = getattr(advisor, "propose_batch", None)
+        if fn is not None:
+            return fn(k)
+        return [advisor.propose() for _ in range(max(int(k), 1))]
+
+    def feedback_batch(
+        self,
+        advisor_id: str,
+        items: List[Tuple[Dict[str, Any], float]],
+    ) -> int:
+        """Record a batch of (knobs, score) pairs member-by-member;
+        returns how many observations were applied. Same pre-batch-API
+        fallback as ``propose_batch``."""
+        advisor = self.get(advisor_id)
+        fn = getattr(advisor, "feedback_batch", None)
+        if fn is not None:
+            return int(fn(items))
+        for knobs, score in items:
+            advisor.feedback(knobs, float(score))
+        return len(items)
 
     def feedback(self, advisor_id: str, knobs: Dict[str, Any], score: float) -> Dict[str, Any]:
         """Record a score; returns the next proposal (matching the

@@ -23,11 +23,11 @@ import logging
 import os
 import random
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import requests
 
-from rafiki_tpu.client.client import AdminRecoveringError, Client
+from rafiki_tpu.client.client import AdminRecoveringError, Client, RafikiError
 from rafiki_tpu.sdk.knob import serialize_knob_config
 
 logger = logging.getLogger(__name__)
@@ -93,6 +93,9 @@ class RemoteAdvisorStore:
 
     def __init__(self, client: Client):
         self._client = client
+        # None = unknown, False = the admin answered an API error on a
+        # batch route (pre-batch-API admin; probed once, then remembered)
+        self._batch_api: Optional[bool] = None
 
     def create_advisor(self, knob_config: Dict[str, Any],
                        advisor_id: Optional[str] = None) -> str:
@@ -104,6 +107,59 @@ class RemoteAdvisorStore:
     def propose(self, advisor_id: str) -> Dict[str, Any]:
         return _ride_out(
             lambda: self._client.propose_knobs(advisor_id), "propose")
+
+    def propose_batch(self, advisor_id: str, k: int) -> List[Dict[str, Any]]:
+        """K proposals in one round trip. A mixed-version fleet (new
+        worker, old admin without the /propose_batch route) degrades to
+        K single proposals — the admin's shared GP still spreads them
+        via its pending fantasies, the worker just pays K round trips."""
+        k = max(int(k), 1)
+        if self._batch_api is False:
+            return [self.propose(advisor_id) for _ in range(k)]
+        try:
+            out = _ride_out(
+                lambda: self._client.propose_knobs_batch(advisor_id, k),
+                "propose_batch")
+            self._batch_api = True
+            return out
+        except AdminRecoveringError:
+            raise  # a recovering admin is not an OLD admin — let it retry
+        except RafikiError as e:
+            # latch the no-batch-API verdict ONLY on a missing route
+            # (404): a transient refusal (503 overload shed, a flaky 500)
+            # must not silently downgrade every later round to K serial
+            # proposals — re-raise and let the caller handle this round
+            if getattr(e, "status", None) != 404:
+                raise
+            self._batch_api = False
+            logger.info(
+                "admin has no batched advisor API (%s); falling back to "
+                "single proposals for this session", e)
+            return [self.propose(advisor_id) for _ in range(k)]
+
+    def feedback_batch(self, advisor_id: str, items) -> int:
+        if self._batch_api is False:
+            for knobs, score in items:
+                self.feedback(advisor_id, knobs, float(score))
+            return len(items)
+        try:
+            out = int(_ride_out(
+                lambda: self._client.feedback_knobs_batch(advisor_id, items),
+                "feedback_batch"))
+            self._batch_api = True
+            return out
+        except AdminRecoveringError:
+            raise
+        except RafikiError as e:
+            if getattr(e, "status", None) != 404:
+                raise  # transient refusal, not a pre-batch-API admin
+            self._batch_api = False
+            logger.info(
+                "admin has no batched advisor API (%s); falling back to "
+                "single feedback calls for this session", e)
+            for knobs, score in items:
+                self.feedback(advisor_id, knobs, float(score))
+            return len(items)
 
     def feedback(self, advisor_id: str, knobs: Dict[str, Any],
                  score: float) -> Dict[str, Any]:

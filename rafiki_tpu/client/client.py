@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import base64
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import requests
 
@@ -21,7 +21,14 @@ from rafiki_tpu.sdk.params import load_params
 
 
 class RafikiError(Exception):
-    pass
+    """Admin API error. ``status`` carries the HTTP status code when the
+    admin answered at all (None for transport/parse failures), so callers
+    can tell a missing route (404 — an old admin without the endpoint)
+    from a transient refusal (e.g. a 503 overload shed)."""
+
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
 
 
 class AdminRecoveringError(RafikiError):
@@ -80,7 +87,8 @@ class Client:
                 # (admin/recovery.py): typed, so callers can wait it out
                 raise AdminRecoveringError(
                     payload.get("error", "admin is recovering"))
-            raise RafikiError(payload.get("error", f"HTTP {resp.status_code}"))
+            raise RafikiError(payload.get("error", f"HTTP {resp.status_code}"),
+                              status=resp.status_code)
         return payload.get("data")
 
     # -- auth --------------------------------------------------------------
@@ -370,6 +378,28 @@ class Client:
 
     def propose_knobs(self, advisor_id: str) -> Dict[str, Any]:
         return self._call("POST", f"/advisors/{advisor_id}/propose")["knobs"]
+
+    def propose_knobs_batch(self, advisor_id: str,
+                            k: int) -> List[Dict[str, Any]]:
+        """K concurrent knob proposals in one call (vectorized trial
+        execution: the worker trains the batch as one vmapped program).
+        Admins predating the batch route answer 404 — callers fall back
+        to K :meth:`propose_knobs` calls (RemoteAdvisorStore does this
+        automatically)."""
+        return self._call(
+            "POST", f"/advisors/{advisor_id}/propose_batch",
+            {"k": int(k)})["knobs_list"]
+
+    def feedback_knobs_batch(
+        self, advisor_id: str,
+        items: List[Tuple[Dict[str, Any], float]],
+    ) -> int:
+        """Record a batch of (knobs, score) observations; returns how
+        many were applied."""
+        return int(self._call(
+            "POST", f"/advisors/{advisor_id}/feedback_batch",
+            {"items": [{"knobs": kn, "score": float(s)}
+                       for kn, s in items]})["count"])
 
     def replay_advisor_feedback(self, advisor_id: str, items,
                                 infeasible=None) -> bool:

@@ -168,6 +168,19 @@ _DYNAMIC_PATHS = {
     #                                   awaiting retry (drop-oldest)
     # (RAFIKI_TRIAL_STALL_S lives in sdk/sandbox.py: the no-frame
     # deadline on sandbox children.)
+    # -- vectorized trial execution (docs/performance.md, "Vectorized
+    # trial execution"). Lazy like the other trial knobs:
+    #   RAFIKI_TRIAL_VMAP=1           0 = kill switch: never train a
+    #                                 population of proposals as one
+    #                                 vmapped program, even for templates
+    #                                 that advertise population_spec
+    #   RAFIKI_TRIAL_VMAP_K=4         proposals drained per vectorized
+    #                                 round (also settable per job via
+    #                                 budget TRIAL_VMAP_K; capped by the
+    #                                 template's PopulationSpec
+    #                                 max_members); <2 disables in effect
+    "TRIAL_VMAP": lambda: os.environ.get("RAFIKI_TRIAL_VMAP", "1") != "0",
+    "TRIAL_VMAP_K": lambda: _env_int("RAFIKI_TRIAL_VMAP_K", 4),
     "TRIAL_RETRY_MAX": lambda: _env_int("RAFIKI_TRIAL_RETRY_MAX", 2),
     "TRIAL_RETRY_BACKOFF_S": lambda: _env_float(
         "RAFIKI_TRIAL_RETRY_BACKOFF_S", 0.5),

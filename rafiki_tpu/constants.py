@@ -40,6 +40,13 @@ class BudgetType:
     # the score its partial training earned — a runaway knob draw cannot
     # hold an executor forever.
     TRIAL_TIMEOUT_S = "TRIAL_TIMEOUT_S"
+    # Vectorized trial execution (new capability): proposals drained per
+    # vmapped training round for templates advertising a PopulationSpec
+    # — overrides RAFIKI_TRIAL_VMAP_K for this job. The worker trains
+    # each shape-compatible bucket of that many proposals as ONE
+    # PopulationTrainer program on its chip grant (worker/train.py;
+    # docs/performance.md "Vectorized trial execution").
+    TRIAL_VMAP_K = "TRIAL_VMAP_K"
     # Chips granted to EACH inference worker (new capability): >1 gives a
     # serving executor a multi-chip mesh, so a model too big (or too slow)
     # for one chip serves its pjit'd predict sharded over ICI — the serving

@@ -33,7 +33,9 @@ from rafiki_tpu.sdk.population import PopulationTrainer  # noqa: F401
 from rafiki_tpu.sdk.model import (  # noqa: F401
     BaseModel,
     InvalidModelClassError,
+    PopulationSpec,
     load_model_class,
+    population_capability,
     test_model_class,
     validate_model_dependencies,
 )

@@ -540,8 +540,31 @@ class DataParallelTrainer:
                             state: Any = None) -> Tuple[Any, Any, Any, int]:
         """Restore into the shapes of freshly-initialized (params,
         opt_state[, state]) — flax's from-target restore keeps optax's
-        NamedTuple state structure intact."""
+        NamedTuple state structure intact.
+
+        Restored param shapes are verified against the fit's own target
+        BEFORE anything reaches the device: flax takes the blob's array
+        shapes at face value, so a checkpoint written under a different
+        program — a population-stacked (K, ...) checkpoint left behind by
+        a crashed vmapped batch whose lead trial is now re-run scalar, or
+        an architecture-knob change — would otherwise restore "cleanly"
+        and die later as a cryptic shape error inside the jitted step
+        (classified USER, terminally erroring a perfectly good trial). A
+        mismatch is typed artifact corruption: fit()'s restore guard logs
+        it and starts fresh, the standard corrupt-checkpoint contract."""
+        from rafiki_tpu.sdk.artifact import ArtifactCorruptError
+
         restored = restore_checkpoint_host(path, params, opt_state, state)
+        got = [np.shape(x) for x in jax.tree.leaves(restored["params"])]
+        want = [np.shape(x) for x in jax.tree.leaves(params)]
+        if got != want:
+            raise ArtifactCorruptError(
+                path,
+                f"checkpoint param shapes {got[:4]}{'…' if len(got) > 4 else ''} "
+                f"do not match this trial's {want[:4]}"
+                f"{'…' if len(want) > 4 else ''} — written under a different "
+                f"program (population-stacked, or different architecture "
+                f"knobs); treating as corrupt (fresh start)")
         params = self.device_put_params(restored["params"])
         opt_state = jax.device_put(restored["opt_state"], self._repl)
         if state is not None:

@@ -43,6 +43,46 @@ class InvalidModelClassError(Exception):
     pass
 
 
+class PopulationSpec:
+    """Declares that a template can train a POPULATION of knob configs as
+    one vmapped XLA program (the trials/hour/chip lever — SURVEY §7.3,
+    ROADMAP item 3). Set as a class attribute::
+
+        class MyModel(BaseModel):
+            population_spec = PopulationSpec(dynamic_knobs=("learning_rate",))
+
+    ``dynamic_knobs`` names the knobs that may DIFFER across members of
+    one vmapped program — pure hyperparameters that ride the optimizer
+    state (lr/momentum/weight-decay through ``tunable_optimizer``).
+    Every other knob is treated as program-shaping (architecture, batch
+    size, epochs): the worker's shape-bucketing partitioner
+    (worker/vmap_partition.py) only stacks proposals whose remaining
+    knobs are identical, so members of one program always share one
+    compiled step.
+
+    ``max_members`` caps how many members the worker stacks into one
+    program — the per-chip memory heuristic (stacked params + opt state
+    scale linearly with K).
+
+    A template advertising a spec must also implement the three
+    population methods on :class:`BaseModel` (``train_population``,
+    ``evaluate_population``, ``dump_member_parameters``);
+    :func:`population_capability` refuses specs whose methods are still
+    the base stubs, so a half-wired template falls back to scalar trials
+    instead of crashing the worker."""
+
+    def __init__(self, dynamic_knobs, max_members: int = 8):
+        self.dynamic_knobs = tuple(dynamic_knobs)
+        if not self.dynamic_knobs:
+            raise ValueError(
+                "PopulationSpec needs at least one dynamic knob name")
+        self.max_members = max(int(max_members), 1)
+
+    def __repr__(self) -> str:
+        return (f"PopulationSpec(dynamic_knobs={self.dynamic_knobs!r}, "
+                f"max_members={self.max_members})")
+
+
 class BaseModel(abc.ABC):
     """Abstract contract every model template implements.
 
@@ -102,6 +142,39 @@ class BaseModel(abc.ABC):
     def destroy(self) -> None:
         """Release resources (default: no-op)."""
 
+    # -- vectorized trial execution (opt-in via ``population_spec``) -------
+
+    #: set to a :class:`PopulationSpec` to advertise that this template can
+    #: train a population of knob configs as ONE vmapped program; the train
+    #: worker then drains K advisor proposals per round and runs each
+    #: shape-compatible bucket through ``train_population`` instead of one
+    #: scalar trial per proposal (worker/train.py).
+    population_spec: Optional[PopulationSpec] = None
+
+    def train_population(self, dataset_uri: str,
+                         member_knobs: List[Dict[str, Any]]) -> None:
+        """Train every member of ``member_knobs`` simultaneously (one
+        vmapped program — see sdk/population.PopulationTrainer). The
+        instance was constructed with ``member_knobs[0]``; members differ
+        only in the spec's ``dynamic_knobs``. ``self.checkpoint_path``
+        checkpoints the STACKED pytrees, giving the whole batch the same
+        mid-trial resume guarantee as scalar trials."""
+        raise NotImplementedError
+
+    def evaluate_population(self, dataset_uri: str) -> List[float]:
+        """One score per member, in ``member_knobs`` order. A member whose
+        score comes back NaN/inf is failed INDIVIDUALLY by the worker
+        (typed INVALID_SCORE + infeasible feedback for that member only),
+        never the batch."""
+        raise NotImplementedError
+
+    def dump_member_parameters(self, member: int) -> Any:
+        """Member ``member``'s parameters in the SAME format
+        ``dump_parameters`` produces — each member becomes its own trial
+        row with its own params artifact, so serving deploys winners
+        exactly like scalar trials."""
+        raise NotImplementedError
+
     def ensemble_stack(self, models: List["BaseModel"]) -> Optional[Any]:
         """Optional fused-ensemble serving hook (budget ``ENSEMBLE_FUSED``).
 
@@ -116,6 +189,32 @@ class BaseModel(abc.ABC):
         fused worker then serves the group sequentially in-process.
         Default: None."""
         return None
+
+
+def population_capability(clazz: type) -> Optional[PopulationSpec]:
+    """The template's :class:`PopulationSpec` iff it is fully wired:
+    a spec instance AND all three population methods overridden. Anything
+    less returns None — the worker then runs scalar trials (automatic
+    fallback; the doctor's "vectorized trials" check surfaces the
+    silent-fallback case when population mode was explicitly asked for)."""
+    spec = getattr(clazz, "population_spec", None)
+    if spec is None:
+        return None
+    import logging
+
+    if not isinstance(spec, PopulationSpec):
+        logging.getLogger(__name__).warning(
+            "%s.population_spec is not a PopulationSpec (%s); ignoring — "
+            "trials run scalar", clazz.__name__, type(spec).__name__)
+        return None
+    for name in ("train_population", "evaluate_population",
+                 "dump_member_parameters"):
+        if getattr(clazz, name, None) is getattr(BaseModel, name):
+            logging.getLogger(__name__).warning(
+                "%s declares population_spec but does not override %s(); "
+                "ignoring — trials run scalar", clazz.__name__, name)
+            return None
+    return spec
 
 
 def load_model_class(

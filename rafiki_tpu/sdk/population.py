@@ -50,6 +50,20 @@ logger = logging.getLogger(__name__)
 
 LossFn = Callable[[Any, Any, jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
 
+#: process-local fit telemetry: how many times :meth:`PopulationTrainer.fit`
+#: ran and with how many stacked members each time (bounded tail). The
+#: vectorized-trial tests assert the tentpole's core claim against this —
+#: K distinct knob vectors trained by ONE fit call — and the bench's
+#: trials_vectorized phase reads it to prove the vmapped path actually
+#: engaged rather than silently falling back to scalar trials.
+FIT_STATS: Dict[str, Any] = {"fit_calls": 0, "member_counts": []}
+_FIT_STATS_TAIL = 64
+
+
+def reset_fit_stats() -> None:
+    FIT_STATS["fit_calls"] = 0
+    FIT_STATS["member_counts"] = []
+
 
 class PopulationTrainer:
     """Train a population of K members that differ only in dynamic
@@ -192,6 +206,9 @@ class PopulationTrainer:
         from rafiki_tpu.sdk.jax_backend import DataParallelTrainer
         from rafiki_tpu.sdk.log import StopTrialEarly
 
+        FIT_STATS["fit_calls"] += 1
+        FIT_STATS["member_counts"].append(self.n_members(params))
+        del FIT_STATS["member_counts"][:-_FIT_STATS_TAIL]
         n = len(data[0])
         fit_cap = (n // self.n_data) * self.n_data
         if fit_cap == 0:
@@ -263,10 +280,29 @@ class PopulationTrainer:
     def _restore_checkpoint(self, path: str, params: Any, opt_state: Any):
         """Restore stacked (params, opt_state) through the shared on-disk
         format interpreter (jax_backend.restore_checkpoint_host) — one
-        checkpoint shape platform-wide."""
+        checkpoint shape platform-wide.
+
+        The member count is verified against the fit's own stack BEFORE
+        anything reaches the device: flax's from-target restore takes the
+        blob's array shapes at face value, so a checkpoint written with a
+        different K would otherwise sail through here and die later as a
+        cryptic XLA reshape inside the epoch scan. A mismatch is typed
+        artifact corruption — fit()'s restore guard then logs it and
+        starts fresh, the same contract as a failed checksum."""
+        from rafiki_tpu.sdk.artifact import ArtifactCorruptError
         from rafiki_tpu.sdk.jax_backend import restore_checkpoint_host
 
         restored = restore_checkpoint_host(path, params, opt_state)
+        expect = self.n_members(params)
+        leaves = jax.tree.leaves(restored["params"])
+        got = int(np.shape(leaves[0])[0]) if leaves else 0
+        if got != expect:
+            raise ArtifactCorruptError(
+                path,
+                f"population checkpoint stacks {got} member(s) but this "
+                f"fit stacks {expect} — resuming with a different "
+                f"population size is not a resume; treating the checkpoint "
+                f"as corrupt (fresh start)")
         params = jax.device_put(restored["params"], self._repl)
         opt_state = jax.device_put(restored["opt_state"], self._repl)
         return params, opt_state, None, int(restored["epoch"])

@@ -18,6 +18,16 @@ TPU-first extra riding the DataParallelTrainer predict seam
 ``RAFIKI_SERVE_INT8=1`` for any SDK-trainer template. Note the env
 switch also applies to trial-time ``evaluate`` — deliberate: trials are
 then SELECTED by the accuracy they will actually serve.
+
+RETIRED FROM THE DEFAULTS (r8): the official bench measured
+``int8_unloaded_speedup = 0.805`` — a slowdown — on the bench CNN's
+matmul shapes (VERDICT r5): those kernels are small enough that the
+in-graph dequantize costs more than the weight-stream saving returns.
+The numerics stay correct and test-bounded, and the path remains
+available for genuinely weight-bandwidth-bound models (large kernels,
+batch ~1) — but ``doctor`` WARNs while ``RAFIKI_SERVE_INT8=1`` is set
+and the bench phase is opt-in (``RAFIKI_BENCH_INT8=1``). See
+docs/performance.md for the full account.
 """
 
 from __future__ import annotations

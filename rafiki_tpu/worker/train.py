@@ -38,8 +38,9 @@ from rafiki_tpu.placement.manager import ServiceContext
 from rafiki_tpu.sdk.jax_backend import enable_persistent_compile_cache
 from rafiki_tpu.sdk.artifact import write_artifact
 from rafiki_tpu.sdk.log import ModelLogger, StopTrialEarly
-from rafiki_tpu.sdk.model import load_model_class
+from rafiki_tpu.sdk.model import load_model_class, population_capability
 from rafiki_tpu.sdk.params import dump_params
+from rafiki_tpu.worker.vmap_partition import partition_for_vmap
 from rafiki_tpu.utils import chaos
 from rafiki_tpu.utils.trace import Tracer, jax_profile
 from rafiki_tpu.worker import faults
@@ -92,6 +93,10 @@ class TrainWorker:
         self._quarantine: set = set()
         self._user_fault_sigs: Dict[str, int] = {}
         self._fault_streak = 0
+        # vectorized trial execution (set per job in _loop): the
+        # template's PopulationSpec when every gate passed, else None
+        self._pop_spec = None
+        self._vmap_k = 1
 
     def start(self, ctx: ServiceContext) -> None:
         """The trial loop; returns when budget is reached or stop is set."""
@@ -152,6 +157,35 @@ class TrainWorker:
         self._model_bytes = model["model_file_bytes"]
         self._model_class = model["model_class"]
         knob_config = clazz.get_knob_config()
+        # Vectorized trial execution (vmap-over-knobs): when the template
+        # advertises a PopulationSpec, drain K proposals per round and
+        # train each shape-compatible bucket as ONE PopulationTrainer
+        # program on this executor's chip grant — K trials for roughly
+        # one trial's dispatch/overhead cost on underutilized chips.
+        # Every gate below degrades to the unchanged scalar path.
+        self._pop_spec = population_capability(clazz)
+        vk = budget.get(BudgetType.TRIAL_VMAP_K)
+        self._vmap_k = int(vk) if vk is not None else int(config.TRIAL_VMAP_K)
+        if self._pop_spec is not None:
+            from rafiki_tpu.sdk.sandbox import sandbox_enabled
+
+            if not config.TRIAL_VMAP:
+                self._pop_spec = None  # operator kill switch
+            elif self._vmap_k < 2:
+                self._pop_spec = None  # a population of one is a trial
+            elif sandbox_enabled():
+                # the sandbox runs one restricted child per trial; a
+                # population shares one process by construction — scalar
+                # until a population-aware sandbox child exists
+                logger.info("RAFIKI_SANDBOX=1: vectorized trial execution "
+                            "disabled; trials run scalar in children")
+                self._pop_spec = None
+            elif not set(self._pop_spec.dynamic_knobs) <= set(knob_config):
+                logger.warning(
+                    "population_spec dynamic knobs %s are not all in the "
+                    "knob config %s; trials run scalar",
+                    self._pop_spec.dynamic_knobs, sorted(knob_config))
+                self._pop_spec = None
         advisor_id = self._advisors.create_advisor(
             knob_config, advisor_id=self._sub_id
         )
@@ -242,6 +276,18 @@ class TrainWorker:
             # check-then-create let N parallel workers overshoot the trial
             # budget by up to N-1
             over_time = deadline is not None and time.time() >= deadline
+            if self._pop_spec is not None and not over_time:
+                verdict = self._population_round(
+                    ctx, clazz, job, model, advisor_id, max_trials)
+                if verdict == "stop":
+                    return
+                if verdict == "budget":
+                    self._send_event(EVENT_BUDGET_REACHED, {
+                        "sub_train_job_id": self._sub_id,
+                        "train_job_id": job["id"],
+                    })
+                    return
+                continue
             trial = None
             tracer = Tracer("pending")
             if not over_time:
@@ -437,7 +483,7 @@ class TrainWorker:
         })
         return False
 
-    def _propose_clear_of_quarantine(self, advisor_id: str):
+    def _propose_clear_of_quarantine(self, advisor_id: str, knobs=None):
         """Propose knobs, re-proposing (bounded) while the draw matches
         a quarantined poison signature. Each rejection ALSO feeds the
         advisor an infeasible observation at the rejected point, so the
@@ -445,8 +491,11 @@ class TrainWorker:
         the loop converges instead of fighting the optimizer forever.
         After RAFIKI_TRIAL_REPROPOSE_MAX rejections the last draw is
         accepted (with a warning): a mostly-quarantined search space
-        must degrade to slow progress, never to a spinning worker."""
-        knobs = self._advisors.propose(advisor_id)
+        must degrade to slow progress, never to a spinning worker.
+        ``knobs`` seeds the loop with an already-made draw (the batch
+        path filters each of its K draws through the same rule)."""
+        if knobs is None:
+            knobs = self._advisors.propose(advisor_id)
         if not self._quarantine:
             return knobs
         limit = max(int(config.TRIAL_REPROPOSE_MAX), 0)
@@ -467,6 +516,346 @@ class TrainWorker:
             "(RAFIKI_TRIAL_REPROPOSE_MAX); accepting it — most of the "
             "search space may be poisoned", limit)
         return knobs
+
+    # -- vectorized trial execution (vmap-over-knobs) ----------------------
+
+    def _propose_batch_clear_of_quarantine(self, advisor_id: str, k: int):
+        """Drain K proposals in one advisor call (the GP spreads them via
+        constant-liar fantasies), then run each draw through the same
+        quarantine filter the scalar path uses. Advisor stores predating
+        propose_batch fall back to K single proposals."""
+        draws = None
+        fn = getattr(self._advisors, "propose_batch", None)
+        if fn is not None:
+            try:
+                draws = fn(advisor_id, k)
+            except Exception:
+                logger.warning("propose_batch failed; falling back to "
+                               "single proposals", exc_info=True)
+        if draws is None:
+            draws = [self._advisors.propose(advisor_id) for _ in range(k)]
+        if not self._quarantine:
+            return draws
+        return [self._propose_clear_of_quarantine(advisor_id, knobs=d)
+                for d in draws]
+
+    def _population_round(self, ctx, clazz, job, model,
+                          advisor_id: str, max_trials: int) -> str:
+        """One vectorized round: drain up to K proposals, bucket them by
+        program shape (worker/vmap_partition.py), atomically reserve a
+        trial ROW per member (the PR-5 budget contract is untouched —
+        reserve_trial's count+insert transaction is still the only
+        authority, so MODEL_TRIAL_COUNT=N yields exactly N rows no
+        matter how K divides N), and train each bucket as one
+        PopulationTrainer program. Singleton buckets run the scalar
+        path. Returns "stop" (worker exiting), "budget" (caller sends
+        the budget-reached event), or "ok" (next round)."""
+        try:
+            self._retry_pending_feedback(advisor_id)
+        except Exception:
+            logger.warning("pending feedback retry failed; proposing "
+                           "without it", exc_info=True)
+        # clamp the drain by the remaining budget (best-effort count; the
+        # per-member reserve below stays authoritative) so a nearly-spent
+        # job doesn't strand K-1 never-scored constant-liar fantasies in
+        # the shared GP
+        live = sum(1 for t in self._db.get_trials_of_sub_train_job(
+            self._sub_id) if t["status"] != TrialStatus.TERMINATED)
+        remaining = max_trials - live
+        if remaining <= 0:
+            return "budget"
+        k = min(self._vmap_k, remaining,
+                max(int(self._pop_spec.max_members), 1))
+        draws = self._propose_batch_clear_of_quarantine(
+            advisor_id, max(k, 1))
+        buckets = partition_for_vmap(draws, self._pop_spec.dynamic_knobs,
+                                     self._pop_spec.max_members)
+        budget_out = False
+        for bucket in buckets:
+            if ctx.stopping:
+                return "stop"
+            members = []
+            for knobs in bucket:
+                trial = self._db.reserve_trial(
+                    self._sub_id, model["id"], knobs,
+                    worker_id=ctx.service_id, max_trials=max_trials)
+                if trial is None:
+                    budget_out = True
+                    break
+                members.append((trial["id"], knobs))
+            if members:
+                if len(members) == 1:
+                    ok = self._execute_trial(ctx, clazz, job, advisor_id,
+                                             members[0][0], members[0][1])
+                else:
+                    ok = self._execute_population_trial(
+                        ctx, clazz, job, advisor_id, members)
+                if not ok:
+                    return "stop"
+            if budget_out:
+                return "budget"
+        return "ok"
+
+    def _execute_population_trial(self, ctx, clazz, job, advisor_id: str,
+                                  members) -> bool:
+        """Run one vmapped batch end to end: train all members as one
+        program, evaluate all members, then settle each member's trial
+        row INDIVIDUALLY — per-member scores feed the advisor one by
+        one, a member whose score fails validation becomes a typed
+        INVALID_SCORE fault + infeasible observation for that member
+        only (never a batch abort), and ASHA rungs are reported per
+        member. A batch-LEVEL failure (template crash, OOM, chaos)
+        falls back to scalar execution of every member, so the full
+        fault taxonomy — same-id infra retries included — applies
+        exactly as if the batch had never been tried. Returns False
+        when the worker is exiting its loop."""
+        lead_id = members[0][0]
+        trial_logger = ModelLogger()
+        # the shared training log lands on the LEAD member's row; sibling
+        # rows still carry their own knobs/score/params/fault columns
+        trial_logger.set_sink(
+            lambda line, _tid=lead_id: self._db.add_trial_log(_tid, line))
+        tracer = Tracer(lead_id)
+        self._install_population_stop_check(trial_logger, advisor_id,
+                                            [tid for tid, _ in members])
+        try:
+            self._chaos_trial(lead_id)
+            results = self._run_population_trial(
+                clazz, members, job, trial_logger, tracer)
+        except Exception:
+            if ctx.stopping:
+                for tid, _ in members:
+                    self._db.mark_trial_as_terminated(tid)
+                    self._cleanup_ckpt(tid)
+                return False
+            logger.warning(
+                "population batch %s failed; re-running its %d members "
+                "as scalar trials (same ids, full fault taxonomy):\n%s",
+                lead_id, len(members), traceback.format_exc())
+            self._cleanup_ckpt(lead_id)
+            for idx, (tid, knobs) in enumerate(members):
+                if ctx.stopping:
+                    # never-started siblings must not stay RUNNING
+                    self._terminate_members(members[idx:])
+                    return False
+                if not self._execute_trial(ctx, clazz, job, advisor_id,
+                                           tid, knobs):
+                    self._terminate_members(members[idx + 1:])
+                    return False
+            return not ctx.stopping
+        # settle COMPLETED members first (pure DB writes): a blocking
+        # scalar re-run or a fail-fast verdict below must never discard a
+        # sibling's already-finished, already-persisted work
+        for tid, knobs, score, params_path, err in results:
+            if err is None:
+                # same ordering contract as the scalar path: feedback
+                # BEFORE mark-complete, so a restarting sibling's
+                # empty-only replay can't double-feed
+                self._feedback_best_effort(advisor_id, knobs, score)
+                self._db.mark_trial_as_complete(tid, score, params_path)
+                self._fault_streak = 0
+                faults.record_counter(self._sub_id,
+                                      "consecutive_user_faults", 0,
+                                      absolute=True)
+        faulted = [r for r in results if r[4] is not None]
+        for idx, (tid, knobs, _, _, err) in enumerate(faulted):
+            kind, detail = faults.classify_failure(err)
+            if kind in faults.RETRYABLE_KINDS:
+                # a platform fault on one member (params persist I/O)
+                # is not a verdict on its knobs OR its siblings:
+                # re-run just this member scalar under the same trial
+                # id — the full taxonomy applies (same-id infra
+                # retries, no budget burn)
+                logger.warning(
+                    "population member %s hit retryable %s fault; "
+                    "re-running it as a scalar trial:\n%s",
+                    tid, kind, detail)
+                if not self._execute_trial(ctx, clazz, job,
+                                           advisor_id, tid, knobs):
+                    self._terminate_members(
+                        [(t, k) for t, k, _, _, _ in faulted[idx + 1:]])
+                    return False
+                continue
+            logger.error("population member %s fault %s:\n%s",
+                         tid, kind, detail)
+            self._db.mark_trial_as_errored(tid, kind, detail)
+            faults.record_fault(self._sub_id, kind)
+            self._feedback_infeasible_best_effort(
+                advisor_id, knobs, kind, trial_id=tid)
+            if not self._note_user_fault(job, tid, knobs, kind):
+                self._terminate_members(
+                    [(t, k) for t, k, _, _, _ in faulted[idx + 1:]])
+                return False  # job fail-fast tripped
+        return not ctx.stopping
+
+    def _terminate_members(self, members) -> None:
+        """Mark a batch's not-yet-settled members TERMINATED when the
+        worker exits mid-settle (stop signal or job fail-fast): a
+        reserved row must never outlive its batch as a forever-RUNNING
+        orphan."""
+        for tid, _ in members:
+            try:
+                self._db.mark_trial_as_terminated(tid)
+                self._cleanup_ckpt(tid)
+            except Exception:
+                logger.warning("failed to terminate batch member %s",
+                               tid, exc_info=True)
+
+    def _run_population_trial(self, clazz, members, job,
+                              trial_logger: ModelLogger,
+                              tracer: Optional[Tracer] = None) -> list:
+        """The vmapped analogue of _run_trial: one model instance
+        (constructed with the lead member's knobs — all members share
+        the program-shaping knobs by bucketing), one train_population
+        call, one evaluate_population call, then per-member score
+        validation and params persistence. Returns
+        ``[(trial_id, knobs, score, params_path, error)]`` with exactly
+        one entry per member; ``error`` is the member's typed fault (an
+        InvalidScoreError) and the other fields None when set. The
+        stacked checkpoint rides the lead member's .ckpt slot through
+        the PR-4 artifact frame, so a restarted batch resumes mid-trial
+        like a scalar trial would (a resume with a different K is typed
+        artifact corruption -> fresh start)."""
+        lead_id = members[0][0]
+        tracer = tracer or Tracer(lead_id)
+        member_knobs = [dict(knobs) for _, knobs in members]
+        model = clazz(**member_knobs[0])
+        model.logger = trial_logger
+        os.makedirs(self._params_dir, exist_ok=True)
+        model.checkpoint_path = os.path.join(
+            self._params_dir, f"{lead_id}.ckpt")
+        try:
+            try:
+                with jax_profile(), tracer.span("train"):
+                    model.train_population(job["train_dataset_uri"],
+                                           member_knobs)
+            except StopTrialEarly:
+                trial_logger.log(
+                    "population batch stopped early by scheduler")
+            trial_logger.set_stop_check(None)
+            with tracer.span("evaluate"):
+                raw_scores = model.evaluate_population(
+                    job["test_dataset_uri"])
+            if raw_scores is None or len(raw_scores) != len(members):
+                # a template answering the wrong number of scores broke the
+                # population contract: fail the BATCH (caller falls back
+                # to scalar, where the taxonomy judges each member alone)
+                raise faults.TrialFault(
+                    f"evaluate_population returned "
+                    f"{0 if raw_scores is None else len(raw_scores)} "
+                    f"score(s) for {len(members)} members",
+                    kind=FaultKind.USER)
+            results = []
+            with tracer.span("persist_params"):
+                for i, (tid, knobs) in enumerate(members):
+                    try:
+                        score = validate_score(raw_scores[i])
+                    except faults.TrialFault as e:
+                        # per-member fault isolation: THIS member is
+                        # infeasible; its siblings' scores stand
+                        results.append((tid, knobs, None, None, e))
+                        continue
+                    params_path = os.path.join(
+                        self._params_dir, f"{tid}.params")
+                    try:
+                        # dump + write both per-member: a template whose
+                        # dump_member_parameters raises for ONE member
+                        # (user code), or a disk blip on one artifact
+                        # (platform), fails that member alone — siblings
+                        # keep their completed, persisted work. The
+                        # caller classifies: retryable kinds re-run the
+                        # member scalar (same id, no budget burn),
+                        # user-class kinds error it with infeasible
+                        # feedback.
+                        params_bytes = dump_params(
+                            model.dump_member_parameters(i))
+                        write_artifact(params_path, params_bytes)
+                    except OSError as e:
+                        results.append((tid, knobs, None, None,
+                                        faults.TrialFault(
+                                            f"params persist failed: {e}",
+                                            kind=FaultKind.INFRA)))
+                        continue
+                    except Exception as e:
+                        results.append((tid, knobs, None, None, e))
+                        continue
+                    results.append((tid, knobs, score, params_path, None))
+            self._cleanup_ckpt(lead_id)
+            return results
+        finally:
+            try:
+                model.destroy()
+            finally:
+                try:
+                    tracer.save()
+                    trial_logger.log(
+                        "population batch phase breakdown",
+                        members=float(len(members)), **{
+                            f"trace_{k}_s": round(v, 4)
+                            for k, v in tracer.summary().items()
+                        })
+                except Exception:
+                    logger.exception("failed to persist batch trace")
+
+    def _install_population_stop_check(self, trial_logger: ModelLogger,
+                                       advisor_id: str,
+                                       member_ids: list) -> None:
+        """The batch variant of _install_stop_check. Wall-clock caps
+        (TRIAL_TIMEOUT_S, the job TIME_HOURS deadline) act on the whole
+        batch — one program, one clock. ASHA rung accounting stays PER
+        MEMBER: each member's ``member{k}_loss`` (PopulationTrainer.fit
+        logs one per epoch) is reported under that member's own trial
+        id, and the batch stops early only when EVERY member's verdict
+        says stop — a population is competitive while any member is.
+        Templates that log only the population-mean ``loss`` degrade to
+        reporting that mean under each member's id (rung rows stay per
+        trial, the signal is just shared)."""
+        early_stop = getattr(self, "_early_stop", False)
+        report = getattr(self._advisors, "report_rung", None)
+        if early_stop and report is None:
+            logger.warning("EARLY_STOP budget set but the advisor store "
+                           "has no report_rung; rung checks disabled")
+        job_deadline = getattr(self, "_job_deadline", None)
+        trial_timeout = getattr(self, "_trial_timeout_s", None)
+        if not ((early_stop and report is not None)
+                or job_deadline is not None or trial_timeout is not None):
+            return
+        batch_start = time.time()
+
+        def check(metrics: Dict[str, Any]) -> bool:
+            now = time.time()
+            if trial_timeout is not None \
+                    and now - batch_start > trial_timeout:
+                logger.info("population batch %s hit TRIAL_TIMEOUT_S=%.0f; "
+                            "stopping", member_ids[0], trial_timeout)
+                return True
+            if job_deadline is not None and now >= job_deadline:
+                logger.info("population batch %s crossed the job "
+                            "TIME_HOURS deadline; stopping", member_ids[0])
+                return True
+            if not (early_stop and report is not None
+                    and "epoch" in metrics):
+                return False
+            rung = int(metrics["epoch"]) + 1
+            keep_any, reported = False, False
+            for i, tid in enumerate(member_ids):
+                value = metrics.get(f"member{i}_loss",
+                                    metrics.get("loss"))
+                if value is None:
+                    continue
+                reported = True
+                try:
+                    if report(advisor_id, tid, rung, value,
+                              min_resource=self._asha_min,
+                              eta=self._asha_eta):
+                        keep_any = True
+                except Exception:
+                    logger.warning("ASHA rung report failed for member "
+                                   "%s; keeping it", tid, exc_info=True)
+                    keep_any = True
+            return reported and not keep_any
+
+        trial_logger.set_stop_check(check)
 
     def _feedback_best_effort(self, advisor_id: str, knobs, score) -> None:
         """Feed a trial score to the advisor, never letting an advisor

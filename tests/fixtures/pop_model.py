@@ -1,0 +1,135 @@
+"""A fast real-JAX population-capable template — the vectorized-trial
+system-test workhorse. Tiny linear softmax classifier trained through the
+SDK's PopulationTrainer, so an end-to-end train job on CPU proves the
+actual tentpole mechanics (K knob vectors in ONE vmapped fit, per-member
+scores/params) in seconds.
+
+Both the scalar and the population path run through the same
+PopulationTrainer (the scalar path is a population of one), so
+``sdk.population.FIT_STATS["member_counts"]`` records exactly how the
+worker batched a job — e.g. ``[2, 2, 1]`` for MODEL_TRIAL_COUNT=5 at
+K=2, the shape the tier-1 acceptance test asserts.
+
+Chaos hook: when the file named by ``RAFIKI_POPFIX_NAN_FILE`` exists,
+``evaluate_population`` consumes it (unlink) and reports NaN for member
+0 of that one batch — the deterministic one-member-faults drill.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rafiki_tpu.sdk import (
+    BaseModel,
+    FixedKnob,
+    FloatKnob,
+    PopulationSpec,
+    PopulationTrainer,
+    cached_trainer,
+    softmax_classifier_loss,
+    tunable_optimizer,
+)
+
+_DIM, _CLASSES = 8, 3
+
+
+def _load(uri):
+    with np.load(uri) as z:
+        return z["x"].astype(np.float32), z["y"].astype(np.int32)
+
+
+def _apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _init(rng):
+    return {"w": 0.01 * jax.random.normal(rng, (_DIM, _CLASSES)),
+            "b": jnp.zeros((_CLASSES,))}
+
+
+class PopFixtureModel(BaseModel):
+    dependencies = {"numpy": None}
+
+    population_spec = PopulationSpec(dynamic_knobs=("lr",), max_members=8)
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "lr": FloatKnob(1e-3, 1e-1, is_exp=True),
+            "width": FixedKnob(_DIM),
+            "fixed_knob": FixedKnob("fixed"),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._trainer = None
+        self._pop_params = None
+        self._params = None  # loaded single-member params (serving)
+
+    def _pop_trainer(self, n_members):
+        return cached_trainer(("PopFixtureModel", n_members),
+                              lambda: PopulationTrainer(
+            softmax_classifier_loss(_apply),
+            tunable_optimizer(optax.sgd, learning_rate=0.05),
+            predict_fn=lambda p, x: jax.nn.softmax(_apply(p, x), axis=-1),
+        ))
+
+    def _fit(self, dataset_uri, member_knobs):
+        x, y = _load(dataset_uri)
+        lrs = [float(k["lr"]) for k in member_knobs]
+        self._trainer = self._pop_trainer(len(lrs))
+        params, opt_state = self._trainer.init(
+            _init, {"learning_rate": lrs}, seed=0)
+        params, _ = self._trainer.fit(
+            params, opt_state, (x, y), epochs=1, batch_size=32,
+            log=self.logger.log, checkpoint_path=self.checkpoint_path)
+        self._pop_params = params
+
+    def _member_scores(self, dataset_uri):
+        x, y = _load(dataset_uri)
+        return [float(s) for s in self._trainer.member_scores(
+            self._pop_params, x, y)]
+
+    # -- scalar contract (a population of one) -----------------------------
+
+    def train(self, dataset_uri):
+        self._fit(dataset_uri, [self._knobs])
+
+    def evaluate(self, dataset_uri):
+        return self._member_scores(dataset_uri)[0]
+
+    # -- population contract -----------------------------------------------
+
+    def train_population(self, dataset_uri, member_knobs):
+        self._fit(dataset_uri, member_knobs)
+
+    def evaluate_population(self, dataset_uri):
+        scores = self._member_scores(dataset_uri)
+        sentinel = os.environ.get("RAFIKI_POPFIX_NAN_FILE")
+        if sentinel and os.path.exists(sentinel):
+            os.unlink(sentinel)  # consume: exactly one member ever faults
+            scores[0] = float("nan")
+        return scores
+
+    def dump_member_parameters(self, member):
+        return jax.tree.map(
+            np.asarray,
+            self._trainer.member_params(self._pop_params, member))
+
+    # -- shared tail of the contract ---------------------------------------
+
+    def dump_parameters(self):
+        return self.dump_member_parameters(0)
+
+    def load_parameters(self, params):
+        self._params = {k: np.asarray(v) for k, v in params.items()}
+
+    def predict(self, queries):
+        x = np.asarray(queries, np.float32)
+        logits = x @ self._params["w"] + self._params["b"]
+        e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        return (e / e.sum(axis=-1, keepdims=True)).tolist()
