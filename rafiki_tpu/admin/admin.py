@@ -16,6 +16,7 @@ REST use are the same code path.
 from __future__ import annotations
 
 import collections
+import json
 import logging
 import os
 import threading
@@ -311,6 +312,18 @@ class Admin:
         # reference model/model.py:244-273)
         from rafiki_tpu.sdk.deps import install_enabled
 
+        # static verification FIRST (analysis/template.py): AST passes
+        # over the uploaded source — the platform catches a bad template
+        # HERE, not after it has burned trial budget and chip-hours, and
+        # at enforce a hostile template (sandbox-forbidden imports) is
+        # rejected BEFORE load_model_class executes its module top level
+        # in this process. enforce rejects on error findings (typed
+        # ModelVerificationError -> 400 at the door); warn persists
+        # findings on the row and logs; off skips (doctor WARNs while
+        # jobs are live). With dependencies=None the verifier reads the
+        # class's literal ``dependencies`` attribute statically.
+        report = self._verify_template(
+            model_file_bytes, model_class, dependencies, enforce=True)
         clazz = load_model_class(model_file_bytes, model_class)
         missing = validate_model_dependencies(clazz)
         if missing and not install_enabled():
@@ -319,6 +332,8 @@ class Admin:
                 f"(set RAFIKI_INSTALL_DEPS=1 to let workers provision them)"
             )
         serialize_knob_config(clazz.get_knob_config())
+        effective_deps = dependencies or dict(
+            getattr(clazz, "dependencies", {}) or {})
         if self.db.get_model_by_name(user_id, name) is not None:
             raise InvalidRequestError(f"Model {name} already exists for user")
         model = self.db.create_model(
@@ -327,10 +342,50 @@ class Admin:
             task,
             model_file_bytes,
             model_class,
-            dependencies or dict(getattr(clazz, "dependencies", {}) or {}),
+            effective_deps,
             access_right,
+            verification=json.dumps(report.to_dict()) if report else None,
         )
         return self._model_view(model)
+
+    @staticmethod
+    def _verify_template(model_file_bytes: bytes, model_class: str,
+                         dependencies: Optional[Dict[str, Optional[str]]],
+                         enforce: bool):
+        """Run the template verifier under the RAFIKI_VERIFY_TEMPLATES
+        mode; returns the report (None when mode=off). ``enforce=False``
+        is the dry-run path (verify_model) — report only, never raise."""
+        from rafiki_tpu import analysis
+
+        mode = analysis.verify_mode()
+        if mode == "off":
+            return None
+        report = analysis.verify_template_bytes(
+            model_file_bytes, model_class, dependencies)
+        if report.findings:
+            logger.warning(
+                "template %s static verification: %s", model_class,
+                "; ".join(str(f) for f in report.findings[:10]))
+        if enforce and mode == "enforce" and not report.ok:
+            raise analysis.ModelVerificationError(report)
+        return report
+
+    def verify_model(
+        self,
+        model_file_bytes: bytes,
+        model_class: str,
+        dependencies: Optional[Dict[str, Optional[str]]] = None,
+    ) -> Dict:
+        """Dry-run the template verifier (POST /models/verify): the full
+        report as JSON, no model row created, nothing rejected — the
+        pre-upload loop clients iterate against. Runs even when
+        RAFIKI_VERIFY_TEMPLATES=off (an explicit dry-run request is an
+        explicit request)."""
+        from rafiki_tpu import analysis
+
+        report = analysis.verify_template_bytes(
+            model_file_bytes, model_class, dependencies)
+        return {"mode": analysis.verify_mode(), **report.to_dict()}
 
     def get_models(
         self, user_id: str, task: Optional[str] = None
@@ -389,6 +444,15 @@ class Admin:
 
     @staticmethod
     def _model_view(model: Dict) -> Dict:
+        # verification rides the row as a JSON blob (db migration r9);
+        # rows from before the verifier (or uploaded under =off) carry
+        # None — doctor's "static analysis" check lists those
+        verification = model.get("verification")
+        if isinstance(verification, str):
+            try:
+                verification = json.loads(verification)
+            except ValueError:
+                verification = None
         return {
             "id": model["id"],
             "user_id": model["user_id"],
@@ -397,6 +461,7 @@ class Admin:
             "model_class": model["model_class"],
             "dependencies": model["dependencies"],
             "access_right": model["access_right"],
+            "verification": verification,
         }
 
     # -- train jobs -------------------------------------------------------------

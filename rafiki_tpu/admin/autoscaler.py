@@ -68,9 +68,12 @@ class Autoscaler:
         # {job_id: {"history": deque[(ts, shed_delta, backlog)],
         #           "last_shed_total": int, "last_action_ts": float,
         #           "last_action": str}}
-        self._jobs: Dict[str, Dict[str, Any]] = {}
-        #: bounded decision log, newest last (fleet-health "autoscaler")
-        self.events: Deque[Dict[str, Any]] = collections.deque(maxlen=100)
+        self._jobs: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        #: bounded decision log, newest last (fleet-health "autoscaler");
+        #: append (tick thread) and snapshot (/fleet/health thread) race —
+        #: iterating a deque during an append raises RuntimeError
+        self.events: Deque[Dict[str, Any]] = (  # guarded-by: _lock
+            collections.deque(maxlen=100))
         from rafiki_tpu.utils.metrics import REGISTRY
 
         self._registry = REGISTRY
@@ -185,6 +188,7 @@ class Autoscaler:
         # -- sample signals ------------------------------------------------
         try:
             backlog = int(predictor.backlog_depth())
+        # lint: absorb(backlog sample is best-effort; 0 skips this tick)
         except Exception:
             backlog = 0
         # observable twin of the internal history: a bounded ring series

@@ -200,6 +200,13 @@ class AdminServer:
                 au["user_id"], _field(b, "name"), _field(b, "task"),
                 _b64_field(b, "model_file_base64"), _field(b, "model_class"),
                 b.get("dependencies"), b.get("access_right", "PRIVATE"))),
+            # static-analysis dry run (analysis/template.py): the full
+            # finding report, no model row created — the pre-upload loop
+            # (Client.verify_model / python -m rafiki_tpu.analysis)
+            r("POST", "/models/verify", _MODEL_DEVS,
+                lambda au, m, b, q: A.verify_model(
+                    _b64_field(b, "model_file_base64"),
+                    _field(b, "model_class"), b.get("dependencies"))),
             r("GET", "/models", _ANY, lambda au, m, b, q: A.get_models(
                 au["user_id"], q.get("task"))),
             r("GET", r"/models/(?P<name>[^/]+)", _ANY, lambda au, m, b, q:

@@ -271,6 +271,7 @@ class ControlPlaneRecovery:
                             f"live re-check of {sid[:8]}")
                     except RecoveryAborted:
                         raise
+                    # lint: absorb(cannot prove orphanhood after retries; leave the row alone)
                     except Exception:
                         continue  # cannot prove orphanhood: do nothing
                     if fresh is not None and fresh["status"] not in _TERMINAL:

@@ -849,6 +849,7 @@ class ServicesManager:
         while callable(depth_fn) and time.monotonic() < deadline:
             try:
                 depth = depth_fn()
+            # lint: absorb(a dead queue handle simply ends the drain wait)
             except Exception:
                 break
             if depth <= 0:
@@ -865,6 +866,7 @@ class ServicesManager:
             if callable(depth_fn):
                 try:
                     leftover = depth_fn()
+                # lint: absorb(final depth read is diagnostic only)
                 except Exception:
                     leftover = -1
                 if leftover:

@@ -1,0 +1,56 @@
+"""Static-analysis subsystem: two AST-based heads, zero untrusted-code
+execution (docs/static-analysis.md).
+
+Head 1 — **template verifier** (:mod:`.template`): a pass pipeline over
+uploaded model source, wired into ``Admin.create_model`` behind
+``RAFIKI_VERIFY_TEMPLATES=enforce|warn|off``, exposed as a dry run via
+``POST /models/verify`` / ``Client.verify_model``, and runnable locally
+as ``python -m rafiki_tpu.analysis template.py [ClassName]``.
+
+Head 2 — **framework self-lint** (:mod:`.framework`): the env-knob /
+broad-except / lock / HTTP-door disciplines PRs 1–8 established by
+convention, enforced over the whole package as a tier-1 test
+(tests/test_framework_lint.py).
+"""
+
+from rafiki_tpu.analysis.findings import (
+    CODES,
+    ERROR,
+    WARN,
+    Finding,
+    ModelVerificationError,
+    VerificationReport,
+)
+from rafiki_tpu.analysis.framework import lint_package
+from rafiki_tpu.analysis.template import (
+    static_population_capability,
+    verify_template_bytes,
+    verify_template_source,
+)
+
+__all__ = [
+    "CODES",
+    "ERROR",
+    "WARN",
+    "Finding",
+    "ModelVerificationError",
+    "VerificationReport",
+    "lint_package",
+    "static_population_capability",
+    "verify_template_bytes",
+    "verify_template_source",
+    "verify_mode",
+]
+
+
+def verify_mode() -> str:
+    """The active upload-verification mode: ``enforce`` (error findings
+    reject the upload with a typed ModelVerificationError), ``warn``
+    (findings are logged + persisted on the model row, upload proceeds),
+    or ``off`` (analysis skipped entirely; doctor WARNs when live jobs
+    exist). Unrecognized values fall back to ``enforce`` — a typo'd
+    knob must not silently disable the safety net."""
+    import os
+
+    mode = os.environ.get("RAFIKI_VERIFY_TEMPLATES", "enforce").lower()
+    return mode if mode in ("enforce", "warn", "off") else "enforce"

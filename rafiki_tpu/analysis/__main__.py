@@ -1,0 +1,63 @@
+"""CLI for pre-upload local use and CI.
+
+    python -m rafiki_tpu.analysis MODEL_FILE [CLASS_NAME] [--json]
+        Run the template verifier; exit 1 when it finds anything
+        (errors OR warnings — the local loop wants the full list).
+
+    python -m rafiki_tpu.analysis --self-lint [--json]
+        Run the framework self-lint over the installed rafiki_tpu
+        package; exit 1 on any finding (what tier-1 enforces).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from rafiki_tpu.analysis import lint_package, verify_template_source
+from rafiki_tpu.analysis.findings import Finding
+
+
+def _print_findings(findings: List[Finding], as_json: bool) -> None:
+    if as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(str(f))
+
+
+def main(argv: List[str]) -> int:
+    args = [a for a in argv if a != "--json"]
+    as_json = "--json" in argv
+    if not args or args[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if args and args[0] in ("-h", "--help") else 2
+    if args[0] == "--self-lint":
+        findings = lint_package()
+        _print_findings(findings, as_json)
+        if not as_json:
+            print(f"self-lint: {len(findings)} finding(s)")
+        return 1 if findings else 0
+    path = args[0]
+    class_name = args[1] if len(args) > 1 else None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        print(f"cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    report = verify_template_source(source, class_name, filename=path)
+    if as_json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        _print_findings(report.findings, as_json=False)
+        cap = ("population-capable"
+               if report.capabilities.get("population") else "scalar")
+        print(f"{path} [{report.class_name or '?'}]: {report.summary()} "
+              f"({cap})")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,449 @@
+"""Head 2 — the framework self-lint: invariants PRs 1–8 established by
+convention, now enforced as a tier-1 test (tests/test_framework_lint.py)
+over the whole ``rafiki_tpu`` package.
+
+Disciplines (annotation grammar in docs/static-analysis.md):
+
+- **env knobs** (FWK101-103): every constant ``RAFIKI_*`` name read via
+  ``os.environ`` / ``os.getenv`` must be declared in config.py (the
+  declaration point is config.py's own source — the ``ENV_KNOBS`` /
+  ``ENV_INTERNAL`` catalogs plus any knob config.py itself reads), and
+  operator-facing knobs must additionally appear in scripts/env.sh and
+  somewhere under docs/. ``ENV_INTERNAL`` names are platform plumbing
+  (worker bootstrap ids etc.) exempt from the operator catalogs.
+
+- **broad excepts** (FWK201): an ``except Exception`` (or bare
+  ``except``) handler must re-raise, log, or carry an explicit
+  ``# lint: absorb(reason)`` annotation on the ``except`` line (or the
+  line above) — silent absorption is allowed only where absorption IS
+  the contract, and then it must say so.
+
+- **locks** (FWK301/302): opt-in. A ``self.attr = ...`` assignment
+  annotated ``# guarded-by: _lock`` makes every other access of
+  ``self.attr`` in that class require a lexically-enclosing
+  ``with self._lock:`` — or the accessing method itself carries
+  ``# guarded-by: _lock`` on its ``def`` line (contract: callers hold
+  the lock), or the access line carries ``# lint: unguarded(reason)``.
+
+- **HTTP doors** (FWK401/402): in the three door modules, an except
+  clause naming a typed ``*Error`` must answer with an explicit 4xx/5xx
+  status (or re-raise), and a generic ``except Exception`` must never
+  interpolate the caught exception into the response body — internal
+  text stays in the server log.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from rafiki_tpu.analysis import astutil
+from rafiki_tpu.analysis.findings import ERROR, Finding
+
+#: modules whose except-clauses answer HTTP requests directly
+DOOR_MODULES = ("admin/http.py", "placement/agent.py",
+                "predictor/server.py")
+
+_ABSORB_RE = re.compile(r"lint:\s*absorb\s*\(")
+_UNGUARDED_RE = re.compile(r"lint:\s*unguarded\s*\(")
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+_LOG_METHOD_NAMES = {"debug", "info", "warning", "warn", "error",
+                     "exception", "critical", "log", "print_exc"}
+
+
+def package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+def lint_package(
+        root: Optional[str] = None,
+        env_sh_path: Optional[str] = None,
+        docs_dir: Optional[str] = None,
+) -> List[Finding]:
+    """Run every framework pass over the package tree; returns findings
+    sorted by (file, line). A clean tree returns []."""
+    root = root or package_root()
+    env_sh_path = env_sh_path or os.path.join(repo_root(), "scripts",
+                                              "env.sh")
+    docs_dir = docs_dir or os.path.join(repo_root(), "docs")
+    findings: List[Finding] = []
+    modules = _load_modules(root, findings)
+    findings.extend(_lint_env_knobs(root, modules, env_sh_path, docs_dir))
+    for rel, (tree, source, comments) in modules.items():
+        findings.extend(_lint_broad_excepts(rel, tree, comments))
+        findings.extend(_lint_locks(rel, tree, comments))
+        if any(rel.endswith(d) for d in DOOR_MODULES):
+            findings.extend(_lint_door(rel, tree))
+    findings.sort(key=lambda f: (f.file, f.line))
+    return findings
+
+
+def _load_modules(root: str, findings: List[Finding]
+                  ) -> Dict[str, Tuple[ast.Module, str, Dict[int, str]]]:
+    out: Dict[str, Tuple[ast.Module, str, Dict[int, str]]] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "web")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            try:
+                tree = ast.parse(source, filename=rel)
+            except SyntaxError as e:
+                findings.append(Finding(
+                    "TPL005", f"module does not parse: {e.msg}", ERROR,
+                    rel, int(e.lineno or 0)))
+                continue
+            out[rel] = (tree, source, astutil.comment_map(source))
+    return out
+
+
+# -- env-knob discipline ----------------------------------------------------
+
+def _env_reads(tree: ast.Module) -> List[Tuple[str, int]]:
+    """(name, lineno) for every constant-keyed os.environ/os.getenv
+    operation whose key starts with RAFIKI_ (reads AND writes — a knob
+    the platform forwards to children is still a knob)."""
+    reads: List[Tuple[str, int]] = []
+
+    def environ_chain(node: ast.AST) -> bool:
+        return (astutil.dotted_name(node) or "").endswith("os.environ") \
+            or (astutil.dotted_name(node) or "") == "environ"
+
+    for node in ast.walk(tree):
+        key: Optional[ast.AST] = None
+        if isinstance(node, ast.Subscript) and environ_chain(node.value):
+            key = node.slice
+        elif isinstance(node, ast.Call):
+            dotted = astutil.dotted_name(node.func) or ""
+            if dotted.endswith("os.getenv") or dotted == "getenv" \
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("get", "setdefault", "pop")
+                        and environ_chain(node.func.value)):
+                key = node.args[0] if node.args else None
+        if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                and key.value.startswith("RAFIKI_"):
+            reads.append((key.value, node.lineno))
+    return reads
+
+
+def _declared_in_config(config_source: str) -> Set[str]:
+    """Every RAFIKI_* string literal in config.py declares that knob —
+    the ENV_KNOBS/ENV_INTERNAL catalogs and config.py's own env reads
+    all count; comments do not (a declaration is data, not prose)."""
+    tree = ast.parse(config_source)
+    return {n.value for n in ast.walk(tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and n.value.startswith("RAFIKI_")}
+
+
+def _internal_knobs(config_source: str) -> Set[str]:
+    """Names listed in config.py's ENV_INTERNAL tuple — declared
+    plumbing exempt from the operator-facing env.sh/docs catalogs."""
+    tree = ast.parse(config_source)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "ENV_INTERNAL"
+                for t in node.targets):
+            if astutil.is_constant(node.value):
+                return set(astutil.literal_value(node.value))
+    return set()
+
+
+def _lint_env_knobs(root: str,
+                    modules: Dict[str, Tuple[ast.Module, str,
+                                             Dict[int, str]]],
+                    env_sh_path: str, docs_dir: str) -> List[Finding]:
+    findings: List[Finding] = []
+    config_rel = os.path.join(os.path.basename(root), "config.py")
+    config_entry = modules.get(config_rel)
+    if config_entry is None:
+        return [Finding("FWK101", "config.py not found — no env-knob "
+                        "declaration point", ERROR,
+                        os.path.basename(root))]
+    declared = _declared_in_config(config_entry[1])
+    internal = _internal_knobs(config_entry[1])
+    try:
+        with open(env_sh_path, "r", encoding="utf-8") as f:
+            env_sh = f.read()
+    except OSError:
+        env_sh = ""
+    docs_text = ""
+    if os.path.isdir(docs_dir):
+        for fname in sorted(os.listdir(docs_dir)):
+            if fname.endswith(".md"):
+                with open(os.path.join(docs_dir, fname), "r",
+                          encoding="utf-8") as f:
+                    docs_text += f.read()
+    reported: Set[Tuple[str, str]] = set()
+    for rel, (tree, _source, _comments) in sorted(modules.items()):
+        for name, lineno in _env_reads(tree):
+            if name not in declared:
+                if (rel, name) in reported:
+                    continue
+                reported.add((rel, name))
+                findings.append(Finding(
+                    "FWK101",
+                    f"{name} is read here but not declared in config.py "
+                    "— add it to ENV_KNOBS (operator knob) or "
+                    "ENV_INTERNAL (platform plumbing)", ERROR, rel,
+                    lineno))
+                continue
+            if name in internal:
+                continue
+            if name not in env_sh and ("env", name) not in reported:
+                reported.add(("env", name))
+                findings.append(Finding(
+                    "FWK102",
+                    f"{name} is an operator knob but scripts/env.sh "
+                    "never mentions it", ERROR, rel, lineno))
+            if name not in docs_text and ("docs", name) not in reported:
+                reported.add(("docs", name))
+                findings.append(Finding(
+                    "FWK103",
+                    f"{name} is an operator knob but no docs/*.md "
+                    "documents it", ERROR, rel, lineno))
+    return findings
+
+
+# -- broad-except discipline ------------------------------------------------
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    return any(astutil.terminal_name(t) in ("Exception", "BaseException")
+               for t in types)
+
+
+def _handler_logs_or_raises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = astutil.terminal_name(node.func)
+            if name in _LOG_METHOD_NAMES:
+                return True
+    return False
+
+
+def _annotated(comments: Dict[int, str], lineno: int,
+               pattern: re.Pattern) -> bool:
+    return bool(pattern.search(comments.get(lineno, ""))
+                or pattern.search(comments.get(lineno - 1, "")))
+
+
+def _lint_broad_excepts(rel: str, tree: ast.Module,
+                        comments: Dict[int, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(node):
+            continue
+        if _handler_logs_or_raises(node):
+            continue
+        if _annotated(comments, node.lineno, _ABSORB_RE):
+            continue
+        findings.append(Finding(
+            "FWK201",
+            "broad except absorbs the error silently — log it, "
+            "re-raise, or annotate the except line with "
+            "'# lint: absorb(reason)' if absorption is the contract",
+            ERROR, rel, node.lineno))
+    return findings
+
+
+# -- lock discipline --------------------------------------------------------
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lint_locks(rel: str, tree: ast.Module,
+                comments: Dict[int, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded: Dict[str, str] = {}  # attr -> lock attr
+        assigned_attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                assigned_attrs.add(attr)
+                m = _GUARDED_BY_RE.search(comments.get(node.lineno, ""))
+                if m:
+                    guarded[attr] = m.group(1)
+        if not guarded:
+            continue
+        for attr, lock in guarded.items():
+            if lock not in assigned_attrs:
+                findings.append(Finding(
+                    "FWK302",
+                    f"{cls.name}.{attr} is guarded-by {lock!r} but the "
+                    "class never assigns self." + lock, ERROR, rel,
+                    cls.lineno))
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__":
+                continue
+            method_holds = _GUARDED_BY_RE.search(
+                comments.get(method.lineno, "")
+                or comments.get(method.lineno - 1, ""))
+            held_always = {method_holds.group(1)} if method_holds else set()
+            findings.extend(_walk_lock_scope(
+                rel, cls.name, method.body, guarded, held_always, comments))
+    return findings
+
+
+def _walk_lock_scope(rel: str, cls_name: str, body: List[ast.stmt],
+                     guarded: Dict[str, str], held: Set[str],
+                     comments: Dict[int, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for stmt in body:
+        acquired: Set[str] = set()
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                attr = _self_attr(expr)
+                if attr is not None:
+                    acquired.add(attr)
+            findings.extend(_walk_lock_scope(
+                rel, cls_name, stmt.body, guarded, held | acquired,
+                comments))
+            continue
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # nested scopes opt out (closures run later)
+        # check attribute uses at THIS statement's own level only —
+        # nested compound bodies (incl. a `with self._lock:` under an
+        # if/for/try) are handled by the recursion below, which credits
+        # the locks they acquire
+        for node in _own_level_nodes(stmt):
+            attr = _self_attr(node)
+            if attr in guarded and guarded[attr] not in held:
+                if _annotated(comments, node.lineno, _UNGUARDED_RE):
+                    continue
+                findings.append(Finding(
+                    "FWK301",
+                    f"{cls_name}.{attr} is guarded-by "
+                    f"{guarded[attr]!r} but accessed here without it — "
+                    "wrap in 'with self." + guarded[attr] + ":', annotate "
+                    "the method '# guarded-by: " + guarded[attr] + "' if "
+                    "callers hold it, or '# lint: unguarded(reason)'",
+                    ERROR, rel, node.lineno))
+        # recurse into compound statements that are not With
+        for child_body in _child_bodies(stmt):
+            findings.extend(_walk_lock_scope(
+                rel, cls_name, child_body, guarded, held, comments))
+    return findings
+
+
+def _own_level_nodes(stmt: ast.stmt):
+    """Nodes evaluated at ``stmt``'s own nesting level: the statement's
+    expressions (an If's test, a For's iter, an Assign's sides) but NOT
+    the bodies of nested compound statements — those are separate lock
+    scopes walked by the recursion."""
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        items = value if isinstance(value, list) else [value]
+        for item in items:
+            if isinstance(item, ast.AST):
+                yield item
+                yield from ast.walk(item)
+
+
+def _child_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    bodies = []
+    for field in ("body", "orelse", "finalbody"):
+        value = getattr(stmt, field, None)
+        if isinstance(value, list) and value \
+                and isinstance(value[0], ast.stmt):
+            bodies.append(value)
+    for handler in getattr(stmt, "handlers", []) or []:
+        bodies.append(handler.body)
+    return bodies
+
+
+# -- HTTP-door discipline ---------------------------------------------------
+
+def _respond_calls(handler_body: List[ast.stmt]) -> List[ast.Call]:
+    calls = []
+    for stmt in handler_body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                name = astutil.terminal_name(node.func) or ""
+                if "respond" in name or name in ("send_error",
+                                                 "send_response"):
+                    calls.append(node)
+    return calls
+
+
+def _lint_door(rel: str, tree: ast.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = _is_broad_handler(node)
+        responds = _respond_calls(node.body)
+        has_raise = any(isinstance(n, ast.Raise)
+                        for stmt in node.body for n in ast.walk(stmt))
+        if not broad and node.type is not None:
+            types = node.type.elts if isinstance(node.type, ast.Tuple) \
+                else [node.type]
+            typed = any((astutil.terminal_name(t) or "").endswith("Error")
+                        for t in types)
+            if typed and not has_raise:
+                statused = any(
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, int) and 400 <= a.value <= 599
+                    for call in responds for a in call.args)
+                if not statused:
+                    findings.append(Finding(
+                        "FWK401",
+                        "typed error caught at an HTTP door without an "
+                        "explicit 4xx/5xx response — map it to a status "
+                        "or re-raise so it cannot decay into a generic "
+                        "500", ERROR, rel, node.lineno))
+        if broad and node.name:
+            for call in responds:
+                leak = astutil.contains(
+                    call, lambda n: isinstance(n, ast.Name)
+                    and n.id == node.name)
+                if leak is not None:
+                    findings.append(Finding(
+                        "FWK402",
+                        f"generic except interpolates {node.name!r} into "
+                        "the HTTP response — internal exception text "
+                        "belongs in the server log, not on the wire",
+                        ERROR, rel, node.lineno))
+                    break
+    return findings

@@ -190,6 +190,7 @@ class HttpWorkerQueue:
                         f"{len(futures)} queries")
                 for fut, pred in zip(futures, preds):
                     fut.set_result(pred)
+            # lint: absorb(the error reaches every waiter via fut.set_error)
             except Exception as e:
                 for fut in futures:
                     fut.set_error(e)
@@ -212,6 +213,7 @@ class HttpWorkerQueue:
                                timeout_s=min(self._timeout_s, 5.0))
                 advertised = set(h.get("wire_versions") or [])
                 self._wire_ok = bool(advertised & wire.SUPPORTED_VERSIONS)
+            # lint: absorb(unprobeable peer falls back to JSON until the next probe)
             except Exception:
                 return False
         return bool(self._wire_ok)

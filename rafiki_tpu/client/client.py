@@ -144,6 +144,29 @@ class Client:
             },
         )
 
+    def verify_model(
+        self,
+        model_file_path: str,
+        model_class: str,
+        dependencies: Optional[Dict[str, Optional[str]]] = None,
+    ) -> Dict:
+        """Dry-run the admin's template verifier (static analysis, no
+        code execution server-side): returns {"mode", "ok", "findings",
+        "capabilities", ...} and never creates a model row — iterate
+        locally until ``ok`` before spending an upload (or run
+        ``python -m rafiki_tpu.analysis file.py`` offline)."""
+        with open(model_file_path, "rb") as f:
+            file_b64 = base64.b64encode(f.read()).decode()
+        return self._call(
+            "POST",
+            "/models/verify",
+            {
+                "model_file_base64": file_b64,
+                "model_class": model_class,
+                "dependencies": dependencies,
+            },
+        )
+
     def get_models(self, task: Optional[str] = None) -> List[Dict]:
         return self._call("GET", "/models", params={"task": task} if task else None)
 

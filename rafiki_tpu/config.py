@@ -274,6 +274,57 @@ def __getattr__(name: str) -> str:
         return _DYNAMIC_PATHS[name]()
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
+
+# -- env-knob declaration point (docs/static-analysis.md, FWK101) -----------
+# Every RAFIKI_* environment name the package reads MUST appear in this
+# file — the framework self-lint (analysis/framework.py) fails tier-1 on
+# any read site whose knob is missing here. Knobs config.py itself reads
+# above are declared implicitly; these catalogs cover names read at
+# their point of use in other modules (lazy/module-local knobs).
+#
+# ENV_KNOBS are operator-facing: the lint additionally requires each to
+# be catalogued in scripts/env.sh and documented under docs/.
+ENV_KNOBS = (
+    # control-plane / placement
+    "RAFIKI_ADMIN_HOST", "RAFIKI_ADMIN_PORT", "RAFIKI_PLACEMENT",
+    "RAFIKI_AGENTS", "RAFIKI_AGENT_KEY", "RAFIKI_AGENT_INSECURE",
+    "RAFIKI_AGENT_HOST", "RAFIKI_AGENT_PORT", "RAFIKI_AGENT_CHIPS",
+    "RAFIKI_LOG_LEVEL",
+    # data plane / serving
+    "RAFIKI_BROKER", "RAFIKI_SHM_RING_BYTES", "RAFIKI_WIRE_BINARY",
+    "RAFIKI_SERVE_INT8",
+    # training / JAX backend
+    "RAFIKI_COMPILE_CACHE_DIR", "RAFIKI_COMPILE_CACHE_CPU",
+    "RAFIKI_TRAINER_CACHE_CAP", "RAFIKI_SCAN_EPOCH",
+    "RAFIKI_SCAN_EPOCH_MAX_BYTES", "RAFIKI_FLASH_THRESHOLD_BYTES",
+    "RAFIKI_NATIVE_CACHE", "RAFIKI_VISIBLE_DEVICES",
+    "RAFIKI_BACKEND_PROBE_TIMEOUT_S", "RAFIKI_BACKEND_PROBE_LOCK",
+    "RAFIKI_BACKEND_PROBE_STALE_S",
+    # sandbox
+    "RAFIKI_SANDBOX", "RAFIKI_SANDBOX_UID", "RAFIKI_SANDBOX_UID_BASE",
+    "RAFIKI_SANDBOX_UID_RANGE", "RAFIKI_SANDBOX_GID",
+    "RAFIKI_SANDBOX_KEEP_GID0", "RAFIKI_SANDBOX_MEM_MB",
+    "RAFIKI_SANDBOX_NOFILE", "RAFIKI_SANDBOX_NETNS",
+    "RAFIKI_SANDBOX_WIDEN_NONOWNED", "RAFIKI_TRIAL_STALL_S",
+    # trials / advisor
+    "RAFIKI_ADVISOR_RETRY_S", "RAFIKI_TRIAL_VMAP_K_WARN",
+    "RAFIKI_INSTALL_DEPS", "RAFIKI_PIP_ARGS",
+    # observability
+    "RAFIKI_METRICS", "RAFIKI_METRICS_RING_S", "RAFIKI_TRACE_SAMPLE",
+    "RAFIKI_TRACE_SLOW_MS", "RAFIKI_TRACE_EXEMPLAR_MAX_MB",
+    "RAFIKI_PROFILE",
+    # static analysis (this PR)
+    "RAFIKI_VERIFY_TEMPLATES",
+)
+
+# ENV_INTERNAL are platform plumbing the placement layer writes into
+# child-process environments (worker bootstrap contract) — declared so
+# the lint knows them, exempt from the operator catalogs.
+ENV_INTERNAL = (
+    "RAFIKI_SERVICE_ID", "RAFIKI_ADMIN_ADDR", "RAFIKI_CHIP_GRANT",
+    "RAFIKI_TRIAL_IDS", "RAFIKI_ORPHAN_SURVIVE",
+)
+
 # How long Admin.predict may reuse a resolved app->predictor route without
 # re-reading the control-plane DB (serving hot path; see admin.predict).
 PREDICT_ROUTE_TTL_S = _env_float("PREDICT_ROUTE_TTL_S", 5.0)

@@ -64,6 +64,7 @@ CREATE TABLE IF NOT EXISTS model (
     model_class TEXT NOT NULL,
     dependencies TEXT NOT NULL,
     access_right TEXT NOT NULL,
+    verification TEXT,
     datetime_created REAL NOT NULL,
     UNIQUE (name, user_id)
 );
@@ -335,6 +336,10 @@ class Database:
         "ALTER TABLE trial ADD COLUMN fault_detail TEXT",
         "ALTER TABLE train_job ADD COLUMN fault_kind TEXT",
         "ALTER TABLE train_job ADD COLUMN error_reason TEXT",
+        # r9 (static analysis): the template verifier's report persists
+        # on the model row (JSON); NULL = uploaded before the verifier
+        # or under RAFIKI_VERIFY_TEMPLATES=off (doctor lists those)
+        "ALTER TABLE model ADD COLUMN verification TEXT",
     )
 
     def _migrate(self) -> None:
@@ -436,12 +441,14 @@ class Database:
         model_class: str,
         dependencies: Dict[str, Optional[str]],
         access_right: str,
+        verification: Optional[str] = None,
     ) -> Dict:
         mid = uuid.uuid4().hex
         self._exec(
             "INSERT INTO model (id, user_id, name, task, model_file_bytes,"
-            " model_class, dependencies, access_right, datetime_created)"
-            " VALUES (?,?,?,?,?,?,?,?,?)",
+            " model_class, dependencies, access_right, verification,"
+            " datetime_created)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?)",
             (
                 mid,
                 user_id,
@@ -451,6 +458,7 @@ class Database:
                 model_class,
                 json.dumps(dependencies),
                 access_right,
+                verification,
                 time.time(),
             ),
         )

@@ -216,5 +216,6 @@ class ShmMessageQueue:
     def __del__(self):
         try:
             self.destroy()
+        # lint: absorb(__del__ during interpreter teardown)
         except Exception:
             pass

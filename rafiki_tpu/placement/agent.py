@@ -244,9 +244,11 @@ class AgentServer:
                     handler, m.group("job"), m.group("wid"), body,
                     binary=binary_req)
             self._respond(handler, 404, {"error": f"no route {method} {path}"})
-        except Exception as e:
+        except Exception:
+            # traceback stays in the agent log; the wire gets a generic
+            # 500 (FWK402: internal text never leaves the door)
             logger.exception("agent request failed")
-            self._respond(handler, 500, {"error": f"{type(e).__name__}: {e}"})
+            self._respond(handler, 500, {"error": "internal agent error"})
 
     def _predict_relay(self, handler, job_id: str, worker_id: str,
                        body: Dict[str, Any], binary: bool = False) -> None:
@@ -323,9 +325,14 @@ class AgentServer:
             return self._respond(handler, 504, {
                 "error": f"worker {worker_id} missed the "
                          f"{timeout_s:.0f}s relay deadline"})
-        except Exception as e:
+        except Exception:
+            # the admin's relay treats ANY 502 as a failed worker — the
+            # detail (traceback included) belongs in the agent log, not
+            # on the wire (FWK402)
+            logger.exception("relay to worker %s failed", worker_id)
             return self._respond(handler, 502, {
-                "error": f"worker {worker_id}: {type(e).__name__}: {e}"})
+                "error": f"worker {worker_id}: relay failed "
+                         "(see agent log)"})
         payload: Dict[str, Any] = {"predictions": preds}
         if rt is not None:
             # offsets relative to this agent's submit time; the relay

@@ -801,6 +801,7 @@ class HostAgentPlacementManager(PlacementManager):
                     # problem, not a dead machine
                     alive = True
                     err = f"healthz {e.code}: {e.message}"
+                # lint: absorb(transport failure IS the down signal; recorded via _note_heartbeat)
                 except Exception as e:
                     alive = False
                     err = str(e)
@@ -906,6 +907,7 @@ class HostAgentPlacementManager(PlacementManager):
             if self.db is not None:
                 try:
                     row = self.db.get_service(sid)
+                # lint: absorb(store hiccup reads as non-terminal; teardown stays conservative)
                 except Exception:
                     row = None
                 if row is not None and row["status"] in ("STOPPED",

@@ -374,6 +374,7 @@ class Predictor:
                     return [f.result(0.0) for f in futs]
                 except TimeoutError:
                     continue  # not ready yet — keep it in the pool
+                # lint: absorb(failed replica leaves the hedge pool; survivors or the SLO timeout answer)
                 except Exception:
                     issued.remove(futs)  # replica answered with an error
             if not issued or time.monotonic() >= until:

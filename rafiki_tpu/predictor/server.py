@@ -170,6 +170,7 @@ class PredictorServer:
         if callable(qstats_fn):
             try:
                 qstats = qstats_fn()
+            # lint: absorb(/healthz must answer even when a stats hook crashes)
             except Exception:
                 qstats = {}
             if qstats:
@@ -214,9 +215,11 @@ class PredictorServer:
 
                 try:
                     arr = _np.load(io.BytesIO(raw), allow_pickle=False)
-                except Exception as e:  # malformed/pickled: client error
+                # lint: absorb(hostile npy bytes answer 400, never a 500)
+                except Exception:
                     return self._respond(handler, 400, {
-                        "error": f"bad npy body: {e}"})
+                        "error": "bad npy body (expected a valid, "
+                                 "non-pickled .npy array)"})
                 if arr.ndim < 1 or arr.shape[0] == 0:
                     return self._respond(handler, 400, {
                         "error": "npy body must have a leading batch axis"})
@@ -306,6 +309,7 @@ class PredictorServer:
                 arr = None
                 try:
                     arr = _np.asarray(preds)
+                # lint: absorb(un-arrayable predictions take the JSON response path)
                 except Exception:
                     pass
                 if arr is not None and arr.dtype != object:

@@ -102,6 +102,7 @@ def _no_new_privs() -> None:
 
     try:
         ctypes.CDLL(None, use_errno=True).prctl(38, 1, 0, 0, 0)
+    # lint: absorb(prctl hardening is best-effort on old kernels)
     except Exception:
         pass
 
@@ -146,6 +147,7 @@ def main() -> int:
     setup = json.loads(sys.stdin.readline())
     try:
         _lockdown(setup)
+    # lint: absorb(the err frame carries the failure to the parent as INFRA)
     except Exception:
         # where=lockdown: the HARNESS failed, not the template — the
         # parent classifies this INFRA (retryable), never USER
@@ -195,6 +197,7 @@ def main() -> int:
             model.destroy()
         _emit({"t": "done", "score": score, "params_b64": params_b64})
         return 0
+    # lint: absorb(the err frame carries the failure to the parent for fault classification)
     except Exception as e:
         # error_type lets the parent map the failure into the fault
         # taxonomy (MemoryError -> MEM, everything else -> USER)
@@ -222,12 +225,14 @@ def _serve(setup: dict) -> int:
             load_params(base64.b64decode(setup["params_b64"])))
         try:
             model.warm_up()
+        # lint: absorb(warm_up is optional; the failure is logged to the trial log frame)
         except Exception:
             _emit({"t": "log", "line": json.dumps({
                 "type": "MESSAGE",
                 "message": "warm_up failed in sandbox (serving anyway)",
                 "time": 0})})
         _emit({"t": "ready"})
+    # lint: absorb(warm_up is optional; the failure is logged to the trial log frame)
     except Exception as e:
         _emit({"t": "err", "error": f"{type(e).__name__}: {e}",
                "traceback": traceback.format_exc()[-4000:]})
@@ -245,6 +250,7 @@ def _serve(setup: dict) -> int:
             try:
                 preds = model.predict(frame["queries"])
                 _emit({"t": "preds", "predictions": list(preds)})
+            # lint: absorb(per-request err frame; the serving loop must survive template bugs)
             except Exception as e:
                 _emit({"t": "err", "error": f"{type(e).__name__}: {e}",
                        "traceback": traceback.format_exc()[-2000:]})

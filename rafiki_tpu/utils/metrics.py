@@ -341,8 +341,8 @@ class Registry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}
-        self._rings: Dict[str, Ring] = {}
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: _lock
+        self._rings: Dict[str, Ring] = {}  # guarded-by: _lock
 
     def _get_or_create(self, cls, name: str, help_text: str,
                        label_names: Sequence[str], **kw) -> _Metric:

@@ -112,6 +112,7 @@ def main() -> int:
             if orphaned:
                 try:
                     svc = db.get_service(service_id)
+                # lint: absorb(store hiccup while orphaned: keep serving, retry next beat)
                 except Exception:
                     continue  # store hiccup: keep working
                 if svc is None or svc["status"] in ("STOPPED", "ERRORED"):

@@ -213,6 +213,7 @@ def knob_signature(knob_config, knobs: Dict[str, Any]) -> str:
             u = knobs_to_unit(knob_config, knobs)
             cells = [int(round(float(x) * SIGNATURE_GRID)) for x in u]
             return "u:" + ",".join(str(c) for c in cells)
+        # lint: absorb(unexpected knob shape falls through to the JSON signature)
         except Exception:  # unexpected knob shape: fall through to JSON
             pass
     import json

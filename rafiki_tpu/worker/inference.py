@@ -105,6 +105,7 @@ def _record_queue(service_id: str, queue) -> None:
         return
     try:
         q = stats_fn()
+    # lint: absorb(queue stats are best-effort telemetry)
     except Exception:
         return
     with _stats_lock:

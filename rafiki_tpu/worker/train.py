@@ -776,6 +776,7 @@ class TrainWorker:
                                             f"params persist failed: {e}",
                                             kind=FaultKind.INFRA)))
                         continue
+                    # lint: absorb(the exception is carried in results for per-member fault classification)
                     except Exception as e:
                         results.append((tid, knobs, None, None, e))
                         continue

@@ -214,6 +214,51 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                       (K stacked param+opt copies must
 #                                       fit HBM beside the dataset)
 
+# Static analysis (docs/static-analysis.md): AST template verifier at
+# upload + framework self-lint in tier-1. The lint REQUIRES every
+# operator knob to be catalogued in this file (FWK102):
+#   RAFIKI_VERIFY_TEMPLATES=enforce     enforce = error findings reject the
+#                                       upload with a typed
+#                                       ModelVerificationError; warn = accept,
+#                                       persist + log findings; off = skip
+#                                       (doctor WARNs while jobs are live)
+
+# Knob catalog — names read at their point of use (declared in
+# config.py ENV_KNOBS; one line per knob so the self-lint can hold this
+# file to completeness):
+#   RAFIKI_LOG_LEVEL=INFO               admin/agent process log level
+#   RAFIKI_DATA_DIR, RAFIKI_PARAMS_DIR, RAFIKI_LOGS_DIR
+#                                       override the $RAFIKI_WORKDIR/{data,
+#                                       params,logs} layout per directory
+#   RAFIKI_BROKER=shm                   force the shared-memory serving
+#                                       data plane (default: auto-detect)
+#   RAFIKI_AGENT_HOST / RAFIKI_AGENT_PORT
+#                                       bind address of a host agent
+#                                       (scripts/start_agent.sh)
+#   RAFIKI_AGENT_CHIPS='0,1,2,3'        chip inventory an agent advertises
+#   RAFIKI_AGENT_KEY=...                shared fleet key agents require
+#                                       (RAFIKI_AGENT_INSECURE=1 runs keyless
+#                                       — doctor WARNs)
+#   RAFIKI_VISIBLE_DEVICES='0,1'        restrict the JAX device mesh
+#   RAFIKI_COMPILE_CACHE_DIR=...        persistent XLA compile cache dir
+#                                       (RAFIKI_COMPILE_CACHE_CPU=1 extends
+#                                       it to CPU backends — test/dev)
+#   RAFIKI_TRAINER_CACHE_CAP=8          compiled-trainer reuse cache entries
+#   RAFIKI_SCAN_EPOCH=auto              lax.scan the epoch loop (auto sizes
+#                                       via RAFIKI_SCAN_EPOCH_MAX_BYTES)
+#   RAFIKI_FLASH_THRESHOLD_BYTES=...    flash-attention engage threshold
+#   RAFIKI_NATIVE_CACHE=...             native shm-queue build cache dir
+#   RAFIKI_SANDBOX_UID_RANGE=...        uid-hash range for per-trial jails
+#                                       (with RAFIKI_SANDBOX_UID_BASE)
+#   RAFIKI_SANDBOX_KEEP_GID0=1          jailed children retain group root
+#   RAFIKI_SANDBOX_NOFILE=...           RLIMIT_NOFILE inside the jail
+#   RAFIKI_BACKEND_PROBE_TIMEOUT_S=60   bounded accelerator probe (bench/
+#                                       doctor); lock file
+#                                       RAFIKI_BACKEND_PROBE_LOCK, stale-
+#                                       child kill age
+#                                       RAFIKI_BACKEND_PROBE_STALE_S
+#   RAFIKI_PROFILE=1                    per-phase profile spans in logs
+
 # Deterministic fault injection — MUST stay off outside drills/tests
 # (sites: call_agent, agent, worker — stalls/slows serving replicas for
 # overload drills — wire, whose `corrupt` action garbles shm frames for

@@ -1,0 +1,36 @@
+"""TPL007 (warning): ``dependencies`` is computed — the platform cannot
+provision what it cannot read statically."""
+
+import os
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+def _deps():
+    return {"numpy": os.environ.get("NUMPY_VERSION")}
+
+
+class DepsNotLiteral(BaseModel):
+    dependencies = _deps()
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

@@ -1,0 +1,36 @@
+"""SBX001: subprocess is sandbox-forbidden — and hiding it behind a
+try/except ImportError guard must not evade the pass."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+try:
+    import subprocess
+except ImportError:
+    subprocess = None
+
+
+class ForbiddenImport(BaseModel):
+    dependencies = {}
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        if subprocess is not None:
+            subprocess.run(["id"], check=False)
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

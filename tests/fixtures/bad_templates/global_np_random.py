@@ -1,0 +1,34 @@
+"""JAX002 (warning): process-global numpy RNG — vmapped members and
+forked sandbox children share that state; thread a Generator instead."""
+
+import numpy as np
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+class GlobalNpRandom(BaseModel):
+    dependencies = {"numpy": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        np.random.seed(42)
+        self._w = None
+
+    def train(self, dataset_uri):
+        self._w = np.random.randn(4)
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {"w": self._w.tolist() if self._w is not None else []}
+
+    def load_parameters(self, params):
+        self._w = np.asarray(params.get("w", []))

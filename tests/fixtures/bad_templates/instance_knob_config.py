@@ -1,0 +1,29 @@
+"""TPL006: get_knob_config takes ``self`` — the advisor reads the knob
+space from the class, before any instance exists."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+class InstanceKnobConfig(BaseModel):
+    dependencies = {}
+
+    def get_knob_config(self):
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

@@ -1,0 +1,42 @@
+"""JAX003: assigning to ``self`` inside a jit-traced function — the
+side effect runs once at trace time, then never again."""
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+class JitSelfMutation(BaseModel):
+    dependencies = {"jax": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self.last_loss = None
+
+    def train(self, dataset_uri):
+        def step(w, x):
+            loss = jnp.sum(w * x)
+            self.last_loss = loss
+            return w - 0.01 * x
+
+        fn = jax.jit(step)
+        w = jnp.ones((4,))
+        for _ in range(3):
+            w = fn(w, jnp.ones((4,)))
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

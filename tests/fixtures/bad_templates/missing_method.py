@@ -1,0 +1,26 @@
+"""TPL001: predict() is missing — the BaseModel contract is incomplete."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+class MissingMethod(BaseModel):
+    dependencies = {}
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

@@ -1,0 +1,22 @@
+"""TPL004: no BaseModel subclass anywhere in the file."""
+
+
+class NotAModel:
+    @staticmethod
+    def get_knob_config():
+        return {}
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

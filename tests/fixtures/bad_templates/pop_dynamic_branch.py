@@ -1,0 +1,44 @@
+"""POP003: train_population branches on the dynamic knob ``lr`` —
+members of one vmapped program must share one trace."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob, PopulationSpec
+
+
+class PopDynamicBranch(BaseModel):
+    dependencies = {}
+    population_spec = PopulationSpec(dynamic_knobs=("lr",))
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass
+
+    def train_population(self, dataset_uri, member_knobs):
+        for knobs in member_knobs:
+            if knobs["lr"] > 0.01:
+                self._schedule = "cosine"
+            else:
+                self._schedule = "constant"
+
+    def evaluate_population(self, dataset_uri):
+        return [0.5 for _ in range(2)]
+
+    def dump_member_parameters(self, member):
+        return {}

@@ -1,0 +1,31 @@
+"""POP002: population_spec declared but the three population methods are
+not overridden — the worker would silently fall back to scalar trials."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob, PopulationSpec
+
+
+class PopHalfWired(BaseModel):
+    dependencies = {}
+    population_spec = PopulationSpec(dynamic_knobs=("lr",))
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

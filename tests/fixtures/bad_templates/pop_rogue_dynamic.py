@@ -1,0 +1,40 @@
+"""POP001: dynamic knob ``momentum`` is not in the knob config — the
+advisor never proposes it, so the partitioner cannot bucket on it."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob, PopulationSpec
+
+
+class PopRogueDynamic(BaseModel):
+    dependencies = {}
+    population_spec = PopulationSpec(dynamic_knobs=("momentum",))
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass
+
+    def train_population(self, dataset_uri, member_knobs):
+        pass
+
+    def evaluate_population(self, dataset_uri):
+        return [0.5]
+
+    def dump_member_parameters(self, member):
+        return {}

@@ -1,0 +1,8 @@
+"""TPL005: the template does not parse."""
+
+from rafiki_tpu.sdk import BaseModel
+
+
+class SyntaxBroken(BaseModel)
+    def train(self, dataset_uri):
+        pass

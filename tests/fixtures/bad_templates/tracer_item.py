@@ -1,0 +1,41 @@
+"""JAX001: ``.item()`` inside a jitted function forces a device sync
+per step (or a ConcretizationTypeError)."""
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+class TracerItem(BaseModel):
+    dependencies = {"jax": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._loss = 0.0
+
+    def train(self, dataset_uri):
+        @jax.jit
+        def step(w, x):
+            loss = jnp.sum(w * x)
+            return w - 0.01 * loss.item() * x
+
+        w = jnp.ones((4,))
+        for _ in range(3):
+            w = step(w, jnp.ones((4,)))
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

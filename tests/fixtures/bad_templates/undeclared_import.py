@@ -1,0 +1,32 @@
+"""TPL003: pandas is imported but never declared in ``dependencies`` —
+the trial dies at import time on a fresh worker."""
+
+import pandas as pd
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+class UndeclaredImport(BaseModel):
+    dependencies = {}
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        self._frame = pd.DataFrame({"x": [1.0]})
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

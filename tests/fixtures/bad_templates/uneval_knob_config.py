@@ -1,0 +1,35 @@
+"""TPL002: the knob space depends on runtime state — the advisor would
+have to execute user code to learn it."""
+
+import os
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob, IntegerKnob
+
+
+class UnevalKnobConfig(BaseModel):
+    dependencies = {}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "lr": FloatKnob(1e-4, 1e-1),
+            "units": IntegerKnob(1, int(os.environ.get("MAX_UNITS", 8))),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

@@ -1,0 +1,394 @@
+"""Static-analysis subsystem, head 1: the upload-time template verifier
+(rafiki_tpu/analysis/template.py).
+
+Contract under test (ISSUE 9 acceptance):
+- every bad-template corpus fixture (tests/fixtures/bad_templates/) is
+  flagged with exactly its intended finding code;
+- ZERO false positives across every shipped examples/ and
+  tests/fixtures/ template;
+- an enforce-mode upload of a bad template is rejected with a typed
+  ModelVerificationError BEFORE any trial runs, warn mode persists the
+  findings on the model row, off skips;
+- the dry-run surfaces (POST /models/verify, Client.verify_model,
+  ``python -m rafiki_tpu.analysis``) report without creating rows;
+- static_population_capability is the capability oracle (doctor's old
+  byte sniff replaced).
+"""
+
+import glob
+import json
+import os
+import textwrap
+
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.analysis import (
+    ModelVerificationError,
+    VerificationReport,
+    static_population_capability,
+    verify_template_bytes,
+    verify_template_source,
+)
+from rafiki_tpu.analysis.__main__ import main as analysis_cli
+
+HERE = os.path.dirname(__file__)
+REPO = os.path.dirname(HERE)
+BAD_DIR = os.path.join(HERE, "fixtures", "bad_templates")
+FAKE_MODEL = os.path.join(HERE, "fixtures", "fake_model.py")
+
+#: fixture file -> the one finding code it must trigger
+CORPUS = {
+    "missing_method.py": "TPL001",
+    "uneval_knob_config.py": "TPL002",
+    "undeclared_import.py": "TPL003",
+    "not_a_model.py": "TPL004",
+    "syntax_error.py": "TPL005",
+    "instance_knob_config.py": "TPL006",
+    "deps_not_literal.py": "TPL007",
+    "forbidden_import.py": "SBX001",
+    "pop_rogue_dynamic.py": "POP001",
+    "pop_half_wired.py": "POP002",
+    "pop_dynamic_branch.py": "POP003",
+    "tracer_item.py": "JAX001",
+    "global_np_random.py": "JAX002",
+    "jit_self_mutation.py": "JAX003",
+}
+
+GOOD_TEMPLATES = sorted(
+    glob.glob(os.path.join(REPO, "examples", "models", "*", "*.py"))
+    + [os.path.join(HERE, "fixtures", f)
+       for f in ("fake_model.py", "mesh_probe_model.py", "pop_model.py")])
+
+
+def _read(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+# -- corpus: every detector fires on its fixture ----------------------------
+
+@pytest.mark.parametrize("fname,code", sorted(CORPUS.items()))
+def test_bad_template_corpus_flags_exactly_its_violation(fname, code):
+    report = verify_template_source(
+        _read(os.path.join(BAD_DIR, fname)), filename=fname)
+    codes = {f.code for f in report.findings}
+    assert codes == {code}, (
+        f"{fname}: expected exactly {{{code}}}, got {codes}: "
+        f"{[str(f) for f in report.findings]}")
+
+
+def test_corpus_covers_at_least_ten_distinct_violations():
+    assert len(set(CORPUS.values())) >= 10
+    on_disk = {os.path.basename(p)
+               for p in glob.glob(os.path.join(BAD_DIR, "*.py"))}
+    assert on_disk == set(CORPUS)  # no unasserted fixture rots in the dir
+
+
+# -- zero false positives on everything shipped -----------------------------
+
+@pytest.mark.parametrize(
+    "path", GOOD_TEMPLATES, ids=[os.path.basename(p)
+                                 for p in GOOD_TEMPLATES])
+def test_no_false_positives_on_shipped_templates(path):
+    report = verify_template_source(_read(path), filename=path)
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_population_capability_oracle_matches_runtime_contract():
+    # pop_model + JaxCnn advertise the PR-8 population interface...
+    spec = static_population_capability(_read(
+        os.path.join(HERE, "fixtures", "pop_model.py")))
+    assert spec is not None and spec["dynamic_knobs"] == ["lr"]
+    jaxcnn = static_population_capability(_read(os.path.join(
+        REPO, "examples", "models", "image_classification", "JaxCnn.py")))
+    assert jaxcnn is not None and "learning_rate" in jaxcnn["dynamic_knobs"]
+    # ...FakeModel does not; a half-wired spec reads as incapable (the
+    # exact case the old b"population_spec"-in-bytes sniff got wrong)
+    assert static_population_capability(_read(FAKE_MODEL)) is None
+    assert static_population_capability(_read(
+        os.path.join(BAD_DIR, "pop_half_wired.py"))) is None
+    # bytes entry point (what doctor feeds it)
+    assert static_population_capability(b"not python(") is None
+
+
+# -- upload wiring: enforce / warn / off ------------------------------------
+
+@pytest.fixture()
+def admin(tmp_path):
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import (ChipAllocator,
+                                              LocalPlacementManager)
+
+    a = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    yield a
+    a.shutdown()
+
+
+def _uid(admin):
+    return admin.authenticate_user(
+        config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+
+
+def test_enforce_rejects_bad_upload_before_any_trial(admin, monkeypatch):
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "enforce")
+    uid = _uid(admin)
+    bad = _read(os.path.join(BAD_DIR, "pop_half_wired.py")).encode()
+    with pytest.raises(ModelVerificationError) as ei:
+        admin.create_model(uid, "badpop", "IMAGE_CLASSIFICATION", bad,
+                           "PopHalfWired")
+    assert "POP002" in str(ei.value)
+    assert ei.value.report.errors  # the typed error carries the report
+    assert admin.get_models(uid) == []  # no row, nothing to trial
+
+
+def test_enforce_is_the_default_and_tolerates_typos(admin, monkeypatch):
+    monkeypatch.delenv("RAFIKI_VERIFY_TEMPLATES", raising=False)
+    uid = _uid(admin)
+    bad = _read(os.path.join(BAD_DIR, "missing_method.py")).encode()
+    with pytest.raises(ModelVerificationError):
+        admin.create_model(uid, "bad1", "T", bad, "MissingMethod")
+    # a typo'd mode must not silently disable the safety net
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "enforec")
+    with pytest.raises(ModelVerificationError):
+        admin.create_model(uid, "bad2", "T", bad, "MissingMethod")
+
+
+def test_warn_mode_uploads_but_persists_findings(admin, monkeypatch):
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "warn")
+    uid = _uid(admin)
+    bad = _read(os.path.join(BAD_DIR, "missing_method.py")).encode()
+    view = admin.create_model(uid, "warned", "T", bad, "MissingMethod")
+    assert view["verification"]["ok"] is False
+    codes = {f["code"] for f in view["verification"]["findings"]}
+    assert codes == {"TPL001"}
+
+
+def test_off_mode_skips_and_row_reads_unverified(admin, monkeypatch):
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "off")
+    uid = _uid(admin)
+    bad = _read(os.path.join(BAD_DIR, "missing_method.py")).encode()
+    view = admin.create_model(uid, "unchecked", "T", bad, "MissingMethod")
+    assert view["verification"] is None
+
+
+def test_good_upload_persists_clean_report(admin, monkeypatch):
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "enforce")
+    uid = _uid(admin)
+    with open(FAKE_MODEL, "rb") as f:
+        view = admin.create_model(uid, "fake", "T", f.read(), "FakeModel")
+    assert view["verification"]["ok"] is True
+    assert view["verification"]["findings"] == []
+
+
+def test_verify_model_dry_run_creates_no_row(admin):
+    uid = _uid(admin)
+    bad = _read(os.path.join(BAD_DIR, "undeclared_import.py")).encode()
+    out = admin.verify_model(bad, "UndeclaredImport")
+    assert out["ok"] is False
+    assert {f["code"] for f in out["findings"]} == {"TPL003"}
+    # JAX pitfalls are warnings: surfaced, but ok stays True (a
+    # heuristic must never block an upload at enforce)
+    warned = admin.verify_model(
+        _read(os.path.join(BAD_DIR, "tracer_item.py")).encode(),
+        "TracerItem")
+    assert warned["ok"] is True
+    assert {f["code"] for f in warned["findings"]} == {"JAX001"}
+    assert {f["severity"] for f in warned["findings"]} == {"warn"}
+    assert admin.get_models(uid) == []
+
+
+# -- HTTP + Client surface --------------------------------------------------
+
+def test_verify_model_over_http(tmp_path):
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import Client, RafikiError
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import (ChipAllocator,
+                                              LocalPlacementManager)
+
+    admin = Admin(
+        db=Database(":memory:"),
+        placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+        params_dir=str(tmp_path / "params"),
+    )
+    srv = AdminServer(admin, port=0).start()
+    try:
+        c = Client("127.0.0.1", srv.port)
+        c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        out = c.verify_model(
+            os.path.join(BAD_DIR, "undeclared_import.py"),
+            "UndeclaredImport")
+        assert out["ok"] is False
+        assert {f["code"] for f in out["findings"]} == {"TPL003"}
+        assert out["mode"] in ("enforce", "warn", "off")
+        # clean template answers ok through the same surface
+        assert c.verify_model(FAKE_MODEL, "FakeModel")["ok"] is True
+        # enforce-mode rejection over the wire is a 400 with the codes
+        with pytest.raises(RafikiError) as ei:
+            c.create_model("bad", "T",
+                           os.path.join(BAD_DIR, "undeclared_import.py"),
+                           "UndeclaredImport")
+        assert ei.value.status == 400
+        assert "TPL003" in str(ei.value)
+    finally:
+        srv.stop()
+        admin.shutdown()
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_exits_nonzero_on_findings(capsys):
+    rc = analysis_cli([os.path.join(BAD_DIR, "missing_method.py")])
+    assert rc == 1
+    assert "TPL001" in capsys.readouterr().out
+
+
+def test_cli_clean_template_exits_zero(capsys):
+    rc = analysis_cli([FAKE_MODEL, "FakeModel"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_json_report(capsys):
+    # warn-only template: CLI still exits 1 (the local loop wants the
+    # full list) while ok stays True
+    rc = analysis_cli([os.path.join(BAD_DIR, "tracer_item.py"), "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert payload["findings"]
+
+
+# -- report model -----------------------------------------------------------
+
+def test_report_round_trips_through_json():
+    report = verify_template_bytes(b"import subprocess\n")
+    blob = json.dumps(report.to_dict())
+    back = VerificationReport.from_dict(json.loads(blob))
+    assert [f.code for f in back.findings] == [
+        f.code for f in report.findings]
+    assert back.ok == report.ok
+
+
+def test_non_utf8_bytes_are_a_typed_finding():
+    report = verify_template_bytes(b"\xff\xfe broken")
+    assert not report.ok
+    assert report.findings[0].code == "TPL005"
+
+
+# -- review-hardening regressions -------------------------------------------
+
+def test_binop_constants_never_escape_as_exceptions():
+    """is_constant accepts arithmetic BinOps; literal_value must
+    evaluate them instead of letting ast.literal_eval's ValueError
+    escape verify_template_source (which promises findings, never
+    raises)."""
+    src = _read(FAKE_MODEL).replace(
+        'dependencies = {"numpy": None}',
+        'dependencies = {"numpy": None, "torch": 1 + 1}')
+    report = verify_template_source(src)  # must not raise
+    assert "torch" not in str(report.findings)  # declared, evaluated
+    spec = verify_template_source(
+        _read(os.path.join(HERE, "fixtures", "pop_model.py")).replace(
+            'dynamic_knobs=("lr",)', 'dynamic_knobs=("l" + "r",)'))
+    assert spec.capabilities["population_spec"]["dynamic_knobs"] == ["lr"]
+
+
+def test_static_shape_coercions_under_jit_are_not_flagged():
+    """int(x.shape[0]) and np.array of constants inside jit are valid
+    JAX — shapes are static at trace time, constants are closed over."""
+    report = verify_template_source(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+        class ShapeOk(BaseModel):
+            dependencies = {"jax": None}
+
+            @staticmethod
+            def get_knob_config():
+                return {"lr": FloatKnob(1e-4, 1e-1)}
+
+            def __init__(self, **knobs):
+                super().__init__(**knobs)
+
+            def train(self, dataset_uri):
+                @jax.jit
+                def step(w, x):
+                    n = int(x.shape[0])
+                    scale = np.array([0.5, 2.0])
+                    return w - jnp.sum(x) / n * scale[0]
+
+                step(jnp.ones(4), jnp.ones(4))
+
+            def evaluate(self, dataset_uri):
+                return 0.5
+
+            def predict(self, queries):
+                return [0.0 for _ in queries]
+
+            def dump_parameters(self):
+                return {}
+
+            def load_parameters(self, params):
+                pass
+        """))
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_jax_pitfalls_are_warnings_not_upload_blockers():
+    for fname in ("tracer_item.py", "jit_self_mutation.py",
+                  "global_np_random.py"):
+        report = verify_template_source(
+            _read(os.path.join(BAD_DIR, fname)), filename=fname)
+        assert report.findings and report.ok, fname  # flagged, not fatal
+
+
+def test_enforce_rejects_hostile_template_without_executing_it(
+        admin, monkeypatch, tmp_path):
+    """The verifier runs BEFORE load_model_class: a hostile template's
+    module top level must never execute in the admin process when
+    enforce rejects it."""
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "enforce")
+    sentinel = tmp_path / "pwned"
+    hostile = _read(os.path.join(BAD_DIR, "forbidden_import.py")) + (
+        f"\n\nopen({str(sentinel)!r}, 'w').close()\n")
+    uid = _uid(admin)
+    with pytest.raises(ModelVerificationError) as ei:
+        admin.create_model(uid, "hostile", "T", hostile.encode(),
+                           "ForbiddenImport")
+    assert "SBX001" in str(ei.value)
+    assert not sentinel.exists()  # top-level code never ran
+
+
+# -- doctor -----------------------------------------------------------------
+
+def test_doctor_static_analysis_check(tmp_path, monkeypatch):
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.doctor import check_static_analysis
+    from rafiki_tpu.utils.auth import hash_password
+
+    monkeypatch.setenv("RAFIKI_WORKDIR", str(tmp_path))
+    db = Database(str(tmp_path / "rafiki.sqlite3"))
+    user = db.create_user("u@x", hash_password("pw"), "ADMIN")
+    with open(FAKE_MODEL, "rb") as f:
+        db.create_model(user["id"], "unchecked", "T", f.read(),
+                        "FakeModel", {}, "PRIVATE", verification=None)
+    db.close()
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "enforce")
+    name, status, detail = check_static_analysis()
+    assert name == "static analysis"
+    assert status == "WARN"
+    assert "unchecked" in detail
+    # off + no live jobs + (still) unverified models: mode surfaces
+    monkeypatch.setenv("RAFIKI_VERIFY_TEMPLATES", "off")
+    _, status2, detail2 = check_static_analysis()
+    assert "mode=off" in detail2
