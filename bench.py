@@ -652,6 +652,167 @@ def bench_shm_binary_serving(n_clients: int = 4,
         broker.close()
 
 
+def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
+                           prefix: str = "serving_generate") -> dict:
+    """Generative serving phase (docs/serving-generation.md): N concurrent
+    streaming clients drive a real PredictorServer /generate ->
+    Predictor -> InProcessBroker -> GenerationWorker slot-scheduler stack
+    over a tiny-but-real KV-cached LM (models/lm.py). Reports TTFT
+    p50/p95 (client-observed, first delta vs request start), aggregate
+    tokens/s across the co-resident streams, and mean slot utilization —
+    the continuous-batching numbers the subsystem exists for.
+    Deployment-free on purpose, same layers as production serving."""
+    import threading as _threading
+
+    import jax
+    import requests as _requests
+
+    from rafiki_tpu import config as _config
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.models import lm
+    from rafiki_tpu.predictor.predictor import Predictor
+    from rafiki_tpu.predictor.server import PredictorServer
+    from rafiki_tpu.sdk.model import BaseModel, GenerationSpec
+    from rafiki_tpu.utils.metrics import REGISTRY
+    from rafiki_tpu.worker.generation import GenerationWorker
+
+    cfg = lm.tiny(vocab=256, max_len=160, dim=64, depth=2, heads=4)
+    params = lm.init(jax.random.PRNGKey(0), cfg)
+
+    class _BenchLM(BaseModel):
+        generation_spec = GenerationSpec(eos_token_id=None, max_context=160)
+
+        @staticmethod
+        def get_knob_config():
+            return {}
+
+        def train(self, dataset_uri):
+            pass
+
+        def evaluate(self, dataset_uri):
+            return 0.0
+
+        def predict(self, queries):
+            return [0 for _ in queries]
+
+        def dump_parameters(self):
+            return params
+
+        def load_parameters(self, p):
+            pass
+
+        def init_kv_cache(self, max_slots):
+            self._jit_prefill = jax.jit(
+                lambda c, s, ids, ln: lm.prefill(params, c, s, ids, ln, cfg))
+            self._jit_decode = jax.jit(
+                lambda c, ids, pos: lm.decode_step(params, c, ids, pos, cfg))
+            return lm.init_kv_cache(cfg, max_slots)
+
+        def prefill(self, cache, slot, prompt_ids):
+            import numpy as _np
+
+            bucket = 32
+            ids = _np.zeros(bucket, _np.int32)
+            ids[:len(prompt_ids)] = prompt_ids
+            logits, cache = self._jit_prefill(
+                cache, slot, ids, len(prompt_ids))
+            return int(lm.greedy_token(logits)), cache
+
+        def decode_step(self, cache, ids, positions):
+            logits, cache = self._jit_decode(cache, ids, positions)
+            return lm.greedy_token(logits), cache
+
+    class _Ctx:
+        service_id = "genbench-w1"
+        chips = None
+        stopping = False
+
+        def ready(self):
+            pass
+
+    broker = InProcessBroker()
+    worker = GenerationWorker("genbench", "t1", db=None, broker=broker)
+    worker._load_model = lambda sid: _BenchLM()
+    ctx = _Ctx()
+    wt = _threading.Thread(target=worker.start, args=(ctx,), daemon=True)
+    wt.start()
+    # wait for the worker's queue to register
+    for _ in range(200):
+        if broker.get_worker_queues("genbench"):
+            break
+        time.sleep(0.02)
+    predictor = Predictor("genbench", broker, task=None)
+    server = PredictorServer(predictor, "genbench", auth=False).start()
+    try:
+        results = []
+        res_lock = _threading.Lock()
+
+        def client(seed: int):
+            rng = np.random.default_rng(seed)
+            prompt = [int(t) for t in rng.integers(1, 250, size=8)]
+            t0 = time.monotonic()
+            ttft = None
+            tokens = 0
+            with _requests.post(
+                    f"http://127.0.0.1:{server.port}/generate",
+                    json={"prompt_ids": prompt, "max_tokens": max_tokens,
+                          "timeout_s": 120.0},
+                    stream=True, timeout=180) as resp:
+                buf = b""
+                for data in resp.iter_content(chunk_size=None):
+                    buf += data
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        delta = json.loads(line)
+                        if ttft is None:
+                            ttft = time.monotonic() - t0
+                        tokens += len(delta.get("tokens") or [])
+                        if delta.get("finished"):
+                            with res_lock:
+                                results.append(
+                                    (ttft, tokens,
+                                     time.monotonic() - t0))
+                            return
+
+        # untimed warm-up stream: compiles prefill + decode programs
+        client(0)
+        threads = [_threading.Thread(target=client, args=(i + 1,),
+                                     daemon=True)
+                   for i in range(n_clients)]
+        results.clear()
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        wall = time.monotonic() - t0
+        occ = [v for _, v in
+               REGISTRY.ring("slot_occupancy:job:genbench").series()]
+        ttfts = sorted(r[0] * 1000.0 for r in results if r[0] is not None)
+        total_tokens = sum(r[1] for r in results)
+        return {
+            f"{prefix}_clients": n_clients,
+            f"{prefix}_streams_completed": len(results),
+            f"{prefix}_ttft_p50_ms": (
+                round(ttfts[len(ttfts) // 2], 2) if ttfts else None),
+            f"{prefix}_ttft_p95_ms": (
+                round(ttfts[min(int(len(ttfts) * 0.95),
+                                len(ttfts) - 1)], 2) if ttfts else None),
+            f"{prefix}_tokens_s": (
+                round(total_tokens / wall, 1) if wall > 0 else 0.0),
+            f"{prefix}_slot_utilization": (
+                round(sum(occ) / len(occ), 3) if occ else None),
+            f"{prefix}_max_slots": int(_config.GEN_MAX_SLOTS),
+        }
+    finally:
+        ctx.stopping = True
+        server.stop(drain_timeout_s=0.0)
+        broker.unregister_worker("genbench", "genbench-w1")
+        wt.join(timeout=10)
+
+
 def _door_hist_percentiles(door: str, prefix: str) -> dict:
     """p50/p95/p99 (ms) from the serving door's OWN latency histogram
     (rafiki_request_seconds{door=...}, utils/metrics.py) — the
@@ -1083,6 +1244,16 @@ def main():
                             "native shmqueue unavailable"
                 except Exception as e:
                     serving["serving_shm_binary_error"] = repr(e)
+            # ---- generative serving: N streaming clients, one worker ---
+            # (PR 10's own phase: TTFT percentiles, aggregate tokens/s,
+            # slot utilization over the continuous-batching scheduler;
+            # deployment-free like the shm phase — same serving layers)
+            if BENCH_SERVING and os.environ.get(
+                    "RAFIKI_BENCH_GEN", "1") not in ("0", "false"):
+                try:
+                    serving.update(bench_serving_generate())
+                except Exception as e:
+                    serving["serving_generate_error"] = repr(e)
             admin.stop_all_jobs()
 
             # ---- vectorized trials: scalar vs vmapped-K, same budget ---

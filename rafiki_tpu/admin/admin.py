@@ -325,6 +325,11 @@ class Admin:
         report = self._verify_template(
             model_file_bytes, model_class, dependencies, enforce=True)
         clazz = load_model_class(model_file_bytes, model_class)
+        # task/capability consistency (docs/serving-generation.md): a
+        # generative template under a classification task — or a
+        # classification template under TEXT_GENERATION — is a typed 400
+        # HERE, not a trial-time crash or a deploy-time surprise
+        self._validate_task_capability(task, clazz, report)
         missing = validate_model_dependencies(clazz)
         if missing and not install_enabled():
             raise InvalidModelClassError(
@@ -347,6 +352,55 @@ class Admin:
             verification=json.dumps(report.to_dict()) if report else None,
         )
         return self._model_view(model)
+
+    @staticmethod
+    def _model_generation_capable(model_row: Dict) -> bool:
+        """Generation capability of a STORED model row: the persisted
+        verification report when one exists, else a fresh static pass
+        over the stored bytes (never executes the template)."""
+        verification = model_row.get("verification")
+        if isinstance(verification, str):
+            try:
+                verification = json.loads(verification)
+            except ValueError:
+                verification = None
+        caps = (verification or {}).get("capabilities") or {}
+        if "generation" in caps:
+            return bool(caps.get("generation"))
+        from rafiki_tpu import analysis
+
+        return analysis.static_generation_capability(
+            model_row["model_file_bytes"],
+            model_row.get("model_class")) is not None
+
+    @staticmethod
+    def _validate_task_capability(task: str, clazz: type, report) -> None:
+        """Task-type plumbing for the generative subsystem: the uploaded
+        template's statically-derived capability (or the runtime oracle
+        when verification ran =off) must MATCH the declared task. Both
+        mismatch directions raise the typed InvalidModelClassError the
+        HTTP door already maps to 400."""
+        from rafiki_tpu.constants import TaskType
+        from rafiki_tpu.sdk.model import generation_capability
+
+        if report is not None and "generation" in (
+                getattr(report, "capabilities", None) or {}):
+            capable = bool(report.capabilities.get("generation"))
+        else:
+            capable = generation_capability(clazz) is not None
+        if task == TaskType.TEXT_GENERATION and not capable:
+            raise InvalidModelClassError(
+                f"task {task} requires a generation-capable template: "
+                "declare a GenerationSpec class attribute and override "
+                "init_kv_cache/prefill/decode_step (sdk/model.py; a "
+                "half-wired spec does not count — see the GEN001 finding)")
+        if capable and task != TaskType.TEXT_GENERATION:
+            raise InvalidModelClassError(
+                f"template advertises a GenerationSpec but was uploaded "
+                f"under task {task}: generative templates must be "
+                f"uploaded under task {TaskType.TEXT_GENERATION} (their "
+                "serving path is the token-streaming decode loop, which "
+                f"a {task} inference job would never deploy)")
 
     @staticmethod
     def _verify_template(model_file_bytes: bytes, model_class: str,
@@ -503,6 +557,21 @@ class Admin:
             models = list(visible.values())
         if not models:
             raise InvalidRequestError(f"No usable models for task {task}")
+        # generative task plumbing: every chosen template must actually be
+        # able to serve the task — rows uploaded before the capability
+        # check existed (or under RAFIKI_VERIFY_TEMPLATES=off) are
+        # re-checked statically (zero uploaded code executes), so the
+        # mismatch is a typed 400 here instead of a trial-time crash
+        from rafiki_tpu.constants import TaskType
+
+        if task == TaskType.TEXT_GENERATION:
+            incapable = [m["name"] for m in models
+                         if not self._model_generation_capable(m)]
+            if incapable:
+                raise InvalidRequestError(
+                    f"task {task} needs generation-capable templates, but "
+                    f"{incapable} advertise no fully-wired GenerationSpec "
+                    "(init_kv_cache/prefill/decode_step; sdk/model.py)")
 
         version = self.db.get_next_app_version(user_id, app)
         job = self.db.create_train_job(
@@ -1157,13 +1226,29 @@ class Admin:
                         # worker's queue exposes them (queue_depth gauge,
                         # expired/shed totals)
                         **{k: int(payload[k])
-                           for k in ("queue_depth", "expired", "shed")
+                           for k in ("queue_depth", "expired", "shed",
+                                     "gen_slots_busy", "gen_slots_max")
                            if k in payload},
                     }
                     self._remote_serving_stats.move_to_end(sid)
                     while (len(self._remote_serving_stats)
                            > self._remote_serving_stats_cap):
                         self._remote_serving_stats.popitem(last=False)
+                if "gen_slots_busy" in payload:
+                    # the autoscaler's generative load signal lives in
+                    # THIS process's registry; a process-placed
+                    # generation worker's occupancy reaches it through
+                    # this relay (in-process workers record the ring
+                    # directly — same name, so the reader can't tell)
+                    worker_row = self.db.get_inference_job_worker(sid)
+                    slots_max = max(int(payload.get("gen_slots_max", 1)), 1)
+                    if worker_row is not None:
+                        from rafiki_tpu.utils.metrics import REGISTRY
+
+                        REGISTRY.ring(
+                            "slot_occupancy:job:"
+                            f"{worker_row['inference_job_id']}").record(
+                            int(payload["gen_slots_busy"]) / slots_max)
         except Exception:
             logger.exception("event %s failed", name)
 

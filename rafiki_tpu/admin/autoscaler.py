@@ -212,6 +212,18 @@ class Autoscaler:
         span_s = now - window[0][0]
         live = self._services.live_inference_workers(job_id)
         n_live = len(live)
+        # generative jobs (worker/generation.py): queue depth alone
+        # under-reads their load — admitted streams occupy SLOTS for
+        # hundreds of decode steps while the queue sits near empty. The
+        # workers publish a per-job occupancy ring (busy/max fraction);
+        # a sustained-full slot table is the generation-plane overload
+        # signal, symmetric with backlog depth for the one-shot plane.
+        wall_now = time.time()
+        occ = [v for t, v in
+               self._registry.ring(f"slot_occupancy:job:{job_id}").series()
+               if wall_now - t <= window_s]
+        mean_occ = (sum(occ) / len(occ)) if occ else 0.0
+        max_occ = max(occ) if occ else 0.0
         signals = {
             "shed_in_window": shed_in_window,
             "mean_backlog": round(mean_depth, 2),
@@ -219,21 +231,31 @@ class Autoscaler:
             "window_span_s": round(span_s, 2),
             "replicas": n_live,
         }
+        if occ:
+            signals["slot_occupancy"] = round(mean_occ, 2)
         # -- decide --------------------------------------------------------
         step = max(int(config.AUTOSCALE_STEP), 1)
         since_action = now - st["last_action_ts"]
+        occ_high = float(config.GEN_OCCUPANCY_HIGH)
         overloaded = (
             shed_in_window >= max(int(config.AUTOSCALE_SHED_THRESHOLD), 1)
-            or mean_depth >= float(config.AUTOSCALE_DEPTH_HIGH))
+            or mean_depth >= float(config.AUTOSCALE_DEPTH_HIGH)
+            or (bool(occ) and mean_occ >= occ_high))
         idle = (shed_in_window == 0
-                and max_depth <= float(config.AUTOSCALE_DEPTH_LOW))
+                and max_depth <= float(config.AUTOSCALE_DEPTH_LOW)
+                # saturated generation slots hold the floor even with an
+                # empty queue (half of HIGH = comfortably unsaturated)
+                and max_occ <= occ_high / 2)
         if overloaded and n_live < int(config.AUTOSCALE_MAX_REPLICAS):
             if since_action < float(config.AUTOSCALE_COOLDOWN_UP_S):
                 return None
             step = min(step, int(config.AUTOSCALE_MAX_REPLICAS) - n_live)
-            reason = ("sustained shed" if shed_in_window
-                      >= int(config.AUTOSCALE_SHED_THRESHOLD)
-                      else "sustained backlog depth")
+            if shed_in_window >= int(config.AUTOSCALE_SHED_THRESHOLD):
+                reason = "sustained shed"
+            elif mean_depth >= float(config.AUTOSCALE_DEPTH_HIGH):
+                reason = "sustained backlog depth"
+            else:
+                reason = "generation slot occupancy"
             return self._act(job_id, st, "scale_up", step, reason,
                              signals)
         if idle and n_live > int(config.AUTOSCALE_MIN_REPLICAS):

@@ -30,6 +30,7 @@ from rafiki_tpu.constants import (
     InferenceJobStatus,
     ServiceStatus,
     ServiceType,
+    TaskType,
     TrainJobStatus,
 )
 from rafiki_tpu.db.database import Database
@@ -316,6 +317,13 @@ class ServicesManager:
             raise ServiceDeploymentError(
                 f"Train job {train_job['id']} has no completed trials"
             )
+        # generative serving (docs/serving-generation.md): one BEST trial
+        # serves the job — a token stream answers from exactly one model
+        # (there is no cross-trial ensembling of incremental deltas), so
+        # extra best trials would be dead weight; replicas still scale it
+        generative = train_job["task"] == TaskType.TEXT_GENERATION
+        if generative:
+            best_trials = best_trials[:1]
         created: List[str] = []
         worker_trials: Dict[str, str] = {}
         # Capacity-aware replica count. Replicas buy capacity only when they
@@ -360,6 +368,15 @@ class ServicesManager:
         # (worker/inference.py _FusedEnsembleModel). Deployment shape
         # becomes n_replicas fused workers instead of a fleet per trial.
         fused = bool(budget.get(BudgetType.ENSEMBLE_FUSED, 0))
+        if fused and generative:
+            # fusing co-locates trials to answer one batch as one unit —
+            # meaningless for a single-trial token stream; refuse typed
+            # rather than deploy a worker shape the decode loop can't run
+            self._db.mark_inference_job_as_errored(inference_job_id)
+            raise ServiceDeploymentError(
+                "budget ENSEMBLE_FUSED is unsupported for TEXT_GENERATION "
+                "jobs: a token stream answers from one model, not a fused "
+                "cross-trial ensemble — drop ENSEMBLE_FUSED")
         if fused:
             from rafiki_tpu.sdk.sandbox import sandbox_enabled
 
@@ -401,7 +418,12 @@ class ServicesManager:
                     service["id"], inference_job_id, unit["trial_id"]
                 )
                 worker_trials[service["id"]] = unit["group"]
-                worker = InferenceWorker(
+                worker_cls = InferenceWorker
+                if generative:
+                    from rafiki_tpu.worker.generation import GenerationWorker
+
+                    worker_cls = GenerationWorker
+                worker = worker_cls(
                     inference_job_id, unit["trial_id"], self._db,
                     self._broker, trial_ids=unit["trial_ids"],
                 )
@@ -709,7 +731,12 @@ class ServicesManager:
             service = self._db.create_service(ServiceType.INFERENCE)
             self._db.create_inference_job_worker(
                 service["id"], inference_job_id, unit["trial_id"])
-            worker = InferenceWorker(
+            worker_cls = InferenceWorker
+            if train_job["task"] == TaskType.TEXT_GENERATION:
+                from rafiki_tpu.worker.generation import GenerationWorker
+
+                worker_cls = GenerationWorker
+            worker = worker_cls(
                 inference_job_id, unit["trial_id"], self._db, self._broker,
                 trial_ids=unit["trial_ids"],
             )

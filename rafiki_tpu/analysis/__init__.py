@@ -23,6 +23,7 @@ from rafiki_tpu.analysis.findings import (
 )
 from rafiki_tpu.analysis.framework import lint_package
 from rafiki_tpu.analysis.template import (
+    static_generation_capability,
     static_population_capability,
     verify_template_bytes,
     verify_template_source,
@@ -36,6 +37,7 @@ __all__ = [
     "ModelVerificationError",
     "VerificationReport",
     "lint_package",
+    "static_generation_capability",
     "static_population_capability",
     "verify_template_bytes",
     "verify_template_source",

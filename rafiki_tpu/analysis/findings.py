@@ -41,6 +41,9 @@ CODES: Dict[str, str] = {
     "POP002": "population_spec declared but population methods missing",
     "POP003": "Python branching on a dynamic knob in the train path",
     "POP004": "population_spec is not statically parseable",
+    "GEN001": "generation_spec declared but decode methods missing",
+    "GEN002": "generation decode method has an inconsistent signature",
+    "GEN003": "generation_spec is not statically parseable",
     "JAX001": "host sync (.item()/float()/np.asarray) on a traced value",
     "JAX002": "legacy global numpy.random API (thread PRNG keys instead)",
     "JAX003": "mutation of self state inside a jit/vmap-traced function",

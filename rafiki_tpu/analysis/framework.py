@@ -80,7 +80,7 @@ def lint_package(
         findings.extend(_lint_broad_excepts(rel, tree, comments))
         findings.extend(_lint_locks(rel, tree, comments))
         if any(rel.endswith(d) for d in DOOR_MODULES):
-            findings.extend(_lint_door(rel, tree))
+            findings.extend(_lint_door(rel, tree, comments))
     findings.sort(key=lambda f: (f.file, f.line))
     return findings
 
@@ -407,7 +407,8 @@ def _respond_calls(handler_body: List[ast.stmt]) -> List[ast.Call]:
     return calls
 
 
-def _lint_door(rel: str, tree: ast.Module) -> List[Finding]:
+def _lint_door(rel: str, tree: ast.Module,
+               comments: Dict[int, str]) -> List[Finding]:
     findings: List[Finding] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.ExceptHandler):
@@ -421,6 +422,12 @@ def _lint_door(rel: str, tree: ast.Module) -> List[Finding]:
                 else [node.type]
             typed = any((astutil.terminal_name(t) or "").endswith("Error")
                         for t in types)
+            # the FWK201 escape hatch applies here too: a handler MID-
+            # STREAM (chunked response already at 200) cannot answer a
+            # status — its contract is the typed terminal frame, and the
+            # annotation names it
+            if typed and _annotated(comments, node.lineno, _ABSORB_RE):
+                typed = False
             if typed and not has_raise:
                 statused = any(
                     isinstance(a, ast.Constant)

@@ -107,10 +107,13 @@ def verify_template_source(
                                   filename)
     spec = _check_population(report, target, classes, methods, knob_names,
                              filename)
+    gen_spec = _check_generation(report, target, classes, methods, filename)
     _check_jax_pitfalls(report, tree, filename)
     report.capabilities = {
         "population": spec is not None,
         "population_spec": spec,
+        "generation": gen_spec is not None,
+        "generation_spec": gen_spec,
     }
     return report
 
@@ -146,6 +149,22 @@ def static_population_capability(
         report = verify_template_source(source, class_name)
     if report.capabilities.get("population"):
         return report.capabilities.get("population_spec")
+    return None
+
+
+def static_generation_capability(
+        source, class_name: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """The static mirror of sdk/model.generation_capability: the parsed
+    GenerationSpec dict iff the template declares one AND overrides the
+    three decode methods — else None. THE capability oracle for callers
+    that must not execute uploaded code (Admin.create_train_job's
+    task/capability consistency check, doctor.py)."""
+    if isinstance(source, bytes):
+        report = verify_template_bytes(source, class_name)
+    else:
+        report = verify_template_source(source, class_name)
+    if report.capabilities.get("generation"):
+        return report.capabilities.get("generation_spec")
     return None
 
 
@@ -569,6 +588,83 @@ def _check_population(
         if fn is not None:
             _check_dynamic_knob_branching(report, fn, set(dynamic), filename)
     return {"dynamic_knobs": list(dynamic), "max_members": max_members}
+
+
+# -- pass: generative capability contract (GEN00x) ---------------------------
+
+#: decode methods a generation-capable template must override, with the
+#: positional-arg count (self included) the worker calls them with —
+#: sdk/model.py BaseModel.{init_kv_cache,prefill,decode_step}
+GENERATION_SIGNATURES = {
+    "init_kv_cache": 2,   # (self, max_slots)
+    "prefill": 4,         # (self, cache, slot, prompt_ids)
+    "decode_step": 4,     # (self, cache, ids, positions)
+}
+
+
+def _check_generation(
+        report: VerificationReport, target: ast.ClassDef,
+        classes: Dict[str, ast.ClassDef],
+        methods: Dict[str, ast.FunctionDef],
+        filename: str,
+) -> Optional[Dict[str, Any]]:
+    """The generative capability contract (mirrors _check_population):
+    a template advertising ``generation_spec`` must override the three
+    decode methods with the signatures the slot scheduler
+    (worker/generation.py) calls. Half-wired = WARN — the capability is
+    simply not advertised (generation_capability returns None), and the
+    task/capability consistency check at upload turns that into a typed
+    400 for TEXT_GENERATION uploads."""
+    node = astutil.class_attr_assign(target, classes, "generation_spec")
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and node.value is None:
+        return None
+    lineno = getattr(node, "lineno", target.lineno)
+    if not (isinstance(node, ast.Call)
+            and astutil.terminal_name(node.func) == "GenerationSpec"):
+        report.add("GEN003",
+                   "generation_spec is not a literal GenerationSpec(...) "
+                   "call — capability cannot be verified statically and a "
+                   "TEXT_GENERATION upload would be refused", WARN,
+                   filename, lineno)
+        return None
+    kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+    args = list(node.args)
+    spec: Dict[str, Any] = {"eos_token_id": None, "max_context": 128}
+    for key, pos in (("eos_token_id", 0), ("max_context", 1)):
+        val_node = args[pos] if len(args) > pos else kwargs.get(key)
+        if val_node is not None and astutil.is_constant(val_node):
+            try:
+                spec[key] = astutil.literal_value(val_node)
+            except ValueError:
+                pass
+    missing = [m for m in GENERATION_SIGNATURES if m not in methods]
+    if missing:
+        report.add("GEN001",
+                   f"{target.name} declares generation_spec but does not "
+                   f"override {', '.join(m + '()' for m in missing)} — the "
+                   "template is NOT generation-capable "
+                   "(sdk/model.generation_capability) and cannot be "
+                   "uploaded under task TEXT_GENERATION", WARN, filename,
+                   lineno)
+        return None
+    for mname, n_args in GENERATION_SIGNATURES.items():
+        fn = methods[mname]
+        if fn.args.vararg is not None:
+            continue  # *args swallows anything the worker passes
+        # callable with exactly n_args positionals: defaults shrink the
+        # required count, positional-only params count like ordinary ones
+        total = len(fn.args.posonlyargs) + len(fn.args.args)
+        required = total - len(fn.args.defaults)
+        if not required <= n_args <= total:
+            report.add("GEN002",
+                       f"{mname}() accepts {required}..{total} positional "
+                       f"arg(s) but the slot scheduler calls it with "
+                       f"{n_args} (worker/generation.py) — the first "
+                       "mid-serving call would raise TypeError", WARN,
+                       filename, fn.lineno)
+    return spec
 
 
 def _check_dynamic_knob_branching(

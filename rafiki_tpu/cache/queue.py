@@ -92,6 +92,122 @@ class QueryFuture:
         return self._value
 
 
+class GenerationError(RuntimeError):
+    """A generation stream ended with a typed terminal fault (mid-stream
+    worker error, stalled decode, malformed request). The streaming door
+    maps it to a terminal error frame on the open response — never a
+    silent hang — and :meth:`TokenStream.next_delta` re-raises it."""
+
+
+class TokenDelta:
+    """One increment of a generation stream: the token ids emitted since
+    the previous delta, plus the terminal flags. ``finished`` is True on
+    the stream's LAST delta; ``reason`` then says why (``eos`` |
+    ``max_tokens`` | ``context`` | ``deadline`` | ``error`` |
+    ``cancelled``) and ``error`` carries the fault text when reason is
+    ``error``."""
+
+    __slots__ = ("tokens", "finished", "reason", "error")
+
+    def __init__(self, tokens: List[int], finished: bool = False,
+                 reason: Optional[str] = None,
+                 error: Optional[str] = None) -> None:
+        self.tokens = list(tokens)
+        self.finished = bool(finished)
+        self.reason = reason
+        self.error = error
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"tokens": self.tokens,
+                               "finished": self.finished}
+        if self.reason is not None:
+            out["reason"] = self.reason
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+class TokenStream:
+    """The per-sequence channel between the generation worker's slot
+    scheduler and the streaming door.
+
+    The worker PUSHES :class:`TokenDelta` increments (and exactly one
+    terminal delta: ``finished=True`` or a typed failure); the door PULLS
+    with :meth:`next_delta` and writes each increment to the chunked HTTP
+    response as it lands. ``cancel()`` is the consumer's back-signal — a
+    client that disconnected mid-stream — which the scheduler reads to
+    evict the slot instead of decoding for nobody."""
+
+    __slots__ = ("seq_id", "_cond", "_deltas", "_finished", "_cancelled")
+
+    def __init__(self, seq_id: str) -> None:
+        self.seq_id = seq_id
+        self._cond = threading.Condition()
+        self._deltas: List[TokenDelta] = []
+        self._finished = False
+        self._cancelled = False
+
+    def push(self, tokens: List[int], finished: bool = False,
+             reason: Optional[str] = None) -> None:
+        """Worker side: append one increment (terminal when ``finished``).
+        Pushes after the terminal delta are dropped — a scheduler racing a
+        door-side cancel must not resurrect a closed stream."""
+        with self._cond:
+            if self._finished:
+                return
+            self._deltas.append(TokenDelta(tokens, finished, reason))
+            self._finished = self._finished or finished
+            self._cond.notify_all()
+
+    def fail(self, message: str) -> None:
+        """Worker side: terminal typed fault — the stream ends with an
+        error delta (reason ``error``), never a silent stop."""
+        with self._cond:
+            if self._finished:
+                return
+            self._deltas.append(
+                TokenDelta([], finished=True, reason="error", error=message))
+            self._finished = True
+            self._cond.notify_all()
+
+    def cancel(self) -> None:
+        """Consumer side: stop decoding for this sequence (client gone or
+        the door gave up on a stalled stream). The scheduler evicts the
+        slot at its next step."""
+        with self._cond:
+            self._cancelled = True
+            self._cond.notify_all()
+
+    @property
+    def cancelled(self) -> bool:
+        with self._cond:
+            return self._cancelled
+
+    @property
+    def finished(self) -> bool:
+        with self._cond:
+            return self._finished and not self._deltas
+
+    def next_delta(self, timeout: Optional[float] = None) -> TokenDelta:
+        """Block for the next increment. Raises ``TimeoutError`` when no
+        delta lands inside ``timeout`` (the door's stall detector — it
+        converts this into a terminal error frame), ``GenerationError``
+        when the stream already delivered its terminal error, and
+        ``StopIteration`` once the terminal delta has been consumed."""
+        with self._cond:
+            if not self._deltas and self._finished:
+                raise StopIteration
+            if not self._deltas and not self._cond.wait_for(
+                    lambda: bool(self._deltas), timeout):
+                raise TimeoutError(
+                    f"no token for sequence {self.seq_id} within "
+                    f"{(timeout or 0.0):.1f}s")
+            delta = self._deltas.pop(0)
+            if delta.error is not None:
+                raise GenerationError(delta.error)
+            return delta
+
+
 class WorkerQueue:
     """A single inference worker's bounded inbox of pending queries.
 

@@ -63,7 +63,19 @@ MAGIC = b"\xabRWF"
 # Fleet-relay peers advertise SUPPORTED_VERSIONS on /healthz and the
 # sender picks the intersection (cache/fleet.py).
 VERSION = 2
-SUPPORTED_VERSIONS = frozenset({1, 2})
+# v3: the INCREMENTAL-RESPONSE message kind (generative serving,
+# docs/serving-generation.md). A v3 frame is an ordinary frame whose
+# header carries a "g" key — {"sid": sequence id, "fin": finished flag,
+# "reason": finish reason, "err": terminal error} — and whose single
+# array-table entry is the delta's token ids. Token-delta frames are
+# version-marked 3 precisely so an OLD peer can never half-understand
+# one: a {1,2} decoder answers the typed WireFormatError("unsupported
+# wire version"), and senders consult the peer's advertised versions
+# (the /healthz wire_versions handshake; the streaming door's explicit
+# Accept opt-in) before ever emitting one. Non-generative traffic keeps
+# emitting v1/v2 byte-identically.
+TOKEN_DELTA_VERSION = 3
+SUPPORTED_VERSIONS = frozenset({1, 2, 3})
 _ALIGN = 16
 # HTTP Content-Type for frames on the fleet relay (placement/agent.py
 # negotiates it via the /healthz "wire_versions" advertisement)
@@ -189,15 +201,19 @@ def decode(raw: bytes) -> Any:
     return decode_meta(raw)[0]
 
 
-def decode_meta(raw: bytes) -> tuple:
+def decode_meta(raw: bytes, versions: frozenset = SUPPORTED_VERSIONS
+                ) -> tuple:
     """Like :func:`decode` but returns ``(body, meta)`` where ``meta`` is
     the frame-level metadata dict — ``{"trace": ...}`` for a v2 frame
-    carrying request-trace context, ``{}`` otherwise."""
+    carrying request-trace context, ``{"gen": ...}`` for a v3 token-delta
+    frame, ``{}`` otherwise. ``versions`` narrows what this receiver
+    accepts (tests model old peers with it; the default is everything
+    this build speaks)."""
     if not is_frame(raw):
         raise WireFormatError("not a wire frame (bad magic)")
     if len(raw) < 10:
         raise WireFormatError("truncated frame header")
-    if raw[4] not in SUPPORTED_VERSIONS:
+    if raw[4] not in versions:
         raise WireFormatError(f"unsupported wire version {raw[4]}")
     hlen = int.from_bytes(raw[6:10], "little")
     if 10 + hlen > len(raw):
@@ -210,6 +226,8 @@ def decode_meta(raw: bytes) -> tuple:
     meta = {}
     if isinstance(header, dict) and "t" in header:
         meta["trace"] = header["t"]
+    if isinstance(header, dict) and "g" in header:
+        meta["gen"] = header["g"]
     payload_start = 10 + hlen + _pad16(10 + hlen)
     payload = memoryview(raw)[payload_start:]
     views: List[np.ndarray] = []
@@ -259,6 +277,62 @@ def decode_any_meta(raw: bytes) -> tuple:
         return json.loads(raw), {}
     except (ValueError, UnicodeDecodeError) as e:
         raise WireFormatError(f"neither wire frame nor JSON: {e}") from e
+
+
+# -- incremental-response message kind (generative serving) ------------------
+
+def encode_token_delta(seq_id: str, tokens, finished: bool = False,
+                       reason: Any = None, error: Any = None) -> bytes:
+    """One v3 token-delta frame: sequence id + this increment's token ids
+    + the finished flag (and, on the terminal delta, the finish reason /
+    typed error text). The streaming door emits these to clients that
+    opted in via Accept, and the shm/fleet hops may relay them to peers
+    advertising wire version 3 — an old peer rejects the version byte
+    with a typed WireFormatError before ever misreading the kind."""
+    arr = np.ascontiguousarray(np.asarray(list(tokens), dtype=np.int32))
+    g: dict = {"sid": str(seq_id), "fin": bool(finished)}
+    if reason is not None:
+        g["reason"] = str(reason)
+    if error is not None:
+        g["err"] = str(error)
+    table = [[arr.dtype.str, list(arr.shape), 0, arr.nbytes]]
+    header = json.dumps({"b": {_ND_KEY: 0}, "a": table, "g": g}).encode()
+    return b"".join([
+        MAGIC, bytes([TOKEN_DELTA_VERSION, 0]),
+        len(header).to_bytes(4, "little"), header,
+        b"\x00" * _pad16(len(MAGIC) + 2 + 4 + len(header)),
+        arr.tobytes()])
+
+
+def is_token_delta(raw: bytes) -> bool:
+    """Cheap sniff: a frame whose version byte marks the incremental-
+    response kind (full validation happens in :func:`decode_token_delta`)."""
+    return is_frame(raw) and len(raw) >= 5 and raw[4] == TOKEN_DELTA_VERSION
+
+
+def decode_token_delta(raw: bytes,
+                       versions: frozenset = SUPPORTED_VERSIONS):
+    """Decode one incremental-response frame into ``(seq_id,
+    TokenDelta)``. Every malformed shape — missing "g" metadata, wrong
+    field types, non-integer token payload, truncation — raises the one
+    :class:`WireFormatError` receivers already absorb."""
+    from rafiki_tpu.cache.queue import TokenDelta
+
+    body, meta = decode_meta(raw, versions)
+    g = meta.get("gen")
+    if not isinstance(g, dict):
+        raise WireFormatError("frame carries no token-delta metadata")
+    sid, fin = g.get("sid"), g.get("fin")
+    if not isinstance(sid, str) or not isinstance(fin, bool):
+        raise WireFormatError("garbled token-delta metadata (sid/fin)")
+    reason, err = g.get("reason"), g.get("err")
+    if ((reason is not None and not isinstance(reason, str))
+            or (err is not None and not isinstance(err, str))):
+        raise WireFormatError("garbled token-delta metadata (reason/err)")
+    if not isinstance(body, np.ndarray) or body.dtype.kind not in "iu":
+        raise WireFormatError("token-delta payload is not an integer array")
+    return sid, TokenDelta([int(t) for t in body.ravel()],
+                           finished=fin, reason=reason, error=err)
 
 
 def dumps(obj: Any, trace: Any = None) -> bytes:

@@ -11,6 +11,7 @@ client.py:487-506), inference job CRUD, predict, advisor endpoints,
 from __future__ import annotations
 
 import base64
+import json
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -36,6 +37,13 @@ class AdminRecoveringError(RafikiError):
     plane crash recovery) is still running. Retryable: poll
     :meth:`Client.wait_until_admin_ready` or just retry after the
     ``Retry-After`` interval."""
+
+
+class GenerationStreamError(RafikiError):
+    """A generation stream ended with a typed terminal error frame
+    (mid-stream worker fault, stalled decode past the door's inter-token
+    timeout). Tokens yielded before the fault are valid — the stream
+    failed, not the transport."""
 
 
 class Client:
@@ -303,6 +311,29 @@ class Client:
         )
         return data["predictions"]
 
+    def _dedicated_door(self, app: str, app_version: int):
+        """Resolve (and TTL-cache) the app's dedicated predictor door as
+        ``(host, port, expiry)`` — shared by :meth:`predict_direct` and
+        :meth:`generate`; entries drop on any request failure so a moved
+        door re-resolves within seconds."""
+        import time as _time
+
+        from rafiki_tpu import config as _config
+
+        key = (app, app_version)
+        cached = self._predictor_ports.get(key)
+        now = _time.monotonic()
+        if cached is None or cached[2] < now:
+            inf = self.get_inference_job(app, app_version)
+            host, port = inf.get("predictor_host"), inf.get("predictor_port")
+            if not host or not port:
+                raise RafikiError(
+                    f"inference job for {app} has no dedicated predictor "
+                    f"port (deployment runs without RAFIKI_PREDICTOR_PORTS)")
+            cached = (host, port, now + _config.PREDICT_ROUTE_TTL_S)
+            self._predictor_ports[key] = cached
+        return cached
+
     def predict_direct(
         self, app: str, queries: Any, app_version: int = -1
     ) -> List[Any]:
@@ -321,22 +352,8 @@ class Client:
         window, not per predict — and dropped on any failure, so a
         redeploy (or an app_version=-1 'latest' that moved) re-resolves
         within seconds rather than serving a stale port forever."""
-        import time as _time
-
-        from rafiki_tpu import config as _config
-
         key = (app, app_version)
-        cached = self._predictor_ports.get(key)
-        now = _time.monotonic()
-        if cached is None or cached[2] < now:
-            inf = self.get_inference_job(app, app_version)
-            host, port = inf.get("predictor_host"), inf.get("predictor_port")
-            if not host or not port:
-                raise RafikiError(
-                    f"inference job for {app} has no dedicated predictor "
-                    f"port (deployment runs without RAFIKI_PREDICTOR_PORTS)")
-            cached = (host, port, now + _config.PREDICT_ROUTE_TTL_S)
-            self._predictor_ports[key] = cached
+        cached = self._dedicated_door(app, app_version)
         headers = {}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
@@ -386,6 +403,109 @@ class Client:
             raise RafikiError(payload.get("error",
                                           f"HTTP {resp.status_code}"))
         return payload["data"]["predictions"]
+
+    def generate(self, app: str, prompt_ids: List[int],
+                 max_tokens: Optional[int] = None, app_version: int = -1,
+                 timeout_s: Optional[float] = None, binary: bool = False):
+        """Stream a ``TEXT_GENERATION`` completion token-by-token through
+        the app's dedicated predictor door (POST /generate, chunked
+        transfer). Yields one delta dict per emitted increment —
+        ``{"tokens": [...], "finished": bool, "reason": ...}`` — the
+        moment it arrives, so the first token lands long before a long
+        completion ends.
+
+        ``binary=True`` opts into length-prefixed v3 wire token-delta
+        frames instead of JSON lines (the zero-parse path; old doors that
+        ignore the Accept header still answer JSON — the frame sniff
+        handles either). A typed terminal error frame (mid-stream worker
+        fault, stalled decode) raises :class:`GenerationStreamError`
+        after yielding every token received before the fault."""
+        key = (app, app_version)
+        host, port, _ = self._dedicated_door(app, app_version)
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        body: Dict[str, Any] = {"prompt_ids": list(prompt_ids)}
+        if max_tokens is not None:
+            body["max_tokens"] = int(max_tokens)
+        if timeout_s is not None:
+            body["timeout_s"] = float(timeout_s)
+        if binary:
+            from rafiki_tpu.cache import wire
+
+            headers["Accept"] = wire.CONTENT_TYPE
+        try:
+            resp = self._http.request(
+                "POST", f"http://{host}:{port}/generate",
+                headers=headers, json=body, stream=True)
+        except requests.RequestException as e:
+            self._predictor_ports.pop(key, None)
+            raise RafikiError(f"dedicated predictor unreachable: {e}")
+        with resp:
+            if resp.status_code != 200:
+                self._predictor_ports.pop(key, None)
+                try:
+                    payload = resp.json()
+                except ValueError:
+                    payload = {}
+                raise RafikiError(
+                    payload.get("error", f"HTTP {resp.status_code}"),
+                    status=resp.status_code)
+            ctype = (resp.headers.get("Content-Type") or "").split(";")[0]
+            deltas = (self._iter_wire_deltas(resp)
+                      if ctype == "application/x-rafiki-wire"
+                      else self._iter_json_deltas(resp))
+            try:
+                yield from deltas
+            except requests.RequestException as e:
+                # the stream was cut by the TRANSPORT (door/worker host
+                # died mid-chunk — no terminal delta arrived): typed like
+                # every other route failure, and the cached door is
+                # suspect, so drop it for the next call
+                self._predictor_ports.pop(key, None)
+                raise RafikiError(
+                    f"generation stream cut mid-transfer: {e}")
+
+    @staticmethod
+    def _iter_json_deltas(resp):
+        buf = b""
+        for data in resp.iter_content(chunk_size=None):
+            buf += data
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                try:
+                    delta = json.loads(line)
+                except ValueError as e:
+                    raise RafikiError(f"garbled stream delta: {e}")
+                if delta.get("error"):
+                    raise GenerationStreamError(delta["error"])
+                yield delta
+                if delta.get("finished"):
+                    return
+
+    @staticmethod
+    def _iter_wire_deltas(resp):
+        from rafiki_tpu.cache import wire
+
+        buf = b""
+        for data in resp.iter_content(chunk_size=None):
+            buf += data
+            while len(buf) >= 4:
+                n = int.from_bytes(buf[:4], "little")
+                if len(buf) < 4 + n:
+                    break
+                frame, buf = buf[4:4 + n], buf[4 + n:]
+                try:
+                    _, delta = wire.decode_token_delta(frame)
+                except wire.WireFormatError as e:
+                    raise RafikiError(f"garbled token-delta frame: {e}")
+                if delta.error is not None:
+                    raise GenerationStreamError(delta.error)
+                yield delta.to_json()
+                if delta.finished:
+                    return
 
     # -- advisors (reference client.py:586-644) ----------------------------------
 

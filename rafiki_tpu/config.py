@@ -228,6 +228,29 @@ _DYNAMIC_PATHS = {
     #                                   past its fair share before 429s
     #   RAFIKI_AUTOSCALE_FAIR_WEIGHTS=  "appA=3,appB=1" (unlisted
     #                                   tenants weigh 1)
+    # -- generative serving (docs/serving-generation.md). Lazy like the
+    # other serving knobs so a live deployment's NEXT worker/stream picks
+    # up a retune:
+    #   RAFIKI_GEN_MAX_SLOTS=8          co-resident sequences per
+    #                                   generation worker (the KV cache is
+    #                                   preallocated at this width; doctor
+    #                                   WARNs past the memory heuristic)
+    #   RAFIKI_GEN_MAX_TOKENS=64        per-request decode budget cap (a
+    #                                   request asking more is clamped)
+    #   RAFIKI_GEN_STREAM_TIMEOUT_S=10  door-side inter-token stall
+    #                                   timeout: a stream with no delta
+    #                                   for this long ends with a typed
+    #                                   terminal error frame
+    #   RAFIKI_GEN_OCCUPANCY_HIGH=0.85  mean slot occupancy over the
+    #                                   autoscaler window that reads
+    #                                   "generation slots saturated" and
+    #                                   scales the job up
+    "GEN_MAX_SLOTS": lambda: _env_int("RAFIKI_GEN_MAX_SLOTS", 8),
+    "GEN_MAX_TOKENS": lambda: _env_int("RAFIKI_GEN_MAX_TOKENS", 64),
+    "GEN_STREAM_TIMEOUT_S": lambda: _env_float(
+        "RAFIKI_GEN_STREAM_TIMEOUT_S", 10.0),
+    "GEN_OCCUPANCY_HIGH": lambda: _env_float(
+        "RAFIKI_GEN_OCCUPANCY_HIGH", 0.85),
     "AUTOSCALE": lambda: os.environ.get("RAFIKI_AUTOSCALE", "0") == "1",
     "AUTOSCALE_INTERVAL_S": lambda: _env_float(
         "RAFIKI_AUTOSCALE_INTERVAL_S", 2.0),

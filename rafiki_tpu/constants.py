@@ -69,6 +69,15 @@ class TaskType:
     # Present only in the vivansxu fork (reference rafiki/constants.py:62).
     IMAGE_GENERATION = "IMAGE_GENERATION"
     TEXT_CLASSIFICATION = "TEXT_CLASSIFICATION"
+    # Token-streaming generative serving (new capability; no reference
+    # analogue): templates must advertise a fully-wired GenerationSpec
+    # (sdk/model.py), inference workers run the continuous-batching
+    # decode loop (worker/generation.py), and the dedicated predictor
+    # door streams deltas (docs/serving-generation.md). Task/capability
+    # consistency is validated at model upload AND train-job creation —
+    # a generative template on a classification job (or vice versa) is a
+    # typed 400, never a trial-time crash.
+    TEXT_GENERATION = "TEXT_GENERATION"
 
 
 class ModelDependency:

@@ -520,6 +520,96 @@ def check_int8_serving() -> Check:
             "docs/performance.md explains when int8 can still win")
 
 
+def check_generative_serving() -> Check:
+    """Generative serving (docs/serving-generation.md): WARN when the
+    slot table is misconfigured against the chip-memory heuristic (every
+    slot preallocates a max_context-long KV ring — slots x context is the
+    cache's token capacity, and past ~64 slots a worker is trading HBM
+    for queueing the door could do better), when the stall detector is
+    disabled, and when live TEXT_GENERATION jobs have no reachable
+    streaming door (the chunked /generate route only exists on the
+    dedicated per-job predictor port)."""
+    from rafiki_tpu import config
+
+    notes = []
+    warn = False
+    slots = int(config.GEN_MAX_SLOTS)
+    if slots < 1:
+        warn = True
+        notes.append(f"RAFIKI_GEN_MAX_SLOTS={slots}: generation workers "
+                     "clamp to 1 slot — continuous batching is OFF")
+    elif slots > 64:
+        warn = True
+        notes.append(
+            f"RAFIKI_GEN_MAX_SLOTS={slots} is past the memory heuristic "
+            "(~64): each slot preallocates a full max_context KV ring in "
+            "HBM and decode advances EVERY slot each step — prefer more "
+            "replicas over a wider table")
+    if float(config.GEN_STREAM_TIMEOUT_S) <= 0:
+        warn = True
+        notes.append("RAFIKI_GEN_STREAM_TIMEOUT_S<=0: the door clamps "
+                     "the stall detector to 0.1s — streams may be cut "
+                     "before slow decodes deliver")
+    gen_jobs = 0
+    doors = []
+    target = str(config.DB_PATH)
+    is_url = target.startswith(("postgresql://", "postgres://"))
+    if is_url or os.path.exists(target):
+        try:
+            from rafiki_tpu.db.database import Database
+
+            db = Database(target)
+            try:
+                for inf in db.get_inference_jobs_by_statuses(["RUNNING"]):
+                    tj = db.get_train_job(inf["train_job_id"])
+                    if not tj or tj["task"] != "TEXT_GENERATION":
+                        continue
+                    gen_jobs += 1
+                    psvc = (db.get_service(inf["predictor_service_id"])
+                            if inf.get("predictor_service_id") else None)
+                    host = (psvc or {}).get("host")
+                    port = (psvc or {}).get("port")
+                    if not host or not port:
+                        warn = True
+                        notes.append(
+                            f"gen job {inf['id'][:8]}: no dedicated "
+                            "predictor door published — streaming "
+                            "/generate needs RAFIKI_PREDICTOR_PORTS=1")
+                        continue
+                    try:
+                        import urllib.request
+
+                        with urllib.request.urlopen(
+                                f"http://{host}:{port}/healthz",
+                                timeout=2.0) as resp:
+                            ok = resp.status == 200
+                    # lint: absorb(an unreachable door is the WARN itself, not a crash)
+                    except Exception:
+                        ok = False
+                    if ok:
+                        doors.append(f"{host}:{port}")
+                    else:
+                        warn = True
+                        notes.append(
+                            f"gen job {inf['id'][:8]}: streaming door "
+                            f"{host}:{port} UNREACHABLE")
+            finally:
+                db.close()
+        # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+        except Exception as e:
+            return ("generative serving", WARN,
+                    f"could not scan {target}: {type(e).__name__}: {e}")
+    if warn:
+        return ("generative serving", WARN, "; ".join(notes))
+    detail = (f"{slots} slots/worker, max {int(config.GEN_MAX_TOKENS)} "
+              f"tokens/request, stall cutoff "
+              f"{float(config.GEN_STREAM_TIMEOUT_S):g}s")
+    if gen_jobs:
+        detail += (f"; {gen_jobs} live generation job(s), doors: "
+                   + (", ".join(doors) or "none"))
+    return ("generative serving", PASS, detail)
+
+
 def check_autoscaler(total_chips: int = None) -> Check:
     """Elastic serving autoscaler (docs/failure-model.md "Overload
     adaptation"): WARN when the serving plane is visibly shedding while
@@ -768,7 +858,7 @@ CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
     check_chaos, check_overload_knobs, check_autoscaler, check_recovery,
     check_trial_faults, check_vectorized_trials, check_static_analysis,
-    check_int8_serving,
+    check_int8_serving, check_generative_serving,
     check_observability, check_agents, check_backend,
 ]
 

@@ -89,6 +89,148 @@ def loss_fn(params: Params, batch: Tuple[jax.Array, jax.Array],
     return total, {"ce": loss, "moe_aux": aux}
 
 
+# -- autoregressive decode path (generative serving) -------------------------
+#
+# The serving subsystem (worker/generation.py) drives these three functions:
+# ``init_kv_cache`` preallocates a fixed-shape per-layer K/V ring for a fixed
+# number of sequence SLOTS, ``prefill`` ingests one slot's prompt (same math
+# as ``apply`` — causal full-sequence attention — while also writing the
+# prompt's K/V into the slot), and ``decode_step`` advances EVERY slot by one
+# token against the cache. All shapes are fixed at cache-allocation time, so
+# one jitted decode program serves the whole lifetime of the batch: sequences
+# join (prefill) and leave (slot reuse) without recompiling, which is what
+# makes token-level continuous batching cheap.
+#
+# Both forwards share one implementation (``_cached_forward``): prefill is
+# the T=P case with positions 0..P-1, decode the T=1 case at each slot's
+# current position. Dense blocks only — MoE routing differs per token batch
+# and is refused at cache init.
+
+Cache = Dict[str, jax.Array]
+
+
+def init_kv_cache(cfg: LMConfig, max_slots: int,
+                  max_len: Optional[int] = None,
+                  dtype=jnp.float32) -> Cache:
+    """Preallocate the decode cache: per-layer K/V of shape
+    ``(depth, max_slots, max_len, heads, head_dim)``. ``max_len`` defaults
+    to ``cfg.max_len`` (prompt + generated tokens must fit)."""
+    if cfg.encoder.moe_experts > 0:
+        raise ValueError(
+            "KV-cached decode supports dense blocks only (moe_experts=0): "
+            "MoE top-k routing is per-token and the fixed-shape decode "
+            "program cannot carry its dispatch state in the cache")
+    enc = cfg.encoder
+    max_len = int(max_len or cfg.max_len)
+    shape = (enc.depth, int(max_slots), max_len, enc.heads,
+             enc.dim // enc.heads)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_max_len(cache: Cache) -> int:
+    return int(cache["k"].shape[2])
+
+
+def cache_max_slots(cache: Cache) -> int:
+    return int(cache["k"].shape[1])
+
+
+def _cached_forward(params: Params, ck: jax.Array, cv: jax.Array,
+                    ids: jax.Array, positions: jax.Array, cfg: LMConfig
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared prefill/decode forward over per-slot caches.
+
+    ``ids``/``positions``: (B, T) int32 — token ids and the cache indices
+    they occupy. ``ck``/``cv``: (depth, B, L, H, Dh) — the cache rows of
+    the B slots being advanced. New K/V are written at ``positions`` and
+    attention reads the cache up to each query's own position (causal by
+    construction). Returns (logits (B, T, V) f32, new_ck, new_cv).
+    Same math as :func:`apply` for dense blocks (reference attention,
+    f32 softmax statistics), so a prefilled-then-decoded sequence tracks
+    the full-sequence forward."""
+    enc = cfg.encoder
+    b, t = ids.shape
+    length = ck.shape[2]
+    compute_dtype = ck.dtype
+    x = core.embedding(params["embed"], ids, dtype=compute_dtype)
+    pos_table = params["pos"][0].astype(compute_dtype)  # (max_len, D)
+    x = x + jnp.take(pos_table, positions, axis=0)      # (B, T, D)
+    batch_ix = jnp.arange(b)[:, None]                   # (B, 1)
+    # (B, T, L): query token at positions[b, i] attends cache slots <= it
+    mask = jnp.arange(length)[None, None, :] <= positions[:, :, None]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(enc.dim // enc.heads, jnp.float32))
+
+    def body(x, layer):
+        p, lk, lv = layer  # block params, (B, L, H, Dh) cache planes
+        h = core.layernorm(p["ln1"], x)
+        q = jnp.einsum("btd,dhk->bthk", h, p["attn"]["wq"].astype(x.dtype))
+        k = jnp.einsum("btd,dhk->bthk", h, p["attn"]["wk"].astype(x.dtype))
+        v = jnp.einsum("btd,dhk->bthk", h, p["attn"]["wv"].astype(x.dtype))
+        lk = lk.at[batch_ix, positions].set(k.astype(lk.dtype))
+        lv = lv.at[batch_ix, positions].set(v.astype(lv.dtype))
+        s = jnp.einsum("bthk,blhk->bthl", q, lk.astype(q.dtype),
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask[:, :, None, :], s, -1e30)  # broadcast over H
+        a = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bthl,blhk->bthk", a, lv.astype(q.dtype))
+        attn_out = jnp.einsum(
+            "bthk,hkd->btd", o, p["attn"]["wo"].astype(x.dtype))
+        x = x + attn_out + p["attn"]["bo"].astype(x.dtype)
+        h = core.layernorm(p["ln2"], x)
+        h = core.dense(p["mlp"]["w1"], h)
+        h = jax.nn.gelu(h)
+        h = core.dense(p["mlp"]["w2"], h)
+        return x + h, (lk, lv)
+
+    x, (new_ck, new_cv) = jax.lax.scan(body, x, (params["blocks"], ck, cv))
+    x = core.layernorm(params["ln_f"], x)
+    logits = jnp.einsum("btd,vd->btv", x,
+                        params["embed"]["table"].astype(x.dtype))
+    return logits.astype(jnp.float32), new_ck, new_cv
+
+
+def prefill(params: Params, cache: Cache, slot: jax.Array, ids: jax.Array,
+            length: jax.Array, cfg: LMConfig) -> Tuple[jax.Array, Cache]:
+    """Ingest one slot's prompt: write its K/V into ``cache[:, slot]`` and
+    return the next-token logits at the prompt's last REAL position.
+
+    ``ids``: (T,) int32, right-padded to any fixed bucket length so one
+    compiled prefill serves every prompt of that bucket; ``length`` is the
+    true prompt length (pad K/V beyond it are written but sit above the
+    decode frontier, and each decode step overwrites the next index before
+    attention can ever reach it). Returns (logits (V,), cache)."""
+    ids = jnp.asarray(ids, jnp.int32)[None]                    # (1, T)
+    positions = jnp.arange(ids.shape[1], dtype=jnp.int32)[None]
+    ck = cache["k"][:, slot][:, None]                          # (D, 1, L, H, Dh)
+    cv = cache["v"][:, slot][:, None]
+    logits, ck, cv = _cached_forward(params, ck, cv, ids, positions, cfg)
+    cache = {"k": cache["k"].at[:, slot].set(ck[:, 0]),
+             "v": cache["v"].at[:, slot].set(cv[:, 0])}
+    last = jnp.asarray(length, jnp.int32) - 1
+    return logits[0, last], cache
+
+
+def decode_step(params: Params, cache: Cache, ids: jax.Array,
+                positions: jax.Array, cfg: LMConfig
+                ) -> Tuple[jax.Array, Cache]:
+    """Advance every slot one token: ``ids``/``positions`` are (S,) int32
+    (the last emitted token per slot and the cache index it lands at).
+    Returns (logits (S, V) f32, cache). Fixed shapes — one jitted program
+    serves the batch for its whole lifetime; idle slots are advanced too
+    (their outputs are ignored by the scheduler), which wastes flops but
+    never recompiles."""
+    ids = jnp.asarray(ids, jnp.int32)[:, None]                 # (S, 1)
+    positions = jnp.asarray(positions, jnp.int32)[:, None]
+    logits, ck, cv = _cached_forward(
+        params, cache["k"], cache["v"], ids, positions, cfg)
+    return logits[:, 0], {"k": ck, "v": cv}
+
+
+def greedy_token(logits: jax.Array) -> jax.Array:
+    """argmax over the vocab axis — the default (deterministic) sampler."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 def partition_specs(cfg: LMConfig) -> Params:
     return {
         "embed": {"table": P(None, "model")},

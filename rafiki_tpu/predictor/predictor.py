@@ -184,6 +184,51 @@ class Predictor:
     def predict(self, query: Any, timeout_s: Optional[float] = None) -> Any:
         return self.predict_batch([query], timeout_s)[0]
 
+    def generate(self, query: Dict[str, Any],
+                 timeout_s: Optional[float] = None):
+        """Route one generation request to a worker's slot scheduler and
+        return its :class:`~rafiki_tpu.cache.queue.TokenStream`.
+
+        Generation routes to exactly ONE replica (a token stream cannot be
+        ensembled across trials the way one-shot predictions are):
+        round-robin over the routable, non-draining workers, walking past
+        bounded queues that refuse — same failover shape as the first
+        submit of :meth:`predict_batch`. The returned stream's deltas are
+        the worker's; the streaming door owns stall detection. Raises
+        QueueFullError when every queue refuses, TimeoutError when no
+        slot admits the request inside its deadline."""
+        timeout_s = (timeout_s if timeout_s is not None
+                     else config.PREDICT_TIMEOUT_S)
+        deadline = time.monotonic() + timeout_s
+        queues = self._broker.get_worker_queues(self._job_id)
+        if not queues:
+            raise RuntimeError(
+                f"No inference workers registered for job {self._job_id}")
+        trials, draining = self._route_snapshot()
+        routable = [w for w in queues
+                    if (not trials or w in trials) and w not in draining]
+        if not routable:
+            routable = [w for w in queues if not trials or w in trials] \
+                or list(queues)
+        rr = next(self._rr) % len(routable)
+        order = routable[rr:] + routable[:rr]
+        fut = None
+        for wid in order:
+            try:
+                fut = queues[wid].submit_many(
+                    [dict(query, max_duration_s=timeout_s)],
+                    deadline=deadline)[0]
+            except QueueFullError:
+                continue
+            break
+        if fut is None:
+            self._bump("requests_shed")
+            raise QueueFullError(
+                f"all serving queues for job {self._job_id} are full")
+        # the worker resolves the future with the TokenStream the moment
+        # a slot admits the request (prefill done, first token pushed)
+        return fut.result(max(deadline - time.monotonic(), 0.0))
+
     def predict_batch(
         self, queries: List[Any], timeout_s: Optional[float] = None,
         trace=None,

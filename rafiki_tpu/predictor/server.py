@@ -88,7 +88,11 @@ class PredictorServer:
                     server._respond(self, 404, {"error": "no such route"})
 
             def do_POST(self):
-                server._predict(self)
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/generate":
+                    server._generate(self)
+                else:
+                    server._predict(self)
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
@@ -351,6 +355,213 @@ class PredictorServer:
             logger.exception("predict failed on dedicated port for %s",
                              self.app)
             self._respond(handler, 500, {"error": "internal server error"})
+
+    # -- generative serving: the streaming door -----------------------------
+
+    def _generate(self, handler: BaseHTTPRequestHandler) -> None:
+        """POST /generate — the token-streaming door
+        (docs/serving-generation.md). The request is one JSON object
+        ``{"prompt_ids": [...], "max_tokens": N, "timeout_s": T}``;
+        the response is chunked transfer, one delta per chunk: JSON
+        lines by default, or length-prefixed v3 wire token-delta frames
+        when the client sent ``Accept: application/x-rafiki-wire``
+        (binary peers OPT IN — an old client never sees the new message
+        kind). Admission charges the request its ESTIMATED DECODE COST
+        (``max_tokens``), not 1: a 256-token stream occupies a slot ~256
+        times longer than a one-shot predict, and the fairness/backlog
+        books must see that.
+
+        Fault contract: every pre-stream refusal is an ordinary status
+        code (400/401/429/503/504); once streaming begins the status is
+        already 200, so mid-stream faults — an injured worker, a stalled
+        decode step past RAFIKI_GEN_STREAM_TIMEOUT_S — end the response
+        with a TYPED terminal error frame, never a silent hang."""
+        from rafiki_tpu.utils.metrics import REGISTRY
+        from rafiki_tpu.worker.generation import GenerationRequestError
+
+        # release() must pair ONLY with a successful admit(): a request
+        # refused before (or BY) admission never incremented the
+        # in-flight book, and decrementing for it would leak capacity
+        # another stream is holding — the cap would over-admit under a
+        # shed burst
+        held = [False]
+
+        def release():
+            if held[0]:
+                held[0] = False
+                self.admission.release(tenant=self.app)
+
+        try:
+            if self.auth:
+                token = (handler.headers.get("Authorization")
+                         or "").removeprefix("Bearer ")
+                decode_token(token)
+            from rafiki_tpu import config as _config
+            from rafiki_tpu.utils.reqfields import (
+                parse_timeout_s,
+                read_bounded_body,
+            )
+
+            raw, berr = read_bounded_body(
+                handler, _config.PREDICT_MAX_BODY_MB)
+            if berr:
+                return self._respond(
+                    handler, berr[0],
+                    {"error": f"{berr[1]} (PREDICT_MAX_BODY_MB)"})
+            body = json.loads(raw or b"{}")
+            if not isinstance(body, dict):
+                return self._respond(handler, 400, {
+                    "error": "body must be a JSON object like "
+                             '{"prompt_ids": [...]}'})
+            timeout_s, terr = parse_timeout_s(
+                body.get("timeout_s"), default=_config.PREDICT_TIMEOUT_S,
+                label="timeout_s")
+            if terr:
+                return self._respond(handler, 400, {"error": terr})
+            try:
+                max_tokens = int(body.get(
+                    "max_tokens", _config.GEN_MAX_TOKENS))
+            except (TypeError, ValueError):
+                return self._respond(handler, 400, {
+                    "error": "max_tokens must be an integer"})
+            query = {"prompt_ids": body.get("prompt_ids"),
+                     "max_tokens": max_tokens}
+            backlog_fn = getattr(self.predictor, "backlog_depth", None)
+            backlog = backlog_fn() if callable(backlog_fn) else None
+            # cost = the decode budget, not 1 (see docstring)
+            self.admission.admit(timeout_s, backlog_depth=backlog,
+                                 tenant=self.app,
+                                 cost=max(max_tokens, 1))
+            held[0] = True
+            t0 = time.monotonic()
+            stream = self.predictor.generate(query, timeout_s=timeout_s)
+            binary = self._accepts_wire(handler)
+            REGISTRY.histogram(
+                "rafiki_gen_door_ttft_seconds",
+                "admission-to-first-token latency at the streaming door "
+                "(includes queue wait and prefill)").observe(
+                    time.monotonic() - t0)
+            n_tokens = self._stream_deltas(handler, stream, binary)
+            self.admission.observe(time.monotonic() - t0,
+                                   max(n_tokens, 1))
+        except UnauthorizedError as e:
+            self._respond(handler, 401, {"error": str(e)})
+        except json.JSONDecodeError as e:
+            self._respond(handler, 400, {"error": f"bad JSON body: {e}"})
+        except GenerationRequestError as e:
+            self._respond(handler, 400, {"error": str(e)})
+        except (QueueFullError, DeadlineUnmeetableError) as e:
+            self._respond(handler, 429, {"error": str(e)},
+                          headers=retry_after_headers(e))
+        except ServerOverloadedError as e:
+            self._respond(handler, 503, {"error": str(e)},
+                          headers=retry_after_headers(e))
+        except TimeoutError as e:
+            # no slot admitted the request inside its own deadline
+            self._respond(handler, 504, {"error": str(e)})
+        except RuntimeError as e:
+            self._respond(handler, 503, {"error": str(e)})
+        except Exception:
+            logger.exception("generate failed on dedicated port for %s",
+                             self.app)
+            self._respond(handler, 500, {"error": "internal server error"})
+        finally:
+            release()
+
+    def _stream_deltas(self, handler, stream, binary: bool) -> int:
+        """Pump one TokenStream into a chunked HTTP response; returns the
+        token count served. Runs AFTER the 200 status line, so every
+        failure mode in here must end the stream with a terminal frame
+        (and cancel the worker-side slot), never an exception that slams
+        the socket shut mid-chunk without a typed goodbye."""
+        from rafiki_tpu import config as _config
+        from rafiki_tpu.cache import wire
+        from rafiki_tpu.cache.queue import GenerationError
+
+        handler.send_response(200)
+        handler.send_header(
+            "Content-Type",
+            wire.CONTENT_TYPE if binary else "application/x-ndjson")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.send_header("Cache-Control", "no-store")
+        # one stream per connection: clients drop the socket after the
+        # terminal delta, so offering keep-alive only produces a noisy
+        # reset in the server log when they do
+        handler.send_header("Connection", "close")
+        handler.close_connection = True
+        handler.end_headers()
+
+        def chunk(payload: bytes) -> bool:
+            try:
+                handler.wfile.write(
+                    ("%x\r\n" % len(payload)).encode() + payload + b"\r\n")
+                handler.wfile.flush()
+                return True
+            # lint: absorb(client gone mid-stream: status already sent; cancel frees the slot)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                stream.cancel()
+                return False
+
+        def emit(delta) -> bool:
+            if binary:
+                frame = wire.encode_token_delta(
+                    stream.seq_id, delta.tokens, finished=delta.finished,
+                    reason=delta.reason, error=delta.error)
+                return chunk(len(frame).to_bytes(4, "little") + frame)
+            return chunk(json.dumps(delta.to_json()).encode() + b"\n")
+
+        stall_s = max(float(_config.GEN_STREAM_TIMEOUT_S), 0.1)
+        served = 0
+        from rafiki_tpu.cache.queue import TokenDelta
+
+        # the pump waits one stall window per delta; the request's OVERALL
+        # deadline is enforced worker-side (max_duration_s -> eviction
+        # with reason "deadline"), so a live-but-slow stream is never cut
+        # by the door while tokens keep arriving
+        while True:
+            try:
+                delta = stream.next_delta(timeout=stall_s)
+            except StopIteration:
+                break
+            # lint: absorb(mid-stream at 200: the typed terminal frame IS the error path)
+            except TimeoutError:
+                # the stalled-decode drill: the worker went mute on this
+                # sequence — typed terminal frame, then tell the slot
+                # scheduler to evict it
+                emit(TokenDelta([], finished=True, reason="error",
+                                error=f"decode stalled (no token within "
+                                      f"{stall_s:.1f}s)"))
+                stream.cancel()
+                break
+            # lint: absorb(mid-stream at 200: the typed terminal frame IS the error path)
+            except GenerationError as e:
+                emit(TokenDelta([], finished=True, reason="error",
+                                error=str(e)))
+                break
+            served += len(delta.tokens)
+            if not emit(delta):
+                return served
+            if delta.finished:
+                break
+        try:
+            handler.wfile.write(b"0\r\n\r\n")
+            handler.wfile.flush()
+        # lint: absorb(client gone at stream end: nothing left to answer)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            stream.cancel()
+        return served
+
+    @staticmethod
+    def _accepts_wire(handler) -> bool:
+        """Accept check for the binary token-delta stream (same lite rule
+        as :meth:`_accepts_npy`): the client must NAME the wire media
+        type — old clients never see the v3 message kind."""
+        from rafiki_tpu.cache import wire
+
+        accept = handler.headers.get("Accept") or ""
+        return any(
+            part.split(";")[0].strip().lower() == wire.CONTENT_TYPE
+            for part in accept.split(","))
 
     def _metrics(self, handler: BaseHTTPRequestHandler) -> None:
         """GET /metrics: Prometheus text exposition of the process

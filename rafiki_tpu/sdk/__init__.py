@@ -32,8 +32,10 @@ from rafiki_tpu.sdk.log import (  # noqa: F401
 from rafiki_tpu.sdk.population import PopulationTrainer  # noqa: F401
 from rafiki_tpu.sdk.model import (  # noqa: F401
     BaseModel,
+    GenerationSpec,
     InvalidModelClassError,
     PopulationSpec,
+    generation_capability,
     load_model_class,
     population_capability,
     test_model_class,

@@ -26,7 +26,7 @@ import os
 import sys
 import tempfile
 import traceback
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -81,6 +81,37 @@ class PopulationSpec:
     def __repr__(self) -> str:
         return (f"PopulationSpec(dynamic_knobs={self.dynamic_knobs!r}, "
                 f"max_members={self.max_members})")
+
+
+class GenerationSpec:
+    """Declares that a template can serve the ``TEXT_GENERATION`` task:
+    KV-cached autoregressive decode with token-level continuous batching
+    (worker/generation.py). Set as a class attribute::
+
+        class MyLM(BaseModel):
+            generation_spec = GenerationSpec(eos_token_id=0,
+                                             max_context=128)
+
+    ``eos_token_id`` ends a sequence the step it is emitted (None = run to
+    ``max_tokens``); ``max_context`` is the KV-cache ring length per slot —
+    prompt plus generated tokens must fit, and a sequence reaching it is
+    finished with reason ``context``.
+
+    A template advertising a spec must also implement the three decode
+    methods on :class:`BaseModel` (``init_kv_cache``, ``prefill``,
+    ``decode_step``); :func:`generation_capability` refuses specs whose
+    methods are still the base stubs, so a half-wired template is a typed
+    deploy error instead of a mid-serving crash."""
+
+    def __init__(self, eos_token_id: Optional[int] = None,
+                 max_context: int = 128):
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        self.max_context = max(int(max_context), 2)
+
+    def __repr__(self) -> str:
+        return (f"GenerationSpec(eos_token_id={self.eos_token_id!r}, "
+                f"max_context={self.max_context})")
 
 
 class BaseModel(abc.ABC):
@@ -175,6 +206,37 @@ class BaseModel(abc.ABC):
         exactly like scalar trials."""
         raise NotImplementedError
 
+    # -- generative serving (opt-in via ``generation_spec``) ----------------
+
+    #: set to a :class:`GenerationSpec` to advertise that this template can
+    #: serve TEXT_GENERATION: the generation worker then drives the three
+    #: decode methods below in a continuous-batching slot loop
+    #: (worker/generation.py) instead of the one-request/one-answer
+    #: ``predict`` path.
+    generation_spec: Optional["GenerationSpec"] = None
+
+    def init_kv_cache(self, max_slots: int) -> Any:
+        """Preallocate an opaque decode cache for ``max_slots`` co-resident
+        sequences (fixed shapes: one jitted step program serves the cache's
+        whole lifetime). Called once by the generation worker after
+        ``load_parameters``."""
+        raise NotImplementedError
+
+    def prefill(self, cache: Any, slot: int,
+                prompt_ids: List[int]) -> Tuple[int, Any]:
+        """Ingest a prompt into ``slot`` of ``cache`` and return
+        ``(first_generated_token_id, cache)``. Caches are values: return
+        the updated cache (JAX pytrees are immutable)."""
+        raise NotImplementedError
+
+    def decode_step(self, cache: Any, ids: Any, positions: Any
+                    ) -> Tuple[Any, Any]:
+        """One token for EVERY slot: ``ids``/``positions`` are int arrays of
+        length ``max_slots`` — the last emitted token per slot and the cache
+        index it lands at (idle slots carry zeros; their outputs are
+        ignored). Returns ``(next_token_ids, cache)``."""
+        raise NotImplementedError
+
     def ensemble_stack(self, models: List["BaseModel"]) -> Optional[Any]:
         """Optional fused-ensemble serving hook (budget ``ENSEMBLE_FUSED``).
 
@@ -213,6 +275,35 @@ def population_capability(clazz: type) -> Optional[PopulationSpec]:
             logging.getLogger(__name__).warning(
                 "%s declares population_spec but does not override %s(); "
                 "ignoring — trials run scalar", clazz.__name__, name)
+            return None
+    return spec
+
+
+#: the three decode methods a generation-capable template must override
+GENERATION_METHODS = ("init_kv_cache", "prefill", "decode_step")
+
+
+def generation_capability(clazz: type) -> Optional[GenerationSpec]:
+    """The template's :class:`GenerationSpec` iff it is fully wired: a
+    spec instance AND all three decode methods overridden. Anything less
+    returns None — unlike the population fallback there is no scalar path
+    to degrade to, so callers (upload validation, the generation worker)
+    turn None into a typed error rather than a silent downgrade."""
+    spec = getattr(clazz, "generation_spec", None)
+    if spec is None:
+        return None
+    import logging
+
+    if not isinstance(spec, GenerationSpec):
+        logging.getLogger(__name__).warning(
+            "%s.generation_spec is not a GenerationSpec (%s); ignoring",
+            clazz.__name__, type(spec).__name__)
+        return None
+    for name in GENERATION_METHODS:
+        if getattr(clazz, name, None) is getattr(BaseModel, name):
+            logging.getLogger(__name__).warning(
+                "%s declares generation_spec but does not override %s(); "
+                "template is NOT generation-capable", clazz.__name__, name)
             return None
     return spec
 

@@ -25,9 +25,11 @@ Fields:
              ``wire`` (shm frames popped off the serving rings, before
              decode — corruption drills), ``db`` (metadata-store
              statements — transient store-failure drills for
-             control-plane recovery), or ``trial`` (the trial-run
-             chokepoint in the train worker — fault-taxonomy drills).
-             Required.
+             control-plane recovery), ``trial`` (the trial-run
+             chokepoint in the train worker — fault-taxonomy drills), or
+             ``generate`` (the generation decode loop — mid-stream
+             fault / stalled-decode drills, one ask per active slot per
+             round). Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
              ``delay_s`` then proceed — a slow replica), ``error``
@@ -87,6 +89,15 @@ SITE_WIRE = "wire"
 # drill that proves control-plane recovery retries with bounded jittered
 # backoff instead of aborting reconciliation (docs/failure-model.md).
 SITE_DB = "db"
+# generation decode loop (worker/generation.py): one ask per ACTIVE SLOT
+# per decode round, target "{job_id}/{service_id}/slot{i}/{seq_id}" so
+# `match` can injure one co-resident sequence mid-stream. `error` fails
+# exactly that sequence (typed terminal error frame on its stream;
+# siblings keep decoding), `drop` mutes the slot's deltas — the stalled-
+# decode drill the door's inter-token timeout must convert into a typed
+# error frame, never a silent hang — and `delay` slows the whole step
+# (a slow decode) — docs/serving-generation.md "Chaos drills".
+SITE_GENERATE = "generate"
 # trial-run chokepoint (worker/train.py _execute_trial): one ask per
 # trial ATTEMPT, target "{sub_train_job_id} {trial_id}". `error` raises
 # a typed transient fault the taxonomy classifies INFRA (the
@@ -122,7 +133,8 @@ class ChaosRule:
 
     def __post_init__(self) -> None:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
-                             SITE_WIRE, SITE_DB, SITE_TRIAL):
+                             SITE_WIRE, SITE_DB, SITE_TRIAL,
+                             SITE_GENERATE):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
                                ACTION_CORRUPT, ACTION_OOM):

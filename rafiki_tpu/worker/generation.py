@@ -1,0 +1,379 @@
+"""Generation worker: token-streaming decode with continuous batching.
+
+The classification worker (worker/inference.py) is one-request/one-answer:
+take a batch, run ``predict``, resolve futures. Generative serving cannot
+work that way — a 512-token completion would hold its whole batch hostage
+for 512 steps. This worker applies the Orca insight (iteration-level
+scheduling: admit/evict at TOKEN granularity, not request granularity) on
+top of the platform's existing data plane:
+
+- a **fixed-width slot table** (``RAFIKI_GEN_MAX_SLOTS``): the model's KV
+  cache is preallocated for that many co-resident sequences, so one jitted
+  ``decode_step`` program serves the table for its whole lifetime;
+- per decode round the scheduler **pulls newly queued requests** from the
+  same bounded ``WorkerQueue`` every serving hop already uses (deadline /
+  expiry / depth-cap semantics preserved), prefills them into free slots,
+  runs ONE step for every active slot, and pushes each sequence's token
+  delta onto its :class:`~rafiki_tpu.cache.queue.TokenStream`;
+- sequences **leave mid-decode** — EOS, ``max_tokens``, context edge,
+  deadline, client cancel, injected fault — freeing their slot to the next
+  queued request without stalling co-resident sequences.
+
+Observability: time-to-first-token and inter-token-latency histograms,
+a slot-occupancy gauge + per-job ring (the autoscaler's generative
+backlog signal), eviction counters by reason, and the shared
+SERVING_STATS row every stats surface already reads.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from rafiki_tpu import config
+from rafiki_tpu.cache.queue import TokenStream
+from rafiki_tpu.sdk.model import GenerationSpec, generation_capability
+from rafiki_tpu.utils import chaos
+from rafiki_tpu.worker.inference import (
+    InferenceWorker,
+    SERVING_STATS,
+    _record_queue,
+    _stats_lock,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class GenerationUnsupportedError(RuntimeError):
+    """The deployed template does not advertise a fully-wired generation
+    capability — a typed deploy-time error (the serving analogue of
+    InvalidModelClassError), never a mid-stream AttributeError."""
+
+
+class GenerationRequestError(ValueError):
+    """A malformed generation request (bad prompt/max_tokens shape) —
+    resolved onto the request's future so the door answers 400."""
+
+
+def _metrics():
+    """Lazily-created registry handles for the generation plane (same
+    pattern as worker/inference.py — import stays cheap, increments all
+    happen at one site per signal)."""
+    global _M
+    if _M is None:
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        _M = {
+            "ttft": REGISTRY.histogram(
+                "rafiki_gen_ttft_seconds",
+                "prefill-to-first-token latency of admitted generation "
+                "requests (worker side; the door-side histogram adds "
+                "queue wait)"),
+            "intertoken": REGISTRY.histogram(
+                "rafiki_gen_intertoken_seconds",
+                "latency between consecutive decode rounds of a live "
+                "slot table"),
+            "tokens": REGISTRY.counter(
+                "rafiki_gen_tokens_total",
+                "tokens emitted by generation workers in this process"),
+            "slots": REGISTRY.gauge(
+                "rafiki_gen_slots_busy",
+                "generation slots currently decoding", ("service",)),
+            "evictions": REGISTRY.counter(
+                "rafiki_gen_evictions_total",
+                "sequences leaving the slot table, by finish reason",
+                ("reason",)),
+        }
+    return _M
+
+
+_M = None
+
+
+class _Slot:
+    """One resident sequence's scheduler state."""
+
+    __slots__ = ("stream", "last_id", "position", "produced", "max_tokens",
+                 "deadline", "muted", "last_step_t")
+
+    def __init__(self, stream: TokenStream, first_id: int, position: int,
+                 max_tokens: int, deadline: Optional[float]) -> None:
+        self.stream = stream
+        self.last_id = first_id
+        self.position = position      # cache index the NEXT token lands at
+        self.produced = 1             # prefill emitted the first token
+        self.max_tokens = max_tokens
+        self.deadline = deadline
+        #: chaos action=drop: the stalled-decode drill — the slot keeps
+        #: its place but its deltas stop arriving; the DOOR's inter-token
+        #: timeout must convert the silence into a typed error frame
+        self.muted = False
+        self.last_step_t = time.monotonic()
+
+
+class GenerationWorker(InferenceWorker):
+    """Serves one trained trial's LM as a token stream. Reuses the
+    classification worker's model loading / stats reporting / queue
+    registration; only the serve loop differs."""
+
+    def start(self, ctx) -> None:
+        from rafiki_tpu.parallel.mesh import set_device_grant
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        set_device_grant(ctx.chips)
+        model = None
+        queue = self._broker.register_worker(self._job_id, ctx.service_id)
+        try:
+            model = self._load_model(ctx.service_id)
+            spec = generation_capability(type(model))
+            if spec is None:
+                raise GenerationUnsupportedError(
+                    f"trial {self._trial_id}'s template does not advertise "
+                    "a fully-wired GenerationSpec (init_kv_cache/prefill/"
+                    "decode_step) — it cannot serve TEXT_GENERATION")
+            max_slots = max(int(config.GEN_MAX_SLOTS), 1)
+            cache = model.init_kv_cache(max_slots)
+            try:
+                model.warm_up()
+            except Exception:
+                logger.warning(
+                    "warm_up failed in generation worker %s (serving "
+                    "anyway):\n%s", ctx.service_id, traceback.format_exc())
+            ctx.ready()
+            if self._report_stats is not None:
+                threading.Thread(
+                    target=self._stats_reporter, args=(ctx,),
+                    name="stats-reporter", daemon=True).start()
+            slots: List[Optional[_Slot]] = [None] * max_slots
+            occupancy_ring = REGISTRY.ring(
+                f"slot_occupancy:job:{self._job_id}")
+            m = _metrics()
+            self._tokens_emitted = 0
+            while not ctx.stopping:
+                n_active = sum(1 for s in slots if s is not None)
+                free = [i for i, s in enumerate(slots) if s is None]
+                # -- admit: pull queued requests into free slots ----------
+                if free and (n_active == 0 or queue.depth() > 0):
+                    batch = queue.take_batch(
+                        max_size=len(free), deadline_s=0.0,
+                        wait_timeout_s=(0.25 if n_active == 0 else 0.0))
+                    if batch is None:
+                        logger.info("query queue closed; generation "
+                                    "worker %s exiting", ctx.service_id)
+                        break
+                    for fut, query in batch:
+                        cache = self._admit(
+                            model, spec, cache, slots, free, fut, query,
+                            ctx.service_id)
+                    _record_queue(ctx.service_id, queue)
+                n_active = sum(1 for s in slots if s is not None)
+                m["slots"].labels(ctx.service_id).set(n_active)
+                occupancy_ring.record(n_active / max_slots)
+                self._stats_row(ctx.service_id, n_active, max_slots)
+                if n_active == 0:
+                    continue
+                # -- decode: one token for every resident sequence --------
+                cache = self._decode_round(model, spec, cache, slots, ctx)
+        finally:
+            self._broker.unregister_worker(self._job_id, ctx.service_id)
+            if model is not None:
+                model.destroy()
+            set_device_grant(None)
+
+    # -- admission -----------------------------------------------------------
+
+    def _admit(self, model, spec: GenerationSpec, cache,
+               slots: List[Optional[_Slot]], free: List[int], fut, query,
+               service_id: str):
+        """Prefill one queued request into a free slot and hand its
+        TokenStream back through the request's future. A malformed
+        request fails ITS future (typed, -> 400 at the door) and costs no
+        slot; a prefill crash likewise never kills co-resident slots."""
+        try:
+            prompt, max_tokens, max_duration_s = self._parse_query(query)
+        except GenerationRequestError as e:
+            fut.set_error(e)
+            return cache
+        if not free:
+            # take_batch was sized to the free count, but a same-round
+            # earlier admit may have failed and returned its slot unused;
+            # being here with none left means a scheduler bug upstream —
+            # fail the request rather than strand it silently
+            fut.set_error(RuntimeError("no free generation slot"))
+            return cache
+        if len(prompt) + max_tokens > spec.max_context:
+            fut.set_error(GenerationRequestError(
+                f"prompt ({len(prompt)} tokens) + max_tokens "
+                f"({max_tokens}) exceeds the template's max_context "
+                f"({spec.max_context})"))
+            return cache
+        slot_ix = free.pop(0)
+        t0 = time.monotonic()
+        try:
+            first_id, cache = model.prefill(cache, slot_ix, list(prompt))
+        except Exception as e:
+            free.insert(0, slot_ix)
+            logger.error("prefill failed in generation worker %s:\n%s",
+                         service_id, traceback.format_exc())
+            fut.set_error(RuntimeError(f"prefill failed: {e}"))
+            return cache
+        first_id = int(first_id)
+        stream = TokenStream(seq_id=uuid.uuid4().hex[:12])
+        deadline = (time.monotonic() + max_duration_s
+                    if max_duration_s else None)
+        slot = _Slot(stream, first_id, len(prompt), max_tokens, deadline)
+        slots[slot_ix] = slot
+        fut.set_result(stream)
+        from rafiki_tpu.worker.inference import _record_batch
+
+        _record_batch(service_id, 1)  # one admitted request
+        m = _metrics()
+        m["ttft"].observe(time.monotonic() - t0)
+        m["tokens"].inc()
+        finished, reason = self._finish_reason(slot, spec, first_id)
+        stream.push([first_id], finished=finished, reason=reason)
+        if finished:
+            self._evict(slots, slot_ix, reason)
+        return cache
+
+    @staticmethod
+    def _parse_query(query):
+        if not isinstance(query, dict):
+            raise GenerationRequestError(
+                "generation query must be an object with 'prompt_ids'")
+        prompt = query.get("prompt_ids")
+        if (not isinstance(prompt, (list, tuple)) or not prompt
+                or not all(isinstance(t, int) and t >= 0 for t in prompt)):
+            raise GenerationRequestError(
+                "'prompt_ids' must be a non-empty list of non-negative "
+                "token ids")
+        cap = max(int(config.GEN_MAX_TOKENS), 1)
+        raw = query.get("max_tokens", cap)
+        try:
+            max_tokens = int(raw)
+        except (TypeError, ValueError):
+            raise GenerationRequestError(
+                f"max_tokens={raw!r} is not an integer") from None
+        if max_tokens < 1:
+            raise GenerationRequestError(
+                f"max_tokens={max_tokens} must be >= 1")
+        max_tokens = min(max_tokens, cap)
+        max_duration_s = query.get("max_duration_s")
+        if max_duration_s is not None:
+            try:
+                max_duration_s = float(max_duration_s)
+            except (TypeError, ValueError):
+                raise GenerationRequestError(
+                    "max_duration_s must be a number") from None
+        return list(prompt), max_tokens, max_duration_s
+
+    # -- the decode round ----------------------------------------------------
+
+    def _decode_round(self, model, spec: GenerationSpec, cache,
+                      slots: List[Optional[_Slot]], ctx):
+        """Advance every resident sequence one token. Slot-level chaos is
+        consulted per sequence, so a drill injures exactly one stream
+        while siblings keep decoding."""
+        n = len(slots)
+        ids = np.zeros(n, np.int32)
+        positions = np.zeros(n, np.int32)
+        for i, s in enumerate(slots):
+            if s is not None:
+                ids[i] = s.last_id
+                positions[i] = s.position
+        try:
+            next_ids, cache = model.decode_step(cache, ids, positions)
+            next_ids = np.asarray(next_ids)
+        except Exception:
+            # a decode_step crash poisons the whole table (the cache may
+            # be half-written): fail every resident stream TYPED and
+            # clear the table — the worker keeps serving new requests
+            logger.error("decode_step failed in generation worker %s:\n%s",
+                         ctx.service_id, traceback.format_exc())
+            for i, s in enumerate(slots):
+                if s is not None:
+                    s.stream.fail("decode step failed on the serving "
+                                  "worker")
+                    self._evict(slots, i, "error")
+            return cache
+        now = time.monotonic()
+        m = _metrics()
+        for i, slot in enumerate(slots):
+            if slot is None:
+                continue
+            rule = chaos.hit(
+                chaos.SITE_GENERATE,
+                f"{self._job_id}/{ctx.service_id}/slot{i}/"
+                f"{slot.stream.seq_id}")
+            if rule is not None:
+                if rule.action == chaos.ACTION_DELAY:
+                    chaos.sleep_for(rule)
+                elif rule.action == chaos.ACTION_DROP:
+                    # stalled decode: the slot stays resident but its
+                    # deltas stop — the door's inter-token timeout owns
+                    # recovery (typed error frame + cancel)
+                    logger.warning(
+                        "chaos: muting generation slot %d (%s)", i,
+                        slot.stream.seq_id)
+                    slot.muted = True
+                else:  # ACTION_ERROR: mid-stream fault on THIS stream
+                    slot.stream.fail(
+                        "chaos-injected mid-stream generation fault")
+                    self._evict(slots, i, "error")
+                    continue
+            if slot.stream.cancelled:
+                self._evict(slots, i, "cancelled")
+                continue
+            token = int(next_ids[i])
+            slot.position += 1
+            slot.last_id = token
+            slot.produced += 1
+            m["intertoken"].observe(now - slot.last_step_t)
+            slot.last_step_t = now
+            m["tokens"].inc()
+            self._tokens_emitted += 1
+            finished, reason = self._finish_reason(slot, spec, token)
+            if slot.deadline is not None and now >= slot.deadline:
+                finished, reason = True, "deadline"
+            if not slot.muted:
+                slot.stream.push([token], finished=finished, reason=reason)
+            if finished:
+                self._evict(slots, i, reason)
+        return cache
+
+    @staticmethod
+    def _finish_reason(slot: _Slot, spec: GenerationSpec, token: int):
+        if spec.eos_token_id is not None and token == spec.eos_token_id:
+            return True, "eos"
+        if slot.produced >= slot.max_tokens:
+            return True, "max_tokens"
+        if slot.position + 1 >= spec.max_context:
+            return True, "context"
+        return False, None
+
+    @staticmethod
+    def _evict(slots: List[Optional[_Slot]], i: int, reason: str) -> None:
+        slots[i] = None
+        _metrics()["evictions"].labels(reason or "unknown").inc()
+
+    def _stats_row(self, service_id: str, busy: int, max_slots: int) -> None:
+        """Fold the slot picture into the shared SERVING_STATS row (the
+        /healthz + fleet-health + stats-relay surface every PR already
+        reads); the 'queries' counter stays the admitted-request count.
+        ``gen_tokens`` advances every decode round, so the process-mode
+        stats relay (report_stats dedupes on an unchanged row) keeps
+        pushing — and the admin keeps re-recording the occupancy ring —
+        for as long as the table is actually decoding, even when
+        occupancy itself sits pinned at full."""
+        with _stats_lock:
+            s = SERVING_STATS.setdefault(
+                service_id, {"batches": 0, "queries": 0})
+            s["gen_slots_busy"] = busy
+            s["gen_slots_max"] = max_slots
+            s["gen_tokens"] = getattr(self, "_tokens_emitted", 0)

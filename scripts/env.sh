@@ -134,6 +134,33 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # backlog:job:<id> + shed_rate:job:<id>. Decisions (reason + signal
 # snapshot) surface under GET /fleet/health "autoscaler".
 
+# Generative serving — token-streaming TEXT_GENERATION jobs with
+# KV-cached decode and continuous batching (docs/serving-generation.md).
+# The streaming /generate door lives on the dedicated per-job predictor
+# port (RAFIKI_PREDICTOR_PORTS=1); admission charges streams their
+# max_tokens decode budget, not 1:
+#   RAFIKI_GEN_MAX_SLOTS=8              co-resident sequences per
+#                                       generation worker — the KV cache
+#                                       is preallocated at this width and
+#                                       one jitted decode step advances
+#                                       them all (doctor WARNs past the
+#                                       ~64-slot memory heuristic)
+#   RAFIKI_GEN_MAX_TOKENS=64            per-request decode budget cap
+#                                       (requests asking more are clamped)
+#   RAFIKI_GEN_STREAM_TIMEOUT_S=10      door-side inter-token stall
+#                                       timeout: a stream silent this long
+#                                       ends with a typed terminal error
+#                                       frame, never a hang
+#   RAFIKI_GEN_OCCUPANCY_HIGH=0.85      mean slot occupancy over the
+#                                       autoscaler window that reads
+#                                       "slots saturated" and scales the
+#                                       job up (slot_occupancy:job:<id>
+#                                       ring; idle needs <= HIGH/2)
+# New /metrics series: rafiki_gen_ttft_seconds,
+# rafiki_gen_door_ttft_seconds, rafiki_gen_intertoken_seconds,
+# rafiki_gen_tokens_total, rafiki_gen_slots_busy{service},
+# rafiki_gen_evictions_total{reason}.
+
 # TPU backend probe hardening (bench.py / doctor): probes serialize on a
 # machine-wide lockfile so retry loops never stack interpreters onto a
 # wedged libtpu tunnel; abandoned probe children are reaped once stale:
@@ -263,9 +290,10 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # (sites: call_agent, agent, worker — stalls/slows serving replicas for
 # overload drills — wire, whose `corrupt` action garbles shm frames for
 # codec-corruption drills, db, which fails/delays metadata-store
-# statements for control-plane recovery drills, and trial, which
+# statements for control-plane recovery drills, trial, which
 # errors/delays/OOMs the trial-run chokepoint for fault-taxonomy
-# drills):
+# drills, and generate, which injures/stalls one generation slot per
+# rule for mid-stream fault drills):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 

@@ -1,0 +1,32 @@
+"""GEN001: generation_spec declared but the three decode methods are not
+overridden — the template is not generation-capable, and an upload under
+task TEXT_GENERATION would be refused (typed 400)."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob, GenerationSpec
+
+
+class GenHalfWired(BaseModel):
+    dependencies = {}
+    generation_spec = GenerationSpec(eos_token_id=0, max_context=64)
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

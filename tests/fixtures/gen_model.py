@@ -1,0 +1,133 @@
+"""A fast real-JAX generation-capable template — the generative-serving
+system-test workhorse. A tiny decoder-only LM (models/lm.py ``tiny()``
+scale: depth 1, dim 16) trained for a few Adam steps on a deterministic
+token pattern, so an end-to-end TEXT_GENERATION job on CPU proves the
+actual tentpole mechanics (KV-cached prefill/decode through the slot
+scheduler, token deltas over the streaming door) in seconds.
+
+Greedy decode is deterministic, so a test can assert that two streams
+with the same prompt yield the same tokens, and the e2e drill can give
+two clients different ``max_tokens`` and watch the shorter one free its
+slot mid-decode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rafiki_tpu.models import lm
+from rafiki_tpu.sdk import (
+    BaseModel,
+    FixedKnob,
+    FloatKnob,
+    GenerationSpec,
+)
+
+_VOCAB = 64
+_MAX_CONTEXT = 64
+# no EOS: a 3-step-trained LM's greedy argmax can land on ANY token, so
+# an EOS id would make stream lengths nondeterministic across runs — the
+# e2e drill needs exact lengths, and EOS semantics are drilled at the
+# scheduler level with a scripted model (tests/test_generation.py)
+_EOS = None
+_PREFILL_BUCKETS = (8, 16, 32, _MAX_CONTEXT)
+
+
+def _pattern_batch(n_rows=4, seq=32):
+    """Deterministic next-token data: interleaved arithmetic sequences —
+    learnable structure, no dataset file needed."""
+    base = np.arange(n_rows * seq, dtype=np.int32).reshape(n_rows, seq)
+    ids = (base * 3 + 2) % _VOCAB
+    return jnp.asarray(ids), jnp.ones((n_rows, seq), jnp.float32)
+
+
+class TinyGenLM(BaseModel):
+    dependencies = {"numpy": None}
+    generation_spec = GenerationSpec(eos_token_id=_EOS,
+                                     max_context=_MAX_CONTEXT)
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "lr": FloatKnob(1e-3, 1e-1, is_exp=True),
+            "dim": FixedKnob(16),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._cfg = lm.tiny(vocab=_VOCAB, max_len=_MAX_CONTEXT,
+                            dim=int(knobs.get("dim", 16)), depth=1, heads=2)
+        self._params = None
+        self._jit_prefill = None
+        self._jit_decode = None
+
+    def train(self, dataset_uri):
+        import optax
+
+        params = lm.init(jax.random.PRNGKey(0), self._cfg)
+        opt = optax.adam(float(self._knobs.get("lr", 1e-2)))
+        opt_state = opt.init(params)
+        batch = _pattern_batch()
+        grad = jax.jit(jax.grad(
+            lambda p, r: lm.loss_fn(p, batch, r, self._cfg)[0]))
+        for step in range(3):
+            updates, opt_state = opt.update(
+                grad(params, jax.random.PRNGKey(step)), opt_state)
+            params = optax.apply_updates(params, updates)
+        self._params = params
+
+    def evaluate(self, dataset_uri):
+        loss, _ = lm.loss_fn(self._params, _pattern_batch(),
+                             jax.random.PRNGKey(9), self._cfg)
+        return float(-loss)
+
+    def predict(self, queries):
+        """One-shot contract parity: each query is a prompt-id list; the
+        prediction is an 8-token greedy completion (the streaming door is
+        the real serving path — this keeps test_model_class honest)."""
+        out = []
+        for q in queries:
+            cache = self.init_kv_cache(1)
+            tok, cache = self.prefill(cache, 0, list(q))
+            toks = [tok]
+            for _ in range(7):
+                ids = np.array([tok], np.int32)
+                pos = np.array([len(q) + len(toks) - 1], np.int32)
+                nxt, cache = self.decode_step(cache, ids, pos)
+                tok = int(np.asarray(nxt)[0])
+                toks.append(tok)
+            out.append(toks)
+        return out
+
+    def dump_parameters(self):
+        return jax.tree.map(np.asarray, self._params)
+
+    def load_parameters(self, params):
+        self._params = params
+        self._jit_prefill = self._jit_decode = None  # recompile on new params
+
+    # -- generation contract (worker/generation.py drives these) ------------
+
+    def init_kv_cache(self, max_slots):
+        # params may be msgpack-loaded numpy: put them on device once —
+        # a numpy embedding table cannot be indexed by a traced id array
+        params = self._params = jax.tree.map(jnp.asarray, self._params)
+        cfg = self._cfg
+        if self._jit_prefill is None:
+            self._jit_prefill = jax.jit(
+                lambda c, s, ids, n: lm.prefill(params, c, s, ids, n, cfg))
+            self._jit_decode = jax.jit(
+                lambda c, ids, pos: lm.decode_step(params, c, ids, pos, cfg))
+        return lm.init_kv_cache(cfg, max_slots, max_len=_MAX_CONTEXT)
+
+    def prefill(self, cache, slot, prompt_ids):
+        n = len(prompt_ids)
+        bucket = next(b for b in _PREFILL_BUCKETS if b >= n)
+        ids = np.zeros(bucket, np.int32)
+        ids[:n] = prompt_ids
+        logits, cache = self._jit_prefill(cache, slot, ids, n)
+        return int(lm.greedy_token(logits)), cache
+
+    def decode_step(self, cache, ids, positions):
+        logits, cache = self._jit_decode(cache, ids, positions)
+        return lm.greedy_token(logits), cache

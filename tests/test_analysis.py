@@ -50,6 +50,7 @@ CORPUS = {
     "pop_rogue_dynamic.py": "POP001",
     "pop_half_wired.py": "POP002",
     "pop_dynamic_branch.py": "POP003",
+    "gen_half_wired.py": "GEN001",
     "tracer_item.py": "JAX001",
     "global_np_random.py": "JAX002",
     "jit_self_mutation.py": "JAX003",
@@ -58,7 +59,8 @@ CORPUS = {
 GOOD_TEMPLATES = sorted(
     glob.glob(os.path.join(REPO, "examples", "models", "*", "*.py"))
     + [os.path.join(HERE, "fixtures", f)
-       for f in ("fake_model.py", "mesh_probe_model.py", "pop_model.py")])
+       for f in ("fake_model.py", "mesh_probe_model.py", "pop_model.py",
+                 "gen_model.py")])
 
 
 def _read(path):

@@ -688,3 +688,49 @@ def test_operator_scale_api_over_http(tmp_workdir, monkeypatch):
     finally:
         server.stop()
         admin.shutdown()
+
+
+def test_generation_slot_occupancy_drives_scale_up(tmp_workdir,
+                                                   monkeypatch):
+    """Generative jobs load SLOTS, not queues: with shed and backlog
+    thresholds out of reach, a sustained-full slot-occupancy ring alone
+    must scale the job up (reason 'generation slot occupancy'), and a
+    saturated table must hold the scale-down floor even when the queue
+    reads idle (worker/generation.py publishes the ring; here it is fed
+    directly so the decision table is pinned without a jitted LM)."""
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    admin, uid, token, inf = _deploy(
+        tmp_workdir, monkeypatch, "gocc",
+        env={
+            "RAFIKI_AUTOSCALE_WINDOW_S": "30",
+            "RAFIKI_AUTOSCALE_SHED_THRESHOLD": "1000",
+            "RAFIKI_AUTOSCALE_DEPTH_HIGH": "1000",
+            "RAFIKI_AUTOSCALE_DEPTH_LOW": "1000",
+            "RAFIKI_AUTOSCALE_COOLDOWN_UP_S": "0",
+            "RAFIKI_AUTOSCALE_COOLDOWN_DOWN_S": "0",
+            "RAFIKI_AUTOSCALE_MAX_REPLICAS": "8",
+            "RAFIKI_GEN_OCCUPANCY_HIGH": "0.8",
+        })
+    job_id = _job_id(admin, uid, "gocc")
+    scaler = admin.autoscaler
+    ring = REGISTRY.ring(f"slot_occupancy:job:{job_id}")
+    try:
+        before = _replicas(admin, job_id)
+        # comfortably-unsaturated occupancy: no action either way (the
+        # idle path is separately gated by window coverage, so give the
+        # controller a couple of baseline samples first)
+        ring.record(0.2)
+        scaler.tick()
+        assert _replicas(admin, job_id) == before
+        # saturated slots, empty queue, zero shed -> scale UP on the
+        # occupancy signal alone
+        ring.record(1.0)
+        actions = scaler.tick()
+        assert actions and actions[0]["action"] == "scale_up", actions
+        assert actions[0]["reason"] == "generation slot occupancy"
+        assert actions[0]["signals"]["slot_occupancy"] >= 0.5
+        _wait_for(lambda: _replicas(admin, job_id) == before + 1, 30,
+                  "occupancy scale-up to land")
+    finally:
+        admin.shutdown()

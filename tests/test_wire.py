@@ -550,3 +550,84 @@ def test_relay_stays_json_for_peer_without_wire_advertisement():
         q.close()
         httpd.shutdown()
         httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# incremental-response message kind (generative serving, v3 frames)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("tokens,finished,reason,error", [
+    ([1, 2, 3], False, None, None),
+    ([], True, "eos", None),
+    ([42], True, "max_tokens", None),
+    ([], True, "error", "mid-stream worker fault"),
+    (list(range(500)), False, None, None),
+])
+def test_token_delta_roundtrip(tokens, finished, reason, error):
+    raw = wire.encode_token_delta("seq-7", tokens, finished=finished,
+                                  reason=reason, error=error)
+    assert wire.is_token_delta(raw) and wire.is_frame(raw)
+    sid, delta = wire.decode_token_delta(raw)
+    assert sid == "seq-7"
+    assert delta.tokens == list(tokens)
+    assert delta.finished is finished
+    assert delta.reason == reason and delta.error == error
+
+
+def test_token_delta_old_peer_rejects_version_typed():
+    """Mixed-version interop contract: a peer that only speaks v1/v2
+    answers the v3 frame with the ONE typed error every receive loop
+    already absorbs — it can never half-read the new message kind."""
+    raw = wire.encode_token_delta("s", [1, 2], finished=True, reason="eos")
+    with pytest.raises(wire.WireFormatError, match="unsupported wire"):
+        wire.decode_meta(raw, versions=frozenset({1, 2}))
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_token_delta(raw, versions=frozenset({1, 2}))
+    # and the ordinary traffic old peers DO see is unchanged: traceless
+    # frames still emit version 1 byte-identically
+    plain = wire.encode({"q": np.ones((3,), np.float32)})
+    assert plain[4] == 1
+    wire.decode_meta(plain, versions=frozenset({1, 2}))  # decodes clean
+
+
+def test_token_delta_malformed_and_truncated_typed():
+    raw = wire.encode_token_delta("s", [5, 6, 7], finished=False)
+    # truncations at every byte boundary: always the one typed error
+    for cut in (3, 5, 9, 12, len(raw) - 1):
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_token_delta(raw[:cut])
+    # an ordinary frame is NOT a token delta
+    plain = wire.encode({"x": np.ones((2,), np.int32)})
+    with pytest.raises(wire.WireFormatError, match="no token-delta"):
+        wire.decode_token_delta(plain)
+    # garbled generation metadata: wrong field types are typed, not a
+    # KeyError/AttributeError escaping into a worker loop
+    import json as _json
+
+    hlen = int.from_bytes(raw[6:10], "little")
+    hdr = _json.loads(raw[10:10 + hlen])
+    for bad_g in [{"sid": 7, "fin": True}, {"sid": "s", "fin": "yes"},
+                  {"sid": "s", "fin": True, "reason": 3}, "not-a-dict"]:
+        hdr2 = dict(hdr, g=bad_g) if isinstance(bad_g, dict) \
+            else dict(hdr, g=bad_g)
+        h2 = _json.dumps(hdr2).encode()
+        frame = (raw[:6] + len(h2).to_bytes(4, "little") + h2
+                 + b"\x00" * ((-(10 + len(h2))) % 16)
+                 + raw[10 + hlen + ((-(10 + hlen)) % 16):])
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_token_delta(frame)
+
+
+def test_token_delta_fuzzed_flips_never_escape_typed():
+    rng = np.random.default_rng(3)
+    raw = wire.encode_token_delta("fuzz", list(range(16)), finished=True,
+                                  reason="eos")
+    for _ in range(200):
+        buf = bytearray(raw)
+        for _ in range(rng.integers(1, 4)):
+            buf[int(rng.integers(0, len(buf)))] ^= int(
+                rng.integers(1, 256))
+        try:
+            wire.decode_token_delta(bytes(buf))
+        except wire.WireFormatError:
+            pass  # the one allowed outcome besides a clean decode
