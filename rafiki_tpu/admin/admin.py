@@ -194,6 +194,13 @@ class Admin:
         self.autoscaler = Autoscaler(self)
         if config.AUTOSCALE:
             self.autoscaler.start()
+        # safe live rollouts (admin/rollout.py): canary -> rolling ->
+        # done with automatic rollback, updating a RUNNING inference job
+        # to a new trial in place. Constructed before recovery so the
+        # boot pass can resolve a crashed admin's half-finished rollout.
+        from rafiki_tpu.admin.rollout import RolloutController
+
+        self.rollouts = RolloutController(self)
         self._seed_superadmin()
         # -- control-plane crash recovery (admin/recovery.py) -------------
         self._recovery: Dict[str, Any] = {"state": "ready"}
@@ -955,9 +962,85 @@ class Admin:
         inf = self.db.get_running_inference_job_of_train_job(job["id"])
         if inf is None:
             raise InvalidRequestError("No running inference job")
+        # a rollout mid-flight must end (ABORTED, no rollback pass — the
+        # stop below tears the whole fleet down) before the teardown, or
+        # its thread would race the stop placing replicas
+        self.rollouts.abort_for_job(inf["id"], "inference job stopped")
         self.services.stop_inference_services(inf["id"])
         self._drop_predict_routes(inf["id"])
         return self.get_inference_job(user_id, app, job["app_version"])
+
+    # -- safe live rollouts (admin/rollout.py; docs/failure-model.md
+    # "Rollout faults") ------------------------------------------------------
+
+    def _running_inference_job(self, user_id: str, app: str,
+                               app_version: int) -> Dict:
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        inf = self.db.get_running_inference_job_of_train_job(job["id"])
+        if inf is None:
+            raise InvalidRequestError("No running inference job")
+        return inf
+
+    def update_inference_job(
+        self, user_id: str, app: str, app_version: int = -1,
+        trial_id: Optional[str] = None,
+        canary_fraction: Optional[float] = None,
+        batch: Optional[int] = None,
+    ) -> Dict:
+        """Update the app's RUNNING inference job to serve ``trial_id``
+        in place — canary, SLO-judged, rolling replace, automatic
+        rollback — without a redeploy outage. Answers immediately with
+        the rollout row (phase CANARY); poll the status route (or
+        ``Client.wait_until_rollout_done``) for the verdict. A second
+        update while one is in flight raises the typed
+        RolloutInFlightError (→ 409)."""
+        if not trial_id:
+            raise InvalidRequestError("missing rollout target trial_id")
+        inf = self._running_inference_job(user_id, app, app_version)
+        return self.rollouts.start(
+            inf["id"], trial_id, canary_fraction=canary_fraction,
+            batch=batch)
+
+    def get_rollout_status(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        """The newest rollout of the app's current inference job (live
+        phases carry the judge's per-lane signal snapshot)."""
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        infs = self.db.get_inference_jobs_of_train_job(job["id"])
+        for inf in infs:
+            status = self.rollouts.status(inf["id"])
+            if status is not None:
+                return status
+        raise InvalidRequestError(
+            f"no rollout recorded for {app} v{job['app_version']}")
+
+    def abort_rollout(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        inf = self._running_inference_job(user_id, app, app_version)
+        return self.rollouts.abort(inf["id"])
+
+    def ack_rollout(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        """Acknowledge the newest rolled-back rollout (clears the
+        doctor WARN)."""
+        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
+        if job is None:
+            raise InvalidRequestError(f"No such train job {app} v{app_version}")
+        infs = self.db.get_inference_jobs_of_train_job(job["id"])
+        for inf in infs:
+            try:
+                return self.rollouts.ack(inf["id"])
+            except InvalidRequestError:
+                continue
+        raise InvalidRequestError(
+            f"no unacknowledged rollback for {app}")
 
     def _drop_predict_routes(self, inference_job_id: str) -> None:
         """Invalidate cached predict routes for a stopped inference job —
@@ -1144,6 +1227,10 @@ class Admin:
             # loop state, chip-loan picture, recent scale decisions with
             # their reason + signal snapshot
             "autoscaler": self.autoscaler.report(),
+            # safe live rollouts (admin/rollout.py): in-flight rollouts
+            # with the judge's live per-lane signals, plus recent events
+            # (rollback reasons + the signal snapshots they fired on)
+            "rollouts": self.rollouts.report(),
             "serving": {
                 "jobs": jobs,
                 "admission": self._predict_admission.stats(),
@@ -1165,6 +1252,7 @@ class Admin:
         for inf in self.db.get_inference_jobs_by_statuses(
             [InferenceJobStatus.STARTED, InferenceJobStatus.RUNNING]
         ):
+            self.rollouts.abort_for_job(inf["id"], "stop_all_jobs")
             self.services.stop_inference_services(inf["id"])
             self._drop_predict_routes(inf["id"])
         for job in self.db.get_train_jobs_by_statuses(
@@ -1289,6 +1377,10 @@ class Admin:
         # — a tick racing the teardown would re-place replicas
         if getattr(self, "autoscaler", None) is not None:
             self.autoscaler.stop()
+        # rollout runs likewise: a mid-flight placement racing the
+        # teardown would resurrect a replica nothing will ever stop
+        if getattr(self, "rollouts", None) is not None:
+            self.rollouts.stop()
         # a reconcile racing a shutdown would resurrect services the stop
         # below is about to tear down: signal it to ABORT (it checks at
         # every loop top and inside retry backoffs), then join it out

@@ -185,6 +185,19 @@ class Autoscaler:
         if inf is None or inf["status"] != InferenceJobStatus.RUNNING:
             return None
         st = self._job_state(job_id)
+        # a rollout mid-flight owns this job's replica set: the
+        # controller is deliberately adding/draining replicas, and a
+        # concurrent autoscale decision would fight it (drain the canary,
+        # or read the rolling replace's churn as load). Pause decisions
+        # and clear the window, so the first post-rollout decision is
+        # made on a fresh window over the NEW fleet, never on
+        # mid-rollout churn (getattr: the controller is wired right
+        # after this object in the Admin constructor).
+        rollouts = getattr(self._admin, "rollouts", None)
+        if rollouts is not None and rollouts.is_active(job_id):
+            st["history"].clear()
+            st["last_shed_total"] = None
+            return None
         # -- sample signals ------------------------------------------------
         try:
             backlog = int(predictor.backlog_depth())

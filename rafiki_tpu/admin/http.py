@@ -25,6 +25,7 @@ from urllib.parse import parse_qs, urlparse
 
 from rafiki_tpu import config
 from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+from rafiki_tpu.admin.rollout import RolloutInFlightError
 from rafiki_tpu.cache.queue import FrameTooLargeError, QueueFullError
 from rafiki_tpu.constants import UserType
 from rafiki_tpu.placement.manager import InsufficientChipsError
@@ -268,6 +269,30 @@ class AdminServer:
                 _APP_DEVS, lambda au, m, b, q: A.scale_inference_job(
                     au["user_id"], m["app"], int(m["v"]),
                     delta=_num_field(b, "delta", int))),
+            # safe live rollouts (admin/rollout.py): update the RUNNING
+            # inference job to a new trial in place — canary, SLO judge,
+            # rolling replace, automatic rollback. A second update while
+            # one is in flight answers a typed 409.
+            r("POST", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/update",
+                _APP_DEVS, lambda au, m, b, q: A.update_inference_job(
+                    au["user_id"], m["app"], int(m["v"]),
+                    trial_id=_field(b, "trial_id"),
+                    canary_fraction=(
+                        _num_field(b, "canary_fraction", float, -1.0)
+                        if "canary_fraction" in b else None),
+                    batch=(_num_field(b, "batch", int, 1)
+                           if "batch" in b else None))),
+            r("GET", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/rollout",
+                _ANY, lambda au, m, b, q: A.get_rollout_status(
+                    au["user_id"], m["app"], int(m["v"]))),
+            r("POST",
+                r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/rollout/abort",
+                _APP_DEVS, lambda au, m, b, q: A.abort_rollout(
+                    au["user_id"], m["app"], int(m["v"]))),
+            r("POST",
+                r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/rollout/ack",
+                _APP_DEVS, lambda au, m, b, q: A.ack_rollout(
+                    au["user_id"], m["app"], int(m["v"]))),
             # serving (the reference exposed this on a separate predictor app,
             # reference predictor/app.py:23-31)
             r("POST", r"/predict/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:
@@ -447,6 +472,11 @@ class AdminServer:
             # friends from inside Admin stay genuine 500s instead of being
             # masked as client errors with internal text echoed back
             self._respond(handler, 400, {"error": f"{type(e).__name__}: {e}"})
+        except RolloutInFlightError as e:
+            # exactly one live rollout per job: the conflict is the
+            # resource's current state, so 409 (retry after the rollout
+            # ends, or abort it) — typed for Client.update_inference_job
+            self._respond(handler, 409, {"error": f"{type(e).__name__}: {e}"})
         except ArtifactCorruptError as e:
             # a damaged on-disk artifact (params/checkpoint): the client
             # gets the typed error cleanly, never a deserialize traceback

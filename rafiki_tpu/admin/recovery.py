@@ -166,6 +166,8 @@ class ControlPlaneRecovery:
     # -- snapshot (synchronous, in the Admin constructor) ------------------
 
     def snapshot(self) -> Dict[str, Any]:
+        from rafiki_tpu.constants import RolloutPhase
+
         services = self._retry(self.db.get_non_terminal_services,
                                "service scan")
         train_jobs = self._retry(
@@ -176,13 +178,22 @@ class ControlPlaneRecovery:
             lambda: self.db.get_inference_jobs_by_statuses(
                 [InferenceJobStatus.STARTED, InferenceJobStatus.RUNNING]),
             "inference-job scan")
+        # CANARY/ROLLING rollout rows force a reconcile even when every
+        # job row happens to be terminal (e.g. the job was stopped while
+        # the admin was down): a live rollout row must always be
+        # resolved, never stranded
+        rollouts = self._retry(
+            lambda: self.db.get_rollouts_by_phases(
+                list(RolloutPhase.LIVE)),
+            "rollout scan")
         return {"services": services, "train_jobs": train_jobs,
-                "inference_jobs": inference_jobs}
+                "inference_jobs": inference_jobs, "rollouts": rollouts}
 
     @staticmethod
     def needed(snapshot: Dict[str, Any]) -> bool:
-        return any(snapshot[k] for k in
-                   ("services", "train_jobs", "inference_jobs"))
+        return any(snapshot.get(k) for k in
+                   ("services", "train_jobs", "inference_jobs",
+                    "rollouts"))
 
     def empty_report(self) -> Dict[str, Any]:
         return {**self.report, "state": "ready", "duration_s": 0.0}
@@ -410,6 +421,24 @@ class ControlPlaneRecovery:
             except Exception as e:
                 logger.exception("serving adoption failed for %s", job_id)
                 self._reason(f"job {job_id[:8]}: serving adoption failed "
+                             f"({type(e).__name__}: {e})")
+
+        # -- resolve half-finished rollouts (admin/rollout.py): the
+        # adopted worker rows carry each replica's model_version, so a
+        # rollout the dead admin left in CANARY/ROLLING is either
+        # resumed-as-done (fleet already fully new-version) or rolled
+        # back — never stranded mid-phase with a half-judged version
+        # taking traffic
+        rollouts = getattr(admin, "rollouts", None)
+        if rollouts is not None:
+            self._check_abort()
+            try:
+                rollouts.recover_on_boot()
+            except RecoveryAborted:
+                raise
+            except Exception as e:
+                logger.exception("boot-time rollout resolution failed")
+                self._reason(f"rollout resolution failed "
                              f"({type(e).__name__}: {e})")
 
         # -- sweep: no job may stay non-terminal with nothing backing it ---

@@ -39,6 +39,7 @@ from rafiki_tpu.placement.manager import (
     PlacementManager,
 )
 from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.utils import chaos
 from rafiki_tpu.worker.inference import InferenceWorker
 from rafiki_tpu.worker.train import TrainWorker
 
@@ -47,6 +48,24 @@ logger = logging.getLogger(__name__)
 
 class ServiceDeploymentError(Exception):
     pass
+
+
+def _chaos_deploy(inference_job_id: str, trial_id: str) -> None:
+    """RAFIKI_CHAOS site=deploy: the place-new-replica chokepoint shared
+    by the initial deploy, autoscaler scale-ups, and the rollout
+    controller's canary/rolling placements. `error`/`drop` raise the
+    typed deploy failure (the deterministic canary-failure rollback
+    drill); `delay` models a slow deploy (against the rollout's deploy
+    deadline, the deploy-timeout drill)."""
+    rule = chaos.hit(chaos.SITE_DEPLOY, f"{inference_job_id}/{trial_id}")
+    if rule is None:
+        return
+    if rule.action == chaos.ACTION_DELAY:
+        chaos.sleep_for(rule)
+        return
+    raise ServiceDeploymentError(
+        f"chaos-injected deploy failure placing a replica of trial "
+        f"{trial_id} for job {inference_job_id}")
 
 
 class ServicesManager:
@@ -413,6 +432,7 @@ class ServicesManager:
                      for trial in best_trials for _ in range(n_replicas)]
         try:
             for unit in units:
+                _chaos_deploy(inference_job_id, unit["trial_id"])
                 service = self._db.create_service(ServiceType.INFERENCE)
                 self._db.create_inference_job_worker(
                     service["id"], inference_job_id, unit["trial_id"]
@@ -451,6 +471,10 @@ class ServicesManager:
                 # bookkeeping below fails
                 created.append(service["id"])
                 self._db.update_service_chips(service["id"], ctx.chips)
+                # STARTED -> DEPLOYING (guarded) while the deploy wait
+                # runs: a row stuck here past SERVICE_DEPLOY_TIMEOUT_S
+                # is a wedged deploy, and doctor flags it
+                self._db.mark_service_as_deploying(service["id"])
             predictor_service = self._db.create_service(ServiceType.PREDICT)
             self._db.update_inference_job_predictor(
                 inference_job_id, predictor_service["id"]
@@ -623,6 +647,10 @@ class ServicesManager:
                 out.append({"service_id": w["service_id"],
                             "trial_id": w["trial_id"],
                             "group": group_of(w["trial_id"]),
+                            # rollout generation this replica serves
+                            # (admin/rollout.py; 0 = initial deploy)
+                            "model_version": int(
+                                w.get("model_version") or 0),
                             "chips": svc.get("chips") or []})
         return out
 
@@ -716,6 +744,13 @@ class ServicesManager:
         if unit["trial_id"] is None:
             raise ServiceDeploymentError(
                 f"no trial to serve for job {inference_job_id}")
+        # a scaled-up replica inherits its group's rollout generation —
+        # a post-rollout scale-up must not mint version-0 rows beside
+        # version-N siblings (recovery reads the version to reconstruct
+        # a mid-rollout fleet)
+        version = max((w["model_version"] for w in live
+                       if fused or w["group"] == unit["group"]), default=0)
+        _chaos_deploy(inference_job_id, unit["trial_id"])
         # chip loan: exclusive grant only when the arbiter allows it (the
         # training floor stays intact); otherwise shared devices.
         # begin_borrow is an atomic check-AND-reserve so two concurrent
@@ -730,7 +765,8 @@ class ServicesManager:
         try:
             service = self._db.create_service(ServiceType.INFERENCE)
             self._db.create_inference_job_worker(
-                service["id"], inference_job_id, unit["trial_id"])
+                service["id"], inference_job_id, unit["trial_id"],
+                model_version=version)
             worker_cls = InferenceWorker
             if train_job["task"] == TaskType.TEXT_GENERATION:
                 from rafiki_tpu.worker.generation import GenerationWorker
@@ -754,6 +790,7 @@ class ServicesManager:
                 raise
             try:
                 self._db.update_service_chips(service["id"], ctx.chips)
+                self._db.mark_service_as_deploying(service["id"])
                 self._wait_until_services_running([service["id"]])
             except Exception:
                 self._destroy_service(service["id"], wait=False)
@@ -777,6 +814,75 @@ class ServicesManager:
                     "(chips=%s)", inference_job_id[:8], service["id"][:8],
                     unit["group"][:16], ctx.chips)
         return service["id"], borrowed
+
+    # -- safe live rollouts (admin/rollout.py; docs/failure-model.md
+    # "Rollout faults") ------------------------------------------------------
+
+    def deploy_version_replica(self, inference_job_id: str, trial_id: str,
+                               model_version: int) -> str:
+        """Place ONE serving replica of ``trial_id`` carrying
+        ``model_version`` on its worker row — the rollout controller's
+        canary/rolling/restore placement primitive. Same placement shape
+        as the initial deploy (prefers an exclusive chip, falls back to
+        shared devices); no chip-arbiter loan — a rollout replaces
+        capacity, it does not grow it. Raises ServiceDeploymentError on
+        placement failure, deploy timeout, or a chaos ``site=deploy``
+        injection; a failed replica is fully torn down before the raise
+        so the caller's rollback never inherits half-placed state."""
+        inf = self._db.get_inference_job(inference_job_id)
+        if inf is None:
+            raise ServiceDeploymentError(
+                f"no inference job {inference_job_id}")
+        train_job = self._db.get_train_job(inf["train_job_id"])
+        assert train_job is not None
+        budget = inf.get("budget") or {}
+        chips_per_worker = max(
+            int(budget.get(BudgetType.CHIPS_PER_WORKER, 1)), 1)
+        alloc = getattr(self._placement, "allocator", None)
+        if alloc is not None:
+            max_per_service = getattr(
+                alloc, "max_chips_per_service", alloc.total_chips)
+            if chips_per_worker > max_per_service > 0:
+                chips_per_worker = max_per_service
+        _chaos_deploy(inference_job_id, trial_id)
+        service = self._db.create_service(ServiceType.INFERENCE)
+        self._db.create_inference_job_worker(
+            service["id"], inference_job_id, trial_id,
+            model_version=model_version)
+        worker_cls = InferenceWorker
+        if train_job["task"] == TaskType.TEXT_GENERATION:
+            from rafiki_tpu.worker.generation import GenerationWorker
+
+            worker_cls = GenerationWorker
+        worker = worker_cls(
+            inference_job_id, trial_id, self._db, self._broker)
+        try:
+            ctx = self._placement.create_service(
+                service["id"], ServiceType.INFERENCE, worker.start,
+                n_chips=chips_per_worker, best_effort_chips=True,
+                extra={"inference_job_id": inference_job_id,
+                       "trial_id": trial_id},
+            )
+        except Exception as e:
+            self._db.mark_service_as_stopped(service["id"])
+            raise ServiceDeploymentError(
+                f"placing replica of trial {trial_id} failed: "
+                f"{type(e).__name__}: {e}") from e
+        try:
+            self._db.update_service_chips(service["id"], ctx.chips)
+            self._db.mark_service_as_deploying(service["id"])
+            self._wait_until_services_running([service["id"]])
+        except Exception as e:
+            self._destroy_service(service["id"], wait=False)
+            if isinstance(e, ServiceDeploymentError):
+                raise
+            raise ServiceDeploymentError(
+                f"replica of trial {trial_id} never reached RUNNING: "
+                f"{type(e).__name__}: {e}") from e
+        logger.info("rollout: placed replica %s (trial %s, version %d) "
+                    "for job %s", service["id"][:8], trial_id[:8],
+                    model_version, inference_job_id[:8])
+        return service["id"]
 
     def _pick_scale_down_victims(self, inference_job_id: str, n: int,
                                  min_replicas: int) -> List[str]:

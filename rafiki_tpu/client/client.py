@@ -46,6 +46,26 @@ class GenerationStreamError(RafikiError):
     failed, not the transport."""
 
 
+class RolloutInFlightError(RafikiError):
+    """The admin answered 409: a rollout is already in flight for this
+    inference job (exactly one at a time). Wait it out with
+    :meth:`Client.wait_until_rollout_done` or abort it with
+    :meth:`Client.abort_rollout`, then retry."""
+
+
+class RolloutRolledBackError(RafikiError):
+    """The rollout ended without reaching DONE: ``phase`` is
+    ``ROLLED_BACK`` (the SLO judge fired — ``reason`` carries its
+    verdict and the rollout's event log holds the signal snapshot) or
+    ``ABORTED`` (job stopped / admin restarted mid-flight). The job
+    keeps serving the incumbent version."""
+
+    def __init__(self, message: str, phase: str, reason: Optional[str]):
+        super().__init__(message)
+        self.phase = phase
+        self.reason = reason
+
+
 class Client:
     def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000):
         self._base = f"http://{admin_host}:{admin_port}"
@@ -95,6 +115,12 @@ class Client:
                 # (admin/recovery.py): typed, so callers can wait it out
                 raise AdminRecoveringError(
                     payload.get("error", "admin is recovering"))
+            if resp.status_code == 409:
+                # one live rollout per job (admin/rollout.py): typed so
+                # callers can wait the current one out or abort it
+                raise RolloutInFlightError(
+                    payload.get("error", "rollout already in flight"),
+                    status=409)
             raise RafikiError(payload.get("error", f"HTTP {resp.status_code}"),
                               status=resp.status_code)
         return payload.get("data")
@@ -300,6 +326,75 @@ class Client:
         return self._call(
             "POST", f"/inference_jobs/{app}/{app_version}/scale",
             {"delta": int(delta)})
+
+    # -- safe live rollouts (docs/failure-model.md "Rollout faults") ---------
+
+    def update_inference_job(
+        self, app: str, trial_id: str, app_version: int = -1,
+        canary_fraction: Optional[float] = None,
+        batch: Optional[int] = None,
+    ) -> Dict:
+        """Update the app's RUNNING inference job to serve ``trial_id``
+        in place: one canary replica takes ``canary_fraction`` of the
+        traffic while an SLO judge compares it to the incumbents, then a
+        rolling replace in ``batch``-sized steps — zero dropped requests,
+        automatic rollback on a breach. Returns the rollout row (phase
+        ``CANARY``) immediately; follow with
+        :meth:`wait_until_rollout_done`. Raises the typed
+        :class:`RolloutInFlightError` (HTTP 409) while another rollout
+        of the same job is live."""
+        body: Dict[str, Any] = {"trial_id": trial_id}
+        if canary_fraction is not None:
+            body["canary_fraction"] = float(canary_fraction)
+        if batch is not None:
+            body["batch"] = int(batch)
+        return self._call(
+            "POST", f"/inference_jobs/{app}/{app_version}/update", body)
+
+    def get_rollout(self, app: str, app_version: int = -1) -> Dict:
+        """The app's newest rollout (live phases carry the judge's
+        per-lane signal snapshot under ``signals``)."""
+        return self._call(
+            "GET", f"/inference_jobs/{app}/{app_version}/rollout")
+
+    def abort_rollout(self, app: str, app_version: int = -1) -> Dict:
+        """Abort the in-flight rollout: the new version is drained and
+        the incumbents restored (phase ``ROLLED_BACK``, reason
+        "operator abort")."""
+        return self._call(
+            "POST", f"/inference_jobs/{app}/{app_version}/rollout/abort")
+
+    def ack_rollout(self, app: str, app_version: int = -1) -> Dict:
+        """Acknowledge the newest rolled-back rollout (clears the
+        ``python -m rafiki_tpu.doctor`` WARN)."""
+        return self._call(
+            "POST", f"/inference_jobs/{app}/{app_version}/rollout/ack")
+
+    def wait_until_rollout_done(
+        self, app: str, app_version: int = -1, timeout_s: float = 300.0,
+    ) -> Dict:
+        """Poll until the app's rollout reaches a terminal phase.
+        Returns the rollout row on ``DONE``; raises the typed
+        :class:`RolloutRolledBackError` — carrying the judge's reason —
+        on ``ROLLED_BACK``/``ABORTED``, and TimeoutError if it is still
+        live after ``timeout_s``."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            rollout = self.get_rollout(app, app_version)
+            phase = rollout.get("phase")
+            if phase == "DONE":
+                return rollout
+            if phase in ("ROLLED_BACK", "ABORTED"):
+                raise RolloutRolledBackError(
+                    f"rollout {rollout.get('id', '?')[:8]} ended "
+                    f"{phase}: {rollout.get('reason')}",
+                    phase=phase, reason=rollout.get("reason"))
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rollout still {phase} after {timeout_s:.0f}s")
+            _time.sleep(0.1)
 
     def predict(
         self, app: str, queries: List[Any], app_version: int = -1

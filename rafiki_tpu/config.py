@@ -282,6 +282,39 @@ _DYNAMIC_PATHS = {
         "RAFIKI_AUTOSCALE_FAIR_BURST", 32.0),
     "AUTOSCALE_FAIR_WEIGHTS": lambda: os.environ.get(
         "RAFIKI_AUTOSCALE_FAIR_WEIGHTS", ""),
+    # -- safe live rollouts (docs/failure-model.md "Rollout faults").
+    # admin/rollout.py updates a RUNNING inference job to a new trial in
+    # place: one canary replica judged over a trailing window, then a
+    # rolling replace in bounded batches, with automatic rollback on SLO
+    # breach / canary crash / deploy timeout. Lazy so a live rollout's
+    # NEXT phase picks up a retune:
+    #   RAFIKI_ROLLOUT_CANARY_FRACTION=0.1  traffic fraction routed to
+    #                                   the canary replica while it is
+    #                                   judged (0..1)
+    #   RAFIKI_ROLLOUT_JUDGE_WINDOW_S=10  trailing window the SLO judge
+    #                                   compares canary vs incumbent over
+    #   RAFIKI_ROLLOUT_MIN_REQUESTS=5   canary requests needed before an
+    #                                   error-rate/latency verdict counts
+    #                                   (an idle job proceeds after
+    #                                   3x the window with a low-traffic
+    #                                   note instead of stalling forever)
+    #   RAFIKI_ROLLOUT_ERR_DELTA=0.1    max (canary - incumbent) error
+    #                                   rate before automatic rollback
+    #   RAFIKI_ROLLOUT_P95_FACTOR=3.0   canary p95 past incumbent p95 x
+    #                                   this factor is an SLO breach
+    #   RAFIKI_ROLLOUT_BATCH=1          replicas replaced per rolling
+    #                                   batch (place new, drain old)
+    "ROLLOUT_CANARY_FRACTION": lambda: _env_float(
+        "RAFIKI_ROLLOUT_CANARY_FRACTION", 0.1),
+    "ROLLOUT_JUDGE_WINDOW_S": lambda: _env_float(
+        "RAFIKI_ROLLOUT_JUDGE_WINDOW_S", 10.0),
+    "ROLLOUT_MIN_REQUESTS": lambda: _env_int(
+        "RAFIKI_ROLLOUT_MIN_REQUESTS", 5),
+    "ROLLOUT_ERR_DELTA": lambda: _env_float(
+        "RAFIKI_ROLLOUT_ERR_DELTA", 0.1),
+    "ROLLOUT_P95_FACTOR": lambda: _env_float(
+        "RAFIKI_ROLLOUT_P95_FACTOR", 3.0),
+    "ROLLOUT_BATCH": lambda: _env_int("RAFIKI_ROLLOUT_BATCH", 1),
     "RECOVER_ADOPT": lambda: os.environ.get(
         "RAFIKI_RECOVER_ADOPT", "1") != "0",
     "RECOVER_PROBE_TIMEOUT_S": lambda: _env_float(

@@ -131,6 +131,25 @@ class ServiceType:
     ADVISOR = "ADVISOR"
 
 
+class RolloutPhase:
+    # Safe live rollouts (admin/rollout.py; docs/failure-model.md
+    # "Rollout faults"): a RUNNING inference job is updated to a new
+    # trial/model version in place — canary first, then a rolling
+    # replace — with automatic rollback on SLO breach, canary crash, or
+    # deploy timeout. CANARY/ROLLING are the live phases (exactly one
+    # rollout may be in flight per job); DONE/ROLLED_BACK/ABORTED are
+    # terminal. ABORTED = the rollout ended without a rollback pass
+    # (job stopped/errored, or a dead admin's stale row swept at boot).
+    CANARY = "CANARY"
+    ROLLING = "ROLLING"
+    DONE = "DONE"
+    ROLLED_BACK = "ROLLED_BACK"
+    ABORTED = "ABORTED"
+
+    LIVE = (CANARY, ROLLING)
+    TERMINAL = (DONE, ROLLED_BACK, ABORTED)
+
+
 class AgentHealth:
     # Heartbeat-derived state of a host agent (placement/hosts.py monitor;
     # docs/failure-model.md). UNKNOWN = not probed yet.

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional
 from rafiki_tpu import config
 from rafiki_tpu.constants import (
     InferenceJobStatus,
+    RolloutPhase,
     ServiceStatus,
     TrainJobStatus,
     TrialStatus,
@@ -135,7 +136,23 @@ CREATE TABLE IF NOT EXISTS inference_job (
 CREATE TABLE IF NOT EXISTS inference_job_worker (
     service_id TEXT PRIMARY KEY REFERENCES service(id),
     inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
-    trial_id TEXT NOT NULL REFERENCES trial(id)
+    trial_id TEXT NOT NULL REFERENCES trial(id),
+    model_version INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS rollout (
+    id TEXT PRIMARY KEY,
+    inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
+    from_trial_id TEXT,
+    to_trial_id TEXT NOT NULL,
+    from_version INTEGER NOT NULL,
+    to_version INTEGER NOT NULL,
+    n_replicas_before INTEGER NOT NULL DEFAULT 0,
+    phase TEXT NOT NULL,
+    reason TEXT,
+    events TEXT NOT NULL DEFAULT '[]',
+    operator_ack INTEGER NOT NULL DEFAULT 0,
+    datetime_started REAL NOT NULL,
+    datetime_stopped REAL
 );
 CREATE TABLE IF NOT EXISTS trial_log (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -340,10 +357,38 @@ class Database:
         # on the model row (JSON); NULL = uploaded before the verifier
         # or under RAFIKI_VERIFY_TEMPLATES=off (doctor lists those)
         "ALTER TABLE model ADD COLUMN verification TEXT",
+        # r11 (safe live rollouts): which model version a serving replica
+        # runs — a rollout deploys new-version replicas beside the
+        # incumbents, so recovery can reconstruct a mixed-version fleet
+        # (admin/rollout.py; docs/failure-model.md "Rollout faults")
+        "ALTER TABLE inference_job_worker ADD COLUMN"
+        " model_version INTEGER NOT NULL DEFAULT 0",
+        # r11: rollout rows (the CREATE TABLE in _SCHEMA covers fresh
+        # stores; this covers stores created by earlier versions)
+        """CREATE TABLE IF NOT EXISTS rollout (
+    id TEXT PRIMARY KEY,
+    inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
+    from_trial_id TEXT,
+    to_trial_id TEXT NOT NULL,
+    from_version INTEGER NOT NULL,
+    to_version INTEGER NOT NULL,
+    n_replicas_before INTEGER NOT NULL DEFAULT 0,
+    phase TEXT NOT NULL,
+    reason TEXT,
+    events TEXT NOT NULL DEFAULT '[]',
+    operator_ack INTEGER NOT NULL DEFAULT 0,
+    datetime_started REAL NOT NULL,
+    datetime_stopped REAL
+)""",
     )
 
     def _migrate(self) -> None:
         for stmt in self._MIGRATIONS:
+            if self._b.kind == "postgres":
+                # migration DDL needs the same type mapping the schema
+                # gets (REAL is float4 on PG — epoch seconds would lose
+                # sub-minute precision)
+                stmt = translate_ddl(stmt)
             with self._lock:
                 try:
                     self._b.execute(stmt)
@@ -1014,17 +1059,23 @@ class Database:
         )
 
     def create_inference_job_worker(
-        self, service_id: str, inference_job_id: str, trial_id: str
+        self, service_id: str, inference_job_id: str, trial_id: str,
+        model_version: int = 0,
     ) -> Dict:
+        """``model_version`` is the rollout generation this replica
+        serves (0 for the initial deploy; admin/rollout.py bumps it per
+        in-place update) — recovery reads it to reconstruct a
+        mixed-version fleet mid-rollout."""
         self._exec(
             "INSERT INTO inference_job_worker (service_id, inference_job_id,"
-            " trial_id) VALUES (?,?,?)",
-            (service_id, inference_job_id, trial_id),
+            " trial_id, model_version) VALUES (?,?,?,?)",
+            (service_id, inference_job_id, trial_id, int(model_version)),
         )
         return {
             "service_id": service_id,
             "inference_job_id": inference_job_id,
             "trial_id": trial_id,
+            "model_version": int(model_version),
         }
 
     def get_inference_job_worker(self, service_id: str) -> Optional[Dict]:
@@ -1037,6 +1088,87 @@ class Database:
             "SELECT * FROM inference_job_worker WHERE inference_job_id=?",
             (inference_job_id,),
         )
+
+    # -- rollouts (admin/rollout.py; docs/failure-model.md
+    # "Rollout faults") ------------------------------------------------------
+
+    @staticmethod
+    def _parse_rollout(row: Optional[Dict]) -> Optional[Dict]:
+        if row is not None:
+            try:
+                row["events"] = json.loads(row.get("events") or "[]")
+            except ValueError:
+                row["events"] = []
+            row["operator_ack"] = bool(row.get("operator_ack"))
+        return row
+
+    def create_rollout(
+        self, inference_job_id: str, from_trial_id: Optional[str],
+        to_trial_id: str, from_version: int, to_version: int,
+        n_replicas_before: int, phase: str,
+    ) -> Dict:
+        rid = uuid.uuid4().hex
+        self._exec(
+            "INSERT INTO rollout (id, inference_job_id, from_trial_id,"
+            " to_trial_id, from_version, to_version, n_replicas_before,"
+            " phase, datetime_started) VALUES (?,?,?,?,?,?,?,?,?)",
+            (rid, inference_job_id, from_trial_id, to_trial_id,
+             int(from_version), int(to_version), int(n_replicas_before),
+             phase, time.time()),
+        )
+        return self.get_rollout(rid)  # type: ignore[return-value]
+
+    def get_rollout(self, rollout_id: str) -> Optional[Dict]:
+        return self._parse_rollout(self._one(
+            "SELECT * FROM rollout WHERE id=?", (rollout_id,)))
+
+    def get_rollouts_of_inference_job(
+        self, inference_job_id: str
+    ) -> List[Dict]:
+        rows = self._all(
+            "SELECT * FROM rollout WHERE inference_job_id=?"
+            " ORDER BY datetime_started DESC",
+            (inference_job_id,),
+        )
+        return [self._parse_rollout(r) for r in rows]
+
+    def get_rollouts_by_phases(self, phases: List[str]) -> List[Dict]:
+        """Rollout rows in the given phases — recovery scans the LIVE
+        phases (a half-finished rollout must be resumed or rolled back,
+        never stranded) and doctor the unacked ROLLED_BACK ones."""
+        marks = ",".join("?" * len(phases))
+        rows = self._all(
+            f"SELECT * FROM rollout WHERE phase IN ({marks})",
+            tuple(phases),
+        )
+        return [self._parse_rollout(r) for r in rows]
+
+    def mark_rollout_phase(
+        self, rollout_id: str, phase: str, reason: Optional[str] = None,
+    ) -> None:
+        """Phase transition; terminal phases stamp datetime_stopped and
+        record the reason (rollback trigger / abort cause)."""
+        if phase in RolloutPhase.TERMINAL:
+            self._exec(
+                "UPDATE rollout SET phase=?, reason=?, datetime_stopped=?"
+                " WHERE id=?",
+                (phase, reason, time.time(), rollout_id),
+            )
+        else:
+            self._exec(
+                "UPDATE rollout SET phase=? WHERE id=?", (phase, rollout_id))
+
+    def update_rollout_events(self, rollout_id: str, events: List[Dict]) -> None:
+        self._exec(
+            "UPDATE rollout SET events=? WHERE id=?",
+            (json.dumps(events), rollout_id),
+        )
+
+    def ack_rollout(self, rollout_id: str) -> None:
+        """Operator acknowledgment of a rollback (doctor WARNs on
+        ROLLED_BACK rollouts nobody has looked at)."""
+        self._exec(
+            "UPDATE rollout SET operator_ack=1 WHERE id=?", (rollout_id,))
 
     # -- services ------------------------------------------------------------
 
@@ -1100,6 +1232,7 @@ class Database:
             " tj.status AS train_job_status,"
             " iw.inference_job_id AS inference_job_id,"
             " iw.trial_id AS trial_id,"
+            " iw.model_version AS model_version,"
             " ij.status AS inference_job_status,"
             " pj.id AS predictor_job_id,"
             " pj.status AS predictor_job_status"
@@ -1139,9 +1272,14 @@ class Database:
         )
 
     def mark_service_as_deploying(self, service_id: str) -> None:
+        """Guarded STARTED -> DEPLOYING: a fast worker may already have
+        reported RUNNING (or even finished) by the time the deploy path
+        gets here, and that later status must win. Doctor's "rollouts"
+        check flags rows stuck in DEPLOYING past the deploy timeout —
+        the signature of a wedged placement."""
         self._exec(
-            "UPDATE service SET status=? WHERE id=?",
-            (ServiceStatus.DEPLOYING, service_id),
+            "UPDATE service SET status=? WHERE id=? AND status=?",
+            (ServiceStatus.DEPLOYING, service_id, ServiceStatus.STARTED),
         )
 
     def mark_service_as_running(self, service_id: str) -> None:

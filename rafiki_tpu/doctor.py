@@ -273,6 +273,79 @@ def check_recovery() -> Check:
             "no orphaned jobs; adoption enabled")
 
 
+def check_rollouts() -> Check:
+    """Safe live rollouts (docs/failure-model.md "Rollout faults"): WARN
+    on service rows stuck in DEPLOYING longer than
+    SERVICE_DEPLOY_TIMEOUT_S — a wedged placement nothing is waiting on
+    (the deploy path marks rows DEPLOYING while it waits; a live admin's
+    wait either resolves them or tears them down inside the timeout) —
+    and on rolled-back rollouts no operator has acknowledged (a rollback
+    is the platform saying a version was bad; somebody should look
+    before the next update ships the same regression)."""
+    from rafiki_tpu import config
+    from rafiki_tpu.constants import RolloutPhase
+
+    notes = []
+    warn = False
+    live_rollouts = 0
+    target = str(config.DB_PATH)
+    is_url = target.startswith(("postgresql://", "postgres://"))
+    if is_url or os.path.exists(target):
+        try:
+            import time as _time
+
+            from rafiki_tpu.db.database import Database
+
+            timeout_s = float(config.SERVICE_DEPLOY_TIMEOUT_S)
+            now = _time.time()
+            db = Database(target)
+            try:
+                wedged = [
+                    s for s in db.get_services(status="DEPLOYING")
+                    if now - (s.get("datetime_started") or now) > timeout_s]
+                if wedged:
+                    warn = True
+                    notes.append(
+                        f"{len(wedged)} service row(s) stuck in DEPLOYING "
+                        f"longer than SERVICE_DEPLOY_TIMEOUT_S="
+                        f"{timeout_s:g}s: "
+                        + ", ".join(s["id"][:8] for s in wedged[:5])
+                        + (" …" if len(wedged) > 5 else "")
+                        + " — a wedged deploy; restarting the admin "
+                        "reconciles them")
+                unacked = [
+                    r for r in db.get_rollouts_by_phases(
+                        [RolloutPhase.ROLLED_BACK])
+                    if not r["operator_ack"]]
+                if unacked:
+                    warn = True
+                    notes.append(
+                        f"{len(unacked)} rolled-back rollout(s) with no "
+                        "operator ack: "
+                        + "; ".join(
+                            f"job {r['inference_job_id'][:8]} "
+                            f"({(r.get('reason') or 'no reason')[:60]})"
+                            for r in unacked[:3])
+                        + (" …" if len(unacked) > 3 else "")
+                        + " — review, then POST .../rollout/ack "
+                        "(Client.ack_rollout)")
+                live_rollouts = len(db.get_rollouts_by_phases(
+                    list(RolloutPhase.LIVE)))
+            finally:
+                db.close()
+        # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+        except Exception as e:
+            return ("rollouts", WARN,
+                    f"could not scan {target}: {type(e).__name__}: {e}")
+    if warn:
+        return ("rollouts", WARN, "; ".join(notes))
+    detail = (f"no wedged deploys, no unacked rollbacks; "
+              f"{live_rollouts} rollout(s) in flight, canary fraction "
+              f"{float(config.ROLLOUT_CANARY_FRACTION):g}, judge window "
+              f"{float(config.ROLLOUT_JUDGE_WINDOW_S):g}s")
+    return ("rollouts", PASS, detail)
+
+
 def check_trial_faults() -> Check:
     """Training-plane fault tolerance (docs/failure-model.md,
     "Training-plane faults"): WARN when infra-retry is disabled
@@ -857,7 +930,8 @@ def check_agents() -> Check:
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
     check_chaos, check_overload_knobs, check_autoscaler, check_recovery,
-    check_trial_faults, check_vectorized_trials, check_static_analysis,
+    check_rollouts, check_trial_faults, check_vectorized_trials,
+    check_static_analysis,
     check_int8_serving, check_generative_serving,
     check_observability, check_agents, check_backend,
 ]

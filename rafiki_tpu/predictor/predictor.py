@@ -20,6 +20,7 @@ defects fixed by design:
 
 from __future__ import annotations
 
+import collections
 import itertools
 import logging
 import threading
@@ -31,6 +32,12 @@ from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
 from rafiki_tpu.predictor.ensemble import ensemble_predictions
 
 logger = logging.getLogger(__name__)
+
+#: rollout lane labels (admin/rollout.py): while a rollout is in flight,
+#: every request is served by exactly ONE version lane — the incumbent
+#: fleet or the new-version replicas — never an ensemble across versions
+LANE_INCUMBENT = "incumbent"
+LANE_CANARY = "canary"
 
 
 class Predictor:
@@ -80,6 +87,28 @@ class Predictor:
         # the door-level shed_rate:<door> rings can't split a shared door
         # by job
         self._ring_shed = REGISTRY.ring(f"shed_rate:job:{inference_job_id}")
+        # -- rollout version lanes (admin/rollout.py) ----------------------
+        # While a rollout is in flight, requests split by a weighted
+        # counter between the incumbent fleet and the new-version
+        # replicas; each lane's outcomes (ok/error/shed + latency) feed
+        # the SLO judge over a trailing window. Guarded by _route_lock.
+        self._lane_new: Optional[set] = None
+        self._lane_permille = 0
+        self._lane_counter = itertools.count()
+        # (monotonic_ts, duration_s, outcome) per lane, judge-windowed
+        self._lane_stats: Dict[str, collections.deque] = {
+            LANE_INCUMBENT: collections.deque(maxlen=4096),
+            LANE_CANARY: collections.deque(maxlen=4096),
+        }
+        # registry mirrors so the rollout verdict is readable off
+        # GET /metrics too (docs/observability.md)
+        self._m_lane_req = REGISTRY.counter(
+            "rafiki_rollout_requests_total",
+            "requests served per rollout version lane",
+            ("job", "lane", "outcome"))
+        self._m_lane_lat = REGISTRY.histogram(
+            "rafiki_rollout_request_seconds",
+            "request latency per rollout version lane", ("job", "lane"))
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._ol_lock:
@@ -119,6 +148,70 @@ class Predictor:
     def draining_workers(self) -> set:
         with self._route_lock:
             return set(self._draining)
+
+    # -- rollout version lanes (admin/rollout.py; docs/failure-model.md
+    # "Rollout faults") ------------------------------------------------------
+
+    def set_rollout_lane(self, new_workers, fraction: float) -> None:
+        """Begin (or re-weight) version-lane routing: ``new_workers`` are
+        the new-version replicas; ``fraction`` of requests route to them
+        (deterministic weighted counter, not randomness). Starting a lane
+        from scratch clears the per-lane outcome history so the judge
+        never reads a previous rollout's window."""
+        permille = max(0, min(int(round(float(fraction) * 1000)), 1000))
+        with self._route_lock:
+            fresh = self._lane_new is None
+            self._lane_new = set(new_workers)
+            self._lane_permille = permille
+        if fresh:
+            for dq in self._lane_stats.values():
+                dq.clear()
+
+    def clear_rollout_lane(self) -> None:
+        """End version-lane routing (rollout done or rolled back): every
+        routable replica serves every request again."""
+        with self._route_lock:
+            self._lane_new = None
+            self._lane_permille = 0
+
+    def _lane_snapshot(self):
+        with self._route_lock:
+            return (set(self._lane_new) if self._lane_new is not None
+                    else None), self._lane_permille
+
+    def _lane_take_new(self, permille: int) -> bool:
+        """Deterministic weighted lane choice, error-diffusion style:
+        canary picks interleave evenly through the request stream (a
+        plain ``counter % 1000 < permille`` would send the first
+        ``permille`` requests to the canary in one solid burst — the
+        judge window would see all-canary then all-incumbent)."""
+        n = next(self._lane_counter)
+        return (n + 1) * permille // 1000 > n * permille // 1000
+
+    def _lane_record(self, lane: str, outcome: str, duration_s: float) -> None:
+        self._lane_stats[lane].append(
+            (time.monotonic(), duration_s, outcome))
+        self._m_lane_req.labels(self._job_id, lane, outcome).inc()
+        if outcome == "ok":
+            self._m_lane_lat.labels(self._job_id, lane).observe(duration_s)
+
+    def rollout_stats(self, window_s: float) -> Dict[str, Dict[str, Any]]:
+        """Per-lane outcome picture over the trailing ``window_s`` — the
+        SLO judge's input: request/error/shed counts and the ok-latency
+        p95 (sorted-window quantile; the registry histogram mirrors the
+        same series for dashboards)."""
+        cutoff = time.monotonic() - max(window_s, 0.0)
+        out: Dict[str, Dict[str, Any]] = {}
+        for lane, dq in self._lane_stats.items():
+            entries = [e for e in list(dq) if e[0] >= cutoff]
+            oks = sorted(d for _, d, o in entries if o == "ok")
+            errors = sum(1 for e in entries if e[2] == "error")
+            shed = sum(1 for e in entries if e[2] == "shed")
+            p95 = oks[min(int(len(oks) * 0.95), len(oks) - 1)] if oks \
+                else None
+            out[lane] = {"requests": len(entries), "ok": len(oks),
+                         "errors": errors, "shed": shed, "p95_s": p95}
+        return out
 
     def _route_snapshot(self):
         with self._route_lock:
@@ -210,6 +303,20 @@ class Predictor:
         if not routable:
             routable = [w for w in queues if not trials or w in trials] \
                 or list(queues)
+        # rollout lane split: a generation stream answers from ONE
+        # version — canary-lane streams go only to new-version replicas
+        lane_new, permille = self._lane_snapshot()
+        lane = None
+        if lane_new is not None:
+            take_new = self._lane_take_new(permille)
+            picked = [w for w in routable if (w in lane_new) == take_new]
+            if picked:
+                routable = picked
+                lane = LANE_CANARY if take_new else LANE_INCUMBENT
+            else:
+                lane = (LANE_CANARY
+                        if all(w in lane_new for w in routable)
+                        else LANE_INCUMBENT)
         rr = next(self._rr) % len(routable)
         order = routable[rr:] + routable[:rr]
         fut = None
@@ -223,11 +330,22 @@ class Predictor:
             break
         if fut is None:
             self._bump("requests_shed")
+            if lane is not None:
+                self._lane_record(lane, "shed", 0.0)
             raise QueueFullError(
                 f"all serving queues for job {self._job_id} are full")
         # the worker resolves the future with the TokenStream the moment
         # a slot admits the request (prefill done, first token pushed)
-        return fut.result(max(deadline - time.monotonic(), 0.0))
+        t0 = time.monotonic()
+        try:
+            stream = fut.result(max(deadline - time.monotonic(), 0.0))
+        except Exception:
+            if lane is not None:
+                self._lane_record(lane, "error", time.monotonic() - t0)
+            raise
+        if lane is not None:
+            self._lane_record(lane, "ok", time.monotonic() - t0)
+        return stream
 
     def predict_batch(
         self, queries: List[Any], timeout_s: Optional[float] = None,
@@ -237,7 +355,15 @@ class Predictor:
         failover); the ensemble is across trials. ``trace`` (a sampled
         request's RequestTrace) rides the FIRST submit of each trial so
         worker-side spans land in the door's span tree; hedge batches are
-        duplicate work and stay untraced."""
+        duplicate work and stay untraced.
+
+        While a rollout lane is set (admin/rollout.py), each request is
+        served by exactly ONE version lane — predictions are never
+        ensembled across model versions. A canary-lane request whose new-
+        version replica sheds or errors **fails over to the incumbent
+        lane** (bounded blast radius: a bad canary costs the judge an
+        error sample, never the client a request); incumbent-lane
+        failures never fall back onto the version under judgment."""
         timeout_s = timeout_s if timeout_s is not None else config.PREDICT_TIMEOUT_S
         deadline = time.monotonic() + timeout_s
         queues = self._broker.get_worker_queues(self._job_id)
@@ -245,6 +371,55 @@ class Predictor:
             raise RuntimeError(
                 f"No inference workers registered for job {self._job_id}"
             )
+        trials, draining = self._route_snapshot()
+        routable = [w for w in queues
+                    if not trials or w in trials] or list(queues)
+        lane_new, permille = self._lane_snapshot()
+        if lane_new is None:
+            return self._predict_on(
+                queries, queues, routable, trials, draining, deadline,
+                trace)
+        take_new = self._lane_take_new(permille)
+        new_r = [w for w in routable if w in lane_new]
+        old_r = [w for w in routable if w not in lane_new]
+        if take_new and new_r:
+            primary, fallback, lane = new_r, old_r, LANE_CANARY
+        elif old_r:
+            primary, fallback, lane = old_r, [], LANE_INCUMBENT
+        else:
+            # nothing but new-version replicas left (tail of the rolling
+            # phase): they serve everything
+            primary, fallback, lane = new_r or routable, [], LANE_CANARY
+        t0 = time.monotonic()
+        try:
+            preds = self._predict_on(
+                queries, queues, primary, trials, draining, deadline,
+                trace)
+        except QueueFullError:
+            self._lane_record(lane, "shed", time.monotonic() - t0)
+            if lane == LANE_CANARY and fallback \
+                    and time.monotonic() < deadline:
+                return self._predict_on(
+                    queries, queues, fallback, trials, draining, deadline,
+                    trace)
+            raise
+        except Exception:
+            self._lane_record(lane, "error", time.monotonic() - t0)
+            if lane == LANE_CANARY and fallback \
+                    and time.monotonic() < deadline:
+                return self._predict_on(
+                    queries, queues, fallback, trials, draining, deadline,
+                    trace)
+            raise
+        self._lane_record(lane, "ok", time.monotonic() - t0)
+        return preds
+
+    def _predict_on(
+        self, queries: List[Any], queues, routable: List[str],
+        trials: Dict[str, str], draining: set, deadline: float, trace,
+    ) -> List[Any]:
+        """Serve one request against the given routable worker set (the
+        whole fan-out normally; one version lane during a rollout)."""
         # group live workers by trial; with no trial map at all (legacy
         # standalone jobs) unknown workers stand alone, but when a map
         # exists an unmapped queue is a scaled-up replica still WARMING
@@ -255,9 +430,6 @@ class Predictor:
         # queues empty — but if a trial has ONLY draining replicas left,
         # they still serve it (drain is a routing preference, never a
         # way to lose a trial from the ensemble).
-        trials, draining = self._route_snapshot()
-        routable = [w for w in queues
-                    if not trials or w in trials] or list(queues)
         groups: Dict[str, List[str]] = {}
         if draining:
             active = [w for w in routable if w not in draining]

@@ -26,10 +26,12 @@ Fields:
              decode — corruption drills), ``db`` (metadata-store
              statements — transient store-failure drills for
              control-plane recovery), ``trial`` (the trial-run
-             chokepoint in the train worker — fault-taxonomy drills), or
+             chokepoint in the train worker — fault-taxonomy drills),
              ``generate`` (the generation decode loop — mid-stream
              fault / stalled-decode drills, one ask per active slot per
-             round). Required.
+             round), or ``deploy`` (the inference-replica placement
+             chokepoint — canary-failure / deploy-timeout rollback
+             drills for live rollouts). Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
              ``delay_s`` then proceed — a slow replica), ``error``
@@ -98,6 +100,16 @@ SITE_DB = "db"
 # error frame, never a silent hang — and `delay` slows the whole step
 # (a slow decode) — docs/serving-generation.md "Chaos drills".
 SITE_GENERATE = "generate"
+# inference-replica placement chokepoint (admin/services.py — the
+# shared _chaos_deploy ask inside create_inference_services,
+# _scale_up_one, and the rollout controller's deploy_version_replica):
+# one ask per replica placement, target "{inference_job_id}/{trial_id}".
+# `error` (or `drop`) fails the placement with a typed
+# ServiceDeploymentError — the deterministic canary-failure drill —
+# and `delay` models a slow deploy (stacked against the rollout's
+# deploy deadline, it becomes the deploy-timeout rollback drill) —
+# docs/failure-model.md "Rollout faults".
+SITE_DEPLOY = "deploy"
 # trial-run chokepoint (worker/train.py _execute_trial): one ask per
 # trial ATTEMPT, target "{sub_train_job_id} {trial_id}". `error` raises
 # a typed transient fault the taxonomy classifies INFRA (the
@@ -134,7 +146,7 @@ class ChaosRule:
     def __post_init__(self) -> None:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
                              SITE_WIRE, SITE_DB, SITE_TRIAL,
-                             SITE_GENERATE):
+                             SITE_GENERATE, SITE_DEPLOY):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
                                ACTION_CORRUPT, ACTION_OOM):

@@ -161,6 +161,35 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # rafiki_gen_tokens_total, rafiki_gen_slots_busy{service},
 # rafiki_gen_evictions_total{reason}.
 
+# Safe live rollouts (docs/failure-model.md "Rollout faults"). An
+# operator (or automation) updates a RUNNING inference job to a new
+# trial in place — POST /inference_jobs/<app>/<v>/update — one canary
+# replica judged against the incumbents over a trailing window, then a
+# rolling replace with graceful drains, with automatic rollback on SLO
+# breach / canary crash / deploy failure or timeout (one rollout per
+# job; a second update answers typed 409):
+#   RAFIKI_ROLLOUT_CANARY_FRACTION=0.1  traffic fraction routed to the
+#                                       canary while it is judged
+#   RAFIKI_ROLLOUT_JUDGE_WINDOW_S=10    trailing window the SLO judge
+#                                       compares canary vs incumbent over
+#   RAFIKI_ROLLOUT_MIN_REQUESTS=5       canary samples needed before an
+#                                       error-rate/latency verdict (an
+#                                       idle job proceeds after 3x the
+#                                       window with a low-traffic note)
+#   RAFIKI_ROLLOUT_ERR_DELTA=0.1        max (canary - incumbent) error
+#                                       rate before automatic rollback
+#   RAFIKI_ROLLOUT_P95_FACTOR=3.0       canary ok-latency p95 past
+#                                       incumbent p95 x this factor is
+#                                       an SLO breach
+#   RAFIKI_ROLLOUT_BATCH=1              replicas replaced per rolling
+#                                       batch (place new, drain old)
+# New /metrics series: rafiki_rollout_{started,completed,rollbacks}_total
+# {job}, rafiki_rollout_requests_total{job,lane,outcome},
+# rafiki_rollout_request_seconds{job,lane}. Rollout events (reason +
+# signal snapshot) surface under GET /fleet/health "rollouts"; doctor's
+# "rollouts" check WARNs on wedged DEPLOYING rows and unacked rollbacks
+# (POST .../rollout/ack).
+
 # TPU backend probe hardening (bench.py / doctor): probes serialize on a
 # machine-wide lockfile so retry loops never stack interpreters onto a
 # wedged libtpu tunnel; abandoned probe children are reaped once stale:
@@ -292,8 +321,10 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # codec-corruption drills, db, which fails/delays metadata-store
 # statements for control-plane recovery drills, trial, which
 # errors/delays/OOMs the trial-run chokepoint for fault-taxonomy
-# drills, and generate, which injures/stalls one generation slot per
-# rule for mid-stream fault drills):
+# drills, generate, which injures/stalls one generation slot per
+# rule for mid-stream fault drills, and deploy, which fails/delays the
+# inference-replica placement chokepoint for canary-failure and
+# deploy-timeout rollback drills):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 

@@ -162,9 +162,20 @@ def _drive_every_dal_method(db: Database) -> None:
     db.get_running_inference_job_of_train_job(tj["id"])
     db.update_inference_job_predictor(ij["id"], svc["id"])
     db.mark_inference_job_as_running(ij["id"])
-    db.create_inference_job_worker(svc["id"], ij["id"], t["id"])
+    db.create_inference_job_worker(svc["id"], ij["id"], t["id"],
+                                   model_version=1)
     db.get_inference_job_worker(svc["id"])
     db.get_workers_of_inference_job(ij["id"])
+
+    ro = db.create_rollout(ij["id"], t["id"], t["id"], 0, 1, 2, "CANARY")
+    db.get_rollout(ro["id"])
+    db.get_rollouts_of_inference_job(ij["id"])
+    db.get_rollouts_by_phases(["CANARY", "ROLLING"])
+    db.update_rollout_events(ro["id"], [{"event": "started"}])
+    db.mark_rollout_phase(ro["id"], "ROLLING")
+    db.mark_rollout_phase(ro["id"], "ROLLED_BACK", "SLO breach")
+    db.ack_rollout(ro["id"])
+
     db.mark_inference_job_as_stopped(ij["id"])
     db.mark_inference_job_as_errored(ij["id"])
 

@@ -74,6 +74,15 @@ def test_malformed_authed_bodies_get_4xx(server):
          b'{"trial_id": "t", "resource": "three", "value": 0.5}'),
         ("/advisors", b'{"knob_config": {"bad": {"type": "NOPE"}}}'),
         ("/predict/ghost-app", b'{"queries": [[0]]}'),
+        # safe live rollouts: malformed update/abort bodies are clean 4xx
+        ("/inference_jobs/ghost/-1/update", b"{}"),  # missing trial_id
+        ("/inference_jobs/ghost/-1/update", b'{"trial_id": "t",'
+                                            b' "canary_fraction": "lots"}'),
+        ("/inference_jobs/ghost/-1/update", b'{"trial_id": "t",'
+                                            b' "batch": [1]}'),
+        ("/inference_jobs/ghost/-1/update", b'{"trial_id": "t"}'),  # no job
+        ("/inference_jobs/ghost/-1/rollout/abort", b"{}"),
+        ("/inference_jobs/ghost/-1/rollout/ack", b"not json }{"),
     ]
     for path, body in cases:
         status, payload = _post(server, path, body, token=token)

@@ -620,6 +620,155 @@ def test_adoption_rebuilds_advisor_session_with_replayed_scores(tmp_workdir):
 
 
 # ---------------------------------------------------------------------------
+# crash recovery mid-rollout (admin/rollout.py; docs/failure-model.md
+# "Rollout faults"): adoption reconstructs the mixed-version fleet and
+# the boot pass resolves the half-finished rollout — never strands it
+# ---------------------------------------------------------------------------
+
+
+def _rollout_target(db, inf_id):
+    """(inference_job, a COMPLETED non-serving trial, live worker rows)."""
+    inf = db.get_inference_job(inf_id)
+    tj = db.get_train_job(inf["train_job_id"])
+    serving = {w["trial_id"]
+               for w in db.get_workers_of_inference_job(inf_id)}
+    target = next(t["id"] for t in db.get_best_trials_of_train_job(
+        tj["id"], max_count=10) if t["id"] not in serving)
+    return inf, target
+
+
+def test_restart_mid_canary_adopts_mixed_fleet_and_rolls_back(tmp_workdir):
+    """The admin dies between the canary and rolling phases (canary
+    placed, rollout row CANARY). The successor adopts BOTH versions —
+    the worker rows carry each replica's model_version — then rolls the
+    rollout back: canary drained, row ROLLED_BACK with a restart reason,
+    incumbents serving."""
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin1, uid, "midroll", trials=3)
+        admin1.create_inference_job(uid, "midroll")
+        inf_id = db.get_inference_jobs_by_statuses(["RUNNING"])[0]["id"]
+        inf, target = _rollout_target(db, inf_id)
+        incumbents = admin1.services.live_inference_workers(inf_id)
+        n_before = len(incumbents)
+        # the canary phase, frozen right before the judge: one
+        # new-version replica placed, rollout row CANARY
+        canary_sid = admin1.services.deploy_version_replica(
+            inf_id, target, 1)
+        db.create_rollout(inf_id, incumbents[0]["trial_id"], target,
+                          0, 1, n_before, "CANARY")
+
+        _crash(admin1)
+
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        report = _wait_ready(admin2)
+        # BOTH versions were adopted (mixed fleet reconstructed)...
+        assert report["adopted"] >= n_before + 1
+        # ...then the rollout resolved: rolled back, canary drained
+        ro = db.get_rollouts_of_inference_job(inf_id)[0]
+        assert ro["phase"] == "ROLLED_BACK"
+        assert "restart" in ro["reason"]
+        assert _wait_for(lambda: db.get_service(canary_sid)["status"]
+                         in ("STOPPED", "ERRORED"))
+        live = admin2.services.live_inference_workers(inf_id)
+        assert len(live) == n_before
+        assert all(w["model_version"] == 0 for w in live)
+        # the job never stopped serving, on the incumbent version
+        assert db.get_inference_job(inf_id)["status"] == "RUNNING"
+        preds = admin2.predict(uid, "midroll", [[1.0]])
+        assert len(preds) == 1
+        # no version lane left routing on the adopted predictor
+        predictor = admin2.services.get_predictor(inf_id)
+        assert predictor._lane_snapshot() == (None, 0)
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
+
+
+def test_restart_after_rolling_finished_resumes_rollout_as_done(
+        tmp_workdir):
+    """The admin dies after the rolling replace finished (every replica
+    already new-version) but before the row was marked DONE: recovery
+    resumes the rollout as DONE instead of rolling a healthy fleet back."""
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin1, uid, "doneroll", trials=3)
+        admin1.create_inference_job(uid, "doneroll")
+        inf_id = db.get_inference_jobs_by_statuses(["RUNNING"])[0]["id"]
+        inf, target = _rollout_target(db, inf_id)
+        old = admin1.services.live_inference_workers(inf_id)
+        n_before = len(old)
+        # the rolling phase ran to completion: new-version fleet placed,
+        # incumbents drained — only the DONE mark is missing
+        for _ in range(n_before):
+            sid = admin1.services.deploy_version_replica(inf_id, target, 1)
+            admin1.services.get_predictor(inf_id).add_worker(sid, target)
+        admin1.services.drain_replicas(
+            inf_id, [w["service_id"] for w in old])
+        db.create_rollout(inf_id, old[0]["trial_id"], target,
+                          0, 1, n_before, "ROLLING")
+
+        _crash(admin1)
+
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        _wait_ready(admin2)
+        ro = db.get_rollouts_of_inference_job(inf_id)[0]
+        assert ro["phase"] == "DONE"
+        assert "recovery" in ro["reason"]
+        live = admin2.services.live_inference_workers(inf_id)
+        assert len(live) == n_before
+        assert all(w["model_version"] == 1 for w in live)
+        assert all(w["trial_id"] == target for w in live)
+        assert admin2.predict(uid, "doneroll", [[1.0]])
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
+
+
+def test_failed_canary_never_errors_job_with_live_incumbents(tmp_workdir):
+    """Regression (the bounded-blast-radius contract): a canary replica
+    dying must NOT drive refresh_inference_job_status to mark the whole
+    job ERRORED while incumbent replicas still serve."""
+    admin = Admin(db=Database(":memory:"), recover=False,
+                  params_dir=str(tmp_workdir / "params"))
+    try:
+        uid = admin.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin, uid, "canfail", trials=3)
+        admin.create_inference_job(uid, "canfail")
+        inf_id = admin.db.get_inference_jobs_by_statuses(
+            ["RUNNING"])[0]["id"]
+        inf, target = _rollout_target(admin.db, inf_id)
+        canary_sid = admin.services.deploy_version_replica(
+            inf_id, target, 1)
+        # the canary crashes (heartbeat monitor / worker death path)
+        admin.db.mark_service_as_errored(canary_sid)
+        assert admin.services.refresh_inference_job_status(inf_id) is None
+        assert admin.db.get_inference_job(inf_id)["status"] == "RUNNING"
+        assert admin.predict(uid, "canfail", [[1.0]])
+    finally:
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # pid adoption (single-host process placement)
 # ---------------------------------------------------------------------------
 

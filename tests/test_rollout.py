@@ -1,0 +1,566 @@
+"""Safe live rollouts (ISSUE 11; docs/failure-model.md "Rollout
+faults"): a RUNNING inference job is updated to a new trial in place —
+canary, SLO-judged, rolling replace — under continuous concurrent
+client load with zero dropped/errored client requests attributable to
+the rollout, and a bad canary (chaos deploy failure or elevated error
+rate) is automatically rolled back with the reason surfaced in
+GET /fleet/health and counted in rafiki_rollout_rollbacks_total.
+
+Tier-1, CPU-only: chaos schedules make the failures deterministic, and
+the fake model makes every deploy instant."""
+
+import threading
+import time
+
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+from rafiki_tpu.cache.queue import InProcessBroker
+from rafiki_tpu.constants import RolloutPhase, TrainJobStatus
+from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.metrics import REGISTRY
+
+pytestmark = pytest.mark.chaos
+
+FIXTURE = __file__.rsplit("/", 1)[0] + "/fixtures/fake_model.py"
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _deploy(tmp_workdir, monkeypatch, app, env=None):
+    monkeypatch.setenv("RAFIKI_ROLLOUT_JUDGE_WINDOW_S", "1.0")
+    monkeypatch.setenv("RAFIKI_ROLLOUT_MIN_REQUESTS", "3")
+    for k, val in (env or {}).items():
+        monkeypatch.setenv(k, val)
+    admin = Admin(params_dir=str(tmp_workdir / "params"))
+    auth = admin.authenticate_user(
+        config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+    uid = auth["user_id"]
+    with open(FIXTURE, "rb") as f:
+        admin.create_model(uid, "fake", "IMAGE_CLASSIFICATION",
+                           f.read(), "FakeModel")
+    # 3 trials: 2 serve (INFERENCE_MAX_BEST_TRIALS), 1 spare is the
+    # rollout target
+    admin.create_train_job(
+        uid, app, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": 3, "CHIP_COUNT": 0})
+    job = admin.wait_until_train_job_stopped(uid, app, timeout_s=60)
+    assert job["status"] == TrainJobStatus.STOPPED, job
+    admin.create_inference_job(uid, app)
+    return admin, uid
+
+
+def _job_id(admin, uid, app):
+    tj = admin.db.get_train_job_by_app_version(uid, app, -1)
+    return admin.db.get_running_inference_job_of_train_job(tj["id"])["id"]
+
+
+def _target_trial(admin, uid, app, job_id):
+    """A COMPLETED trial the job does not currently serve."""
+    tj = admin.db.get_train_job_by_app_version(uid, app, -1)
+    serving = {w["trial_id"]
+               for w in admin.services.live_inference_workers(job_id)}
+    return next(t["id"]
+                for t in admin.db.get_best_trials_of_train_job(
+                    tj["id"], max_count=10)
+                if t["id"] not in serving)
+
+
+def _wait_terminal(admin, job_id, timeout_s=60):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = admin.rollouts.status(job_id)
+        if st and st["phase"] in RolloutPhase.TERMINAL:
+            return st
+        time.sleep(0.05)
+    raise AssertionError(f"rollout never terminal: {st}")
+
+
+class _Load:
+    """Continuous concurrent predict load; every exception is a drill
+    failure (the acceptance contract: zero dropped/errored client
+    requests attributable to the rollout)."""
+
+    def __init__(self, admin, uid, app, n=3):
+        self._admin, self._uid, self._app = admin, uid, app
+        self.errors, self.ok = [], 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._client)
+                         for _ in range(n)]
+        for t in self._threads:
+            t.start()
+
+    def _client(self):
+        while not self._stop.is_set():
+            try:
+                preds = self._admin.predict(self._uid, self._app, [[0.0]])
+                assert preds
+                with self._lock:
+                    self.ok += 1
+            except Exception as e:
+                with self._lock:
+                    self.errors.append(repr(e))
+            time.sleep(0.01)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill, outcome (a): a good version rolls all the way out
+# ---------------------------------------------------------------------------
+
+
+def test_good_rollout_completes_under_continuous_load(tmp_workdir,
+                                                      monkeypatch):
+    """Canary -> rolling -> done over the real HTTP door + Client under
+    concurrent client load: zero client errors, the job ends serving the
+    new trial on its original replica count, and every phase is a
+    first-class event."""
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import Client
+
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "roll")
+    job_id = _job_id(admin, uid, "roll")
+    server = AdminServer(admin).start()
+    load = None
+    try:
+        target = _target_trial(admin, uid, "roll", job_id)
+        before = admin.services.live_inference_workers(job_id)
+        n_before = len(before)
+        assert n_before >= 2
+        started0 = REGISTRY.counter(
+            "rafiki_rollout_started_total", "", ("job",)).value(job_id)
+
+        client = Client("127.0.0.1", server.port)
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        load = _Load(admin, uid, "roll")
+        time.sleep(0.2)  # the judge window needs incumbent samples too
+
+        row = client.update_inference_job("roll", target,
+                                          canary_fraction=0.4)
+        assert row["phase"] == RolloutPhase.CANARY
+        assert row["to_version"] == 1
+        done = client.wait_until_rollout_done("roll", timeout_s=60)
+        assert done["phase"] == RolloutPhase.DONE
+        load.stop()
+
+        assert not load.errors, load.errors[:5]
+        assert load.ok > 20
+        live = admin.services.live_inference_workers(job_id)
+        assert len(live) == n_before  # fleet converged to its old size
+        assert all(w["trial_id"] == target for w in live)
+        assert all(w["model_version"] == 1 for w in live)
+        # the job still serves (and the lane routing is gone)
+        assert admin.predict(uid, "roll", [[0.0]])
+        assert admin.services.get_predictor(
+            job_id)._lane_snapshot() == (None, 0)
+        # events tell the whole story, and the metrics moved
+        names = [e["event"] for e in done["events"]]
+        assert names[0] == "started" and "completed" in names
+        assert "canary_deployed" in names
+        assert REGISTRY.counter(
+            "rafiki_rollout_started_total", "",
+            ("job",)).value(job_id) == started0 + 1
+        assert REGISTRY.counter(
+            "rafiki_rollout_completed_total", "",
+            ("job",)).value(job_id) >= 1
+        # both lanes actually took traffic during the rollout
+        req = REGISTRY.counter(
+            "rafiki_rollout_requests_total", "",
+            ("job", "lane", "outcome"))
+        assert req.value(job_id, "canary", "ok") > 0
+        assert req.value(job_id, "incumbent", "ok") > 0
+    finally:
+        if load is not None:
+            load.stop()
+        server.stop()
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill, outcome (b): a bad canary is rolled back
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_deploy_failure_rolls_back(tmp_workdir, monkeypatch):
+    """RAFIKI_CHAOS site=deploy fails the canary placement: automatic
+    rollback within the judge window, reason in GET /fleet/health,
+    rafiki_rollout_rollbacks_total incremented, zero client errors."""
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "boom")
+    job_id = _job_id(admin, uid, "boom")
+    load = None
+    try:
+        target = _target_trial(admin, uid, "boom", job_id)
+        before = sorted(w["service_id"] for w in
+                        admin.services.live_inference_workers(job_id))
+        rb0 = REGISTRY.counter(
+            "rafiki_rollout_rollbacks_total", "", ("job",)).value(job_id)
+        chaos.install([chaos.ChaosRule(
+            site=chaos.SITE_DEPLOY, action=chaos.ACTION_ERROR,
+            match=target)])
+        load = _Load(admin, uid, "boom")
+        admin.update_inference_job(uid, "boom", -1, trial_id=target)
+        st = _wait_terminal(admin, job_id)
+        load.stop()
+        chaos.clear()
+
+        assert st["phase"] == RolloutPhase.ROLLED_BACK
+        assert "deploy" in st["reason"]
+        assert not load.errors, load.errors[:5]
+        assert REGISTRY.counter(
+            "rafiki_rollout_rollbacks_total", "",
+            ("job",)).value(job_id) == rb0 + 1
+        # the incumbent fleet is untouched and still serves
+        after = sorted(w["service_id"] for w in
+                       admin.services.live_inference_workers(job_id))
+        assert after == before
+        assert admin.db.get_inference_job(job_id)["status"] == "RUNNING"
+        assert admin.predict(uid, "boom", [[0.0]])
+        # the rollback reason is a first-class fleet-health event
+        events = admin.get_fleet_health()["rollouts"]["events"]
+        rollbacks = [e for e in events if e["event"] == "rollback"]
+        assert rollbacks and "deploy" in rollbacks[-1]["reason"]
+    finally:
+        chaos.clear()
+        if load is not None:
+            load.stop()
+        admin.shutdown()
+
+
+def test_elevated_canary_error_rate_rolls_back(tmp_workdir, monkeypatch):
+    """A canary that deploys fine but ERRORS its batches: the SLO judge
+    sees the error-rate delta and rolls back — while the canary-lane
+    failover keeps every client request answered by the incumbents."""
+    admin, uid = _deploy(
+        tmp_workdir, monkeypatch, "errc",
+        env={"RAFIKI_ROLLOUT_JUDGE_WINDOW_S": "2.0",
+             "RAFIKI_ROLLOUT_MIN_REQUESTS": "3"})
+    job_id = _job_id(admin, uid, "errc")
+    load = None
+    try:
+        target = _target_trial(admin, uid, "errc", job_id)
+        load = _Load(admin, uid, "errc")
+        admin.update_inference_job(uid, "errc", -1, trial_id=target,
+                                   canary_fraction=0.5)
+        # the moment the canary replica exists, chaos-fail ITS batches
+        deadline = time.monotonic() + 30
+        canary_sid = None
+        while time.monotonic() < deadline and canary_sid is None:
+            for w in admin.services.live_inference_workers(job_id):
+                if w["model_version"] == 1:
+                    canary_sid = w["service_id"]
+            time.sleep(0.02)
+        assert canary_sid, "canary never deployed"
+        chaos.install([chaos.ChaosRule(
+            site=chaos.SITE_WORKER, action=chaos.ACTION_ERROR,
+            match=canary_sid)])
+        st = _wait_terminal(admin, job_id)
+        load.stop()
+        chaos.clear()
+
+        assert st["phase"] == RolloutPhase.ROLLED_BACK
+        assert "error rate" in st["reason"]
+        # bounded blast radius: the failing canary cost clients NOTHING
+        assert not load.errors, load.errors[:5]
+        live = admin.services.live_inference_workers(job_id)
+        assert all(w["model_version"] == 0 for w in live)
+        assert admin.predict(uid, "errc", [[0.0]])
+        # the judge's signal snapshot rode the rollback event
+        rollback_events = [e for e in st["events"]
+                           if e["event"] == "rollback"]
+        assert rollback_events
+        signals = rollback_events[-1].get("signals") or {}
+        assert signals.get("canary", {}).get("errors", 0) > 0
+    finally:
+        chaos.clear()
+        if load is not None:
+            load.stop()
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# control surface: 409 in flight, abort, ack, validation
+# ---------------------------------------------------------------------------
+
+
+def test_second_update_is_409_and_abort_rolls_back(tmp_workdir,
+                                                   monkeypatch):
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import Client
+    from rafiki_tpu.client.client import (
+        RolloutInFlightError as ClientRolloutInFlightError,
+    )
+    from rafiki_tpu.client.client import RolloutRolledBackError
+
+    admin, uid = _deploy(
+        tmp_workdir, monkeypatch, "api",
+        env={"RAFIKI_ROLLOUT_JUDGE_WINDOW_S": "60",
+             "RAFIKI_ROLLOUT_MIN_REQUESTS": "100000"})
+    job_id = _job_id(admin, uid, "api")
+    server = AdminServer(admin).start()
+    try:
+        target = _target_trial(admin, uid, "api", job_id)
+        client = Client("127.0.0.1", server.port)
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        client.update_inference_job("api", target)
+        # a second update answers the typed 409 through the real door
+        with pytest.raises(ClientRolloutInFlightError) as ei:
+            client.update_inference_job("api", target)
+        assert ei.value.status == 409
+        # live status carries the judge's per-lane signals
+        st = client.get_rollout("api")
+        assert st["phase"] == RolloutPhase.CANARY
+        assert "signals" in st
+        # abort drains the canary and restores the incumbents
+        out = client.abort_rollout("api")
+        assert out["phase"] == RolloutPhase.ROLLED_BACK
+        assert out["reason"] == "operator abort"
+        live = admin.services.live_inference_workers(job_id)
+        assert all(w["model_version"] == 0 for w in live)
+        # wait_until_rollout_done surfaces the rollback typed
+        with pytest.raises(RolloutRolledBackError) as rbe:
+            client.wait_until_rollout_done("api", timeout_s=5)
+        assert rbe.value.phase == RolloutPhase.ROLLED_BACK
+        assert rbe.value.reason == "operator abort"
+        # ack clears the doctor WARN (exercised in the doctor test)
+        acked = client.ack_rollout("api")
+        assert acked["operator_ack"] is True
+        # a NEW rollout may start now (no stale 409)
+        row = client.update_inference_job("api", target)
+        assert row["phase"] == RolloutPhase.CANARY
+        client.abort_rollout("api")
+    finally:
+        server.stop()
+        admin.shutdown()
+
+
+def test_update_validations_are_typed_400s(tmp_workdir, monkeypatch):
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "val")
+    job_id = _job_id(admin, uid, "val")
+    try:
+        serving = admin.services.live_inference_workers(job_id)[0][
+            "trial_id"]
+        with pytest.raises(InvalidRequestError):
+            admin.update_inference_job(uid, "val", -1,
+                                       trial_id="no-such-trial")
+        with pytest.raises(InvalidRequestError):
+            # already serving that trial
+            admin.update_inference_job(uid, "val", -1, trial_id=serving)
+        with pytest.raises(InvalidRequestError):
+            admin.update_inference_job(
+                uid, "val", -1,
+                trial_id=_target_trial(admin, uid, "val", job_id),
+                canary_fraction=7.0)
+        with pytest.raises(InvalidRequestError):
+            admin.abort_rollout(uid, "val")  # nothing in flight
+        with pytest.raises(InvalidRequestError):
+            admin.get_rollout_status(uid, "val")  # nothing recorded
+    finally:
+        admin.shutdown()
+
+
+def test_autoscaler_pauses_for_job_mid_rollout(tmp_workdir, monkeypatch):
+    """The autoscaler must not fight the rollout controller over the
+    replica set: with a rollout in flight, a flood of shed signals
+    produces NO decision, and the job's window restarts fresh after."""
+    admin, uid = _deploy(
+        tmp_workdir, monkeypatch, "asc",
+        env={"RAFIKI_ROLLOUT_JUDGE_WINDOW_S": "60",
+             "RAFIKI_ROLLOUT_MIN_REQUESTS": "100000",
+             "RAFIKI_AUTOSCALE_SHED_THRESHOLD": "1",
+             "RAFIKI_AUTOSCALE_COOLDOWN_UP_S": "0"})
+    job_id = _job_id(admin, uid, "asc")
+    try:
+        target = _target_trial(admin, uid, "asc", job_id)
+        predictor = admin.services.get_predictor(job_id)
+        scaler = admin.autoscaler
+        scaler.tick()  # baseline
+        admin.update_inference_job(uid, "asc", -1, trial_id=target)
+        assert admin.rollouts.is_active(job_id)
+        # wait out the canary placement so the controller's own replica
+        # add can't race the count below
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not any(
+                w["model_version"] == 1
+                for w in admin.services.live_inference_workers(job_id)):
+            time.sleep(0.02)
+        n_live = len(admin.services.live_inference_workers(job_id))
+        predictor._bump("requests_shed", 10)
+        assert scaler.tick() == []  # paused: no decision mid-rollout
+        assert len(admin.services.live_inference_workers(job_id)) == n_live
+        admin.rollouts.abort(job_id)
+        assert not admin.rollouts.is_active(job_id)
+        # post-rollout: the window restarted — one tick re-baselines,
+        # a fresh burst then decides again
+        scaler.tick()
+        predictor._bump("requests_shed", 10)
+        acted = scaler.tick()
+        assert [a["action"] for a in acted] == ["scale_up"]
+    finally:
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# doctor: wedged deploys + unacked rollbacks
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_rollouts_check(tmp_workdir, monkeypatch):
+    from rafiki_tpu import doctor
+    from rafiki_tpu.db.database import Database
+
+    db = Database(str(tmp_workdir / "rafiki.sqlite3"))
+    monkeypatch.setenv("RAFIKI_DB_PATH", str(tmp_workdir / "rafiki.sqlite3"))
+    try:
+        name, status, detail = doctor.check_rollouts()
+        assert status == doctor.PASS, detail
+
+        # a DEPLOYING row older than the deploy timeout is a wedged deploy
+        svc = db.create_service("INFERENCE")
+        db.mark_service_as_deploying(svc["id"])
+        db._exec("UPDATE service SET datetime_started=? WHERE id=?",
+                 (time.time() - float(config.SERVICE_DEPLOY_TIMEOUT_S)
+                  - 60, svc["id"]))
+        name, status, detail = doctor.check_rollouts()
+        assert status == doctor.WARN
+        assert "DEPLOYING" in detail
+        db.mark_service_as_stopped(svc["id"])
+
+        # an unacked rollback WARNs until the operator acks it
+        u = db.create_user("d@x", "h", "ADMIN")
+        tj = db.create_train_job(u["id"], "dapp", 1, "T", "u", "u", {})
+        ij = db.create_inference_job(u["id"], tj["id"])
+        ro = db.create_rollout(ij["id"], "t0", "t1", 0, 1, 2,
+                               RolloutPhase.CANARY)
+        db.mark_rollout_phase(ro["id"], RolloutPhase.ROLLED_BACK,
+                              "canary error rate 100%")
+        name, status, detail = doctor.check_rollouts()
+        assert status == doctor.WARN
+        assert "no operator ack" in detail
+        db.ack_rollout(ro["id"])
+        name, status, detail = doctor.check_rollouts()
+        assert status == doctor.PASS, detail
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# predictor version lanes (unit)
+# ---------------------------------------------------------------------------
+
+
+class _Server:
+    """Serves a queue; answers ``answer`` or errors every batch."""
+
+    def __init__(self, queue, answer=None, fail=False):
+        self.queue = queue
+        self.answer = answer
+        self.fail = fail
+        self.batches = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            batch = self.queue.take_batch(
+                max_size=16, deadline_s=0.0, wait_timeout_s=0.05)
+            if batch is None:
+                return
+            if not batch:
+                continue
+            self.batches += 1
+            for fut, _ in batch:
+                if self.fail:
+                    fut.set_error(RuntimeError("bad canary"))
+                else:
+                    fut.set_result(self.answer)
+
+
+def _lane_predictor(fail_new=False):
+    broker = InProcessBroker()
+    q_old = broker.register_worker("job", "oldw")
+    q_new = broker.register_worker("job", "neww")
+    old_srv = _Server(q_old, answer=["old"])
+    new_srv = _Server(q_new, answer=["new"], fail=fail_new)
+    p = Predictor("job", broker, None,
+                  worker_trials={"oldw": "trialA", "neww": "trialB"})
+    return p, old_srv, new_srv
+
+
+def test_lane_split_follows_fraction():
+    p, old_srv, new_srv = _lane_predictor()
+    p.set_rollout_lane({"neww"}, 0.5)
+    answers = [p.predict([0.0], timeout_s=5.0) for _ in range(20)]
+    assert ["old"] in answers and ["new"] in answers
+    # a request is served by exactly one lane, never a cross-version
+    # ensemble of both
+    assert all(a in (["old"], ["new"]) for a in answers)
+    stats = p.rollout_stats(60.0)
+    assert stats["canary"]["ok"] + stats["incumbent"]["ok"] == 20
+    assert 5 <= stats["canary"]["ok"] <= 15  # deterministic 50/50-ish
+    # fraction 0: everything incumbent; fraction 1: everything canary
+    p.set_rollout_lane({"neww"}, 0.0)
+    assert all(p.predict([0.0], timeout_s=5.0) == ["old"]
+               for _ in range(5))
+    p.set_rollout_lane({"neww"}, 1.0)
+    assert all(p.predict([0.0], timeout_s=5.0) == ["new"]
+               for _ in range(5))
+    p.clear_rollout_lane()
+    assert p._lane_snapshot() == (None, 0)
+
+
+def test_canary_lane_failure_fails_over_to_incumbent():
+    """A canary whose batches error never costs the client: the request
+    is re-served by the incumbents, and the error lands in the canary
+    lane's judge window."""
+    p, old_srv, new_srv = _lane_predictor(fail_new=True)
+    p.set_rollout_lane({"neww"}, 1.0)  # every request tries the canary
+    for _ in range(5):
+        assert p.predict([0.0], timeout_s=5.0) == ["old"]
+    stats = p.rollout_stats(60.0)
+    assert stats["canary"]["errors"] == 5
+    assert stats["incumbent"]["requests"] == 0  # fallback is untracked
+    req = REGISTRY.counter("rafiki_rollout_requests_total", "",
+                           ("job", "lane", "outcome"))
+    assert req.value("job", "canary", "error") >= 5
+
+
+def test_incumbent_failure_never_falls_back_to_canary():
+    """The version under judgment must not absorb traffic the incumbents
+    failed: an incumbent-lane error surfaces to the caller."""
+    broker = InProcessBroker()
+    q_old = broker.register_worker("job", "oldw")
+    q_new = broker.register_worker("job", "neww")
+    _Server(q_old, fail=True)
+    new_srv = _Server(q_new, answer=["new"])
+    p = Predictor("job", broker, None,
+                  worker_trials={"oldw": "trialA", "neww": "trialB"})
+    p.set_rollout_lane({"neww"}, 0.0)  # all traffic incumbent
+    with pytest.raises(TimeoutError):
+        p.predict([0.0], timeout_s=0.5)
+    assert new_srv.batches == 0  # the canary saw nothing
+    assert p.rollout_stats(60.0)["incumbent"]["errors"] == 1
+
+
+def test_refreshed_lane_keeps_judge_window():
+    """Re-weighting an ACTIVE lane (rolling phase) must not clear the
+    judge's history; starting a fresh lane must."""
+    p, old_srv, new_srv = _lane_predictor()
+    p.set_rollout_lane({"neww"}, 1.0)
+    p.predict([0.0], timeout_s=5.0)
+    assert p.rollout_stats(60.0)["canary"]["ok"] == 1
+    p.set_rollout_lane({"neww"}, 0.5)  # re-weight: history kept
+    assert p.rollout_stats(60.0)["canary"]["ok"] == 1
+    p.clear_rollout_lane()
+    p.set_rollout_lane({"neww"}, 0.5)  # fresh rollout: history cleared
+    assert p.rollout_stats(60.0)["canary"]["ok"] == 0
