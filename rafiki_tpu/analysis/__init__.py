@@ -1,5 +1,5 @@
-"""Static-analysis subsystem: two AST-based heads, zero untrusted-code
-execution (docs/static-analysis.md).
+"""Static-analysis subsystem: three AST-based heads, zero
+untrusted-code execution (docs/static-analysis.md).
 
 Head 1 — **template verifier** (:mod:`.template`): a pass pipeline over
 uploaded model source, wired into ``Admin.create_model`` behind
@@ -11,6 +11,11 @@ Head 2 — **framework self-lint** (:mod:`.framework`): the env-knob /
 broad-except / lock / HTTP-door disciplines PRs 1–8 established by
 convention, enforced over the whole package as a tier-1 test
 (tests/test_framework_lint.py).
+
+Head 3 — **concurrency analyzer** (:mod:`.concurrency`): whole-package
+lockset inference, lock-order deadlock detection, and atomicity lint
+with no annotations required — rides ``lint_package()`` (so tier-1 and
+``--self-lint`` enforce it) and doctor's *concurrency lint* check.
 """
 
 from rafiki_tpu.analysis.findings import (

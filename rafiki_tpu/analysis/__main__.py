@@ -5,8 +5,10 @@
         (errors OR warnings — the local loop wants the full list).
 
     python -m rafiki_tpu.analysis --self-lint [--json]
-        Run the framework self-lint over the installed rafiki_tpu
-        package; exit 1 on any finding (what tier-1 enforces).
+        Run the framework self-lint AND the whole-package concurrency
+        analyzer (lockset inference, lock-order cycles, atomicity)
+        over the installed rafiki_tpu package; exit 1 on any finding
+        (what tier-1 enforces).
 """
 
 from __future__ import annotations

@@ -1,0 +1,787 @@
+"""Head 3 — the whole-package concurrency analyzer.
+
+The platform is a deeply threaded control plane (autoscaler ticks,
+rollout judges, recovery reconcilers, heartbeat monitors, drain paths,
+three data-plane queue families), but PR 9's lock discipline (FWK301)
+protects exactly the attributes someone annotated. This head needs **no
+annotations**: it infers the locking protocol a class already follows
+and reports the sites that break it — classic lockset analysis (Eraser)
+plus compositional reasoning without whole-program aliasing (RacerD),
+kept tractable as a pure AST pass so the PR 9 zero-untrusted-execution
+contract holds.
+
+Finding codes (catalog + how-to-fix recipes in docs/static-analysis.md):
+
+- **CONC101 unguarded write** / **CONC102 unguarded read-in-decision**:
+  for every ``self._attr`` shared across thread contexts, the guarding
+  lock is inferred as the lock held at the *majority* of access sites;
+  the unguarded minority sites are the findings. Escape analysis keeps
+  the noise down: attributes touched only before any
+  ``Thread(...).start()`` / executor submit in the class are
+  thread-confined, and attributes never written after ``__init__`` are
+  immutable-after-publication — both exempt.
+
+- **CONC201 potential deadlock**: acquires-while-holding edges are
+  collected package-wide (including through single-level direct
+  ``self.method()`` calls — the same call-depth budget the POP003 taint
+  pass uses — and through attributes whose class is statically known);
+  a cycle in the graph is reported with one witness per edge. A
+  non-reentrant ``Lock`` re-acquired while already held is the
+  degenerate self-cycle.
+
+- **CONC301 check-then-act** / **CONC302 read-modify-write**: for
+  shared attributes with *no* inferable lock (the family lockset
+  inference cannot help), ``if self._x: ... self._x = ...`` and
+  ``self._x += ...`` / mutating container calls outside any lock scope
+  are flagged — the two atomicity shapes the GIL does not make atomic.
+
+Escape grammar (true negatives the inference cannot see — every
+annotation carries a reason):
+
+- ``# lint: thread-confined(reason)`` on an attribute's assignment (or
+  on the ``class`` line for the whole class): the attribute never
+  escapes to another thread.
+- ``# lint: unguarded(reason)`` on an access line: this site is
+  deliberately lock-free (shared with FWK301's grammar).
+- ``# lint: lock-order(reason)`` on a ``with self._lock:`` line: the
+  acquire-while-holding edges created inside this scope are deliberate.
+- ``# guarded-by: <lock>`` on a ``def`` line (PR 9 grammar): callers
+  hold the lock for the whole method body.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from rafiki_tpu.analysis import astutil
+from rafiki_tpu.analysis.findings import ERROR, WARN, Finding
+
+_UNGUARDED_RE = re.compile(r"lint:\s*unguarded\s*\(")
+_CONFINED_RE = re.compile(r"lint:\s*thread-confined\s*\(")
+_LOCK_ORDER_RE = re.compile(r"lint:\s*lock-order\s*\(")
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: threading primitives that ARE synchronization state — accesses to
+#: these attributes are lock traffic, not shared-data traffic
+_SYNC_CTORS = {"Event", "Semaphore", "BoundedSemaphore", "Barrier",
+               "local"}
+#: thread-safe containers/handles: mutating them needs no caller lock
+_THREADSAFE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+#: attribute names that read as locks even when the assignment is out of
+#: sight (e.g. inherited from a base class in another file)
+_LOCKISH_NAME_RE = re.compile(r"lock|cond|mutex")
+#: container method calls that mutate the receiver in place
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popleft",
+             "appendleft", "clear", "add", "discard", "update",
+             "setdefault", "popitem", "sort", "reverse"}
+
+# access kinds
+READ, WRITE, RMW = "read", "write", "rmw"
+
+#: held-set entries for module-level locks carry this prefix so they can
+#: never collide with a ``self.<attr>`` lock name
+_MOD_PREFIX = "::"
+
+
+def _display_lock(lock: str) -> str:
+    return lock[len(_MOD_PREFIX):] if lock.startswith(_MOD_PREFIX) \
+        else f"self.{lock}"
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "line", "held", "decision", "method",
+                 "exempt")
+
+    def __init__(self, attr: str, kind: str, line: int,
+                 held: frozenset, decision: bool, method: str,
+                 exempt: bool) -> None:
+        self.attr = attr
+        self.kind = kind
+        self.line = line
+        self.held = held
+        self.decision = decision
+        self.method = method
+        self.exempt = exempt
+
+
+class _ClassSummary:
+    """Everything the analyzer knows about one class definition."""
+
+    def __init__(self, rel: str, node: ast.ClassDef,
+                 module_locks: Set[str]) -> None:
+        self.rel = rel
+        self.node = node
+        self.name = node.name
+        self.module_locks = module_locks
+        self.lock_attrs: Set[str] = set()     # created Lock/RLock/Condition
+        self.rlock_attrs: Set[str] = set()    # reentrant subset
+        self.lock_alias: Dict[str, str] = {}  # Condition(self._x) -> _x
+        self.sync_attrs: Set[str] = set()     # Events, semaphores, queues
+        self.confined_attrs: Set[str] = set()
+        self.confined_class = False
+        self.entry_methods: Set[str] = set()  # Thread targets / submits
+        self.thread_reachable: Set[str] = set()
+        self.spawns_threads = False
+        self.calls: Dict[str, Set[str]] = {}  # method -> direct self calls
+        self.methods: Set[str] = set()
+        self.accesses: List[_Access] = []
+        #: (held_lock, acquired_lock, line, method) nested-with edges
+        self.acquires: List[Tuple[str, str, int, str]] = []
+        #: method -> locks acquired directly anywhere in its own body
+        self.method_acquires: Dict[str, Set[str]] = {}
+        #: method -> (line, callee) single-level call sites w/ held locks
+        self.held_calls: List[Tuple[frozenset, ast.Call, int, str]] = []
+        #: attr -> class name it is an instance of (self._x = Foo(...))
+        self.attr_types: Dict[str, str] = {}
+
+    def canonical(self, lock: str) -> str:
+        return self.lock_alias.get(lock, lock)
+
+    def is_lock_attr(self, attr: str) -> bool:
+        return attr in self.lock_attrs or (
+            bool(_LOCKISH_NAME_RE.search(attr))
+            and attr not in self.sync_attrs)
+
+
+def _annotated(comments: Dict[int, str], line: int,
+               pattern: re.Pattern) -> bool:
+    return bool(pattern.search(comments.get(line, ""))
+                or pattern.search(comments.get(line - 1, "")))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _self_attr_base(node: ast.AST) -> Optional[ast.Attribute]:
+    """The ``self._x`` attribute under a chain of subscripts:
+    ``self._x[k][j]`` -> the ``self._x`` node. Mutating an item of a
+    shared container is a mutation the container's lock must cover."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if _self_attr(node) is not None:
+        return node  # type: ignore[return-value]
+    return None
+
+
+def _module_locks(tree: ast.Module) -> Set[str]:
+    """Names assigned ``threading.Lock()``/``RLock()``/``Condition()`` at
+    module level — shared by every instance in the process."""
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = astutil.terminal_name(node.value.func)
+            if ctor in ("Lock", "RLock", "Condition"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _self_method_args(call: ast.Call, methods: Set[str]) -> Set[str]:
+    """Methods of this class handed to a spawn call — ``target=self.m``,
+    positional ``self.m``, or a lambda whose body calls ``self.m``."""
+    out: Set[str] = set()
+    candidates: List[ast.AST] = list(call.args)
+    candidates.extend(kw.value for kw in call.keywords if kw.value)
+    for arg in candidates:
+        attr = _self_attr(arg)
+        if attr is not None and attr in methods:
+            out.add(attr)
+        elif isinstance(arg, ast.Lambda):
+            for n in ast.walk(arg.body):
+                a = _self_attr(n)
+                if a is not None and a in methods:
+                    out.add(a)
+    return out
+
+
+def _is_spawn_call(call: ast.Call) -> bool:
+    name = astutil.terminal_name(call.func)
+    if name in ("Thread", "Timer"):
+        return True
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("submit", "map")
+            and bool(call.args))
+
+
+def _decision_node_ids(stmt: ast.stmt) -> Set[int]:
+    """ids of AST nodes in a *decision* position under ``stmt``: an
+    If/While/IfExp/Assert test or a comprehension condition — a stale
+    read there silently steers control flow (CONC102's shape)."""
+    out: Set[int] = set()
+
+    def mark(sub: Optional[ast.AST]) -> None:
+        if sub is not None:
+            for n in ast.walk(sub):
+                out.add(id(n))
+
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            mark(node.test)
+        elif isinstance(node, ast.Assert):
+            mark(node.test)
+        elif isinstance(node, ast.comprehension):
+            for cond in node.ifs:
+                mark(cond)
+    return out
+
+
+def _own_scope_walk(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """The statement's own expressions — nested statement bodies are
+    separate lock scopes visited by the recursive walk, and nested
+    function/class definitions run later on their own terms."""
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        items = value if isinstance(value, list) else [value]
+        for item in items:
+            if isinstance(item, ast.AST):
+                yield item
+                yield from astutil.walk_no_nested_functions(item)
+
+
+# -- phase 1: per-class summaries -------------------------------------------
+
+def _summarize_class(rel: str, cls: ast.ClassDef,
+                     comments: Dict[int, str],
+                     module_locks: Set[str]) -> _ClassSummary:
+    cs = _ClassSummary(rel, cls, module_locks)
+    cs.confined_class = _annotated(comments, cls.lineno, _CONFINED_RE)
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    cs.methods = set(methods)
+
+    # class-level assignments (locks or typed attrs as class attributes)
+    for node in cls.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    _classify_attr_assign(cs, t.id, node.value,
+                                          node.lineno, comments)
+
+    # first scan: attr classification, spawn sites, self-call graph
+    for mname, mnode in methods.items():
+        cs.calls[mname] = set()
+        for node in astutil.walk_no_nested_functions(mnode):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        _classify_attr_assign(cs, attr, node.value,
+                                              node.lineno, comments)
+            elif isinstance(node, ast.Call):
+                if _is_spawn_call(node):
+                    targets = _self_method_args(node, cs.methods)
+                    cs.entry_methods |= targets
+                    # `.map` only counts with a self-method target —
+                    # jax.tree.map and friends are not thread spawns
+                    name = astutil.terminal_name(node.func)
+                    if targets or name in ("Thread", "Timer", "submit"):
+                        cs.spawns_threads = True
+                func_attr = _self_attr(node.func)
+                if func_attr is not None and func_attr in cs.methods:
+                    cs.calls[mname].add(func_attr)
+    # escape analysis: which methods can run on a spawned thread
+    # (transitive closure over direct self calls from the entry methods)
+    stack = list(cs.entry_methods)
+    while stack:
+        m = stack.pop()
+        if m in cs.thread_reachable:
+            continue
+        cs.thread_reachable.add(m)
+        stack.extend(cs.calls.get(m, ()))
+
+    # second scan: accesses with lexical held-sets + acquire edges
+    for mname, mnode in methods.items():
+        _walk_method(cs, mname, mnode, comments)
+    return cs
+
+
+def _classify_attr_assign(cs: _ClassSummary, attr: str, value: ast.AST,
+                          lineno: int, comments: Dict[int, str]) -> None:
+    if _annotated(comments, lineno, _CONFINED_RE):
+        cs.confined_attrs.add(attr)
+    if not isinstance(value, ast.Call):
+        return
+    ctor = astutil.terminal_name(value.func)
+    if ctor in ("Lock", "RLock"):
+        cs.lock_attrs.add(attr)
+        if ctor == "RLock":
+            cs.rlock_attrs.add(attr)
+    elif ctor == "Condition":
+        cs.lock_attrs.add(attr)
+        # Condition(self._x) wraps _x's very lock: holding either IS
+        # holding the other, so both canonicalize to _x
+        if value.args:
+            inner = _self_attr(value.args[0])
+            if inner is not None:
+                cs.lock_alias[attr] = inner
+    elif ctor in _SYNC_CTORS or ctor in _THREADSAFE_CTORS:
+        cs.sync_attrs.add(attr)
+    elif ctor is not None and ctor[:1].isupper():
+        cs.attr_types.setdefault(attr, ctor)
+
+
+def _with_locks(cs: _ClassSummary, stmt: ast.With) -> List[str]:
+    """Locks a ``with`` statement acquires, in item order."""
+    out: List[str] = []
+    for item in stmt.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        attr = _self_attr(expr)
+        if attr is not None and cs.is_lock_attr(attr):
+            out.append(cs.canonical(attr))
+        elif isinstance(expr, ast.Name) and expr.id in cs.module_locks:
+            out.append(_MOD_PREFIX + expr.id)
+    return out
+
+
+def _walk_method(cs: _ClassSummary, mname: str, mnode: ast.AST,
+                 comments: Dict[int, str]) -> None:
+    held0: Set[str] = set()
+    # both lines checked independently — an unrelated comment on the
+    # def line (# noqa) must not mask an annotation on the line above
+    m = (_GUARDED_BY_RE.search(comments.get(mnode.lineno, ""))
+         or _GUARDED_BY_RE.search(comments.get(mnode.lineno - 1, "")))
+    if m:
+        held0.add(cs.canonical(m.group(1)))
+    cs.method_acquires.setdefault(mname, set())
+    is_init = mname == "__init__"
+    # within __init__, accesses BEFORE the first thread start are
+    # thread-confined: nothing else can observe the half-built object
+    state = {"started": not is_init}
+
+    def visit_stmt(stmt: ast.stmt, held: Set[str]) -> None:
+        decision_ids = _decision_node_ids(stmt)
+        exempt_here = not state["started"]
+        write_nodes: Set[int] = set()
+        rmw_nodes: Set[int] = set()
+
+        def mark_store(target: ast.AST, aug: bool) -> None:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    mark_store(elt, aug)
+                return
+            if _self_attr(target) is not None:
+                write_nodes.add(id(target))
+                if aug:
+                    rmw_nodes.add(id(target))
+            elif isinstance(target, ast.Subscript):
+                # self._x[k] = v / del self._x[k] / self._x[k][j] += v:
+                # mutation of the container itself
+                base = _self_attr_base(target)
+                if base is not None:
+                    write_nodes.add(id(base))
+                    rmw_nodes.add(id(base))
+
+        # the statement itself is part of its own scope: a top-level
+        # Assign/AugAssign/Delete is where most stores live
+        own_nodes = [stmt, *_own_scope_walk(stmt)]
+        for node in own_nodes:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    mark_store(t, aug=False)
+            elif isinstance(node, ast.AugAssign):
+                mark_store(node.target, aug=True)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    mark_store(t, aug=True)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS \
+                    and _self_attr_base(node.func.value) is not None:
+                base = _self_attr_base(node.func.value)
+                write_nodes.add(id(base))
+                rmw_nodes.add(id(base))
+            elif isinstance(node, ast.Call):
+                cs.held_calls.append(
+                    (frozenset(held), node, node.lineno, mname))
+
+        for node in own_nodes:
+            attr = _self_attr(node)
+            if attr is None or cs.is_lock_attr(attr) \
+                    or attr in cs.sync_attrs:
+                continue
+            if id(node) in write_nodes:
+                kind = RMW if id(node) in rmw_nodes else WRITE
+            elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                kind = WRITE
+            else:
+                kind = READ
+            exempt = exempt_here or _annotated(
+                comments, node.lineno, _UNGUARDED_RE)
+            cs.accesses.append(_Access(
+                attr, kind, node.lineno, frozenset(held),
+                kind == READ and id(node) in decision_ids, mname, exempt))
+
+    def walk(body: List[ast.stmt], held: Set[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.With):
+                visit_stmt(stmt, held)
+                inner = set(held)
+                skip_edges = _annotated(comments, stmt.lineno,
+                                        _LOCK_ORDER_RE)
+                for lock in _with_locks(cs, stmt):
+                    cs.method_acquires[mname].add(lock)
+                    if not skip_edges:
+                        for h in inner:
+                            cs.acquires.append((h, lock, stmt.lineno,
+                                                mname))
+                    inner.add(lock)
+                walk(stmt.body, inner)
+                continue
+            # the spawn may sit anywhere in the statement — the
+            # dominant executor idiom ASSIGNS the future
+            # (self._fut = pool.submit(self._run)), so scan the whole
+            # own-scope, and flip BEFORE visiting: writes sharing the
+            # spawn's statement are already observable by the thread
+            if not state["started"] and any(
+                    isinstance(n, ast.Call) and _starts_thread(n)
+                    for n in _own_scope_walk(stmt)):
+                state["started"] = True
+            visit_stmt(stmt, held)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    walk(sub, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                walk(handler.body, held)
+
+    def _starts_thread(call: ast.Call) -> bool:
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "start") or _is_spawn_call(call)
+
+    walk(list(mnode.body), held0)
+
+
+# -- phase 2: lockset + atomicity verdicts ----------------------------------
+
+def _shared_attrs(cs: _ClassSummary) -> Set[str]:
+    """Attributes plausibly reachable from more than one thread.
+
+    - a class that spawns threads shares every attribute accessed both
+      from a thread-entry-reachable method and from a caller-context
+      method (the escape analysis);
+    - a class that owns a lock but spawns nothing (a library object
+      handed between threads — every queue family) shares every
+      attribute the class itself locks somewhere (the lock is the
+      author's own declaration of sharing), plus every attribute some
+      method *container-mutates* while another method touches it — the
+      compound-structure traffic (deque/dict/list mutation racing
+      iteration) that raises at runtime even under the GIL;
+    - either way, an attribute never *written* outside ``__init__`` is
+      immutable-after-publication, and exempt.
+    """
+    by_attr: Dict[str, List[_Access]] = {}
+    for a in cs.accesses:
+        by_attr.setdefault(a.attr, []).append(a)
+    shared: Set[str] = set()
+    for attr, accs in by_attr.items():
+        if attr in cs.confined_attrs:
+            continue
+        live = [a for a in accs
+                if not (a.method == "__init__" and a.exempt)]
+        if not any(a.kind in (WRITE, RMW) and a.method != "__init__"
+                   for a in live):
+            continue
+        contexts = {("thread" if a.method in cs.thread_reachable
+                     else "caller") for a in live}
+        locked_somewhere = any(a.held for a in live)
+        container_rmw = any(a.kind == RMW for a in live
+                            if a.method != "__init__")
+        if cs.spawns_threads and len(contexts) >= 2:
+            shared.add(attr)
+        elif cs.entry_methods and any(
+                a.kind == RMW and a.method in cs.thread_reachable
+                for a in live):
+            # an entry method is not necessarily spawned ONCE: one
+            # listener/sender/reporter thread per job/queue/replica is
+            # the platform's normal shape, and sibling threads of the
+            # same entry lose updates against each other exactly like
+            # two different contexts would
+            shared.add(attr)
+        elif (locked_somewhere or container_rmw) \
+                and len({a.method for a in live}) >= 2:
+            shared.add(attr)
+    return shared
+
+
+def _infer_lock(accs: List[_Access]) -> Optional[Tuple[str, int, int]]:
+    """(lock, covered, total) for the lock held at a strict majority —
+    and at least two — of the non-exempt access sites, else None."""
+    sites = [a for a in accs if not a.exempt]
+    if not sites:
+        return None
+    counts: Dict[str, int] = {}
+    for a in sites:
+        for lock in a.held:
+            counts[lock] = counts.get(lock, 0) + 1
+    if not counts:
+        return None
+    lock = max(sorted(counts), key=lambda k: counts[k])
+    covered = counts[lock]
+    if covered < 2 or covered * 2 <= len(sites):
+        return None
+    return lock, covered, len(sites)
+
+
+def _lockset_findings(cs: _ClassSummary,
+                      shared: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    by_attr: Dict[str, List[_Access]] = {}
+    for a in cs.accesses:
+        by_attr.setdefault(a.attr, []).append(a)
+    for attr in sorted(shared):
+        accs = by_attr[attr]
+        inferred = _infer_lock(accs)
+        if inferred is None:
+            findings.extend(_atomicity_findings(cs, attr, accs))
+            continue
+        lock, covered, total = inferred
+        disp = _display_lock(lock)
+        for a in accs:
+            if a.exempt or lock in a.held:
+                continue
+            where = (f"{cs.name}.{attr} is guarded by {disp} at "
+                     f"{covered}/{total} sites")
+            if a.kind in (WRITE, RMW):
+                findings.append(Finding(
+                    "CONC101",
+                    f"{where} — this write in {a.method}() races them; "
+                    f"move it under 'with {disp}:' or annotate "
+                    "'# lint: unguarded(reason)'",
+                    ERROR, cs.rel, a.line))
+            elif a.decision:
+                findings.append(Finding(
+                    "CONC102",
+                    f"{where} — this read in {a.method}() steers a "
+                    "branch on a possibly-stale value; snapshot it "
+                    f"under 'with {disp}:' or annotate "
+                    "'# lint: unguarded(reason)'",
+                    WARN, cs.rel, a.line))
+    return findings
+
+
+def _atomicity_findings(cs: _ClassSummary, attr: str,
+                        accs: List[_Access]) -> List[Finding]:
+    """CONC301/302 for a shared attribute with no inferable lock."""
+    findings: List[Finding] = []
+    consumed: Set[int] = set()
+    by_method: Dict[str, List[_Access]] = {}
+    for a in accs:
+        by_method.setdefault(a.method, []).append(a)
+    method_nodes = {n.name: n for n in cs.node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    for mname, maccs in sorted(by_method.items()):
+        mnode = method_nodes.get(mname)
+        if mnode is None:
+            continue
+        by_line = {a.line: a for a in maccs}
+        for node in astutil.walk_no_nested_functions(mnode):
+            if not isinstance(node, ast.If):
+                continue
+            test_reads = [n for n in ast.walk(node.test)
+                          if _self_attr(n) == attr and isinstance(
+                              getattr(n, "ctx", None), ast.Load)]
+            if not test_reads:
+                continue
+            test_acc = by_line.get(test_reads[0].lineno)
+            if test_acc is None or test_acc.held or test_acc.exempt:
+                continue
+            end = _subtree_end(node)
+            writes = [a for a in maccs
+                      if a.kind in (WRITE, RMW) and not a.held
+                      and not a.exempt and node.lineno < a.line <= end]
+            if writes:
+                findings.append(Finding(
+                    "CONC301",
+                    f"check-then-act on {cs.name}.{attr} in {mname}(): "
+                    "the test and the write are separate critical "
+                    "sections, so another thread can interleave "
+                    "between them; take one lock around both or "
+                    "annotate '# lint: unguarded(reason)'",
+                    WARN, cs.rel, node.lineno))
+                consumed.add(test_acc.line)
+                consumed.update(w.line for w in writes)
+                break  # one check-then-act per method per attr
+    for a in accs:
+        if a.kind == RMW and not a.held and not a.exempt \
+                and a.line not in consumed:
+            findings.append(Finding(
+                "CONC302",
+                f"read-modify-write of shared {cs.name}.{attr} in "
+                f"{a.method}() outside any lock — augmented assignment "
+                "and container mutation are not atomic across threads; "
+                "guard it or annotate '# lint: unguarded(reason)'",
+                WARN, cs.rel, a.line))
+    return findings
+
+
+def _subtree_end(node: ast.AST) -> int:
+    return max((getattr(n, "lineno", 0) for n in ast.walk(node)),
+               default=getattr(node, "lineno", 0))
+
+
+# -- phase 3: the package-wide lock-order graph -----------------------------
+
+_Node = Tuple[str, str]  # (owner: class name or @module-rel, lock name)
+
+
+class _LockGraph:
+    def __init__(self) -> None:
+        self.edges: Dict[_Node, Dict[_Node, Tuple[str, int, str]]] = {}
+        self.rlocks: Set[_Node] = set()
+
+    def add(self, src: _Node, dst: _Node,
+            witness: Tuple[str, int, str]) -> None:
+        self.edges.setdefault(src, {}).setdefault(dst, witness)
+
+
+def _lock_node(cs: _ClassSummary, lock: str) -> _Node:
+    if lock.startswith(_MOD_PREFIX):
+        return ("@" + cs.rel, lock[len(_MOD_PREFIX):])
+    return (cs.name, lock)
+
+
+def _build_lock_graph(summaries: List[_ClassSummary]) -> _LockGraph:
+    graph = _LockGraph()
+    by_name: Dict[str, _ClassSummary] = {}
+    for cs in summaries:
+        by_name.setdefault(cs.name, cs)
+        for lock in cs.rlock_attrs:
+            graph.rlocks.add((cs.name, cs.canonical(lock)))
+    for cs in summaries:
+        for held, acquired, line, mname in cs.acquires:
+            graph.add(_lock_node(cs, held), _lock_node(cs, acquired),
+                      (cs.rel, line, f"{cs.name}.{mname}"))
+        # one-level call inlining: while holding H, `self.m()` acquires
+        # whatever m acquires directly; `self._x.m()` (where _x's class
+        # is statically known) acquires what THAT m acquires
+        for held, call, line, mname in cs.held_calls:
+            if not held:
+                continue
+            callee_attr = _self_attr(call.func)
+            if callee_attr is not None and callee_attr in cs.methods:
+                target_cs, target_m = cs, callee_attr
+            elif isinstance(call.func, ast.Attribute):
+                recv = _self_attr(call.func.value)
+                target_cs = by_name.get(cs.attr_types.get(recv or "", ""))
+                target_m = call.func.attr
+                if target_cs is None:
+                    continue
+            else:
+                continue
+            for lock in sorted(target_cs.method_acquires.get(target_m,
+                                                             ())):
+                dst = _lock_node(target_cs, lock)
+                for h in sorted(held):
+                    src = _lock_node(cs, h)
+                    label = (f"{cs.name}.{mname} -> "
+                             f"{target_cs.name}.{target_m}()")
+                    graph.add(src, dst, (cs.rel, line, label))
+    return graph
+
+
+def _cycle_findings(graph: _LockGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    # self-deadlock: a non-reentrant lock re-acquired while held
+    for src in sorted(graph.edges):
+        dsts = graph.edges[src]
+        if src in dsts and src not in graph.rlocks:
+            rel, line, where = dsts[src]
+            findings.append(Finding(
+                "CONC201",
+                f"non-reentrant lock {src[0]}.{src[1]} is acquired "
+                f"while already held ({where}) — the thread deadlocks "
+                "against itself; drop the inner acquire, make the "
+                "callee a '# guarded-by:' helper, or annotate the "
+                "acquire '# lint: lock-order(reason)'",
+                ERROR, rel, line))
+    # ordering cycles (the AB/BA shape and longer) via bounded DFS
+    seen_cycles: Set[frozenset] = set()
+    for start in sorted(graph.edges):
+        stack: List[Tuple[_Node, List[_Node]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.edges.get(node, {})):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key in seen_cycles:
+                        continue
+                    seen_cycles.add(key)
+                    hops = path + [start]
+                    witnesses = [
+                        f"{a[0]}.{a[1]} -> {b[0]}.{b[1]} at "
+                        f"{graph.edges[a][b][0]}:{graph.edges[a][b][1]} "
+                        f"({graph.edges[a][b][2]})"
+                        for a, b in zip(hops, hops[1:])]
+                    rel, line, _ = graph.edges[hops[0]][hops[1]]
+                    findings.append(Finding(
+                        "CONC201",
+                        "lock-order cycle — threads taking these locks "
+                        "in opposite orders deadlock: "
+                        + "; ".join(witnesses)
+                        + ". Make every path acquire in one canonical "
+                        "order, or annotate the deliberate acquire "
+                        "'# lint: lock-order(reason)'",
+                        ERROR, rel, line))
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))
+    return findings
+
+
+# -- entry points -----------------------------------------------------------
+
+def analyze_modules(
+        modules: Dict[str, Tuple[ast.Module, str, Dict[int, str]]],
+) -> List[Finding]:
+    """Run the concurrency head over pre-parsed modules ({rel: (tree,
+    source, comment_map)} — the shape framework.lint_package loads).
+    Returns findings sorted by (file, line)."""
+    summaries: List[_ClassSummary] = []
+    for rel, (tree, _source, comments) in sorted(modules.items()):
+        mod_locks = _module_locks(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                summaries.append(
+                    _summarize_class(rel, node, comments, mod_locks))
+    relevant = [cs for cs in summaries
+                if (cs.spawns_threads or cs.lock_attrs)
+                and not cs.confined_class]
+    findings: List[Finding] = []
+    for cs in relevant:
+        findings.extend(_lockset_findings(cs, _shared_attrs(cs)))
+    findings.extend(_cycle_findings(_build_lock_graph(relevant)))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings
+
+
+def analyze_package(root: Optional[str] = None) -> List[Finding]:
+    """Load and analyze a whole package tree (the doctor's
+    concurrency-lint check and ad-hoc use)."""
+    from rafiki_tpu.analysis import framework
+
+    root = root or framework.package_root()
+    parse_errors: List[Finding] = []
+    modules = framework._load_modules(root, parse_errors)
+    return parse_errors + analyze_modules(modules)
+
+
+def analyze_source(source: str, filename: str = "<memory>"
+                   ) -> List[Finding]:
+    """Single-file entry point (tests and the fixture corpus)."""
+    tree = ast.parse(source, filename=filename)
+    return analyze_modules(
+        {filename: (tree, source, astutil.comment_map(source))})

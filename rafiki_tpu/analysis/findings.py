@@ -47,6 +47,12 @@ CODES: Dict[str, str] = {
     "JAX001": "host sync (.item()/float()/np.asarray) on a traced value",
     "JAX002": "legacy global numpy.random API (thread PRNG keys instead)",
     "JAX003": "mutation of self state inside a jit/vmap-traced function",
+    "JAX004": "recompile risk: jit over loop-varying or per-request values",
+    "CONC101": "shared attribute written outside its inferred lock",
+    "CONC102": "branch decided by a read outside the inferred lock",
+    "CONC201": "lock-order cycle / re-acquire — potential deadlock",
+    "CONC301": "check-then-act on a shared attribute without a lock",
+    "CONC302": "read-modify-write on a shared attribute without a lock",
     "FWK101": "RAFIKI_* env read not declared in config.py",
     "FWK102": "RAFIKI_* env knob not catalogued in scripts/env.sh",
     "FWK103": "RAFIKI_* env knob not documented under docs/",

@@ -81,6 +81,13 @@ def lint_package(
         findings.extend(_lint_locks(rel, tree, comments))
         if any(rel.endswith(d) for d in DOOR_MODULES):
             findings.extend(_lint_door(rel, tree, comments))
+    # head 3 — the whole-package concurrency analyzer (lockset
+    # inference, lock-order cycles, atomicity lint) runs over the same
+    # parsed module set; its annotation grammar is documented alongside
+    # the FWK disciplines in docs/static-analysis.md
+    from rafiki_tpu.analysis import concurrency
+
+    findings.extend(concurrency.analyze_modules(modules))
     findings.sort(key=lambda f: (f.file, f.line))
     return findings
 
@@ -312,9 +319,12 @@ def _lint_locks(rel: str, tree: ast.Module,
                 continue
             if method.name == "__init__":
                 continue
-            method_holds = _GUARDED_BY_RE.search(
-                comments.get(method.lineno, "")
-                or comments.get(method.lineno - 1, ""))
+            # both lines independently — an unrelated comment on the
+            # def line (# noqa) must not mask the line-above annotation
+            method_holds = (
+                _GUARDED_BY_RE.search(comments.get(method.lineno, ""))
+                or _GUARDED_BY_RE.search(
+                    comments.get(method.lineno - 1, "")))
             held_always = {method_holds.group(1)} if method_holds else set()
             findings.extend(_walk_lock_scope(
                 rel, cls.name, method.body, guarded, held_always, comments))

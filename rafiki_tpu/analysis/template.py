@@ -840,3 +840,103 @@ def _check_jax_pitfalls(report: VerificationReport, tree: ast.Module,
                     "key instead (vmapped members and forked sandbox "
                     "children share that state)", WARN, filename,
                     node.lineno)
+    _check_recompile_risk(report, tree, filename)
+
+
+#: methods whose bodies run once PER SERVED REQUEST — a jit() there with
+#: static_argnums fed from the request recompiles on every novel value
+_PER_REQUEST_METHODS = {"predict", "predict_batch", "generate"}
+
+
+def _check_recompile_risk(report: VerificationReport, tree: ast.Module,
+                          filename: str) -> None:
+    """JAX004 — the static half of the recompile-cost work: shapes that
+    force XLA to compile a fresh program per loop iteration or per
+    request instead of once.
+
+    (a) ``jax.jit``/``vmap`` applied inside a loop body to a closure
+    that captures a loop-varying Python value: every iteration traces a
+    new function identity with a new constant baked in. Loop variables
+    derived from ``x.shape``/``ndim``/``dtype``/``size`` are exempt
+    (the JAX001 carve-out carried over: shape-bucketed recompiles are a
+    deliberate, bounded cost), as are constant rebinds.
+
+    (b) ``jit(..., static_argnums=/static_argnames=)`` inside a
+    per-request method: a static argument fed from request values
+    recompiles per novel value, the unbounded-compile-cache shape.
+    Both WARN — reachability is approximate, like every JAX detector."""
+    named_funcs: Dict[str, ast.AST] = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        loop_varying: Set[str] = set()
+        exempt: Set[str] = set()
+        if isinstance(loop, ast.For):
+            for t in ast.walk(loop.target):
+                if isinstance(t, ast.Name):
+                    loop_varying.add(t.id)
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Assign):
+                names = [t.id for t in n.targets
+                         if isinstance(t, ast.Name)]
+                if _references_static_shape(n.value) \
+                        or astutil.is_constant(n.value):
+                    exempt.update(names)
+                else:
+                    loop_varying.update(names)
+            elif isinstance(n, ast.AugAssign) \
+                    and isinstance(n.target, ast.Name):
+                loop_varying.add(n.target.id)
+        loop_varying -= exempt
+        if not loop_varying:
+            continue
+        for n in ast.walk(loop):
+            if not isinstance(n, ast.Call):
+                continue
+            if astutil.terminal_name(n.func) not in ("jit", "vmap",
+                                                     "pmap"):
+                continue
+            callee = n.args[0] if n.args else None
+            if isinstance(callee, ast.Lambda):
+                params = {a.arg for a in callee.args.args}
+                body: ast.AST = callee.body
+            elif isinstance(callee, ast.Name) \
+                    and callee.id in named_funcs:
+                fdef = named_funcs[callee.id]
+                params = {a.arg for a in fdef.args.args}
+                body = fdef
+            else:
+                continue
+            captured = sorted(
+                node.id for node in ast.walk(body)
+                if isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in loop_varying and node.id not in params)
+            if captured:
+                report.add(
+                    "JAX004",
+                    f"jit/vmap inside a loop closes over loop-varying "
+                    f"{', '.join(captured)!s} — every iteration traces "
+                    "and compiles a fresh program with the value baked "
+                    "in; hoist the jit out of the loop and pass the "
+                    "value as a traced argument", WARN, filename,
+                    n.lineno)
+    # (b) static_argnums on the per-request path
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or fn.name not in _PER_REQUEST_METHODS:
+            continue
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) \
+                    and astutil.terminal_name(n.func) == "jit" \
+                    and any(kw.arg in ("static_argnums", "static_argnames")
+                            for kw in n.keywords):
+                report.add(
+                    "JAX004",
+                    f"jit(static_argnums=...) inside {fn.name}() marks "
+                    "request-fed values static — every novel value "
+                    "compiles another program and the compile cache "
+                    "grows without bound; jit once at load time and "
+                    "trace the value instead", WARN, filename, n.lineno)

@@ -298,7 +298,7 @@ class WorkerQueue:
             self._cond.notify()
         return futs
 
-    def _drain_fresh(
+    def _drain_fresh(  # guarded-by: _lock
         self, n: int, now: float,
         batch: List[Tuple[QueryFuture, Any]],
     ) -> None:

@@ -574,7 +574,8 @@ class ShmBroker(Broker):
 
     # -- response plumbing -------------------------------------------------
 
-    def _ensure_response_queue(self, job_id: str) -> ShmMessageQueue:
+    def _ensure_response_queue(  # guarded-by: _lock
+            self, job_id: str) -> ShmMessageQueue:
         """Caller holds self._lock."""
         if job_id not in self._response_qs:
             rq = ShmMessageQueue(
@@ -620,7 +621,8 @@ class ShmBroker(Broker):
             self._dec_outstanding_locked(job_id, worker_id)
             return fut, trace
 
-    def _dec_outstanding_locked(self, job_id: str, worker_id: str) -> None:
+    def _dec_outstanding_locked(self, job_id: str,  # guarded-by: _lock
+                                worker_id: str) -> None:
         key = (job_id, worker_id)
         n = self._outstanding.get(key, 0) - 1
         if n <= 0:
@@ -748,16 +750,24 @@ class ShmBroker(Broker):
                 # futures keep waiting and resolve with the request's own
                 # (typed) TimeoutError at the SLO — the listener thread
                 # must outlive any single bad message
-                self.wire_errors += 1
-                from rafiki_tpu.utils.metrics import REGISTRY
-
-                REGISTRY.counter(
-                    "rafiki_wire_errors_total",
-                    "undecodable wire frames dropped (query + response "
-                    "sides)").inc()
+                self._count_wire_error()
                 logger.error("dropping undecodable response frame on %s: %s",
                              job_id, e)
                 continue
+
+    def _count_wire_error(self) -> None:
+        """One undecodable frame. Under the lock: each job's listener is
+        its own thread, and sibling listeners doing a bare ``+=`` on the
+        shared counter lose updates against each other (found by the
+        concurrency lint, CONC302)."""
+        with self._lock:
+            self.wire_errors += 1
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "rafiki_wire_errors_total",
+            "undecodable wire frames dropped (query + response "
+            "sides)").inc()
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -18,7 +18,7 @@ import json
 import os
 import sys
 import tempfile
-from typing import Callable, List, Tuple
+from typing import Callable, Dict, List, Tuple
 
 PASS, WARN, FAIL = "PASS", "WARN", "FAIL"
 
@@ -573,6 +573,40 @@ def check_static_analysis() -> Check:
     return ("static analysis", WARN if warn else PASS, detail)
 
 
+def check_concurrency_lint() -> Check:
+    """The whole-package concurrency analyzer (docs/static-analysis.md,
+    CONC1xx/2xx/3xx): tier-1 pins the shipped tree at zero findings, but
+    an operator running a locally-edited tree never sees CI — WARN when
+    the INSTALLED package lints dirty, so a race or lock-order inversion
+    introduced by a local patch is caught at doctor time, not in
+    production."""
+    try:
+        from rafiki_tpu.analysis.concurrency import analyze_package
+
+        findings = analyze_package()
+    # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+    except Exception as e:
+        return ("concurrency lint", WARN,
+                f"analyzer failed on the installed tree: "
+                f"{type(e).__name__}: {e}")
+    if not findings:
+        return ("concurrency lint", PASS,
+                "installed tree lints clean (lockset inference, "
+                "lock-order cycles, atomicity — zero unannotated "
+                "findings)")
+    by_code: Dict[str, int] = {}
+    for f in findings:
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+    head = "; ".join(str(f) for f in findings[:3])
+    return ("concurrency lint", WARN,
+            f"{len(findings)} finding(s) in the installed tree "
+            f"({', '.join(f'{c}x{n}' for c, n in sorted(by_code.items()))})"
+            f" — local edits regressed the race gate: {head}"
+            + (" …" if len(findings) > 3 else "")
+            + " (fix the race or annotate the true negative; "
+            "python -m rafiki_tpu.analysis --self-lint lists all)")
+
+
 def check_int8_serving() -> Check:
     """int8 weight-only serving (docs/performance.md): retired from the
     default record after measuring a 0.805x SLOWDOWN on the bench matmul
@@ -931,7 +965,7 @@ CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
     check_chaos, check_overload_knobs, check_autoscaler, check_recovery,
     check_rollouts, check_trial_faults, check_vectorized_trials,
-    check_static_analysis,
+    check_static_analysis, check_concurrency_lint,
     check_int8_serving, check_generative_serving,
     check_observability, check_agents, check_backend,
 ]

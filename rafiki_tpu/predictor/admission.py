@@ -254,7 +254,8 @@ class AdmissionController:
 
     # -- multi-tenant weighted fairness -------------------------------------
 
-    def _fair_ceiling_locked(self, tenant: str, cap: int) -> None:
+    def _fair_ceiling_locked(self, tenant: str,  # guarded-by: _lock
+                             cap: int) -> None:
         """No single tenant may occupy EVERY in-flight slot of a shared
         door (caller holds ``self._lock``). The charge gate below can
         only defend a tenant it has admitted at least once — but a flood
@@ -282,7 +283,8 @@ class AdmissionController:
                 f"{cap} in-flight slots",
                 retry_after_s=max(self._ewma_query_s, 1.0))
 
-    def _fair_gate_locked(self, tenant: str, cost: int, cap: int) -> None:
+    def _fair_gate_locked(self, tenant: str, cost: int,  # guarded-by: _lock
+                          cap: int) -> None:
         """Deficit-style fair-share check (caller holds ``self._lock``).
         Check only — the charge lands in :meth:`_fair_charge_locked` once
         the request is actually admitted.
@@ -340,7 +342,8 @@ class AdmissionController:
                     "door is contended",
                     retry_after_s=max(self._ewma_query_s * cost, 1.0))
 
-    def _fair_charge_locked(self, tenant: str, cost: int) -> None:
+    def _fair_charge_locked(self, tenant: str,  # guarded-by: _lock
+                            cost: int) -> None:
         """Book ``cost`` admitted queries against ``tenant`` (caller holds
         ``self._lock``), decaying the tenant's prior charge to now first."""
         from rafiki_tpu import config

@@ -163,9 +163,9 @@ class Predictor:
             fresh = self._lane_new is None
             self._lane_new = set(new_workers)
             self._lane_permille = permille
-        if fresh:
-            for dq in self._lane_stats.values():
-                dq.clear()
+            if fresh:
+                for dq in self._lane_stats.values():
+                    dq.clear()
 
     def clear_rollout_lane(self) -> None:
         """End version-lane routing (rollout done or rolled back): every
@@ -189,8 +189,13 @@ class Predictor:
         return (n + 1) * permille // 1000 > n * permille // 1000
 
     def _lane_record(self, lane: str, outcome: str, duration_s: float) -> None:
-        self._lane_stats[lane].append(
-            (time.monotonic(), duration_s, outcome))
+        # under the route lock: request-handler threads append here while
+        # the rollout judge thread iterates the same deques in
+        # rollout_stats(), and a deque mutated during iteration raises
+        # RuntimeError — which would surface as a failed judge tick
+        with self._route_lock:
+            self._lane_stats[lane].append(
+                (time.monotonic(), duration_s, outcome))
         self._m_lane_req.labels(self._job_id, lane, outcome).inc()
         if outcome == "ok":
             self._m_lane_lat.labels(self._job_id, lane).observe(duration_s)
@@ -202,8 +207,11 @@ class Predictor:
         same series for dashboards)."""
         cutoff = time.monotonic() - max(window_s, 0.0)
         out: Dict[str, Dict[str, Any]] = {}
-        for lane, dq in self._lane_stats.items():
-            entries = [e for e in list(dq) if e[0] >= cutoff]
+        with self._route_lock:
+            snapshots = {lane: list(dq)
+                         for lane, dq in self._lane_stats.items()}
+        for lane, entries_all in snapshots.items():
+            entries = [e for e in entries_all if e[0] >= cutoff]
             oks = sorted(d for _, d, o in entries if o == "ok")
             errors = sum(1 for e in entries if e[2] == "error")
             shed = sum(1 for e in entries if e[2] == "shed")

@@ -251,6 +251,7 @@ class ChaosController:
         must not all contend on one process-global mutex to learn that
         nothing is injected. The unlocked reads are benign: a racing
         install/env-set is picked up by the next call."""
+        # lint: unguarded(documented lock-free fast path; a racing install/env-set is picked up by the next call)
         if (not self._installed and not self._rules and not self._env_value
                 and not os.environ.get(ENV_VAR)):
             # (a truthy cached _env_value means the env was JUST unset:
@@ -265,7 +266,7 @@ class ChaosController:
                     return rule
         return None
 
-    def _refresh_env_locked(self) -> None:
+    def _refresh_env_locked(self) -> None:  # guarded-by: _lock
         if self._installed:
             return
         value = os.environ.get(ENV_VAR, "")

@@ -154,6 +154,7 @@ class GenerationWorker(InferenceWorker):
             occupancy_ring = REGISTRY.ring(
                 f"slot_occupancy:job:{self._job_id}")
             m = _metrics()
+            # lint: thread-confined(only the serve thread writes and reads this; the reporter thread reads the _stats_lock'd module dict copy)
             self._tokens_emitted = 0
             while not ctx.stopping:
                 n_active = sum(1 for s in slots if s is not None)

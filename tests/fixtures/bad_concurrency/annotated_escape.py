@@ -1,0 +1,41 @@
+"""The escape grammar silences each detector when a human vouches for
+the true negative — every annotation carries its reason. Without the
+three annotations this file would flag CONC101 (bare minority write),
+CONC302 (bare ``+=``), and CONC201 (AB after BA)."""
+
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux_lock = threading.Lock()
+        self._total = 0
+        # lint: thread-confined(rebound only in tests before serving starts)
+        self._scale = 1
+
+    def add(self, n):
+        with self._lock:
+            self._total = self._total + n
+
+    def total(self):
+        with self._lock:
+            return self._total
+
+    def reset_between_benchmarks(self):
+        # lint: unguarded(bench harness calls this with the fleet idle)
+        self._total = 0
+
+    def rescale(self, k):
+        self._scale += k  # silent: _scale is annotated thread-confined
+
+    def audit(self):
+        with self._lock:
+            with self._aux_lock:
+                pass
+
+    def repair(self):
+        with self._aux_lock:
+            # lint: lock-order(teardown-only path; audit() cannot run concurrently)
+            with self._lock:
+                pass

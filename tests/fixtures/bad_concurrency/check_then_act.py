@@ -1,0 +1,19 @@
+"""CONC301: lazy init from two threads — both can see ``_model is
+None`` and both build, one clobbering the other mid-use."""
+
+import threading
+
+
+class LazyServer:
+    def __init__(self):
+        self._model = None
+        self._thread = threading.Thread(target=self._refresh, daemon=True)
+        self._thread.start()
+
+    def _refresh(self):
+        self._model = None  # periodic cache drop on the worker thread
+
+    def get(self):
+        if self._model is None:  # check ... — CONC301
+            self._model = object()  # ... then act
+        return self._model

@@ -1,0 +1,21 @@
+"""CONC201: the AB/BA shape — ``transfer_in`` holds A then takes B,
+``transfer_out`` holds B then takes A. Two threads, one in each, wait
+on each other forever."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+
+    def transfer_in(self):
+        with self._alock:
+            with self._block:
+                pass
+
+    def transfer_out(self):
+        with self._block:
+            with self._alock:
+                pass

@@ -1,0 +1,23 @@
+"""CONC102: ``_stopping`` is written under the lock, but ``step``
+branches on a bare read — a possibly-stale decision."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stopping = False
+
+    def stop(self):
+        with self._lock:
+            self._stopping = True
+
+    def restart(self):
+        with self._lock:
+            self._stopping = False
+
+    def step(self):
+        if self._stopping:  # stale read steers the branch — CONC102
+            return "halted"
+        return "pumped"

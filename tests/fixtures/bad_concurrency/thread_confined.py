@@ -1,0 +1,22 @@
+"""True negative for the escape analysis: every ``_cfg`` mutation
+happens in ``__init__`` BEFORE the worker thread starts, so no other
+thread can observe the half-built state — the analyzer must stay
+silent (no annotation needed)."""
+
+import threading
+
+
+class Warmup:
+    def __init__(self, overrides):
+        self._cfg = {"batch": 8}
+        self._cfg.update(overrides)  # confined: nothing observes us yet
+        self._cfg["ready"] = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        if self._cfg["ready"]:
+            return self._cfg["batch"]
+
+    def batch(self):
+        return self._cfg["batch"]

@@ -1,0 +1,19 @@
+"""CONC302: ``+=`` from the worker thread races the caller-side reset;
+read-modify-write is not atomic even under the GIL."""
+
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._count += 1  # lost-update race — CONC302
+
+    def report(self):
+        value = self._count
+        self._count = 0
+        return value

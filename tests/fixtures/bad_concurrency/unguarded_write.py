@@ -1,0 +1,21 @@
+"""CONC101: the class locks ``_items`` at most sites; ``reset`` writes
+it bare — the lockset inference flags exactly the minority write."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def put(self, x):
+        with self._lock:
+            self._items = self._items + [x]
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def reset(self):
+        self._items = []  # races put()/size() — CONC101

@@ -1,0 +1,36 @@
+"""JAX004: the jit inside the epoch loop closes over the loop-varying
+``lr`` — each iteration traces a fresh program with the scalar baked in
+(the bounded shape-bucket recompile, ``bs = int(x.shape[0])``, stays
+exempt)."""
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+
+class LoopJit(BaseModel):
+    @staticmethod
+    def get_knob_config():
+        return {"learning_rate": FloatKnob(1e-4, 1e-2)}
+
+    def train(self, dataset_uri):
+        x = jnp.ones((8, 4))
+        w = jnp.ones((4,))
+        for epoch in range(3):
+            lr = 0.1 / (epoch + 1)
+            bs = int(x.shape[0])  # static-shape derivation: exempt
+            step = jax.jit(lambda p: p - lr * jnp.sum(p) / bs)
+            w = step(w)
+
+    def evaluate(self, dataset_uri):
+        return 1.0
+
+    def predict(self, queries):
+        return [0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass

@@ -54,6 +54,7 @@ CORPUS = {
     "tracer_item.py": "JAX001",
     "global_np_random.py": "JAX002",
     "jit_self_mutation.py": "JAX003",
+    "jit_in_loop.py": "JAX004",
 }
 
 GOOD_TEMPLATES = sorted(
@@ -95,6 +96,47 @@ def test_corpus_covers_at_least_ten_distinct_violations():
 def test_no_false_positives_on_shipped_templates(path):
     report = verify_template_source(_read(path), filename=path)
     assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_jax004_static_argnums_on_the_per_request_path():
+    """The second JAX004 arm: jit(static_argnums=...) inside predict()
+    marks request-fed values static — per-novel-value recompiles. The
+    same jit at load time (train) is a deliberate, bounded cost and
+    stays silent."""
+    base = textwrap.dedent("""
+        import jax
+
+        from rafiki_tpu.sdk import BaseModel, FloatKnob
+
+        class M(BaseModel):
+            @staticmethod
+            def get_knob_config():
+                return {"lr": FloatKnob(1e-4, 1e-2)}
+
+            def train(self, dataset_uri):
+                self._f = jax.jit(lambda x: x, static_argnums=(0,))
+
+            def evaluate(self, dataset_uri):
+                return 1.0
+
+            def predict(self, queries):
+                {predict_body}
+                return [0 for _ in queries]
+
+            def dump_parameters(self):
+                return {}
+
+            def load_parameters(self, params):
+                pass
+        """)
+    dirty = verify_template_source(base.replace(
+        "{predict_body}",
+        "f = jax.jit(self._apply, static_argnums=(1,))"), "M")
+    assert [f.code for f in dirty.findings] == ["JAX004"]
+    assert "static" in dirty.findings[0].message
+    clean = verify_template_source(
+        base.replace("{predict_body}", "pass"), "M")
+    assert clean.findings == []
 
 
 def test_population_capability_oracle_matches_runtime_contract():

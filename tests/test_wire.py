@@ -193,6 +193,34 @@ def test_shm_binary_frames_end_to_end():
 
 
 @needs_native
+def test_wire_error_count_is_exact_across_listener_threads():
+    """Regression for a lost-update race the concurrency lint found
+    (CONC302 on ShmBroker.wire_errors): one listener thread runs per
+    job, and sibling listeners doing a bare ``+=`` on the shared counter
+    drop increments against each other. The count path now runs under
+    the broker lock — N threads hammering it must land on the exact
+    total."""
+    from rafiki_tpu.cache.shm_broker import ShmBroker
+
+    broker = ShmBroker()
+    try:
+        n_threads, per_thread = 8, 5000
+
+        def hammer():
+            for _ in range(per_thread):
+                broker._count_wire_error()
+
+        threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert broker.wire_errors == n_threads * per_thread
+    finally:
+        broker.close()
+
+
+@needs_native
 def test_mixed_version_interop_json_submitter_binary_worker(monkeypatch):
     """A JSON-framing submitter (RAFIKI_WIRE_BINARY=0 — the stand-in for
     an old-version peer) against a binary-capable worker still completes
