@@ -27,6 +27,7 @@ import tempfile
 import threading
 import time
 import traceback
+from typing import Optional
 
 import numpy as np
 
@@ -652,35 +653,26 @@ def bench_shm_binary_serving(n_clients: int = 4,
         broker.close()
 
 
-def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
-                           prefix: str = "serving_generate") -> dict:
-    """Generative serving phase (docs/serving-generation.md): N concurrent
-    streaming clients drive a real PredictorServer /generate ->
-    Predictor -> InProcessBroker -> GenerationWorker slot-scheduler stack
-    over a tiny-but-real KV-cached LM (models/lm.py). Reports TTFT
-    p50/p95 (client-observed, first delta vs request start), aggregate
-    tokens/s across the co-resident streams, and mean slot utilization —
-    the continuous-batching numbers the subsystem exists for.
-    Deployment-free on purpose, same layers as production serving."""
-    import threading as _threading
+_GEN_BENCH_CONTEXT = 160  # the bench LM's max_context
 
+
+def _make_gen_bench_lm():
+    """The tiny-but-real KV-cached LM behind the generative phases —
+    advertises BOTH decode layouts so RAFIKI_GEN_KV_PAGED alone selects
+    the path under test."""
     import jax
-    import requests as _requests
 
-    from rafiki_tpu import config as _config
-    from rafiki_tpu.cache.queue import InProcessBroker
     from rafiki_tpu.models import lm
-    from rafiki_tpu.predictor.predictor import Predictor
-    from rafiki_tpu.predictor.server import PredictorServer
     from rafiki_tpu.sdk.model import BaseModel, GenerationSpec
-    from rafiki_tpu.utils.metrics import REGISTRY
-    from rafiki_tpu.worker.generation import GenerationWorker
 
-    cfg = lm.tiny(vocab=256, max_len=160, dim=64, depth=2, heads=4)
+    cfg = lm.tiny(vocab=256, max_len=_GEN_BENCH_CONTEXT, dim=64, depth=2,
+                  heads=4)
     params = lm.init(jax.random.PRNGKey(0), cfg)
+    buckets = (32, 64, 128, _GEN_BENCH_CONTEXT)
 
     class _BenchLM(BaseModel):
-        generation_spec = GenerationSpec(eos_token_id=None, max_context=160)
+        generation_spec = GenerationSpec(eos_token_id=None,
+                                         max_context=_GEN_BENCH_CONTEXT)
 
         @staticmethod
         def get_knob_config():
@@ -709,53 +701,130 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
             return lm.init_kv_cache(cfg, max_slots)
 
         def prefill(self, cache, slot, prompt_ids):
-            import numpy as _np
-
-            bucket = 32
-            ids = _np.zeros(bucket, _np.int32)
-            ids[:len(prompt_ids)] = prompt_ids
-            logits, cache = self._jit_prefill(
-                cache, slot, ids, len(prompt_ids))
+            n = len(prompt_ids)
+            bucket = next(b for b in buckets if b >= n)
+            ids = np.zeros(bucket, np.int32)
+            ids[:n] = prompt_ids
+            logits, cache = self._jit_prefill(cache, slot, ids, n)
             return int(lm.greedy_token(logits)), cache
 
         def decode_step(self, cache, ids, positions):
             logits, cache = self._jit_decode(cache, ids, positions)
             return lm.greedy_token(logits), cache
 
+        def init_paged_kv_cache(self, pool_blocks, block_tokens):
+            self._jit_paged_prefill = jax.jit(
+                lambda c, bt, ids, st, n: lm.paged_prefill(
+                    params, c, bt, ids, st, n, cfg))
+            self._jit_paged_decode = jax.jit(
+                lambda c, ids, pos, bts: lm.paged_decode_step(
+                    params, c, ids, pos, bts, cfg))
+            self._jit_copy = jax.jit(lm.copy_kv_blocks)
+            return lm.init_paged_kv_cache(cfg, pool_blocks, block_tokens)
+
+        def paged_prefill(self, cache, block_table, prompt_ids, start):
+            n = len(prompt_ids)
+            bucket = next(b for b in buckets if b >= n)
+            ids = np.zeros(bucket, np.int32)
+            ids[:n] = prompt_ids
+            logits, cache = self._jit_paged_prefill(
+                cache, np.asarray(block_table, np.int32), ids,
+                np.int32(start), n)
+            return int(lm.greedy_token(logits)), cache
+
+        def paged_decode_step(self, cache, ids, positions, block_tables):
+            logits, cache = self._jit_paged_decode(
+                cache, ids, positions, np.asarray(block_tables, np.int32))
+            return lm.greedy_token(logits), cache
+
+        def kv_copy_blocks(self, cache, src, dst):
+            return self._jit_copy(cache, src, dst)
+
+    return _BenchLM()
+
+
+def _mixed_prompt(rng, shared_prefix):
+    """The mixed short/long request distribution the paged claims are
+    judged at: 70% short chats (8-24 prompt tokens), 30% long documents
+    (64-96), a third of all requests opening with a shared 16-token
+    system prompt."""
+    if rng.random() < 0.7:
+        n = int(rng.integers(8, 25))
+    else:
+        n = int(rng.integers(64, 97))
+    body = [int(t) for t in rng.integers(1, 250, size=n)]
+    if rng.random() < 0.34:
+        return shared_prefix + body[:max(n - len(shared_prefix), 4)]
+    return body
+
+
+def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
+                           prefix: str = "serving_generate",
+                           paged: Optional[bool] = None) -> dict:
+    """Generative serving phase (docs/serving-generation.md): N concurrent
+    streaming clients at the MIXED short/long prompt distribution drive a
+    real PredictorServer /generate -> Predictor -> InProcessBroker ->
+    GenerationWorker stack over a tiny-but-real KV-cached LM
+    (models/lm.py). Reports TTFT p50/p95 (client-observed), aggregate
+    tokens/s, mean occupancy of the binding resource (KV blocks when
+    paged, slots otherwise), and — under the paged allocator — the pool
+    footprint and prefix-cache hit rate. ``paged`` pins
+    RAFIKI_GEN_KV_PAGED for an A/B leg; None serves at ambient config.
+    Deployment-free on purpose, same layers as production serving."""
+    import threading as _threading
+
+    import requests as _requests
+
+    from rafiki_tpu import config as _config
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.predictor.predictor import Predictor
+    from rafiki_tpu.predictor.server import PredictorServer
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    from rafiki_tpu.worker.generation import GenerationWorker
+
+    env_prev = os.environ.get("RAFIKI_GEN_KV_PAGED")
+    if paged is not None:
+        os.environ["RAFIKI_GEN_KV_PAGED"] = "1" if paged else "0"
+
     class _Ctx:
-        service_id = "genbench-w1"
+        service_id = f"{prefix}-w1"
         chips = None
         stopping = False
 
         def ready(self):
             pass
 
+    job = f"genbench-{prefix}"
     broker = InProcessBroker()
-    worker = GenerationWorker("genbench", "t1", db=None, broker=broker)
-    worker._load_model = lambda sid: _BenchLM()
+    worker = GenerationWorker(job, "t1", db=None, broker=broker)
+    worker._load_model = lambda sid: _make_gen_bench_lm()
     ctx = _Ctx()
     wt = _threading.Thread(target=worker.start, args=(ctx,), daemon=True)
     wt.start()
     # wait for the worker's queue to register
     for _ in range(200):
-        if broker.get_worker_queues("genbench"):
+        if broker.get_worker_queues(job):
             break
         time.sleep(0.02)
-    predictor = Predictor("genbench", broker, task=None)
-    server = PredictorServer(predictor, "genbench", auth=False).start()
+    predictor = Predictor(job, broker, task=None)
+    server = PredictorServer(predictor, job, auth=False).start()
     try:
         results = []
         res_lock = _threading.Lock()
+        shared_prefix = list(range(1, 17))
 
         def client(seed: int):
             rng = np.random.default_rng(seed)
-            prompt = [int(t) for t in rng.integers(1, 250, size=8)]
+            prompt = _mixed_prompt(rng, shared_prefix)
+            budget = min(max_tokens,
+                         _GEN_BENCH_CONTEXT - len(prompt) - 1)
             t0 = time.monotonic()
             ttft = None
             tokens = 0
             with _requests.post(
                     f"http://127.0.0.1:{server.port}/generate",
-                    json={"prompt_ids": prompt, "max_tokens": max_tokens,
+                    json={"prompt_ids": prompt, "max_tokens": budget,
                           "timeout_s": 120.0},
                     stream=True, timeout=180) as resp:
                 buf = b""
@@ -789,10 +858,10 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
             t.join(timeout=300)
         wall = time.monotonic() - t0
         occ = [v for _, v in
-               REGISTRY.ring("slot_occupancy:job:genbench").series()]
+               REGISTRY.ring(f"slot_occupancy:job:{job}").series()]
         ttfts = sorted(r[0] * 1000.0 for r in results if r[0] is not None)
         total_tokens = sum(r[1] for r in results)
-        return {
+        out = {
             f"{prefix}_clients": n_clients,
             f"{prefix}_streams_completed": len(results),
             f"{prefix}_ttft_p50_ms": (
@@ -802,15 +871,189 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
                                 len(ttfts) - 1)], 2) if ttfts else None),
             f"{prefix}_tokens_s": (
                 round(total_tokens / wall, 1) if wall > 0 else 0.0),
-            f"{prefix}_slot_utilization": (
+            f"{prefix}_occupancy": (
                 round(sum(occ) / len(occ), 3) if occ else None),
             f"{prefix}_max_slots": int(_config.GEN_MAX_SLOTS),
+            f"{prefix}_paged": worker._alloc is not None,
         }
+        if worker._alloc is not None:
+            st = worker._alloc.stats()
+            admitted = st["prefix_hits"] + st["prefix_misses"]
+            row_bytes = 2 * 4 * 64  # K+V planes, f32, dim
+            depth = 2
+            out.update({
+                f"{prefix}_kv_blocks_used_hw": st["used_blocks"],
+                f"{prefix}_kv_pool_blocks": st["pool_blocks"],
+                f"{prefix}_kv_pool_bytes": (
+                    st["pool_blocks"] * st["block_tokens"] * depth
+                    * row_bytes),
+                f"{prefix}_prefix_hit_rate": (
+                    round(st["prefix_hits"] / admitted, 3) if admitted
+                    else None),
+                f"{prefix}_prefix_hit_tokens": st["prefix_hit_tokens"],
+                f"{prefix}_cow_copies": st["cow_copies"],
+            })
+        return out
     finally:
         ctx.stopping = True
         server.stop(drain_timeout_s=0.0)
-        broker.unregister_worker("genbench", "genbench-w1")
+        broker.unregister_worker(job, ctx.service_id)
         wt.join(timeout=10)
+        if paged is not None:
+            if env_prev is None:
+                os.environ.pop("RAFIKI_GEN_KV_PAGED", None)
+            else:
+                os.environ["RAFIKI_GEN_KV_PAGED"] = env_prev
+
+
+def bench_kv_capacity(prefix: str = "serving_generate") -> dict:
+    """streams_per_chip at the mixed prompt distribution, paged vs ring
+    at EQUAL KV memory — the headline multiplier of the paged allocator,
+    measured against the REAL allocator (worker/kv_paging.py admits
+    streams until the pool refuses), not arithmetic. The ring holds
+    exactly ``slots`` streams whatever their lengths; the paged pool
+    holds streams until their USED tokens fill the same byte budget."""
+    from rafiki_tpu import config as _config
+    from rafiki_tpu.worker.kv_paging import PagedKVAllocator
+
+    bt = max(int(_config.GEN_KV_BLOCK_TOKENS), 1)
+    slots = max(int(_config.GEN_MAX_SLOTS), 1)
+    table_blocks = -(-_GEN_BENCH_CONTEXT // bt)
+    pool_blocks = slots * table_blocks  # equal memory to the ring
+    alloc = PagedKVAllocator(pool_blocks, bt, table_blocks,
+                             prefix_cache=bool(_config.GEN_PREFIX_CACHE))
+    rng = np.random.default_rng(7)
+    shared_prefix = list(range(1, 17))
+    resident = 0
+    while True:
+        prompt = _mixed_prompt(rng, shared_prefix)
+        # a stream's working set: prompt + a typical 32-token completion
+        total = min(len(prompt) + 32, _GEN_BENCH_CONTEXT)
+        alloc.open_slot(resident, prompt)
+        if not alloc.ensure_capacity(resident, total - 1):
+            alloc.close_slot(resident)
+            break
+        resident += 1
+        if resident >= pool_blocks:  # safety: distribution fits forever
+            break
+    return {
+        f"{prefix}_streams_per_chip_paged": resident,
+        f"{prefix}_streams_per_chip_ring": slots,
+        f"{prefix}_streams_per_chip_gain": round(resident / slots, 2),
+    }
+
+
+def bench_gen_join_drill(prefix: str = "serving_generate_join") -> dict:
+    """Chunked-prefill regression drill: resident streams' inter-token
+    p95 while a max-context prompt joins mid-decode, against the no-join
+    baseline (the `rafiki_gen_intertoken_seconds` guard, client-side).
+    With RAFIKI_GEN_PREFILL_CHUNK the join is ingested chunk-by-chunk
+    between decode rounds, so the residents' p95 should hold near
+    baseline; a one-shot prefill of the same prompt is the failure mode
+    this exists to catch."""
+    import threading as _threading
+
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.worker.generation import GenerationWorker
+
+    class _Ctx:
+        service_id = f"{prefix}-w1"
+        chips = None
+        stopping = False
+
+        def ready(self):
+            pass
+
+    env_prev = os.environ.get("RAFIKI_GEN_KV_PAGED")
+    os.environ["RAFIKI_GEN_KV_PAGED"] = "1"
+    job = f"genbench-{prefix}"
+    broker = InProcessBroker()
+    worker = GenerationWorker(job, "t1", db=None, broker=broker)
+    worker._load_model = lambda sid: _make_gen_bench_lm()
+    ctx = _Ctx()
+    wt = _threading.Thread(target=worker.start, args=(ctx,), daemon=True)
+    wt.start()
+    for _ in range(200):
+        if broker.get_worker_queues(job):
+            break
+        time.sleep(0.02)
+    q = list(broker.get_worker_queues(job).values())[0]
+
+    def stream(prompt, max_tokens, gaps=None):
+        fut = q.submit_many(
+            [{"prompt_ids": prompt, "max_tokens": max_tokens}],
+            deadline=time.monotonic() + 120)[0]
+        s = fut.result(60)
+        last = time.monotonic()
+        toks = 0
+        while True:
+            try:
+                d = s.next_delta(30)
+            except StopIteration:
+                break
+            now = time.monotonic()
+            if gaps is not None and d.tokens:
+                gaps.append(now - last)
+            last = now
+            toks += len(d.tokens)
+            if d.finished:
+                break
+        return toks
+
+    def p95(xs):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(int(len(xs) * 0.95), len(xs) - 1)] * 1000.0, 3)
+
+    try:
+        stream([3, 1, 4], 8)  # warm-up: compile prefill + decode
+        # baseline: one resident stream, no join
+        base_gaps = []
+        stream([5, 6, 7, 8], 64, gaps=base_gaps)
+        # drill: resident decodes while a max-context prompt joins
+        join_gaps = []
+        resident_done = _threading.Event()
+
+        def resident():
+            stream([5, 6, 7, 8], 64, gaps=join_gaps)
+            resident_done.set()
+
+        rt = _threading.Thread(target=resident, daemon=True)
+        rt.start()
+        time.sleep(0.05)  # the resident is mid-decode
+        long_prompt = [int(t) for t in
+                       np.random.default_rng(3).integers(
+                           1, 250, size=_GEN_BENCH_CONTEXT - 10)]
+        stream(long_prompt, 4)
+        rt.join(timeout=120)
+        from rafiki_tpu import config as _config
+
+        # drop the first gap (includes the resident's own prefill)
+        base_p95 = p95(base_gaps[1:])
+        join_p95 = p95(join_gaps[1:])
+        # the regression budget: the join may cost residents at most 3x
+        # the no-join p95 (plus a 20 ms absolute floor for timer noise) —
+        # a one-shot prefill of a max-context prompt blows through this
+        budget_ms = (max(base_p95 * 3.0, base_p95 + 20.0)
+                     if base_p95 is not None else None)
+        return {
+            f"{prefix}_baseline_intertoken_p95_ms": base_p95,
+            f"{prefix}_intertoken_p95_ms": join_p95,
+            f"{prefix}_p95_budget_ms": budget_ms,
+            f"{prefix}_within_budget": (
+                bool(join_p95 <= budget_ms)
+                if None not in (join_p95, budget_ms) else None),
+            f"{prefix}_prefill_chunk": int(_config.GEN_PREFILL_CHUNK),
+        }
+    finally:
+        ctx.stopping = True
+        broker.unregister_worker(job, ctx.service_id)
+        wt.join(timeout=10)
+        if env_prev is None:
+            os.environ.pop("RAFIKI_GEN_KV_PAGED", None)
+        else:
+            os.environ["RAFIKI_GEN_KV_PAGED"] = env_prev
 
 
 def _door_hist_percentiles(door: str, prefix: str) -> dict:
@@ -1251,7 +1494,22 @@ def main():
             if BENCH_SERVING and os.environ.get(
                     "RAFIKI_BENCH_GEN", "1") not in ("0", "false"):
                 try:
-                    serving.update(bench_serving_generate())
+                    # paged leg (the default layout) at the mixed
+                    # short/long distribution...
+                    serving.update(bench_serving_generate(
+                        prefix="serving_generate_paged", paged=True))
+                    # ...vs the legacy contiguous ring, same stack
+                    serving.update(bench_serving_generate(
+                        prefix="serving_generate_ring", paged=False))
+                    pt = serving.get("serving_generate_paged_tokens_s")
+                    rt_ = serving.get("serving_generate_ring_tokens_s")
+                    if pt and rt_:
+                        serving["serving_generate_paged_speedup"] = round(
+                            pt / rt_, 3)
+                    # allocator-level streams/chip at equal KV memory
+                    serving.update(bench_kv_capacity())
+                    # chunked-prefill long-prompt-join latency drill
+                    serving.update(bench_gen_join_drill())
                 except Exception as e:
                     serving["serving_generate_error"] = repr(e)
             admin.stop_all_jobs()

@@ -1197,6 +1197,35 @@ class Admin:
         with self._predict_route_lock:
             for sid, s in self._remote_serving_stats.items():
                 workers.setdefault(sid, {}).update(s)
+        # generative serving picture, aggregated per job (the workers'
+        # rows carry their job id): the paged-KV pool footprint and the
+        # per-tenant prefix-cache hit rates the shared-prefix lever is
+        # judged by (docs/serving-generation.md)
+        generation: Dict[str, Any] = {}
+        for s in workers.values():
+            job = s.get("gen_job")
+            if not job:
+                continue
+            g = generation.setdefault(job, {
+                "workers": 0, "slots_busy": 0, "tokens": 0,
+                "kv_blocks_used": 0, "kv_pool_blocks": 0,
+                "prefix_hits": 0, "prefix_misses": 0,
+                "prefix_hit_tokens": 0,
+            })
+            g["workers"] += 1
+            g["slots_busy"] += int(s.get("gen_slots_busy", 0))
+            g["tokens"] += int(s.get("gen_tokens", 0))
+            g["kv_blocks_used"] += int(s.get("gen_kv_blocks_used", 0))
+            g["kv_pool_blocks"] += int(s.get("gen_kv_pool_blocks", 0))
+            g["prefix_hits"] += int(s.get("gen_prefix_hits", 0))
+            g["prefix_misses"] += int(s.get("gen_prefix_misses", 0))
+            g["prefix_hit_tokens"] += int(
+                s.get("gen_prefix_hit_tokens", 0))
+        for g in generation.values():
+            admitted = g["prefix_hits"] + g["prefix_misses"]
+            g["prefix_hit_rate"] = (
+                round(g["prefix_hits"] / admitted, 3) if admitted
+                else None)
         # training-plane fault picture (docs/failure-model.md,
         # "Training-plane faults"): per-job fault-kind counters and
         # absorbed retries from the STORE (covers every placement mode),
@@ -1238,6 +1267,9 @@ class Admin:
                 # (weighted fair admission, RAFIKI_AUTOSCALE_FAIR)
                 "fair_shares": self._predict_admission.fair_shares(),
                 "workers": workers,
+                # per-job generative picture: paged-KV pool footprint +
+                # prefix-cache hit rates (worker/kv_paging.py)
+                "generation": generation,
             },
             "training": {
                 "jobs": train_jobs,
@@ -1312,11 +1344,21 @@ class Admin:
                         "queries": int(payload.get("queries", 0)),
                         # overload counters ride the same event when the
                         # worker's queue exposes them (queue_depth gauge,
-                        # expired/shed totals)
+                        # expired/shed totals); paged-KV generation
+                        # workers add the block-pool + prefix-cache
+                        # picture fleet health aggregates per job
                         **{k: int(payload[k])
                            for k in ("queue_depth", "expired", "shed",
-                                     "gen_slots_busy", "gen_slots_max")
+                                     "gen_slots_busy", "gen_slots_max",
+                                     "gen_kv_blocks_used",
+                                     "gen_kv_pool_blocks",
+                                     "gen_kv_block_tokens",
+                                     "gen_prefix_hits",
+                                     "gen_prefix_misses",
+                                     "gen_prefix_hit_tokens")
                            if k in payload},
+                        **({"gen_job": str(payload["gen_job"])}
+                           if "gen_job" in payload else {}),
                     }
                     self._remote_serving_stats.move_to_end(sid)
                     while (len(self._remote_serving_stats)
@@ -1327,16 +1369,27 @@ class Admin:
                     # THIS process's registry; a process-placed
                     # generation worker's occupancy reaches it through
                     # this relay (in-process workers record the ring
-                    # directly — same name, so the reader can't tell)
+                    # directly — same name, so the reader can't tell).
+                    # Under the paged layout the binding resource is the
+                    # BLOCK POOL, so its fraction is the signal; ring
+                    # workers keep reporting busy slots.
                     worker_row = self.db.get_inference_job_worker(sid)
-                    slots_max = max(int(payload.get("gen_slots_max", 1)), 1)
+                    if "gen_kv_pool_blocks" in payload:
+                        pool = max(int(payload["gen_kv_pool_blocks"]), 1)
+                        occupancy = int(
+                            payload.get("gen_kv_blocks_used", 0)) / pool
+                    else:
+                        slots_max = max(
+                            int(payload.get("gen_slots_max", 1)), 1)
+                        occupancy = int(
+                            payload["gen_slots_busy"]) / slots_max
                     if worker_row is not None:
                         from rafiki_tpu.utils.metrics import REGISTRY
 
                         REGISTRY.ring(
                             "slot_occupancy:job:"
                             f"{worker_row['inference_job_id']}").record(
-                            int(payload["gen_slots_busy"]) / slots_max)
+                            occupancy)
         except Exception:
             logger.exception("event %s failed", name)
 

@@ -226,10 +226,14 @@ class Autoscaler:
         live = self._services.live_inference_workers(job_id)
         n_live = len(live)
         # generative jobs (worker/generation.py): queue depth alone
-        # under-reads their load — admitted streams occupy SLOTS for
-        # hundreds of decode steps while the queue sits near empty. The
-        # workers publish a per-job occupancy ring (busy/max fraction);
-        # a sustained-full slot table is the generation-plane overload
+        # under-reads their load — admitted streams occupy decode memory
+        # for hundreds of steps while the queue sits near empty. The
+        # workers publish a per-job occupancy ring (fraction of the
+        # BINDING resource: KV-pool blocks under the paged allocator,
+        # busy slots under the legacy ring — a few long streams can
+        # exhaust the pool with the slot table half empty, so block
+        # occupancy is what predicts the next admission stalling);
+        # sustained-high occupancy is the generation-plane overload
         # signal, symmetric with backlog depth for the one-shot plane.
         wall_now = time.time()
         occ = [v for t, v in

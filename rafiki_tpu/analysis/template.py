@@ -601,6 +601,16 @@ GENERATION_SIGNATURES = {
     "decode_step": 4,     # (self, cache, ids, positions)
 }
 
+#: OPTIONAL paged-allocator refinement (sdk/model.py
+#: GENERATION_PAGED_METHODS): arity-checked only when the template
+#: overrides them — absence just means the worker serves the legacy ring
+PAGED_GENERATION_SIGNATURES = {
+    "init_paged_kv_cache": 3,  # (self, pool_blocks, block_tokens)
+    "paged_prefill": 5,        # (self, cache, block_table, ids, start)
+    "paged_decode_step": 5,    # (self, cache, ids, positions, tables)
+    "kv_copy_blocks": 4,       # (self, cache, src, dst)
+}
+
 
 def _check_generation(
         report: VerificationReport, target: ast.ClassDef,
@@ -649,7 +659,11 @@ def _check_generation(
                    "uploaded under task TEXT_GENERATION", WARN, filename,
                    lineno)
         return None
-    for mname, n_args in GENERATION_SIGNATURES.items():
+    to_check = dict(GENERATION_SIGNATURES)
+    # the paged refinement is opt-in: only overridden methods are checked
+    to_check.update({m: n for m, n in PAGED_GENERATION_SIGNATURES.items()
+                     if m in methods})
+    for mname, n_args in to_check.items():
         fn = methods[mname]
         if fn.args.vararg is not None:
             continue  # *args swallows anything the worker passes

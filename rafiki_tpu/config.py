@@ -245,7 +245,39 @@ _DYNAMIC_PATHS = {
     #                                   autoscaler window that reads
     #                                   "generation slots saturated" and
     #                                   scales the job up
+    #   RAFIKI_GEN_KV_PAGED=1           0 = legacy contiguous ring per
+    #                                   slot (the A/B baseline); 1 = the
+    #                                   block/paged KV allocator for
+    #                                   templates that advertise the
+    #                                   paged methods (worker/kv_paging)
+    #   RAFIKI_GEN_KV_BLOCK_TOKENS=16   K/V rows per pool page — the
+    #                                   paging granularity (doctor WARNs
+    #                                   on degenerate sizes)
+    #   RAFIKI_GEN_KV_POOL_BLOCKS=0     pages in the pool; 0 = auto-size
+    #                                   to the legacy ring's capacity
+    #                                   (slots x ceil(max_context/block))
+    #                                   so paged-vs-ring A/B runs at
+    #                                   equal KV memory
+    #   RAFIKI_GEN_PREFIX_CACHE=1       0 = never share prompt-prefix
+    #                                   blocks across streams (hit/miss
+    #                                   counters and the doctor surface a
+    #                                   disabled cache under shared-
+    #                                   prefix traffic)
+    #   RAFIKI_GEN_PREFILL_CHUNK=64     prompt tokens ingested per
+    #                                   scheduler round (paged path): a
+    #                                   long-prompt join interleaves with
+    #                                   decode rounds instead of stalling
+    #                                   resident streams (0 = one-shot
+    #                                   prefill)
     "GEN_MAX_SLOTS": lambda: _env_int("RAFIKI_GEN_MAX_SLOTS", 8),
+    "GEN_KV_PAGED": lambda: os.environ.get(
+        "RAFIKI_GEN_KV_PAGED", "1") != "0",
+    "GEN_KV_BLOCK_TOKENS": lambda: _env_int(
+        "RAFIKI_GEN_KV_BLOCK_TOKENS", 16),
+    "GEN_KV_POOL_BLOCKS": lambda: _env_int("RAFIKI_GEN_KV_POOL_BLOCKS", 0),
+    "GEN_PREFIX_CACHE": lambda: os.environ.get(
+        "RAFIKI_GEN_PREFIX_CACHE", "1") != "0",
+    "GEN_PREFILL_CHUNK": lambda: _env_int("RAFIKI_GEN_PREFILL_CHUNK", 64),
     "GEN_MAX_TOKENS": lambda: _env_int("RAFIKI_GEN_MAX_TOKENS", 64),
     "GEN_STREAM_TIMEOUT_S": lambda: _env_float(
         "RAFIKI_GEN_STREAM_TIMEOUT_S", 10.0),

@@ -627,15 +627,27 @@ def check_int8_serving() -> Check:
             "docs/performance.md explains when int8 can still win")
 
 
+#: paged-KV pool capacity (block_tokens x pool_blocks, in tokens) past
+#: which the doctor reads "this pool will not fit beside the model in
+#: chip HBM" — the paged twin of the ~64-slot ring heuristic (64 slots of
+#: a 4k context).
+PAGED_POOL_TOKEN_HEURISTIC = 64 * 4096
+
+
 def check_generative_serving() -> Check:
     """Generative serving (docs/serving-generation.md): WARN when the
     slot table is misconfigured against the chip-memory heuristic (every
     slot preallocates a max_context-long KV ring — slots x context is the
     cache's token capacity, and past ~64 slots a worker is trading HBM
-    for queueing the door could do better), when the stall detector is
-    disabled, and when live TEXT_GENERATION jobs have no reachable
-    streaming door (the chunked /generate route only exists on the
-    dedicated per-job predictor port)."""
+    for queueing the door could do better), when the PAGED layout is
+    degenerate (block size < 8 amplifies table/gather overhead, past the
+    2048-token ceiling a "page" is bigger than most contexts and paging
+    buys nothing) or its pool capacity exceeds the chip-memory heuristic,
+    when the prefix cache is disabled while the shareable-prefix counter
+    shows shared-prompt traffic, when the stall detector is disabled, and
+    when live TEXT_GENERATION jobs have no reachable streaming door (the
+    chunked /generate route only exists on the dedicated per-job
+    predictor port)."""
     from rafiki_tpu import config
 
     notes = []
@@ -652,6 +664,42 @@ def check_generative_serving() -> Check:
             "(~64): each slot preallocates a full max_context KV ring in "
             "HBM and decode advances EVERY slot each step — prefer more "
             "replicas over a wider table")
+    block_tokens = int(config.GEN_KV_BLOCK_TOKENS)
+    pool_blocks = int(config.GEN_KV_POOL_BLOCKS)
+    if bool(config.GEN_KV_PAGED):
+        if block_tokens < 8 or block_tokens > 2048:
+            warn = True
+            notes.append(
+                f"RAFIKI_GEN_KV_BLOCK_TOKENS={block_tokens} is degenerate "
+                "(sane range 8..2048): tiny pages spend the pool on block-"
+                "table overhead, giant pages degrade to one-ring-per-slot")
+        if pool_blocks and block_tokens * pool_blocks \
+                > PAGED_POOL_TOKEN_HEURISTIC:
+            warn = True
+            notes.append(
+                f"RAFIKI_GEN_KV_BLOCK_TOKENS={block_tokens} x "
+                f"RAFIKI_GEN_KV_POOL_BLOCKS={pool_blocks} = "
+                f"{block_tokens * pool_blocks} tokens of K/V exceeds the "
+                f"chip-memory heuristic ({PAGED_POOL_TOKEN_HEURISTIC}): "
+                "the pool competes with the model for HBM — prefer more "
+                "replicas over a deeper pool")
+        if not bool(config.GEN_PREFIX_CACHE):
+            try:
+                from rafiki_tpu.utils.metrics import REGISTRY
+
+                shareable = REGISTRY.get(
+                    "rafiki_gen_prefix_shareable_total")
+                shared_n = shareable.value() if shareable else 0
+            # lint: absorb(telemetry probe is best-effort inside a doctor check)
+            except Exception:
+                shared_n = 0
+            if shared_n > 0:
+                warn = True
+                notes.append(
+                    f"RAFIKI_GEN_PREFIX_CACHE=0 while "
+                    f"{int(shared_n)} admissions shared a prompt prefix "
+                    "(rafiki_gen_prefix_shareable_total): these streams "
+                    "are re-paying prefill the cache would serve free")
     if float(config.GEN_STREAM_TIMEOUT_S) <= 0:
         warn = True
         notes.append("RAFIKI_GEN_STREAM_TIMEOUT_S<=0: the door clamps "
@@ -711,6 +759,15 @@ def check_generative_serving() -> Check:
     detail = (f"{slots} slots/worker, max {int(config.GEN_MAX_TOKENS)} "
               f"tokens/request, stall cutoff "
               f"{float(config.GEN_STREAM_TIMEOUT_S):g}s")
+    if bool(config.GEN_KV_PAGED):
+        detail += (f"; paged KV: {block_tokens}-token blocks, pool "
+                   + (f"{pool_blocks} blocks" if pool_blocks
+                      else "auto-sized (ring parity)")
+                   + (", prefix cache on"
+                      if bool(config.GEN_PREFIX_CACHE)
+                      else ", prefix cache OFF"))
+    else:
+        detail += "; paged KV OFF (legacy contiguous ring)"
     if gen_jobs:
         detail += (f"; {gen_jobs} live generation job(s), doors: "
                    + (", ".join(doors) or "none"))

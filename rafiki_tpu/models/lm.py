@@ -226,6 +226,150 @@ def decode_step(params: Params, cache: Cache, ids: jax.Array,
     return logits[:, 0], {"k": ck, "v": cv}
 
 
+# -- paged KV cache (block-granular decode memory) ---------------------------
+#
+# The contiguous ring above preallocates ``max_slots x max_len`` K/V rows
+# whatever the actual sequence lengths are — HBM cost is worst-case, which
+# caps co-resident streams. The paged layout (PagedAttention, vLLM) keeps
+# one flat POOL of fixed-size blocks (``block_tokens`` K/V rows each) plus a
+# per-slot BLOCK TABLE mapping logical positions to physical blocks, so a
+# slot only holds blocks for tokens it has actually written — and blocks
+# whose contents are a shared prompt prefix can appear in many tables at
+# once (the worker-side allocator, worker/kv_paging.py, owns refcounts and
+# copy-on-write; this layer is pure array math).
+#
+# Shapes stay fixed: every forward gathers the slot's logical view
+# ``(depth, B, table_blocks*block_tokens, H, Dh)`` from the pool through
+# the table, runs the SAME ``_cached_forward`` as the ring path (so paged
+# outputs are bit-identical given the same logical contents), then scatters
+# ONLY the newly-written rows back. Sentinel table entries (>= pool size)
+# gather clipped garbage that the causal mask keeps out of every real
+# query, and their writes are dropped (`mode="drop"`), so idle slots and
+# bucket padding never touch a live block.
+
+def init_paged_kv_cache(cfg: LMConfig, pool_blocks: int, block_tokens: int,
+                        dtype=jnp.float32) -> Cache:
+    """Preallocate the paged decode pool: per-layer K/V of shape
+    ``(depth, pool_blocks, block_tokens, heads, head_dim)``. Same MoE
+    refusal as the ring cache — the fixed-shape decode program cannot
+    carry per-token dispatch state."""
+    if cfg.encoder.moe_experts > 0:
+        raise ValueError(
+            "KV-cached decode supports dense blocks only (moe_experts=0): "
+            "MoE top-k routing is per-token and the fixed-shape decode "
+            "program cannot carry its dispatch state in the cache")
+    enc = cfg.encoder
+    shape = (enc.depth, int(pool_blocks), int(block_tokens), enc.heads,
+             enc.dim // enc.heads)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_pool_blocks(cache: Cache) -> int:
+    return int(cache["k"].shape[1])
+
+
+def paged_block_tokens(cache: Cache) -> int:
+    return int(cache["k"].shape[2])
+
+
+def paged_pool_bytes(cache: Cache) -> int:
+    """Persistent HBM the pool holds (both K and V planes)."""
+    return int(cache["k"].nbytes + cache["v"].nbytes)
+
+
+def _paged_view(plane: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """Gather logical per-slot views from the pool: ``plane`` is
+    (depth, NBpool, BT, H, Dh), ``block_tables`` (B, NB) int32 ->
+    (depth, B, NB*BT, H, Dh). Out-of-range (sentinel) entries clip to the
+    last pool block — finite garbage the mask excludes."""
+    depth = plane.shape[0]
+    b, nb = block_tables.shape
+    bt, h, dh = plane.shape[2], plane.shape[3], plane.shape[4]
+    flat = jnp.take(plane, block_tables.reshape(-1), axis=1, mode="clip")
+    return flat.reshape(depth, b, nb, bt, h, dh).reshape(
+        depth, b, nb * bt, h, dh)
+
+
+def _scatter_rows(plane: jax.Array, new_view: jax.Array,
+                  block_tables: jax.Array, positions: jax.Array
+                  ) -> jax.Array:
+    """Write the view rows at ``positions`` back into the pool.
+
+    ``new_view``: (depth, B, L, H, Dh) updated logical views;
+    ``positions``: (B, T) logical indices that were written this call.
+    Rows mapping through a sentinel table entry (or past the table) are
+    dropped — never clamped onto a live block."""
+    nbpool = plane.shape[1]
+    bt = plane.shape[2]
+    b, t = positions.shape
+    nb = block_tables.shape[1]
+    limit = nb * bt
+    blk_ix = jnp.clip(positions // bt, 0, nb - 1)               # (B, T)
+    phys = jnp.take_along_axis(block_tables, blk_ix, axis=1)    # (B, T)
+    phys = jnp.where(positions < limit, phys, nbpool)           # drop pads
+    off = positions % bt
+    # rows being written: (depth, B, T, H, Dh)
+    vals = jnp.take_along_axis(
+        new_view, positions[None, :, :, None, None], axis=2)
+    return plane.at[:, phys, off].set(vals, mode="drop")
+
+
+def paged_prefill(params: Params, cache: Cache, block_table: jax.Array,
+                  ids: jax.Array, start: jax.Array, length: jax.Array,
+                  cfg: LMConfig) -> Tuple[jax.Array, Cache]:
+    """Ingest (a chunk of) one slot's prompt at logical positions
+    ``start .. start+T-1``. ``block_table``: (NB,) int32 physical blocks
+    covering the slot's logical space (sentinel entries for unallocated
+    tails); ``ids``: (T,) suffix tokens right-padded to a bucket;
+    ``length`` the true token count of this chunk. Returns
+    (logits (V,) at the chunk's last REAL position, cache) — for
+    intermediate chunks of a chunked prefill the caller ignores the
+    logits; the final chunk's logits yield the first generated token."""
+    ids = jnp.asarray(ids, jnp.int32)[None]                      # (1, T)
+    t = ids.shape[1]
+    start = jnp.asarray(start, jnp.int32)
+    positions = (start + jnp.arange(t, dtype=jnp.int32))[None]   # (1, T)
+    bt2 = jnp.asarray(block_table, jnp.int32)[None]              # (1, NB)
+    vk = _paged_view(cache["k"], bt2)
+    vv = _paged_view(cache["v"], bt2)
+    logits, ck, cv = _cached_forward(params, vk, vv, ids, positions, cfg)
+    cache = {"k": _scatter_rows(cache["k"], ck, bt2, positions),
+             "v": _scatter_rows(cache["v"], cv, bt2, positions)}
+    last = jnp.asarray(length, jnp.int32) - 1
+    return logits[0, last], cache
+
+
+def paged_decode_step(params: Params, cache: Cache, ids: jax.Array,
+                      positions: jax.Array, block_tables: jax.Array,
+                      cfg: LMConfig) -> Tuple[jax.Array, Cache]:
+    """Advance every slot one token against the pool: ``ids``/``positions``
+    (S,) int32, ``block_tables`` (S, NB) int32. Fixed shapes — one jitted
+    program serves the pool's whole lifetime; idle slots carry all-sentinel
+    table rows so their writes are dropped and their (ignored) outputs read
+    only clipped garbage."""
+    ids = jnp.asarray(ids, jnp.int32)[:, None]                   # (S, 1)
+    positions2 = jnp.asarray(positions, jnp.int32)[:, None]
+    bts = jnp.asarray(block_tables, jnp.int32)
+    vk = _paged_view(cache["k"], bts)
+    vv = _paged_view(cache["v"], bts)
+    logits, ck, cv = _cached_forward(params, vk, vv, ids, positions2, cfg)
+    cache = {"k": _scatter_rows(cache["k"], ck, bts, positions2),
+             "v": _scatter_rows(cache["v"], cv, bts, positions2)}
+    return logits[:, 0], cache
+
+
+def copy_kv_blocks(cache: Cache, src: jax.Array, dst: jax.Array) -> Cache:
+    """Copy whole pool blocks ``src[i] -> dst[i]`` (both (M,) int32) — the
+    allocator's copy-on-write primitive. dst blocks are always private to
+    one slot, so indices never collide."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return {"k": cache["k"].at[:, dst].set(
+                jnp.take(cache["k"], src, axis=1)),
+            "v": cache["v"].at[:, dst].set(
+                jnp.take(cache["v"], src, axis=1))}
+
+
 def greedy_token(logits: jax.Array) -> jax.Array:
     """argmax over the vocab axis — the default (deterministic) sampler."""
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)

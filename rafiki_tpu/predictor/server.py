@@ -37,6 +37,22 @@ from rafiki_tpu.utils.reqfields import LowLatencyHandler
 logger = logging.getLogger(__name__)
 
 
+def _generate_cost(prompt_len: int, max_tokens: int) -> int:
+    """Admission cost of one /generate request, in the units of the
+    resource that actually gates the generation worker: KV-pool BLOCKS
+    under the paged allocator (ceil((prompt + decode budget) / block
+    tokens) — a long prompt holds pages even while producing few tokens,
+    so prompt length must charge), or the decode budget itself under the
+    legacy contiguous ring (every slot costs max_context there, so only
+    residency TIME differentiates requests)."""
+    from rafiki_tpu import config as _config
+
+    if bool(_config.GEN_KV_PAGED):
+        bt = max(int(_config.GEN_KV_BLOCK_TOKENS), 1)
+        return max(-(-(prompt_len + max_tokens) // bt), 1)
+    return max(max_tokens, 1)
+
+
 class PredictorServer:
     """One jsonified POST /predict + GET /healthz listener over one
     Predictor (predictor/predictor.py).
@@ -366,10 +382,13 @@ class PredictorServer:
         lines by default, or length-prefixed v3 wire token-delta frames
         when the client sent ``Accept: application/x-rafiki-wire``
         (binary peers OPT IN — an old client never sees the new message
-        kind). Admission charges the request its ESTIMATED DECODE COST
-        (``max_tokens``), not 1: a 256-token stream occupies a slot ~256
-        times longer than a one-shot predict, and the fairness/backlog
-        books must see that.
+        kind). Admission charges the request its ESTIMATED DECODE COST,
+        not 1 — see :func:`_generate_cost`: KV-pool BLOCKS under the
+        paged allocator (prompt + budget, the resource that actually
+        gates worker admission), ``max_tokens`` under the legacy ring.
+        Either way a 256-token stream occupies decode memory ~256 times
+        longer than a one-shot predict, and the fairness/backlog books
+        must see that.
 
         Fault contract: every pre-stream refusal is an ordinary status
         code (400/401/429/503/504); once streaming begins the status is
@@ -428,10 +447,14 @@ class PredictorServer:
                      "max_tokens": max_tokens}
             backlog_fn = getattr(self.predictor, "backlog_depth", None)
             backlog = backlog_fn() if callable(backlog_fn) else None
-            # cost = the decode budget, not 1 (see docstring)
+            # cost = the estimated decode footprint, not 1 (see docstring)
+            prompt_ids = body.get("prompt_ids")
+            prompt_len = (len(prompt_ids)
+                          if isinstance(prompt_ids, (list, tuple)) else 0)
             self.admission.admit(timeout_s, backlog_depth=backlog,
                                  tenant=self.app,
-                                 cost=max(max_tokens, 1))
+                                 cost=_generate_cost(prompt_len,
+                                                     max_tokens))
             held[0] = True
             t0 = time.monotonic()
             stream = self.predictor.generate(query, timeout_s=timeout_s)

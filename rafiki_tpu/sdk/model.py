@@ -237,6 +237,44 @@ class BaseModel(abc.ABC):
         ignored). Returns ``(next_token_ids, cache)``."""
         raise NotImplementedError
 
+    # -- paged decode memory (opt-in refinement of the generation contract)
+
+    def init_paged_kv_cache(self, pool_blocks: int,
+                            block_tokens: int) -> Any:
+        """Preallocate a BLOCK-POOL decode cache: ``pool_blocks`` pages of
+        ``block_tokens`` K/V rows each, instead of one contiguous ring per
+        slot. Templates that also override the three ``paged_*`` methods
+        below serve under the paged allocator (worker/kv_paging.py) —
+        co-resident streams are then bound by *used* tokens, not
+        ``slots x max_context`` — and gain shared-prefix caching and
+        chunked prefill for free. Templates without them keep the ring
+        path unchanged."""
+        raise NotImplementedError
+
+    def paged_prefill(self, cache: Any, block_table: Any,
+                      prompt_ids: List[int], start: int
+                      ) -> Tuple[int, Any]:
+        """Ingest prompt tokens at logical positions ``start ..
+        start + len(prompt_ids) - 1`` of the slot whose physical pages
+        are ``block_table`` (int32, fixed width, sentinel = pool size for
+        unallocated entries). Returns ``(next_token_id, cache)`` — the
+        token is only meaningful when this call covered the prompt's last
+        position (chunked prefill ignores intermediate returns)."""
+        raise NotImplementedError
+
+    def paged_decode_step(self, cache: Any, ids: Any, positions: Any,
+                          block_tables: Any) -> Tuple[Any, Any]:
+        """One token for EVERY slot against the block pool:
+        ``block_tables`` is (max_slots, table_blocks) int32 (idle slots
+        carry all-sentinel rows). Same fixed-shape/one-program contract
+        as ``decode_step``."""
+        raise NotImplementedError
+
+    def kv_copy_blocks(self, cache: Any, src: Any, dst: Any) -> Any:
+        """Copy whole pool pages ``src[i] -> dst[i]`` — the allocator's
+        copy-on-write primitive (models/lm.py ``copy_kv_blocks``)."""
+        raise NotImplementedError
+
     def ensemble_stack(self, models: List["BaseModel"]) -> Optional[Any]:
         """Optional fused-ensemble serving hook (budget ``ENSEMBLE_FUSED``).
 
@@ -304,6 +342,27 @@ def generation_capability(clazz: type) -> Optional[GenerationSpec]:
             logging.getLogger(__name__).warning(
                 "%s declares generation_spec but does not override %s(); "
                 "template is NOT generation-capable", clazz.__name__, name)
+            return None
+    return spec
+
+
+#: the additional methods a template must override to serve under the
+#: paged KV allocator (block pool + prefix cache + chunked prefill)
+GENERATION_PAGED_METHODS = ("init_paged_kv_cache", "paged_prefill",
+                            "paged_decode_step", "kv_copy_blocks")
+
+
+def paged_generation_capability(clazz: type) -> Optional[GenerationSpec]:
+    """The template's :class:`GenerationSpec` iff it is paged-capable:
+    the full base generation contract PLUS all four paged methods
+    overridden. None degrades the worker to the contiguous-ring path —
+    a safe fallback (unlike the base contract, where None is a typed
+    deploy error), surfaced by the doctor's generative-serving check."""
+    spec = generation_capability(clazz)
+    if spec is None:
+        return None
+    for name in GENERATION_PAGED_METHODS:
+        if getattr(clazz, name, None) is getattr(BaseModel, name):
             return None
     return spec
 

@@ -19,26 +19,58 @@ top of the platform's existing data plane:
   deadline, client cancel, injected fault — freeing their slot to the next
   queued request without stalling co-resident sequences.
 
+Decode memory comes in two layouts. Templates that implement only the
+base generation contract get the **contiguous ring**: one
+``max_context``-long K/V ring per slot, simple but worst-case-sized.
+Templates that also implement the paged methods (sdk/model.py
+``GENERATION_PAGED_METHODS``) serve under the **paged KV allocator**
+(worker/kv_paging.py, ``RAFIKI_GEN_KV_PAGED``): a fixed pool of
+``RAFIKI_GEN_KV_BLOCK_TOKENS``-sized pages plus per-slot block tables, so
+resident streams are bound by *used* tokens rather than
+``slots x max_context``. The paged path adds three levers the ring cannot
+offer:
+
+- **shared prefix cache** (``RAFIKI_GEN_PREFIX_CACHE``): prompt-prefix
+  blocks are content-hashed, refcounted, and mapped read-only into later
+  streams — N streams sharing a system prompt pay prefill once, with
+  copy-on-write protecting the partial tail block when streams diverge;
+- **chunked prefill** (``RAFIKI_GEN_PREFILL_CHUNK``): a long-prompt join
+  is ingested a chunk per scheduler round, interleaved with decode
+  rounds, so resident streams' inter-token latency never stalls behind
+  one giant prompt;
+- **preempt-don't-crash**: pool exhaustion preempts the youngest stream
+  (blocks freed, the stream transparently re-queued and later resumed
+  from a fresh prefill of its tokens-so-far — greedy decode makes the
+  continuation exact) instead of failing a round.
+
 Observability: time-to-first-token and inter-token-latency histograms,
 a slot-occupancy gauge + per-job ring (the autoscaler's generative
-backlog signal), eviction counters by reason, and the shared
-SERVING_STATS row every stats surface already reads.
+backlog signal — BLOCK-pool occupancy under the paged layout, busy
+slots under the ring), prefix hit/miss/evict + COW + preemption
+counters, eviction counters by reason, and the shared SERVING_STATS row
+every stats surface already reads.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import threading
 import time
 import traceback
 import uuid
-from typing import Any, Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from rafiki_tpu import config
 from rafiki_tpu.cache.queue import TokenStream
-from rafiki_tpu.sdk.model import GenerationSpec, generation_capability
+from rafiki_tpu.sdk.model import (
+    GenerationSpec,
+    generation_capability,
+    paged_generation_capability,
+)
 from rafiki_tpu.utils import chaos
 from rafiki_tpu.worker.inference import (
     InferenceWorker,
@@ -46,6 +78,7 @@ from rafiki_tpu.worker.inference import (
     _record_queue,
     _stats_lock,
 )
+from rafiki_tpu.worker.kv_paging import PagedKVAllocator
 
 logger = logging.getLogger(__name__)
 
@@ -89,32 +122,100 @@ def _metrics():
                 "rafiki_gen_evictions_total",
                 "sequences leaving the slot table, by finish reason",
                 ("reason",)),
+            "kv_used": REGISTRY.gauge(
+                "rafiki_gen_kv_blocks_used",
+                "paged-KV pool blocks currently allocated", ("service",)),
+            "kv_pool": REGISTRY.gauge(
+                "rafiki_gen_kv_pool_blocks",
+                "paged-KV pool size in blocks", ("service",)),
+            "prefix_hits": REGISTRY.counter(
+                "rafiki_gen_prefix_hits_total",
+                "admissions that reused cached prompt-prefix blocks"),
+            "prefix_misses": REGISTRY.counter(
+                "rafiki_gen_prefix_misses_total",
+                "admissions that found no cached prefix"),
+            "prefix_tokens": REGISTRY.counter(
+                "rafiki_gen_prefix_tokens_total",
+                "prompt tokens served from the prefix cache instead of "
+                "prefill compute"),
+            "prefix_evictions": REGISTRY.counter(
+                "rafiki_gen_prefix_evictions_total",
+                "prefix-cache entries evicted (LRU, refcount back to "
+                "zero)"),
+            "prefix_shareable": REGISTRY.counter(
+                "rafiki_gen_prefix_shareable_total",
+                "admitted prompts whose leading tokens matched a "
+                "recently-seen prompt (shared-prefix traffic signal — "
+                "counted even while the prefix cache is disabled, so the "
+                "doctor can flag a disabled cache under shareable load)"),
+            "cow": REGISTRY.counter(
+                "rafiki_gen_kv_cow_copies_total",
+                "copy-on-write page copies (tail-block divergence)"),
+            "preempts": REGISTRY.counter(
+                "rafiki_gen_preemptions_total",
+                "streams preempted by pool exhaustion (blocks freed, "
+                "request re-queued and later resumed)"),
         }
     return _M
 
 
 _M = None
 
+#: leading-token window hashed for the shared-prefix-traffic signal
+_SHARE_PROBE_TOKENS = 16
+
 
 class _Slot:
     """One resident sequence's scheduler state."""
 
     __slots__ = ("stream", "last_id", "position", "produced", "max_tokens",
-                 "deadline", "muted", "last_step_t")
+                 "deadline", "muted", "last_step_t", "prompt", "tokens",
+                 "pending_from", "seq", "t0")
 
-    def __init__(self, stream: TokenStream, first_id: int, position: int,
-                 max_tokens: int, deadline: Optional[float]) -> None:
+    def __init__(self, stream: TokenStream, prompt: List[int],
+                 max_tokens: int, deadline: Optional[float], seq: int,
+                 produced: int = 0,
+                 pending_from: Optional[int] = None) -> None:
         self.stream = stream
-        self.last_id = first_id
-        self.position = position      # cache index the NEXT token lands at
-        self.produced = 1             # prefill emitted the first token
+        self.prompt = prompt          # full token history being prefilled
+        self.tokens: List[int] = []   # tokens produced SINCE (re)admission
+        self.last_id = 0
+        self.position = 0             # cache index the NEXT token lands at
+        self.produced = produced      # client-visible tokens so far
         self.max_tokens = max_tokens
         self.deadline = deadline
+        #: admission order — pool exhaustion preempts the YOUNGEST stream
+        self.seq = seq
+        #: next prompt index still to prefill (None = decoding)
+        self.pending_from = pending_from
+        #: admit time, for the TTFT observation (None after first token
+        #: or for preemption resumes — a resume is not a first token)
+        self.t0: Optional[float] = None
         #: chaos action=drop: the stalled-decode drill — the slot keeps
         #: its place but its deltas stop arriving; the DOOR's inter-token
         #: timeout must convert the silence into a typed error frame
         self.muted = False
         self.last_step_t = time.monotonic()
+
+
+class _Pending:
+    """A stream waiting for pool blocks: either a not-yet-admitted
+    request (``fut``/``query`` set) or a preempted resident stream being
+    resumed (``stream``/``prompt`` carry its full token history)."""
+
+    __slots__ = ("fut", "query", "stream", "prompt", "produced",
+                 "max_tokens", "deadline", "seq")
+
+    def __init__(self, seq: int, fut=None, query=None, stream=None,
+                 prompt=None, produced=0, max_tokens=0, deadline=None):
+        self.seq = seq
+        self.fut = fut
+        self.query = query
+        self.stream = stream
+        self.prompt = prompt
+        self.produced = produced
+        self.max_tokens = max_tokens
+        self.deadline = deadline
 
 
 class GenerationWorker(InferenceWorker):
@@ -138,7 +239,27 @@ class GenerationWorker(InferenceWorker):
                     "a fully-wired GenerationSpec (init_kv_cache/prefill/"
                     "decode_step) — it cannot serve TEXT_GENERATION")
             max_slots = max(int(config.GEN_MAX_SLOTS), 1)
-            cache = model.init_kv_cache(max_slots)
+            self._alloc: Optional[PagedKVAllocator] = None
+            self._chunk = 0
+            paged_spec = paged_generation_capability(type(model))
+            if bool(config.GEN_KV_PAGED) and paged_spec is not None:
+                block_tokens = max(int(config.GEN_KV_BLOCK_TOKENS), 1)
+                table_blocks = -(-int(spec.max_context) // block_tokens)
+                pool_blocks = (int(config.GEN_KV_POOL_BLOCKS)
+                               or max_slots * table_blocks)
+                self._alloc = PagedKVAllocator(
+                    pool_blocks, block_tokens, table_blocks,
+                    prefix_cache=bool(config.GEN_PREFIX_CACHE))
+                self._chunk = max(int(config.GEN_PREFILL_CHUNK), 0)
+                cache = model.init_paged_kv_cache(pool_blocks, block_tokens)
+                logger.info(
+                    "generation worker %s: paged KV (%d blocks x %d "
+                    "tokens, prefix cache %s, prefill chunk %d)",
+                    ctx.service_id, pool_blocks, block_tokens,
+                    "on" if self._alloc.prefix_cache else "off",
+                    self._chunk)
+            else:
+                cache = model.init_kv_cache(max_slots)
             try:
                 model.warm_up()
             except Exception:
@@ -156,14 +277,25 @@ class GenerationWorker(InferenceWorker):
             m = _metrics()
             # lint: thread-confined(only the serve thread writes and reads this; the reporter thread reads the _stats_lock'd module dict copy)
             self._tokens_emitted = 0
+            # lint: thread-confined(admission order counter — the serve thread is the only scheduler)
+            self._seq = 0
+            # lint: thread-confined(preempted/stashed continuations — only the serve thread admits, preempts, and resumes)
+            self._pending = []
+            self._recent_prefixes: "OrderedDict[str, bool]" = OrderedDict()
+            self._last_alloc_stats: Dict[str, int] = {}
             while not ctx.stopping:
                 n_active = sum(1 for s in slots if s is not None)
                 free = [i for i, s in enumerate(slots) if s is None]
-                # -- admit: pull queued requests into free slots ----------
-                if free and (n_active == 0 or queue.depth() > 0):
+                # -- admit: resumes first, then queued requests -----------
+                if free and self._pending:
+                    cache = self._readmit(model, spec, cache, slots, free,
+                                          ctx.service_id)
+                if free and (n_active == 0 or queue.depth() > 0) \
+                        and self._room_for_new():
                     batch = queue.take_batch(
                         max_size=len(free), deadline_s=0.0,
-                        wait_timeout_s=(0.25 if n_active == 0 else 0.0))
+                        wait_timeout_s=(0.25 if n_active == 0
+                                        and not self._pending else 0.0))
                     if batch is None:
                         logger.info("query queue closed; generation "
                                     "worker %s exiting", ctx.service_id)
@@ -173,14 +305,28 @@ class GenerationWorker(InferenceWorker):
                             model, spec, cache, slots, free, fut, query,
                             ctx.service_id)
                     _record_queue(ctx.service_id, queue)
+                # -- chunked prefill: one chunk per prefilling slot -------
+                if self._alloc is not None:
+                    cache = self._prefill_round(model, spec, cache, slots,
+                                                ctx)
                 n_active = sum(1 for s in slots if s is not None)
-                m["slots"].labels(ctx.service_id).set(n_active)
-                occupancy_ring.record(n_active / max_slots)
-                self._stats_row(ctx.service_id, n_active, max_slots)
-                if n_active == 0:
+                m["slots"].labels(ctx.service_id).set(
+                    sum(1 for s in slots
+                        if s is not None and s.pending_from is None))
+                self._mirror_alloc(ctx.service_id, m)
+                occupancy_ring.record(self._occupancy(slots, max_slots))
+                self._stats_row(ctx.service_id, slots, max_slots)
+                if n_active == 0 and not self._pending:
                     continue
                 # -- decode: one token for every resident sequence --------
-                cache = self._decode_round(model, spec, cache, slots, ctx)
+                if any(s is not None and s.pending_from is None
+                       for s in slots):
+                    cache = self._decode_round(model, spec, cache, slots,
+                                               ctx)
+                elif n_active == 0:
+                    # only stashed streams remain and nothing can run —
+                    # don't spin while the pool refills
+                    time.sleep(0.005)
         finally:
             self._broker.unregister_worker(self._job_id, ctx.service_id)
             if model is not None:
@@ -189,13 +335,27 @@ class GenerationWorker(InferenceWorker):
 
     # -- admission -----------------------------------------------------------
 
+    def _room_for_new(self) -> bool:
+        """Gate NEW queue pulls under the paged allocator: stashed
+        streams resume first, and an effectively-dry pool admits no one
+        (churning admissions straight into preemption helps nobody)."""
+        if self._alloc is None:
+            return True
+        if self._pending:
+            return False
+        return (self._alloc.free_blocks()
+                + self._alloc.evictable_blocks()) >= 2
+
     def _admit(self, model, spec: GenerationSpec, cache,
                slots: List[Optional[_Slot]], free: List[int], fut, query,
-               service_id: str):
+               service_id: str, seq: Optional[int] = None):
         """Prefill one queued request into a free slot and hand its
         TokenStream back through the request's future. A malformed
         request fails ITS future (typed, -> 400 at the door) and costs no
-        slot; a prefill crash likewise never kills co-resident slots."""
+        slot; a prefill crash likewise never kills co-resident slots.
+        ``seq`` re-admits a stashed request under its ORIGINAL admission
+        order — minting a fresh one would make the oldest waiter the
+        youngest resident and the first preemption victim (starvation)."""
         try:
             prompt, max_tokens, max_duration_s = self._parse_query(query)
         except GenerationRequestError as e:
@@ -214,6 +374,22 @@ class GenerationWorker(InferenceWorker):
                 f"({max_tokens}) exceeds the template's max_context "
                 f"({spec.max_context})"))
             return cache
+        self._note_shareable(prompt)
+        deadline = (time.monotonic() + max_duration_s
+                    if max_duration_s else None)
+        if self._alloc is not None:
+            if self._alloc.blocks_for(len(prompt) + 1) \
+                    > self._alloc.pool_blocks:
+                fut.set_error(GenerationRequestError(
+                    f"prompt ({len(prompt)} tokens) cannot fit the KV "
+                    f"pool ({self._alloc.pool_blocks} blocks x "
+                    f"{self._alloc.block_tokens} tokens) — raise "
+                    "RAFIKI_GEN_KV_POOL_BLOCKS"))
+                return cache
+            return self._admit_paged(model, spec, cache, slots, free, fut,
+                                     prompt, max_tokens, deadline,
+                                     service_id, seq=seq)
+        # -- contiguous-ring path -------------------------------------------
         slot_ix = free.pop(0)
         t0 = time.monotonic()
         try:
@@ -226,9 +402,11 @@ class GenerationWorker(InferenceWorker):
             return cache
         first_id = int(first_id)
         stream = TokenStream(seq_id=uuid.uuid4().hex[:12])
-        deadline = (time.monotonic() + max_duration_s
-                    if max_duration_s else None)
-        slot = _Slot(stream, first_id, len(prompt), max_tokens, deadline)
+        slot = _Slot(stream, list(prompt), max_tokens, deadline,
+                     self._next_seq() if seq is None else seq, produced=1)
+        slot.last_id = first_id
+        slot.position = len(prompt)
+        slot.tokens.append(first_id)
         slots[slot_ix] = slot
         fut.set_result(stream)
         from rafiki_tpu.worker.inference import _record_batch
@@ -242,6 +420,432 @@ class GenerationWorker(InferenceWorker):
         if finished:
             self._evict(slots, slot_ix, reason)
         return cache
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _note_shareable(self, prompt: List[int]) -> None:
+        """Record shared-prefix traffic whether or not the cache is on —
+        the doctor's disabled-cache-under-shareable-load signal."""
+        probe = tuple(prompt[:_SHARE_PROBE_TOKENS])
+        if len(probe) < 2:
+            return
+        d = hashlib.sha1(np.asarray(probe, np.int64).tobytes()).hexdigest()
+        lru = self._recent_prefixes
+        if d in lru:
+            lru.move_to_end(d)
+            _metrics()["prefix_shareable"].inc()
+            return
+        lru[d] = True
+        while len(lru) > 512:
+            lru.popitem(last=False)
+
+    # -- paged admission / prefill -------------------------------------------
+
+    def _admit_paged(self, model, spec, cache, slots, free, fut, prompt,
+                     max_tokens, deadline, service_id, seq=None):
+        """Open a block table for the prompt (mapping any cached prefix),
+        run the FIRST prefill chunk synchronously, and resolve the
+        request's future. Remaining chunks (long prompts) advance one per
+        scheduler round so resident streams keep decoding in between. A
+        pool too full for even the first chunk stashes the request — it
+        is the youngest stream, so IT waits, not the residents."""
+        slot_ix = free.pop(0)
+        slot = _Slot(TokenStream(seq_id=uuid.uuid4().hex[:12]),
+                     list(prompt), max_tokens, deadline,
+                     self._next_seq() if seq is None else seq)
+        plan = self._alloc.open_slot(slot_ix, prompt)
+        slot.pending_from = plan.cached_tokens
+        slot.position = plan.cached_tokens
+        slot.t0 = time.monotonic()
+        slots[slot_ix] = slot  # before _try_chunk: a same-call finish
+        # (tiny prompt hitting EOS on its first token) evicts through the
+        # normal path
+        try:
+            if plan.copies:
+                cache = self._apply_copies(model, cache, plan.copies)
+            n = len(prompt)
+            end = n if self._chunk <= 0 else min(n, plan.cached_tokens
+                                                 + self._chunk)
+            ok, cache = self._try_chunk(model, spec, cache, slots, slot_ix,
+                                        slot, end)
+            if not ok:
+                # pool dry: stash the request un-admitted (future intact)
+                slots[slot_ix] = None
+                self._alloc.close_slot(slot_ix)
+                free.insert(0, slot_ix)
+                self._stash(_Pending(
+                    slot.seq, fut=fut,
+                    query={"prompt_ids": prompt, "max_tokens": max_tokens,
+                           "max_duration_s": None},
+                    deadline=deadline))
+                return cache
+        except Exception as e:
+            slots[slot_ix] = None
+            self._alloc.close_slot(slot_ix)
+            free.insert(0, slot_ix)
+            logger.error("prefill failed in generation worker %s:\n%s",
+                         service_id, traceback.format_exc())
+            fut.set_error(RuntimeError(f"prefill failed: {e}"))
+            return cache
+        fut.set_result(slot.stream)
+        from rafiki_tpu.worker.inference import _record_batch
+
+        _record_batch(service_id, 1)
+        return cache
+
+    def _readmit(self, model, spec, cache, slots, free, service_id):
+        """Resume stashed streams (oldest first): preempted residents
+        re-prefill their full token history — greedy decode makes the
+        continuation exact — and not-yet-admitted requests go through
+        the normal paged admission."""
+        while free and self._pending:
+            entry = self._pending[0]
+            if not self._room_for_resume(entry):
+                break
+            self._pending.pop(0)
+            now = time.monotonic()
+            if entry.deadline is not None and now >= entry.deadline:
+                if entry.stream is not None:
+                    entry.stream.push([], finished=True, reason="deadline")
+                elif entry.fut is not None:
+                    entry.fut.set_error(TimeoutError(
+                        "generation request expired waiting for KV pool "
+                        "blocks"))
+                continue
+            if entry.fut is not None:
+                if entry.deadline is not None:
+                    # re-derive the request's remaining duration so the
+                    # resumed admission keeps the original absolute bound
+                    entry.query["max_duration_s"] = max(
+                        entry.deadline - now, 0.001)
+                cache = self._admit(model, spec, cache, slots, free,
+                                    entry.fut, entry.query, service_id,
+                                    seq=entry.seq)
+                continue
+            if entry.stream.cancelled:
+                continue
+            slot_ix = free.pop(0)
+            slot = _Slot(entry.stream, list(entry.prompt),
+                         entry.max_tokens, entry.deadline, entry.seq,
+                         produced=entry.produced)
+            plan = self._alloc.open_slot(slot_ix, slot.prompt)
+            slot.pending_from = plan.cached_tokens
+            slot.position = plan.cached_tokens
+            try:
+                if plan.copies:
+                    cache = self._apply_copies(model, cache, plan.copies)
+            except Exception:
+                logger.error("resume copy failed in generation worker "
+                             "%s:\n%s", service_id,
+                             traceback.format_exc())
+                self._alloc.close_slot(slot_ix)
+                free.insert(0, slot_ix)
+                slot.stream.fail("preempted stream could not be resumed")
+                continue
+            slots[slot_ix] = slot  # chunks advance in _prefill_round
+        return cache
+
+    def _room_for_resume(self, entry: _Pending) -> bool:
+        need = self._alloc.blocks_for(
+            self._chunk if self._chunk > 0
+            else len(entry.prompt or (entry.query or {}).get(
+                "prompt_ids", [])) + 1)
+        return (self._alloc.free_blocks()
+                + self._alloc.evictable_blocks()) >= max(need, 1)
+
+    def _stash(self, entry: _Pending) -> None:
+        self._pending.append(entry)
+        self._pending.sort(key=lambda e: e.seq)
+
+    def _apply_copies(self, model, cache, copies):
+        src = np.asarray([s for s, _ in copies], np.int32)
+        dst = np.asarray([d for _, d in copies], np.int32)
+        return model.kv_copy_blocks(cache, src, dst)
+
+    def _try_chunk(self, model, spec, cache, slots, slot_ix, slot, end):
+        """Prefill prompt positions [pending_from, end) for one slot.
+        Returns (ok, cache); ok=False means the pool could not supply
+        blocks even after preempting every younger stream — the CALLER
+        stashes/fails this slot. Exceptions propagate (model crash)."""
+        start = slot.pending_from
+        n = len(slot.prompt)
+        if not self._make_capacity(slots, slot_ix, end - 1):
+            return False, cache
+        for ix in range(start // self._alloc.block_tokens,
+                        (end - 1) // self._alloc.block_tokens + 1):
+            copies = self._alloc.ensure_writable(
+                slot_ix, ix * self._alloc.block_tokens)
+            if copies is None:
+                if not self._preempt_youngest(slots, exclude=slot_ix):
+                    return False, cache
+                copies = self._alloc.ensure_writable(
+                    slot_ix, ix * self._alloc.block_tokens)
+                if copies is None:
+                    return False, cache
+            if copies:
+                cache = self._apply_copies(model, cache, copies)
+        chunk_tokens = slot.prompt[start:end]
+        tok, cache = model.paged_prefill(
+            cache, self._alloc.table_row(slot_ix), list(chunk_tokens),
+            int(start))
+        slot.pending_from = end
+        slot.position = end
+        if end < n:
+            return True, cache
+        # final chunk: first generated token
+        tok = int(tok)
+        slot.pending_from = None
+        slot.last_id = tok
+        slot.produced += 1
+        slot.tokens.append(tok)
+        m = _metrics()
+        now = time.monotonic()
+        if slot.t0 is not None:
+            m["ttft"].observe(now - slot.t0)
+            slot.t0 = None
+        m["tokens"].inc()
+        slot.last_step_t = now
+        self._tokens_emitted += 1
+        finished, reason = self._finish_reason(slot, spec, tok)
+        if slot.deadline is not None and now >= slot.deadline:
+            finished, reason = True, "deadline"
+        slot.stream.push([tok], finished=finished, reason=reason)
+        if finished:
+            self._evict_slot(slots, slot_ix, reason)
+        else:
+            self._alloc.publish(slot_ix, slot.prompt)
+        return True, cache
+
+    def _prefill_round(self, model, spec, cache, slots, ctx):
+        """Advance every PREFILLING slot by one chunk — interleaved with
+        decode rounds so a max-context prompt joining never stalls
+        resident streams' inter-token latency."""
+        for i, slot in enumerate(slots):
+            if slot is None or slot.pending_from is None:
+                continue
+            if slot.stream.cancelled:
+                self._evict_slot(slots, i, "cancelled")
+                continue
+            n = len(slot.prompt)
+            end = n if self._chunk <= 0 else min(n, slot.pending_from
+                                                 + self._chunk)
+            try:
+                ok, cache = self._try_chunk(model, spec, cache, slots, i,
+                                            slot, end)
+            except Exception:
+                logger.error(
+                    "chunked prefill failed in generation worker %s:\n%s",
+                    ctx.service_id, traceback.format_exc())
+                slot.stream.fail("prefill failed on the serving worker")
+                self._evict_slot(slots, i, "error")
+                continue
+            if not ok and slots[i] is slot:
+                # pool dry even after preempting younger streams: this
+                # slot yields its blocks and waits its turn
+                self._preempt(slots, i)
+        return cache
+
+    # -- preemption ----------------------------------------------------------
+
+    def _make_capacity(self, slots, slot_ix, position) -> bool:
+        """ensure_capacity with the pool-exhaustion policy: preempt the
+        youngest resident stream YOUNGER than the requester (typed:
+        blocks freed, request re-queued) until the allocation lands or no
+        such victim remains — an older stream is never displaced by a
+        newer one, so the oldest stream always makes progress and the
+        preemption chain terminates."""
+        while not self._alloc.ensure_capacity(slot_ix, position):
+            if not self._preempt_youngest(slots, exclude=slot_ix):
+                return False
+        return True
+
+    def _preempt_youngest(self, slots, exclude: int) -> bool:
+        """Preempt the youngest resident stream younger than ``exclude``
+        (by admission order); False when there is nobody eligible."""
+        mine = slots[exclude].seq if slots[exclude] is not None else -1
+        cand = [(s.seq, i) for i, s in enumerate(slots)
+                if s is not None and i != exclude and s.seq > mine]
+        if not cand:
+            return False
+        _, victim = max(cand)
+        self._preempt(slots, victim)
+        return True
+
+    def _preempt(self, slots, i) -> None:
+        """Evict slot ``i`` for pool exhaustion: its blocks return to the
+        pool and the stream is re-queued as a continuation (full token
+        history re-prefilled on resume — the client just sees a pause,
+        never an error or duplicate tokens). A stream whose grown history
+        can NEVER fit the pool again is failed typed instead: re-queueing
+        it would cycle preempt -> resume -> preempt forever while
+        ``_room_for_new`` holds all new admissions behind it."""
+        slot = slots[i]
+        slots[i] = None
+        self._alloc.close_slot(i)
+        m = _metrics()
+        if slot.stream.cancelled:
+            m["evictions"].labels("cancelled").inc()
+            return
+        history = list(slot.prompt)
+        if slot.pending_from is None:
+            history += slot.tokens
+        if self._alloc.blocks_for(len(history) + 1) \
+                > self._alloc.pool_blocks:
+            slot.stream.fail(
+                f"stream outgrew the KV pool ({len(history)} tokens vs "
+                f"{self._alloc.pool_blocks} blocks x "
+                f"{self._alloc.block_tokens} tokens) — raise "
+                "RAFIKI_GEN_KV_POOL_BLOCKS")
+            m["evictions"].labels("kv_pool").inc()
+            return
+        m["evictions"].labels("preempted").inc()
+        m["preempts"].inc()
+        logger.warning(
+            "generation worker: KV pool exhausted — preempting youngest "
+            "stream %s (seq %d, %d tokens produced); re-queued",
+            slot.stream.seq_id, slot.seq, slot.produced)
+        self._stash(_Pending(
+            slot.seq, stream=slot.stream, prompt=history,
+            produced=slot.produced, max_tokens=slot.max_tokens,
+            deadline=slot.deadline))
+
+    # -- the decode round ----------------------------------------------------
+
+    def _decode_round(self, model, spec: GenerationSpec, cache,
+                      slots: List[Optional[_Slot]], ctx):
+        """Advance every resident DECODING sequence one token. Slot-level
+        chaos is consulted per sequence, so a drill injures exactly one
+        stream while siblings keep decoding."""
+        n = len(slots)
+        paged = self._alloc is not None
+        if paged:
+            # growth + COW barriers for this round's writes
+            for i, s in enumerate(slots):
+                if s is None or s.pending_from is not None:
+                    continue
+                if not self._make_capacity(slots, i, s.position):
+                    if slots[i] is s:
+                        self._preempt(slots, i)
+                    continue
+                copies = self._alloc.ensure_writable(i, s.position)
+                if copies is None:
+                    if not self._preempt_youngest(slots, exclude=i):
+                        s.stream.fail(
+                            "KV pool exhausted and no sibling stream "
+                            "left to preempt — raise "
+                            "RAFIKI_GEN_KV_POOL_BLOCKS")
+                        self._evict_slot(slots, i, "kv_pool")
+                        continue
+                    copies = self._alloc.ensure_writable(i, s.position)
+                    if copies is None:
+                        s.stream.fail("KV pool exhausted")
+                        self._evict_slot(slots, i, "kv_pool")
+                        continue
+                if copies:
+                    cache = self._apply_copies(model, cache, copies)
+        active = [(i, s) for i, s in enumerate(slots)
+                  if s is not None and s.pending_from is None]
+        if not active:
+            return cache
+        ids = np.zeros(n, np.int32)
+        positions = np.zeros(n, np.int32)
+        for i, s in active:
+            ids[i] = s.last_id
+            positions[i] = s.position
+        try:
+            if paged:
+                tables = np.stack([
+                    self._alloc.table_row(i) if (slots[i] is not None and
+                                                 slots[i].pending_from
+                                                 is None)
+                    else self._alloc.idle_row()
+                    for i in range(n)])
+                next_ids, cache = model.paged_decode_step(
+                    cache, ids, positions, tables)
+            else:
+                next_ids, cache = model.decode_step(cache, ids, positions)
+            next_ids = np.asarray(next_ids)
+        except Exception:
+            # a decode_step crash poisons the whole table (the cache may
+            # be half-written): fail every resident stream TYPED and
+            # clear the table — the worker keeps serving new requests
+            logger.error("decode_step failed in generation worker %s:\n%s",
+                         ctx.service_id, traceback.format_exc())
+            for i, s in enumerate(slots):
+                if s is not None:
+                    s.stream.fail("decode step failed on the serving "
+                                  "worker")
+                    self._evict_slot(slots, i, "error")
+            return cache
+        now = time.monotonic()
+        m = _metrics()
+        for i, slot in enumerate(slots):
+            if slot is None or slot.pending_from is not None:
+                continue
+            rule = chaos.hit(
+                chaos.SITE_GENERATE,
+                f"{self._job_id}/{ctx.service_id}/slot{i}/"
+                f"{slot.stream.seq_id}")
+            if rule is not None:
+                if rule.action == chaos.ACTION_DELAY:
+                    chaos.sleep_for(rule)
+                elif rule.action == chaos.ACTION_DROP:
+                    # stalled decode: the slot stays resident but its
+                    # deltas stop — the door's inter-token timeout owns
+                    # recovery (typed error frame + cancel)
+                    logger.warning(
+                        "chaos: muting generation slot %d (%s)", i,
+                        slot.stream.seq_id)
+                    slot.muted = True
+                else:  # ACTION_ERROR: mid-stream fault on THIS stream
+                    slot.stream.fail(
+                        "chaos-injected mid-stream generation fault")
+                    self._evict_slot(slots, i, "error")
+                    continue
+            if slot.stream.cancelled:
+                self._evict_slot(slots, i, "cancelled")
+                continue
+            token = int(next_ids[i])
+            slot.position += 1
+            slot.last_id = token
+            slot.produced += 1
+            slot.tokens.append(token)
+            m["intertoken"].observe(now - slot.last_step_t)
+            slot.last_step_t = now
+            m["tokens"].inc()
+            self._tokens_emitted += 1
+            finished, reason = self._finish_reason(slot, spec, token)
+            if slot.deadline is not None and now >= slot.deadline:
+                finished, reason = True, "deadline"
+            if not slot.muted:
+                slot.stream.push([token], finished=finished, reason=reason)
+            if finished:
+                self._evict_slot(slots, i, reason)
+        return cache
+
+    @staticmethod
+    def _finish_reason(slot: _Slot, spec: GenerationSpec, token: int):
+        if spec.eos_token_id is not None and token == spec.eos_token_id:
+            return True, "eos"
+        if slot.produced >= slot.max_tokens:
+            return True, "max_tokens"
+        if slot.position + 1 >= spec.max_context:
+            return True, "context"
+        return False, None
+
+    def _evict_slot(self, slots: List[Optional[_Slot]], i: int,
+                    reason: str) -> None:
+        slots[i] = None
+        if self._alloc is not None:
+            self._alloc.close_slot(i)
+        _metrics()["evictions"].labels(reason or "unknown").inc()
+
+    # kept for compatibility with the ring-path call sites/tests
+    def _evict(self, slots: List[Optional[_Slot]], i: int,
+               reason: str) -> None:
+        self._evict_slot(slots, i, reason)
 
     @staticmethod
     def _parse_query(query):
@@ -274,96 +878,39 @@ class GenerationWorker(InferenceWorker):
                     "max_duration_s must be a number") from None
         return list(prompt), max_tokens, max_duration_s
 
-    # -- the decode round ----------------------------------------------------
+    # -- observability -------------------------------------------------------
 
-    def _decode_round(self, model, spec: GenerationSpec, cache,
-                      slots: List[Optional[_Slot]], ctx):
-        """Advance every resident sequence one token. Slot-level chaos is
-        consulted per sequence, so a drill injures exactly one stream
-        while siblings keep decoding."""
-        n = len(slots)
-        ids = np.zeros(n, np.int32)
-        positions = np.zeros(n, np.int32)
-        for i, s in enumerate(slots):
-            if s is not None:
-                ids[i] = s.last_id
-                positions[i] = s.position
-        try:
-            next_ids, cache = model.decode_step(cache, ids, positions)
-            next_ids = np.asarray(next_ids)
-        except Exception:
-            # a decode_step crash poisons the whole table (the cache may
-            # be half-written): fail every resident stream TYPED and
-            # clear the table — the worker keeps serving new requests
-            logger.error("decode_step failed in generation worker %s:\n%s",
-                         ctx.service_id, traceback.format_exc())
-            for i, s in enumerate(slots):
-                if s is not None:
-                    s.stream.fail("decode step failed on the serving "
-                                  "worker")
-                    self._evict(slots, i, "error")
-            return cache
-        now = time.monotonic()
-        m = _metrics()
-        for i, slot in enumerate(slots):
-            if slot is None:
-                continue
-            rule = chaos.hit(
-                chaos.SITE_GENERATE,
-                f"{self._job_id}/{ctx.service_id}/slot{i}/"
-                f"{slot.stream.seq_id}")
-            if rule is not None:
-                if rule.action == chaos.ACTION_DELAY:
-                    chaos.sleep_for(rule)
-                elif rule.action == chaos.ACTION_DROP:
-                    # stalled decode: the slot stays resident but its
-                    # deltas stop — the door's inter-token timeout owns
-                    # recovery (typed error frame + cancel)
-                    logger.warning(
-                        "chaos: muting generation slot %d (%s)", i,
-                        slot.stream.seq_id)
-                    slot.muted = True
-                else:  # ACTION_ERROR: mid-stream fault on THIS stream
-                    slot.stream.fail(
-                        "chaos-injected mid-stream generation fault")
-                    self._evict(slots, i, "error")
-                    continue
-            if slot.stream.cancelled:
-                self._evict(slots, i, "cancelled")
-                continue
-            token = int(next_ids[i])
-            slot.position += 1
-            slot.last_id = token
-            slot.produced += 1
-            m["intertoken"].observe(now - slot.last_step_t)
-            slot.last_step_t = now
-            m["tokens"].inc()
-            self._tokens_emitted += 1
-            finished, reason = self._finish_reason(slot, spec, token)
-            if slot.deadline is not None and now >= slot.deadline:
-                finished, reason = True, "deadline"
-            if not slot.muted:
-                slot.stream.push([token], finished=finished, reason=reason)
-            if finished:
-                self._evict(slots, i, reason)
-        return cache
+    def _occupancy(self, slots, max_slots: int) -> float:
+        """The autoscaler's saturation signal: under the paged layout the
+        binding resource is POOL BLOCKS, not slots — a few long streams
+        can exhaust the pool with the slot table half empty, and block
+        occupancy is what predicts the next admission stalling."""
+        if self._alloc is not None:
+            return self._alloc.used_blocks() / self._alloc.pool_blocks
+        busy = sum(1 for s in slots if s is not None)
+        return busy / max_slots
 
-    @staticmethod
-    def _finish_reason(slot: _Slot, spec: GenerationSpec, token: int):
-        if spec.eos_token_id is not None and token == spec.eos_token_id:
-            return True, "eos"
-        if slot.produced >= slot.max_tokens:
-            return True, "max_tokens"
-        if slot.position + 1 >= spec.max_context:
-            return True, "context"
-        return False, None
+    def _mirror_alloc(self, service_id: str, m) -> None:
+        """Mirror the allocator's cumulative counters into the PR-6
+        registry by delta (one site per loop — host-side bookkeeping has
+        no natural increment hook) and refresh the pool gauges."""
+        if self._alloc is None:
+            return
+        st = self._alloc.stats()
+        last = self._last_alloc_stats
+        for key, counter in (("prefix_hits", "prefix_hits"),
+                             ("prefix_misses", "prefix_misses"),
+                             ("prefix_hit_tokens", "prefix_tokens"),
+                             ("cow_copies", "cow"),
+                             ("cache_evictions", "prefix_evictions")):
+            delta = st[key] - last.get(key, 0)
+            if delta > 0:
+                m[counter].inc(delta)
+        self._last_alloc_stats = st
+        m["kv_used"].labels(service_id).set(st["used_blocks"])
+        m["kv_pool"].labels(service_id).set(st["pool_blocks"])
 
-    @staticmethod
-    def _evict(slots: List[Optional[_Slot]], i: int, reason: str) -> None:
-        slots[i] = None
-        _metrics()["evictions"].labels(reason or "unknown").inc()
-
-    def _stats_row(self, service_id: str, busy: int, max_slots: int) -> None:
+    def _stats_row(self, service_id: str, slots, max_slots: int) -> None:
         """Fold the slot picture into the shared SERVING_STATS row (the
         /healthz + fleet-health + stats-relay surface every PR already
         reads); the 'queries' counter stays the admitted-request count.
@@ -371,10 +918,23 @@ class GenerationWorker(InferenceWorker):
         stats relay (report_stats dedupes on an unchanged row) keeps
         pushing — and the admin keeps re-recording the occupancy ring —
         for as long as the table is actually decoding, even when
-        occupancy itself sits pinned at full."""
+        occupancy itself sits pinned at full. Under the paged layout the
+        row also carries the block-pool picture (the admin relay then
+        records BLOCK occupancy into the autoscaler ring) and the prefix
+        hit counters fleet health aggregates per job."""
+        busy = sum(1 for s in slots if s is not None)
         with _stats_lock:
             s = SERVING_STATS.setdefault(
                 service_id, {"batches": 0, "queries": 0})
             s["gen_slots_busy"] = busy
             s["gen_slots_max"] = max_slots
             s["gen_tokens"] = getattr(self, "_tokens_emitted", 0)
+            s["gen_job"] = self._job_id
+            if self._alloc is not None:
+                st = self._last_alloc_stats or self._alloc.stats()
+                s["gen_kv_blocks_used"] = st["used_blocks"]
+                s["gen_kv_pool_blocks"] = st["pool_blocks"]
+                s["gen_kv_block_tokens"] = st["block_tokens"]
+                s["gen_prefix_hits"] = st["prefix_hits"]
+                s["gen_prefix_misses"] = st["prefix_misses"]
+                s["gen_prefix_hit_tokens"] = st["prefix_hit_tokens"]

@@ -138,7 +138,8 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # KV-cached decode and continuous batching (docs/serving-generation.md).
 # The streaming /generate door lives on the dedicated per-job predictor
 # port (RAFIKI_PREDICTOR_PORTS=1); admission charges streams their
-# max_tokens decode budget, not 1:
+# estimated decode footprint (KV blocks when paged, max_tokens under the
+# legacy ring), not 1:
 #   RAFIKI_GEN_MAX_SLOTS=8              co-resident sequences per
 #                                       generation worker — the KV cache
 #                                       is preallocated at this width and
@@ -151,15 +152,50 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                       timeout: a stream silent this long
 #                                       ends with a typed terminal error
 #                                       frame, never a hang
-#   RAFIKI_GEN_OCCUPANCY_HIGH=0.85      mean slot occupancy over the
-#                                       autoscaler window that reads
-#                                       "slots saturated" and scales the
+#   RAFIKI_GEN_OCCUPANCY_HIGH=0.85      mean occupancy of the binding
+#                                       decode resource (KV-pool blocks
+#                                       when paged, busy slots otherwise)
+#                                       over the autoscaler window that
+#                                       reads "saturated" and scales the
 #                                       job up (slot_occupancy:job:<id>
 #                                       ring; idle needs <= HIGH/2)
+# Paged KV + prefix cache + chunked prefill (docs/serving-generation.md
+# "Paged KV and prefix caching") — templates advertising the paged decode
+# methods serve from a block pool instead of per-slot rings, so resident
+# streams are bound by USED tokens, shared prompt prefixes are prefilled
+# once, and long-prompt joins never stall resident streams:
+#   RAFIKI_GEN_KV_PAGED=1               0 = legacy contiguous ring per
+#                                       slot (the bench A/B baseline)
+#   RAFIKI_GEN_KV_BLOCK_TOKENS=16       K/V rows per pool page — the
+#                                       paging granularity (doctor WARNs
+#                                       outside 8..2048)
+#   RAFIKI_GEN_KV_POOL_BLOCKS=0         pool size in pages; 0 auto-sizes
+#                                       to ring parity (slots x
+#                                       ceil(max_context/block)); doctor
+#                                       WARNs past the chip-memory
+#                                       heuristic. Exhaustion preempts
+#                                       the YOUNGEST stream (blocks
+#                                       freed, request re-queued and
+#                                       resumed) — never a crashed round
+#   RAFIKI_GEN_PREFIX_CACHE=1           0 = never share prompt-prefix
+#                                       blocks (doctor WARNs when the
+#                                       shareable-traffic counter shows
+#                                       shared prompts anyway)
+#   RAFIKI_GEN_PREFILL_CHUNK=64         prompt tokens ingested per
+#                                       scheduler round (paged path):
+#                                       long-prompt joins interleave
+#                                       with decode rounds (0 = one-shot
+#                                       prefill)
 # New /metrics series: rafiki_gen_ttft_seconds,
 # rafiki_gen_door_ttft_seconds, rafiki_gen_intertoken_seconds,
 # rafiki_gen_tokens_total, rafiki_gen_slots_busy{service},
-# rafiki_gen_evictions_total{reason}.
+# rafiki_gen_evictions_total{reason}, rafiki_gen_kv_blocks_used{service},
+# rafiki_gen_kv_pool_blocks{service}, rafiki_gen_prefix_hits_total,
+# rafiki_gen_prefix_misses_total, rafiki_gen_prefix_tokens_total,
+# rafiki_gen_prefix_evictions_total, rafiki_gen_prefix_shareable_total,
+# rafiki_gen_kv_cow_copies_total, rafiki_gen_preemptions_total. Per-job
+# pool footprint + prefix hit rates surface under GET /fleet/health
+# "serving.generation".
 
 # Safe live rollouts (docs/failure-model.md "Rollout faults"). An
 # operator (or automation) updates a RUNNING inference job to a new

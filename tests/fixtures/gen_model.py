@@ -60,6 +60,9 @@ class TinyGenLM(BaseModel):
         self._params = None
         self._jit_prefill = None
         self._jit_decode = None
+        self._jit_paged_prefill = None
+        self._jit_paged_decode = None
+        self._jit_copy = None
 
     def train(self, dataset_uri):
         import optax
@@ -104,14 +107,20 @@ class TinyGenLM(BaseModel):
 
     def load_parameters(self, params):
         self._params = params
-        self._jit_prefill = self._jit_decode = None  # recompile on new params
+        # recompile on new params
+        self._jit_prefill = self._jit_decode = None
+        self._jit_paged_prefill = self._jit_paged_decode = None
 
     # -- generation contract (worker/generation.py drives these) ------------
 
-    def init_kv_cache(self, max_slots):
+    def _device_params(self):
         # params may be msgpack-loaded numpy: put them on device once —
         # a numpy embedding table cannot be indexed by a traced id array
-        params = self._params = jax.tree.map(jnp.asarray, self._params)
+        self._params = jax.tree.map(jnp.asarray, self._params)
+        return self._params
+
+    def init_kv_cache(self, max_slots):
+        params = self._device_params()
         cfg = self._cfg
         if self._jit_prefill is None:
             self._jit_prefill = jax.jit(
@@ -131,3 +140,35 @@ class TinyGenLM(BaseModel):
     def decode_step(self, cache, ids, positions):
         logits, cache = self._jit_decode(cache, ids, positions)
         return lm.greedy_token(logits), cache
+
+    # -- paged decode memory (worker/kv_paging.py drives these) --------------
+
+    def init_paged_kv_cache(self, pool_blocks, block_tokens):
+        params = self._device_params()
+        cfg = self._cfg
+        self._jit_paged_prefill = jax.jit(
+            lambda c, bt, ids, st, n: lm.paged_prefill(
+                params, c, bt, ids, st, n, cfg))
+        self._jit_paged_decode = jax.jit(
+            lambda c, ids, pos, bts: lm.paged_decode_step(
+                params, c, ids, pos, bts, cfg))
+        self._jit_copy = jax.jit(lm.copy_kv_blocks)
+        return lm.init_paged_kv_cache(cfg, pool_blocks, block_tokens)
+
+    def paged_prefill(self, cache, block_table, prompt_ids, start):
+        n = len(prompt_ids)
+        bucket = next(b for b in _PREFILL_BUCKETS if b >= n)
+        ids = np.zeros(bucket, np.int32)
+        ids[:n] = prompt_ids
+        logits, cache = self._jit_paged_prefill(
+            cache, np.asarray(block_table, np.int32), ids,
+            np.int32(start), n)
+        return int(lm.greedy_token(logits)), cache
+
+    def paged_decode_step(self, cache, ids, positions, block_tables):
+        logits, cache = self._jit_paged_decode(
+            cache, ids, positions, np.asarray(block_tables, np.int32))
+        return lm.greedy_token(logits), cache
+
+    def kv_copy_blocks(self, cache, src, dst):
+        return self._jit_copy(cache, src, dst)

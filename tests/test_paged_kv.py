@@ -1,0 +1,686 @@
+"""Paged KV allocator + shared prefix cache + chunked prefill
+(worker/kv_paging.py, models/lm.py paged forwards, the generation
+worker's paged scheduler). THE tier-1 invariant lives here: paged
+``decode_step`` output is bit-identical to the contiguous-ring path for
+the same prompts, including across a copy-on-write divergence point."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.worker.kv_paging import (
+    KVPoolExhaustedError,
+    PagedKVAllocator,
+)
+
+HERE = os.path.dirname(__file__)
+GEN_FIXTURE = os.path.join(HERE, "fixtures", "gen_model.py")
+
+
+# -- model layer: the tier-1 bit-identity invariant ---------------------------
+
+def test_paged_forward_bit_identical_to_ring():
+    """Prefill + decode through block tables must produce EXACTLY the
+    ring path's logits — the gather view presents the same logical rows
+    to the same `_cached_forward`, so even the float bits match."""
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models import lm
+
+    cfg = lm.tiny(vocab=64, max_len=32, dim=16, depth=2, heads=2)
+    params = lm.init(jax.random.PRNGKey(0), cfg)
+    bt, nb = 8, 4
+    prompt = jnp.array([5, 9, 2, 7, 3], jnp.int32)
+    n = 5
+
+    ring = lm.init_kv_cache(cfg, max_slots=2, max_len=32)
+    lg_r, ring = lm.prefill(params, ring, 0, jnp.pad(prompt, (0, 3)), n,
+                            cfg)
+    pool = lm.init_paged_kv_cache(cfg, pool_blocks=8, block_tokens=bt)
+    table = np.full(nb, 8, np.int32)
+    table[0], table[1] = 3, 6  # non-contiguous physical pages on purpose
+    lg_p, pool = lm.paged_prefill(params, pool, table,
+                                  jnp.pad(prompt, (0, 3)), 0, n, cfg)
+    assert np.array_equal(np.asarray(lg_r), np.asarray(lg_p))
+
+    ids = np.array([int(lm.greedy_token(lg_r)), 0], np.int32)
+    pos = np.array([n, 0], np.int32)
+    tables = np.full((2, nb), 8, np.int32)
+    tables[0] = table
+    for _ in range(6):
+        lg2_r, ring = lm.decode_step(params, ring, ids,
+                                     jnp.asarray(pos), cfg)
+        lg2_p, pool = lm.paged_decode_step(params, pool, ids, pos,
+                                           tables, cfg)
+        assert np.array_equal(np.asarray(lg2_r), np.asarray(lg2_p))
+        t = int(lm.greedy_token(lg2_r)[0])
+        ids[0] = t
+        pos[0] += 1
+        blk = pos[0] // bt
+        if pos[0] % bt == 0 and blk < nb and tables[0][blk] == 8:
+            tables[0][blk] = 1  # grow the table mid-decode
+
+
+def test_paged_cow_divergence_no_corruption():
+    """Two streams sharing a prefix page, diverging at the tail: the
+    INCUMBENT stream's decode must stay BIT-identical to its ring
+    reference through the sibling's divergence (its pages are never
+    touched — the COW invariant), and the diverging stream must track its
+    own ring reference at token level (its suffix is forwarded with a
+    different shape than a full prefill, so bit-identity is per-shape:
+    ulp-level rounding differs, the greedy stream must not)."""
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models import lm
+
+    cfg = lm.tiny(vocab=64, max_len=32, dim=16, depth=1, heads=2)
+    params = lm.init(jax.random.PRNGKey(1), cfg)
+    bt, nb = 8, 4
+    shared = [4, 8, 15, 16, 23, 42, 7, 1]          # exactly one block
+    pa = shared + [11]
+    pb = shared + [33]                              # diverges at pos 8
+
+    pool = lm.init_paged_kv_cache(cfg, pool_blocks=8, block_tokens=bt)
+    # stream A prefills the shared block (page 0) + its tail (page 1)
+    ta = np.full(nb, 8, np.int32)
+    ta[0], ta[1] = 0, 1
+    lga, pool = lm.paged_prefill(params, pool, ta,
+                                 np.asarray(pa, np.int32), 0, 9, cfg)
+    # stream B shares page 0, gets its own tail page 2; it only forwards
+    # its one-token suffix at position 8 — the shared page serves 0..7
+    tb = np.full(nb, 8, np.int32)
+    tb[0], tb[1] = 0, 2
+    lgb, pool = lm.paged_prefill(params, pool, tb,
+                                 np.asarray([33], np.int32), 8, 1, cfg)
+    # reference: two independent ring caches
+    ring = lm.init_kv_cache(cfg, max_slots=2, max_len=32)
+    lga_r, ring = lm.prefill(params, ring, 0,
+                             np.pad(np.asarray(pa, np.int32), (0, 7)), 9,
+                             cfg)
+    lgb_r, ring = lm.prefill(params, ring, 1,
+                             np.pad(np.asarray(pb, np.int32), (0, 7)), 9,
+                             cfg)
+    # A forwarded the same shape as the ring prefill: bit-identical
+    assert np.array_equal(np.asarray(lga), np.asarray(lga_r))
+    # B skipped the shared span: token-identical, logits within ulps
+    assert int(lm.greedy_token(lgb)) == int(lm.greedy_token(lgb_r))
+    assert np.allclose(np.asarray(lgb), np.asarray(lgb_r), atol=1e-5)
+    ids = np.array([int(lm.greedy_token(lga)),
+                    int(lm.greedy_token(lgb))], np.int32)
+    pos = np.array([9, 9], np.int32)
+    tables = np.stack([ta, tb])
+    for _ in range(5):
+        lg_r, ring = lm.decode_step(params, ring, ids,
+                                    jnp.asarray(pos), cfg)
+        lg_p, pool = lm.paged_decode_step(params, pool, ids, pos,
+                                          tables, cfg)
+        # slot A: bit-identical through B's divergence — B never wrote
+        # into the shared page
+        assert np.array_equal(np.asarray(lg_r)[0], np.asarray(lg_p)[0])
+        # slot B: the greedy stream tracks its ring reference exactly
+        assert np.array_equal(np.asarray(lm.greedy_token(lg_r)),
+                              np.asarray(lm.greedy_token(lg_p)))
+        assert np.allclose(np.asarray(lg_r)[1], np.asarray(lg_p)[1],
+                           atol=1e-5)
+        ids = np.asarray(lm.greedy_token(lg_r))
+        pos += 1
+        for s in range(2):
+            blk = pos[s] // bt
+            if pos[s] % bt == 0 and tables[s][blk] == 8:
+                tables[s][blk] = 3 + s
+
+
+def test_paged_cache_refuses_moe():
+    from rafiki_tpu.models import lm
+
+    with pytest.raises(ValueError, match="dense blocks only"):
+        lm.init_paged_kv_cache(lm.tiny(moe_experts=2), 4, 8)
+
+
+# -- the allocator ------------------------------------------------------------
+
+def test_allocator_alloc_free_refcounts():
+    a = PagedKVAllocator(pool_blocks=8, block_tokens=4, table_blocks=4,
+                         prefix_cache=False)
+    plan = a.open_slot(0, [1, 2, 3, 4, 5])
+    assert plan.cached_tokens == 0 and not plan.copies
+    assert a.ensure_capacity(0, 5)          # 2 blocks for 6 positions
+    assert a.used_blocks() == 2
+    row = a.table_row(0)
+    assert row.shape == (4,) and (row[2:] == a.sentinel).all()
+    a.close_slot(0)
+    assert a.used_blocks() == 0
+    assert all(r == 0 for r in a.refcounts())
+    with pytest.raises(KVPoolExhaustedError):
+        a.ensure_capacity(0, 999)
+
+
+def test_allocator_prefix_chain_hit_and_tail_cow():
+    bt = 4
+    a = PagedKVAllocator(pool_blocks=16, block_tokens=bt, table_blocks=8)
+    prompt = list(range(10))                 # 2 full blocks + 2-token tail
+    a.open_slot("A", prompt)
+    assert a.ensure_capacity("A", 9)
+    a.publish("A", prompt)
+    # chain entries for blocks 0/1, tail entry for tokens (8, 9)
+    assert a.stats()["cache_entries"] == 3
+    # identical prompt: chain hit (8 tokens) + tail COPY of 1 usable token
+    plan = a.open_slot("B", prompt)
+    assert plan.cached_tokens == 9           # usable = n-1
+    assert len(plan.copies) == 1             # the tail page was copied
+    assert a.hits == 1 and a.hit_tokens == 9
+    # the copy target is private to B: writing position 9 needs no COW
+    assert a.ensure_writable("B", 9) == []
+    # A, the publisher, must COW before writing into its published tail
+    copies = a.ensure_writable("A", 10 // bt * bt + 2)
+    assert copies and copies[0][0] != copies[0][1]
+    # refcounts drain to cache-only on close, to zero on drop_cache
+    a.close_slot("A")
+    a.close_slot("B")
+    assert a.evictable_blocks() == a.stats()["cache_entries"] == 3
+    freed = a.drop_cache()
+    assert freed == 3
+    assert all(r == 0 for r in a.refcounts())
+    assert a.free_blocks() == 16
+
+
+def test_allocator_lru_eviction_under_pressure():
+    bt = 4
+    a = PagedKVAllocator(pool_blocks=4, block_tokens=bt, table_blocks=4)
+    a.open_slot("A", list(range(5)))
+    assert a.ensure_capacity("A", 4)
+    a.publish("A", list(range(5)))     # chain block 0 + tail block cached
+    a.close_slot("A")
+    assert a.used_blocks() == 2              # cache holds two pages
+    # a new slot needing the whole pool evicts the cache LRU-style
+    a.open_slot("B", list(range(100, 113)))
+    assert a.ensure_capacity("B", 12)        # 4 blocks
+    assert a.used_blocks() == 4 and a.cache_evictions == 2
+    a.close_slot("B")
+    assert a.free_blocks() == 4
+
+
+def test_allocator_tail_copy_survives_lru_pressure():
+    """Review regression: open_slot's tail copy must pin the matched
+    entry across the allocation — with the free list dry, _alloc_one's
+    LRU eviction could otherwise evict (and free!) the very block it is
+    about to copy from, crashing the admission (or copying a block onto
+    itself)."""
+    bt = 4
+    a = PagedKVAllocator(pool_blocks=2, block_tokens=bt, table_blocks=4)
+    prompt = list(range(6))                  # 1 chain block + 2-token tail
+    a.open_slot("A", prompt)
+    assert a.ensure_capacity("A", 5)
+    a.publish("A", prompt)
+    a.close_slot("A")
+    assert a.free_blocks() == 0              # both pages cache-held
+    # same prompt, free list dry: the chain page maps shared; the tail
+    # copy cannot be satisfied (its own entry is the only LRU candidate
+    # and must NOT be evicted out from under the copy) — admission
+    # degrades to chain-only instead of crashing
+    plan = a.open_slot("B", prompt)
+    assert plan.cached_tokens == 4 and plan.copies == []
+    # the tail entry survived intact
+    assert a.stats()["cache_entries"] == 2
+
+
+def test_stream_outgrowing_pool_fails_typed_not_forever(monkeypatch):
+    """Review regression: a stream whose history grows past what the
+    whole pool can hold must end with a TYPED kv_pool error — not cycle
+    preempt -> resume forever while blocking all new admissions."""
+    from rafiki_tpu.cache.queue import GenerationError, InProcessBroker
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_POOL_BLOCKS", "2")   # 16 tokens
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_PREFIX_CACHE", "0")
+    monkeypatch.setenv("RAFIKI_GEN_PREFILL_CHUNK", "8")
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, _tiny_model(), job="growjob")
+    q = list(broker.get_worker_queues("growjob").values())[0]
+    try:
+        # admission fits (ceil(11/8)=2 blocks) but position 16 needs a
+        # third block the pool will never have
+        s = _stream(q, [3] * 10, 20)
+        with pytest.raises(GenerationError, match="outgrew the KV pool"):
+            while True:
+                d = s.next_delta(20)
+                if d.finished:
+                    break
+        # the worker is healthy and admitting: a small request completes
+        toks, _ = _drain(_stream(q, [5, 6], 3))
+        assert len(toks) == 3
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+def test_readmitted_request_keeps_original_seq(monkeypatch):
+    """Review regression: a stashed request resumed through _admit must
+    keep its ORIGINAL admission seq — a fresh seq would make the oldest
+    waiter the youngest resident and the first preemption victim."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, _tiny_model(), job="seqjob")
+    q = list(broker.get_worker_queues("seqjob").values())[0]
+    try:
+        # drive one admission so the worker's scheduler state exists
+        _drain(_stream(q, [2, 3], 2))
+        seen = {}
+        orig = worker._admit_paged
+
+        def spy(model, spec, cache, slots, free, fut, prompt, max_tokens,
+                deadline, service_id, seq=None):
+            seen["seq"] = seq
+            return orig(model, spec, cache, slots, free, fut, prompt,
+                        max_tokens, deadline, service_id, seq=seq)
+
+        worker._admit_paged = spy
+        from rafiki_tpu.worker.generation import _Pending
+
+        # simulate the re-admission path with a stashed (fut, query) that
+        # carries its original seq
+        class _Fut:
+            def set_result(self, v):
+                seen["resolved"] = v
+
+            def set_error(self, e):
+                seen["error"] = e
+
+        worker._pending.append(_Pending(
+            7, fut=_Fut(), query={"prompt_ids": [4, 5], "max_tokens": 2}))
+        deadline = time.monotonic() + 10
+        while "seq" not in seen and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert seen.get("seq") == 7, seen
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+def test_allocator_disabled_prefix_cache_never_shares():
+    a = PagedKVAllocator(pool_blocks=8, block_tokens=4, table_blocks=4,
+                         prefix_cache=False)
+    prompt = list(range(9))
+    a.open_slot("A", prompt)
+    a.ensure_capacity("A", 8)
+    a.publish("A", prompt)
+    assert a.stats()["cache_entries"] == 0
+    plan = a.open_slot("B", prompt)
+    assert plan.cached_tokens == 0 and a.hits == 0
+
+
+# -- the worker's paged scheduler ---------------------------------------------
+
+class _Ctx:
+    def __init__(self, service_id="w1"):
+        self.service_id = service_id
+        self.chips = None
+        self.stopping = False
+
+    def ready(self):
+        pass
+
+
+def _tiny_model():
+    sys.path.insert(0, HERE)
+    try:
+        from fixtures.gen_model import TinyGenLM
+    finally:
+        sys.path.pop(0)
+    m = TinyGenLM()
+    m.train(None)
+    return m
+
+
+def _start_worker(broker, model, job="pagedjob"):
+    from rafiki_tpu.worker.generation import GenerationWorker
+
+    worker = GenerationWorker(job, "trial1", db=None, broker=broker)
+    worker._load_model = lambda sid: model
+    ctx = _Ctx()
+    t = threading.Thread(target=worker.start, args=(ctx,), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not broker.get_worker_queues(job) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert broker.get_worker_queues(job), "worker never registered"
+    return worker, ctx, t
+
+
+def _stream(q, prompt, max_tokens, timeout_s=30.0):
+    fut = q.submit_many([{"prompt_ids": list(prompt),
+                          "max_tokens": max_tokens}],
+                        deadline=time.monotonic() + timeout_s)[0]
+    return fut.result(timeout_s)
+
+
+def _drain(stream, timeout_s=30.0):
+    toks, reason = [], None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            d = stream.next_delta(1.0)
+        except TimeoutError:
+            continue
+        except StopIteration:
+            break
+        toks.extend(d.tokens)
+        if d.finished:
+            reason = d.reason
+            break
+    return toks, reason
+
+
+def test_worker_paged_matches_ring_e2e(monkeypatch):
+    """The scheduler-level half of the invariant: the same prompts served
+    under the paged allocator (prefix sharing + COW + chunked prefill
+    active) and under the legacy ring produce identical token streams."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_PREFILL_CHUNK", "8")
+    shared = list(range(1, 21))
+    prompts = [shared + [30], shared + [30], shared + [40], [7, 7, 7]]
+
+    def serve(paged: bool, job: str):
+        monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1" if paged else "0")
+        broker = InProcessBroker()
+        worker, ctx, t = _start_worker(broker, _tiny_model(), job=job)
+        q = list(broker.get_worker_queues(job).values())[0]
+        try:
+            out = []
+            for p in prompts:
+                toks, _ = _drain(_stream(q, p, 6))
+                out.append(toks)
+            return out, worker
+        finally:
+            ctx.stopping = True
+            t.join(timeout=10)
+
+    paged_out, worker = serve(True, "pj1")
+    assert worker._alloc is not None, "paged path must have engaged"
+    st = worker._alloc.stats()
+    assert st["prefix_hits"] >= 2, st       # identical + diverging prompt
+    assert st["cow_copies"] >= 1, st
+    ring_out, worker2 = serve(False, "rj1")
+    assert worker2._alloc is None
+    assert paged_out == ring_out
+    assert paged_out[0] == paged_out[1]     # identical prompts, same stream
+
+
+def test_worker_shared_prefix_pays_prefill_once(monkeypatch):
+    """N streams sharing a system prompt: after the first, admissions hit
+    the chain cache — the model's paged_prefill only ever forwards the
+    unshared suffix (call lengths prove the prefill was paid once)."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "4")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_PREFILL_CHUNK", "0")
+    model = _tiny_model()
+    calls = []
+    orig = model.paged_prefill
+
+    def spy(cache, block_table, prompt_ids, start):
+        calls.append((int(start), len(prompt_ids)))
+        return orig(cache, block_table, prompt_ids, start)
+
+    model.paged_prefill = spy
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, model, job="sharejob")
+    q = list(broker.get_worker_queues("sharejob").values())[0]
+    try:
+        system = list(range(1, 25))          # 24 tokens = 3 full blocks
+        streams = [_stream(q, system + [30 + i], 4) for i in range(4)]
+        outs = [_drain(s) for s in streams]
+        assert all(len(toks) == 4 for toks, _ in outs)
+        first = calls[0]
+        assert first == (0, 25)              # full prefill, once
+        # every later admission forwarded only the tail past the cache
+        assert all(c[0] >= 16 and c[1] <= 9 for c in calls[1:]), calls
+        assert worker._alloc.hits == 3 and worker._alloc.misses == 1
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+@pytest.mark.chaos
+def test_pool_exhaustion_preempts_youngest_typed(monkeypatch):
+    """The pool-exhaustion drill: a flood of long streams through a pool
+    sized for ~1.5 of them. The youngest is preempted (typed counter,
+    blocks freed, request re-queued) while older siblings advance; every
+    stream still completes with the exact greedy continuation, and after
+    the flood the refcounts drain back to zero."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "3")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_POOL_BLOCKS", "6")  # 48 tokens total
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_PREFIX_CACHE", "0")  # pure pool drill
+    monkeypatch.setenv("RAFIKI_GEN_PREFILL_CHUNK", "8")
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, _tiny_model(), job="floodjob")
+    q = list(broker.get_worker_queues("floodjob").values())[0]
+    try:
+        preempts0 = REGISTRY.get(
+            "rafiki_gen_preemptions_total").value()
+        # each stream wants 16 prompt + 16 decode = 32 tokens = 4 blocks;
+        # three concurrent want 12 blocks against a 6-block pool
+        prompts = [[10 + i] * 16 for i in range(3)]
+        streams = [_stream(q, p, 16) for p in prompts]
+        outs = [_drain(s, timeout_s=60) for s in streams]
+        for i, (toks, reason) in enumerate(outs):
+            assert len(toks) == 16, f"stream {i}: {reason} {toks}"
+        preempts = REGISTRY.get(
+            "rafiki_gen_preemptions_total").value() - preempts0
+        assert preempts >= 1, "pool pressure must have preempted someone"
+        # continuation is exact: a fresh uncontended run of the same
+        # prompt yields the same tokens the preempted stream streamed
+        solo, _ = _drain(_stream(q, prompts[2], 16), timeout_s=60)
+        assert solo == outs[2][0]
+        deadline = time.monotonic() + 10
+        while worker._alloc.used_blocks() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert worker._alloc.used_blocks() == 0
+        assert all(r == 0 for r in worker._alloc.refcounts())
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+def test_chunked_prefill_interleaves_with_decode(monkeypatch):
+    """A max-context prompt joining must NOT stall resident streams: its
+    prefill is ingested chunk-by-chunk with decode rounds in between, so
+    the resident stream keeps emitting while the join is mid-prefill."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_PREFIX_CACHE", "0")
+    monkeypatch.setenv("RAFIKI_GEN_PREFILL_CHUNK", "8")
+    model = _tiny_model()
+    events = []
+    op, od = model.paged_prefill, model.paged_decode_step
+
+    def spy_p(cache, bt, ids, start):
+        events.append(("prefill", int(start)))
+        return op(cache, bt, ids, start)
+
+    def spy_d(cache, ids, pos, bts):
+        events.append(("decode", None))
+        return od(cache, ids, pos, bts)
+
+    model.paged_prefill, model.paged_decode_step = spy_p, spy_d
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, model, job="joinjob")
+    q = list(broker.get_worker_queues("joinjob").values())[0]
+    try:
+        resident = _stream(q, [5, 6, 7], 48)      # long-running resident
+        # wait until the resident is decoding
+        resident.next_delta(10)
+        long_prompt = list(range(1, 57))          # 56 tokens = 7 chunks
+        join = _stream(q, long_prompt, 4)
+        toks_j, _ = _drain(join)
+        assert len(toks_j) == 4
+        resident.cancel()
+        # the join's prefill chunks must have decode rounds between them
+        starts = [i for i, e in enumerate(events) if e[0] == "prefill"
+                  and e[1] > 0]
+        assert len(starts) >= 3, "long prompt must have chunked"
+        interleaved = sum(
+            1 for a, b in zip(starts, starts[1:])
+            if any(events[i][0] == "decode" for i in range(a + 1, b)))
+        assert interleaved >= len(starts) - 2, (
+            f"chunks must interleave with decode rounds: {events}")
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+def test_worker_stats_row_carries_block_picture(monkeypatch):
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.worker.inference import serving_stats
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, _tiny_model(), job="statsjob")
+    q = list(broker.get_worker_queues("statsjob").values())[0]
+    try:
+        toks, _ = _drain(_stream(q, [3, 1, 4], 3))
+        assert len(toks) == 3
+        row = serving_stats()[ctx.service_id]
+        assert row["gen_kv_pool_blocks"] == worker._alloc.pool_blocks
+        assert row["gen_kv_block_tokens"] == 8
+        assert "gen_prefix_hits" in row and "gen_kv_blocks_used" in row
+        assert row["gen_job"] == "statsjob"
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+def test_long_prompt_join_intertoken_p95_within_budget(monkeypatch):
+    """THE chunked-prefill acceptance drill (bench.py owns the
+    measurement): a max-context prompt joining mid-decode leaves the
+    resident stream's inter-token p95 within the no-join budget
+    (3x baseline + timer-noise floor) because the join is ingested
+    chunk-by-chunk between decode rounds."""
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "16")
+    monkeypatch.setenv("RAFIKI_GEN_PREFILL_CHUNK", "32")
+    sys.path.insert(0, os.path.dirname(HERE))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    out = bench.bench_gen_join_drill(prefix="drill")
+    assert out["drill_intertoken_p95_ms"] is not None
+    assert out["drill_within_budget"], out
+
+
+# -- door admission cost + fleet health ---------------------------------------
+
+def test_generate_admission_cost_in_block_units(monkeypatch):
+    from rafiki_tpu.predictor.server import _generate_cost
+
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "16")
+    # a long prompt charges even with a tiny decode budget
+    assert _generate_cost(120, 8) == 8       # ceil(128/16)
+    assert _generate_cost(0, 1) == 1
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "0")
+    assert _generate_cost(120, 8) == 8       # ring: the decode budget
+    assert _generate_cost(120, 256) == 256
+
+
+def test_fleet_health_aggregates_generation_per_job():
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import (
+        ChipAllocator,
+        LocalPlacementManager,
+    )
+
+    admin = Admin(db=Database(":memory:"),
+                  placement=LocalPlacementManager(
+                      allocator=ChipAllocator([0])))
+    try:
+        admin.db.get_inference_job_worker = (
+            lambda sid: {"service_id": sid, "inference_job_id": "jobG",
+                         "trial_id": "t"})
+        for sid, hits in (("svcA", 3), ("svcB", 5)):
+            admin.handle_event("inference_worker_stats", {
+                "service_id": sid, "batches": 1, "queries": 4,
+                "gen_slots_busy": 1, "gen_slots_max": 2,
+                "gen_tokens": 10, "gen_job": "jobG",
+                "gen_kv_blocks_used": 6, "gen_kv_pool_blocks": 40,
+                "gen_prefix_hits": hits, "gen_prefix_misses": 1,
+                "gen_prefix_hit_tokens": hits * 16})
+        gen = admin.get_fleet_health()["serving"]["generation"]
+        assert gen["jobG"]["workers"] == 2
+        assert gen["jobG"]["prefix_hits"] == 8
+        assert gen["jobG"]["kv_pool_blocks"] == 80
+        assert gen["jobG"]["prefix_hit_rate"] == 0.8
+        # block occupancy (not slot occupancy) fed the autoscaler ring
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        series = REGISTRY.ring("slot_occupancy:job:jobG").series()
+        assert series and abs(series[-1][1] - 6 / 40) < 1e-9
+    finally:
+        admin.shutdown()
+
+
+# -- doctor -------------------------------------------------------------------
+
+def test_doctor_paged_layout_warns(monkeypatch):
+    from rafiki_tpu.doctor import check_generative_serving
+
+    monkeypatch.setenv("RAFIKI_DB_PATH", "/nonexistent/nowhere.sqlite3")
+    name, status, _ = check_generative_serving()
+    assert name == "generative serving" and status == "PASS"
+    # degenerate block size, both edges
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "2")
+    _, status, detail = check_generative_serving()
+    assert status == "WARN" and "degenerate" in detail
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "9999")
+    _, status, detail = check_generative_serving()
+    assert status == "WARN" and "degenerate" in detail
+    monkeypatch.delenv("RAFIKI_GEN_KV_BLOCK_TOKENS")
+    # pool capacity past the chip-memory heuristic
+    monkeypatch.setenv("RAFIKI_GEN_KV_POOL_BLOCKS", "100000")
+    _, status, detail = check_generative_serving()
+    assert status == "WARN" and "memory heuristic" in detail
+    monkeypatch.delenv("RAFIKI_GEN_KV_POOL_BLOCKS")
+
+
+def test_doctor_warns_disabled_prefix_cache_under_shareable_traffic(
+        monkeypatch):
+    from rafiki_tpu.doctor import check_generative_serving
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    monkeypatch.setenv("RAFIKI_DB_PATH", "/nonexistent/nowhere.sqlite3")
+    # cache ENABLED: shareable traffic is never a warning by itself
+    _, status, _ = check_generative_serving()
+    assert status == "PASS"
+    monkeypatch.setenv("RAFIKI_GEN_PREFIX_CACHE", "0")
+    REGISTRY.counter("rafiki_gen_prefix_shareable_total").inc(5)
+    _, status, detail = check_generative_serving()
+    assert status == "WARN" and "RAFIKI_GEN_PREFIX_CACHE" in detail
