@@ -1143,11 +1143,16 @@ class Admin:
         backlog_fn = getattr(predictor, "backlog_depth", None)
         # tenant = the app: the admin door is SHARED across jobs, so this
         # is where one hot job saturating its weighted fair share gets
-        # 429s while cold jobs keep their latency (RAFIKI_AUTOSCALE_FAIR)
+        # 429s while cold jobs keep their latency (RAFIKI_AUTOSCALE_FAIR).
+        # With the prediction cache on, cost is the MISSES-ONLY estimate
+        # (predictor/result_cache.py) — cache hits shed no load, so the
+        # fairness book charges only what will reach a worker.
+        cost_fn = getattr(predictor, "admission_cost", None)
+        cost = cost_fn(queries) if callable(cost_fn) else len(queries)
         self._predict_admission.admit(
             config.PREDICT_TIMEOUT_S,
             backlog_depth=backlog_fn() if callable(backlog_fn) else None,
-            tenant=tenant, cost=len(queries))
+            tenant=tenant, cost=cost)
         t0 = time.monotonic()
         try:
             preds = predictor.predict_batch(queries)
@@ -1270,12 +1275,27 @@ class Admin:
                 # per-job generative picture: paged-KV pool footprint +
                 # prefix-cache hit rates (worker/kv_paging.py)
                 "generation": generation,
+                # prediction result cache + single-flight picture
+                # (predictor/result_cache.py): bounds, occupancy, and
+                # per-tenant hit rates
+                "prediction_cache": self._prediction_cache_stats(),
             },
             "training": {
                 "jobs": train_jobs,
                 "workers": _tstats(),
             },
         }
+
+    @staticmethod
+    def _prediction_cache_stats() -> Dict[str, Any]:
+        from rafiki_tpu.predictor.result_cache import get_cache
+
+        try:
+            return get_cache().stats()
+        # lint: absorb(fleet health must answer even when the cache probe faults)
+        except Exception:
+            logger.exception("prediction-cache stats probe failed")
+            return {}
 
     def stop_all_jobs(self) -> None:
         """Stop every running train/inference job (reference client

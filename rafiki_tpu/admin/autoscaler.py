@@ -179,6 +179,28 @@ class Autoscaler:
             total += int(s.get("shed_fairness", 0))
         return total
 
+    @staticmethod
+    def _cache_hit_rate(job_id: str, st: Dict[str, Any]
+                        ) -> Optional[float]:
+        """Hit rate of the job's prediction cache since the previous
+        tick (None while the cache serves nothing — keeps pre-cache
+        decision records byte-stable)."""
+        try:
+            from rafiki_tpu.predictor.result_cache import get_cache
+
+            hits, misses = get_cache().job_totals(job_id)
+        # lint: absorb(cache totals are a best-effort signal annotation)
+        except Exception:
+            return None
+        last = st.get("last_cache_totals")
+        st["last_cache_totals"] = (hits, misses)
+        if last is None:
+            return None
+        dh, dm = hits - last[0], misses - last[1]
+        if dh + dm <= 0:
+            return None
+        return round(dh / (dh + dm), 3)
+
     def _tick_job(self, job_id: str, predictor,
                   now: float) -> Optional[Dict[str, Any]]:
         inf = self._db.get_inference_job(job_id)
@@ -250,6 +272,16 @@ class Autoscaler:
         }
         if occ:
             signals["slot_occupancy"] = round(mean_occ, 2)
+        # prediction-cache hit rate since the last tick
+        # (predictor/result_cache.py): purely a decision-record
+        # annotation — backlog and shed already measure MISS load by
+        # construction (hits never touch a queue or shed anyone), which
+        # is exactly why the loop stops flapping when the cache is on.
+        # The operator reading a scale event should see what the cache
+        # absorbed alongside what leaked through.
+        hit_rate = self._cache_hit_rate(job_id, st)
+        if hit_rate is not None:
+            signals["cache_hit_rate"] = hit_rate
         # -- decide --------------------------------------------------------
         step = max(int(config.AUTOSCALE_STEP), 1)
         since_action = now - st["last_action_ts"]

@@ -425,8 +425,12 @@ class RolloutController:
         # lane membership BEFORE the replica becomes routable: a request
         # landing between add_worker and the lane update would ensemble
         # the unjudged canary with the incumbents (and book its outcome
-        # against the incumbent baseline)
-        predictor.set_rollout_lane(set(run.new_sids), run.fraction)
+        # against the incumbent baseline). new_version keys the canary
+        # lane's prediction-cache traffic apart from the incumbents'
+        # (predictor/result_cache.py: a cached canary answer can never
+        # leak into the incumbent lane)
+        predictor.set_rollout_lane(set(run.new_sids), run.fraction,
+                                   new_version=run.to_version)
         predictor.add_worker(sid, run.to_trial)
         self._event(run, "canary_deployed",
                     detail=f"replica {sid[:8]} at fraction "
@@ -478,7 +482,8 @@ class RolloutController:
             # phase (the canary fraction only governed the CANARY phase)
             predictor.set_rollout_lane(
                 set(run.new_sids),
-                len(new) / max(len(old) + len(new), 1))
+                len(new) / max(len(old) + len(new), 1),
+                new_version=run.to_version)
             # keep total capacity >= n_before: place first, then drain.
             # The canary already counts toward the n_before target, so
             # the final fleet converges to exactly the pre-rollout size
@@ -503,7 +508,8 @@ class RolloutController:
                 predictor.set_rollout_lane(
                     set(run.new_sids),
                     (len(new) + placed)
-                    / max(len(old) + len(new) + placed, 1))
+                    / max(len(old) + len(new) + placed, 1),
+                    new_version=run.to_version)
                 predictor.add_worker(sid, run.to_trial)
             victims = [w["service_id"] for w in old[:run.batch]]
             _, removed = self._services.drain_replicas(
@@ -538,7 +544,19 @@ class RolloutController:
     def _finish(self, run: _Run) -> None:
         predictor = self._services.get_predictor(run.job_id)
         if predictor is not None:
+            # promote BEFORE clearing the lane: a request racing the
+            # promotion either keys on the (still-set) canary lane or on
+            # the already-bumped serving version — never on the replaced
+            # version. The flush then drops every older version's
+            # entries (the canary's own fills stay: they are the new
+            # incumbent's warm start) and bumps the fill epoch so a
+            # forward resolved against the replaced fleet can't land.
+            predictor.set_serving_version(run.to_version)
             predictor.clear_rollout_lane()
+        from rafiki_tpu.predictor.result_cache import get_cache
+
+        get_cache().flush_job(run.job_id, keep_version=run.to_version,
+                              reason="rollout done")
         self._db.mark_rollout_phase(run.rollout_id, RolloutPhase.DONE)
         self._m_completed.labels(run.job_id).inc()
         self._event(run, "completed",
@@ -605,6 +623,16 @@ class RolloutController:
         predictor = self._services.get_predictor(job_id)
         if predictor is not None and new_sids:
             predictor.set_rollout_lane(set(new_sids), 0.0)
+        # every cached answer of the aborted version dies NOW — before
+        # the restore places replicas — and the epoch bump drops fills
+        # from forwards still in flight against it. Full flush (not
+        # keep_version): rollbacks are rare, and a cold cache is cheaper
+        # than reasoning about which incumbent entries survived the
+        # churn. A later rollout REUSES this to_version number, so its
+        # entries must be provably gone (predictor/result_cache.py).
+        from rafiki_tpu.predictor.result_cache import get_cache
+
+        get_cache().flush_job(job_id, reason="rollback")
         live = self._services.live_inference_workers(job_id)
         old_live = [w for w in live if w["model_version"] != to_version]
         deficit = n_before - len(old_live)
@@ -639,6 +667,12 @@ class RolloutController:
                     "rollback: draining new-version replicas of job %s "
                     "failed", job_id[:8])
         if predictor is not None:
+            # pin the cache key back to the restored generation: a
+            # predictor ADOPTED over a mixed mid-rollout fleet read its
+            # serving version off the worker rows' max — which is the
+            # version this rollback just retired (live rollbacks no-op:
+            # only _finish ever bumps the serving version)
+            predictor.set_serving_version(from_version)
             predictor.clear_rollout_lane()
 
     # -- boot-time resolution (admin/recovery.py) ---------------------------

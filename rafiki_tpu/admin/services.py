@@ -313,6 +313,13 @@ class ServicesManager:
             psrv = self._predict_servers.pop(inference_job_id, None)
         if psrv is not None:
             psrv.stop()
+        # the job's cached predictions die with its serving head: a
+        # redeploy under the same app must never answer from the torn-
+        # down fleet's cache (predictor/result_cache.py; the epoch bump
+        # also drops in-flight fills that raced this teardown)
+        from rafiki_tpu.predictor.result_cache import get_cache
+
+        get_cache().flush_job(inference_job_id, reason="teardown")
         if inf and inf.get("predictor_service_id"):
             self._db.mark_service_as_stopped(inf["predictor_service_id"])
         if errored:
@@ -532,13 +539,26 @@ class ServicesManager:
         budget = inf.get("budget") or {}
         fused = bool(budget.get(BudgetType.ENSEMBLE_FUSED, 0))
         group = f"fused:{inference_job_id}" if fused else None
+        workers = self._db.get_workers_of_inference_job(inference_job_id)
         worker_trials = {
-            w["service_id"]: (group or w["trial_id"])
-            for w in self._db.get_workers_of_inference_job(inference_job_id)
+            w["service_id"]: (group or w["trial_id"]) for w in workers
         }
+        # recovery adoption invalidates the job's prediction cache: the
+        # adopted fleet may differ from what the dead admin last served
+        # (a rollout resolved at boot, replicas lost), and a pre-crash
+        # answer must never outlive the reconcile (in practice the cache
+        # died with the old process — this guards the same-process
+        # adoption paths tests and retries exercise). The rebuilt
+        # Predictor carries the adopted fleet's real rollout generation
+        # so cache keys stay version-true.
+        from rafiki_tpu.predictor.result_cache import get_cache
+
+        get_cache().flush_job(inference_job_id, reason="adoption")
+        version = max((int(w.get("model_version") or 0) for w in workers),
+                      default=0)
         predictor = Predictor(
             inference_job_id, self._broker, train_job["task"],
-            worker_trials=worker_trials,
+            worker_trials=worker_trials, serving_version=version,
         )
         with self._lock:
             self._predictors[inference_job_id] = predictor

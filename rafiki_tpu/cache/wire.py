@@ -344,6 +344,53 @@ def dumps(obj: Any, trace: Any = None) -> bytes:
     return json.dumps(obj, default=json_default).encode()
 
 
+# -- content digests (prediction result cache) -------------------------------
+
+def canonical_digest(obj: Any) -> "str | None":
+    """Stable content hash of one query for the prediction result cache
+    (predictor/result_cache.py): two byte-identical queries must map to
+    one digest however they arrived. Array-bearing payloads ride the
+    v1 binary encoding (dtype + shape + raw bytes — the same canonical
+    form every serving hop already speaks, so a binary-door query and
+    its JSON-door twin hash alike once decoded); everything else falls
+    back to sorted-key canonical JSON. Returns ``None`` for payloads
+    with no canonical encoding (exotic objects) — the cache treats those
+    as permanently uncacheable, never an error on the serving path.
+
+    Collision stance: blake2b-128 over the canonical bytes. A cache hit
+    substitutes one model forward for another, so the only damage a
+    collision could do is serve query A's prediction to query B — at
+    2^64 birthday cost that is not a realistic event, and the cache is
+    flushed on every model-version change regardless.
+    """
+    import hashlib
+
+    try:
+        if isinstance(obj, np.ndarray) or _has_array(obj):
+            raw = encode(obj)
+        else:
+            raw = json.dumps(obj, sort_keys=True,
+                             separators=(",", ":")).encode()
+    except (TypeError, ValueError):
+        return None
+    return hashlib.blake2b(raw, digest_size=16).hexdigest()
+
+
+def _has_array(obj: Any, depth: int = 0) -> bool:
+    """True when ``obj`` carries an ndarray/numpy scalar anywhere a
+    frame encoder would find one (bounded depth — a pathological deep
+    query just takes the JSON fallback)."""
+    if depth > 8:
+        return False
+    if isinstance(obj, (np.ndarray, np.generic)):
+        return True
+    if isinstance(obj, dict):
+        return any(_has_array(v, depth + 1) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(_has_array(v, depth + 1) for v in obj)
+    return False
+
+
 def stackable(queries: List[Any]) -> bool:
     """True when ``queries`` is a non-empty homogeneous batch of numeric
     ndarrays (same dtype+shape) — the single definition of 'stackable'

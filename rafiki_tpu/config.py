@@ -133,6 +133,39 @@ _DYNAMIC_PATHS = {
     "PREDICT_HEDGE_SUPPRESS_DEPTH": lambda: _env_int(
         "RAFIKI_PREDICT_HEDGE_SUPPRESS_DEPTH", PREDICT_MAX_BATCH_SIZE),
     "PREDICT_DRAIN_S": lambda: _env_float("RAFIKI_PREDICT_DRAIN_S", 5.0),
+    # -- prediction result cache + single-flight coalescing (docs/
+    # performance.md "Prediction caching & single-flight"). Lazy so a
+    # live deployment's NEXT request picks up a retune. OFF by default:
+    # serving identical answers to identical queries is a behavior
+    # change the operator opts into (a template whose predict is
+    # deliberately stochastic would be silently de-randomized):
+    #   RAFIKI_PREDICT_CACHE=1          serve repeated identical queries
+    #                                   from a bounded in-process cache
+    #                                   keyed (query digest, job, served
+    #                                   model version) — invalidated on
+    #                                   deploy/rollback/recovery
+    #                                   adoption, excluded for
+    #                                   TEXT_GENERATION and ensembled-
+    #                                   stochastic jobs
+    #   RAFIKI_PREDICT_CACHE_TTL_S=30   entry lifetime; <=0 disables
+    #                                   fills (doctor WARNs with the
+    #                                   cache on)
+    #   RAFIKI_PREDICT_CACHE_MAX_BYTES=67108864  byte cap, LRU-evicted
+    #                                   (doctor WARNs past the host-
+    #                                   memory heuristic)
+    #   RAFIKI_PREDICT_SINGLEFLIGHT=1   0 = concurrent identical misses
+    #                                   each pay their own forward
+    #                                   instead of sharing the leader's
+    #                                   (only consulted while the cache
+    #                                   is on)
+    "PREDICT_CACHE": lambda: os.environ.get(
+        "RAFIKI_PREDICT_CACHE", "0") == "1",
+    "PREDICT_CACHE_TTL_S": lambda: _env_float(
+        "RAFIKI_PREDICT_CACHE_TTL_S", 30.0),
+    "PREDICT_CACHE_MAX_BYTES": lambda: _env_int(
+        "RAFIKI_PREDICT_CACHE_MAX_BYTES", 64 * 1024 * 1024),
+    "PREDICT_SINGLEFLIGHT": lambda: os.environ.get(
+        "RAFIKI_PREDICT_SINGLEFLIGHT", "1") != "0",
     # -- control-plane crash recovery (docs/failure-model.md, "Control-
     # plane faults"). A fresh Admin on an existing store reconciles the
     # DB against what is actually running before opening its doors:

@@ -774,6 +774,114 @@ def check_generative_serving() -> Check:
     return ("generative serving", PASS, detail)
 
 
+#: prediction-cache byte cap past which the doctor reads "this cache
+#: will contend with the models for host memory" — results live in the
+#: admin process's RAM beside every Predictor, door, and broker ring
+PREDICT_CACHE_BYTES_HEURISTIC = 1 << 30
+
+
+def check_prediction_cache() -> Check:
+    """Prediction result cache + single-flight (docs/performance.md
+    "Prediction caching & single-flight"): WARN when the cache is ON
+    with a zero TTL (every fill is dropped — pure digest overhead), when
+    the byte cap is past the host-memory heuristic, when it is enabled
+    alongside live TEXT_GENERATION jobs (generative serving is excluded
+    by design, so the operator's knob is doing less than they think),
+    and when it is OFF while the sampled duplicate-query counter shows
+    sustained identical-query traffic being forwarded redundantly (the
+    `shareable`-style signal, applied to classification)."""
+    from rafiki_tpu import config
+
+    enabled = bool(config.PREDICT_CACHE)
+    notes = []
+    warn = False
+    if enabled:
+        ttl = float(config.PREDICT_CACHE_TTL_S)
+        if ttl <= 0:
+            warn = True
+            notes.append(
+                f"RAFIKI_PREDICT_CACHE_TTL_S={ttl:g} with the cache ON: "
+                "every fill is dropped, so requests pay the digest cost "
+                "and never hit — set a positive TTL or disable the cache")
+        cap = int(config.PREDICT_CACHE_MAX_BYTES)
+        if cap > PREDICT_CACHE_BYTES_HEURISTIC:
+            warn = True
+            notes.append(
+                f"RAFIKI_PREDICT_CACHE_MAX_BYTES={cap} is past the "
+                f"host-memory heuristic ({PREDICT_CACHE_BYTES_HEURISTIC}): "
+                "the cache shares the admin process's RAM with every "
+                "serving head and broker ring — prefer a shorter TTL "
+                "over a deeper cache")
+        target = str(config.DB_PATH)
+        is_url = target.startswith(("postgresql://", "postgres://"))
+        if is_url or os.path.exists(target):
+            try:
+                from rafiki_tpu.db.database import Database
+
+                db = Database(target)
+                try:
+                    gen_jobs = [
+                        inf["id"][:8]
+                        for inf in db.get_inference_jobs_by_statuses(
+                            ["RUNNING"])
+                        if (db.get_train_job(inf["train_job_id"]) or {}
+                            ).get("task") == "TEXT_GENERATION"]
+                finally:
+                    db.close()
+                if gen_jobs:
+                    warn = True
+                    notes.append(
+                        "RAFIKI_PREDICT_CACHE=1 beside live "
+                        f"TEXT_GENERATION job(s) {gen_jobs}: generative "
+                        "serving is EXCLUDED from the prediction cache "
+                        "by design (token streams answer from decode "
+                        "state, not a one-shot forward) — the knob does "
+                        "nothing for those jobs; the shared-prefix KV "
+                        "cache (RAFIKI_GEN_PREFIX_CACHE) is their "
+                        "equivalent lever")
+            # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+            except Exception as e:
+                notes.append(f"could not scan {target} for generative "
+                             f"jobs: {type(e).__name__}: {e}")
+    else:
+        try:
+            from rafiki_tpu.utils.metrics import REGISTRY
+
+            shareable = REGISTRY.get("rafiki_cache_shareable_total")
+            # per-job labeled family: the signal is the fleet-wide sum
+            shared_n = (sum(ch.value()
+                            for ch in shareable.children().values())
+                        if shareable else 0)
+        # lint: absorb(telemetry probe is best-effort inside a doctor check)
+        except Exception:
+            shared_n = 0
+        if shared_n > 0:
+            warn = True
+            notes.append(
+                f"RAFIKI_PREDICT_CACHE=0 while the sampled duplicate-"
+                f"query probe counted {int(shared_n)} repeat(s) "
+                "(rafiki_cache_shareable_total, 1-in-16 sampling): "
+                "identical queries are re-paying model forwards the "
+                "cache would serve free — consider "
+                "RAFIKI_PREDICT_CACHE=1 (results must be deterministic "
+                "per model version; flushed automatically on deploy/"
+                "rollback/adoption)")
+    if warn:
+        return ("prediction cache", WARN, "; ".join(notes))
+    if not enabled:
+        return ("prediction cache", PASS,
+                "off (default; no duplicate-query traffic observed — "
+                "RAFIKI_PREDICT_CACHE=1 adds a versioned result cache "
+                "with single-flight coalescing)")
+    detail = (f"on: TTL {float(config.PREDICT_CACHE_TTL_S):g}s, cap "
+              f"{int(config.PREDICT_CACHE_MAX_BYTES)} bytes, "
+              "single-flight "
+              + ("on" if bool(config.PREDICT_SINGLEFLIGHT) else "OFF"))
+    if notes:
+        detail += "; " + "; ".join(notes)
+    return ("prediction cache", PASS, detail)
+
+
 def check_autoscaler(total_chips: int = None) -> Check:
     """Elastic serving autoscaler (docs/failure-model.md "Overload
     adaptation"): WARN when the serving plane is visibly shedding while
@@ -1024,6 +1132,7 @@ CHECKS: List[Callable[[], Check]] = [
     check_rollouts, check_trial_faults, check_vectorized_trials,
     check_static_analysis, check_concurrency_lint,
     check_int8_serving, check_generative_serving,
+    check_prediction_cache,
     check_observability, check_agents, check_backend,
 ]
 

@@ -210,7 +210,10 @@ class AdmissionController:
         ``tenant`` names the requesting job/app for the weighted-fair
         gate (``RAFIKI_AUTOSCALE_FAIR``); ``cost`` is the query count the
         tenant is charged on admission. ``None`` (every pre-existing call
-        site) skips fairness entirely."""
+        site) skips fairness entirely. ``cost=0`` is legal — a request
+        the prediction cache will answer entirely still claims an
+        in-flight slot (the handler thread is real) but charges nothing
+        to the fairness book (it sheds no load onto the worker fleet)."""
         with self._lock:
             cap = self._cap()
             if tenant is not None:
@@ -228,7 +231,7 @@ class AdmissionController:
                     f"in flight)",
                     retry_after_s=max(self._ewma_query_s, 1.0))
             if tenant is not None:
-                self._fair_gate_locked(tenant, max(int(cost), 1), cap)
+                self._fair_gate_locked(tenant, max(int(cost), 0), cap)
             est_wait = (backlog_depth * self._ewma_query_s
                         if backlog_depth and self._ewma_query_s > 0 else 0.0)
             if est_wait > timeout_s > 0:
@@ -247,8 +250,9 @@ class AdmissionController:
                     self._fair_inflight.get(tenant, 0) + 1)
                 # charge only what was actually ADMITTED — a request shed
                 # at the capacity/deadline/fairness checks above must not
-                # inflate the tenant's "admitted queries" book
-                self._fair_charge_locked(tenant, max(int(cost), 1))
+                # inflate the tenant's "admitted queries" book (cost 0:
+                # a fully-cache-served request charges nothing)
+                self._fair_charge_locked(tenant, max(int(cost), 0))
             self._m_admitted.inc()
             self._g_inflight.inc()
 

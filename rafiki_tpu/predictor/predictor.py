@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import config
 from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
-from rafiki_tpu.predictor.ensemble import ensemble_predictions
+from rafiki_tpu.predictor.ensemble import _PROB_TASKS, ensemble_predictions
 
 logger = logging.getLogger(__name__)
 
@@ -43,11 +43,19 @@ LANE_CANARY = "canary"
 class Predictor:
     def __init__(self, inference_job_id: str, broker: Broker,
                  task: Optional[str],
-                 worker_trials: Optional[Dict[str, str]] = None):
+                 worker_trials: Optional[Dict[str, str]] = None,
+                 serving_version: int = 0):
         """``worker_trials`` maps worker service_id -> trial_id (built by the
         deploy path from the inference_job_worker rows). Workers absent from
         the map are treated as single-replica trials of their own — the
-        fan-out-to-all behavior degrades gracefully, never silently drops."""
+        fan-out-to-all behavior degrades gracefully, never silently drops.
+
+        ``serving_version`` is the fleet's rollout generation (the
+        ``model_version`` on the inference_job_worker rows; 0 for an
+        initial deploy) — the prediction result cache keys on it, so a
+        rebuilt Predictor (recovery adoption) must carry the adopted
+        fleet's real version, and a completed rollout bumps it via
+        :meth:`set_serving_version`."""
         self._job_id = inference_job_id
         self._broker = broker
         self._task = task
@@ -95,6 +103,21 @@ class Predictor:
         self._lane_new: Optional[set] = None
         self._lane_permille = 0
         self._lane_counter = itertools.count()
+        # -- prediction result cache (predictor/result_cache.py) ----------
+        # the cache keys on (digest, job, SERVED model version):
+        # _serving_version is the incumbent fleet's rollout generation,
+        # _lane_version the new version while a rollout lane is set.
+        # Both guarded by _route_lock (they change exactly when lane/
+        # routing state does).
+        self._serving_version = int(serving_version)  # guarded-by: _route_lock
+        self._lane_version: Optional[int] = None  # guarded-by: _route_lock
+        # sampled duplicate-query probe for the cache-OFF shareable
+        # signal (doctor): itertools.count is atomic enough for sampling
+        self._share_rr = itertools.count()
+        self._cache_degraded_logged = False
+        # per-thread digest hand-off from admission_cost to the serve
+        # path (one canonical-digest pass per request, not two)
+        self._tls = threading.local()
         # (monotonic_ts, duration_s, outcome) per lane, judge-windowed
         self._lane_stats: Dict[str, collections.deque] = {
             LANE_INCUMBENT: collections.deque(maxlen=4096),
@@ -152,17 +175,26 @@ class Predictor:
     # -- rollout version lanes (admin/rollout.py; docs/failure-model.md
     # "Rollout faults") ------------------------------------------------------
 
-    def set_rollout_lane(self, new_workers, fraction: float) -> None:
+    def set_rollout_lane(self, new_workers, fraction: float,
+                         new_version: Optional[int] = None) -> None:
         """Begin (or re-weight) version-lane routing: ``new_workers`` are
         the new-version replicas; ``fraction`` of requests route to them
         (deterministic weighted counter, not randomness). Starting a lane
         from scratch clears the per-lane outcome history so the judge
-        never reads a previous rollout's window."""
+        never reads a previous rollout's window.
+
+        ``new_version`` is the canary lane's model version (the rollout
+        controller's ``to_version``): the prediction cache keys canary-
+        lane traffic on it so a cached canary answer can never leak into
+        the incumbent lane. ``None`` keeps the current lane version (the
+        re-weight calls mid-rolling and the rollback's fraction-0 call)."""
         permille = max(0, min(int(round(float(fraction) * 1000)), 1000))
         with self._route_lock:
             fresh = self._lane_new is None
             self._lane_new = set(new_workers)
             self._lane_permille = permille
+            if new_version is not None:
+                self._lane_version = int(new_version)
             if fresh:
                 for dq in self._lane_stats.values():
                     dq.clear()
@@ -173,6 +205,19 @@ class Predictor:
         with self._route_lock:
             self._lane_new = None
             self._lane_permille = 0
+            self._lane_version = None
+
+    def set_serving_version(self, version: int) -> None:
+        """The incumbent fleet's rollout generation moved (rollout DONE
+        promotes ``to_version``): subsequent cache reads/fills key on the
+        new version — entries of the replaced model become structurally
+        unreachable even before the flush removes them."""
+        with self._route_lock:
+            self._serving_version = int(version)
+
+    def serving_version(self) -> int:
+        with self._route_lock:
+            return self._serving_version
 
     def _lane_snapshot(self):
         with self._route_lock:
@@ -371,7 +416,15 @@ class Predictor:
         version replica sheds or errors **fails over to the incumbent
         lane** (bounded blast radius: a bad canary costs the judge an
         error sample, never the client a request); incumbent-lane
-        failures never fall back onto the version under judgment."""
+        failures never fall back onto the version under judgment.
+
+        With ``RAFIKI_PREDICT_CACHE=1`` (predictor/result_cache.py),
+        repeated identical queries are answered from a bounded versioned
+        cache before any worker queue is touched, and concurrent
+        identical misses coalesce into one forward (single-flight). The
+        cache path is taken per query, so a mixed request forwards only
+        its misses — the batching-aware fill then lands one entry per
+        resolved query."""
         timeout_s = timeout_s if timeout_s is not None else config.PREDICT_TIMEOUT_S
         deadline = time.monotonic() + timeout_s
         queues = self._broker.get_worker_queues(self._job_id)
@@ -383,51 +436,333 @@ class Predictor:
         routable = [w for w in queues
                     if not trials or w in trials] or list(queues)
         lane_new, permille = self._lane_snapshot()
-        if lane_new is None:
-            return self._predict_on(
+        # ONE lane draw per request, shared by the cached and uncached
+        # paths (drawing per sub-batch would skew the canary interleave),
+        # and ONE lane split shared by the cache plan and the serving
+        # path — the cache must key on the lane that will actually serve
+        take_new = (self._lane_take_new(permille)
+                    if lane_new is not None else False)
+        split = self._lane_split(routable, lane_new, take_new)
+        plan = self._cache_plan(split)
+        if plan is None:
+            # drop any digest stash admission_cost left on this thread —
+            # the uncached path will never consume it
+            self._take_digest_stash(queries)
+            self._maybe_note_shareable(queries)
+            preds, _fillable = self._serve_lanes(
                 queries, queues, routable, trials, draining, deadline,
-                trace)
-        take_new = self._lane_take_new(permille)
+                trace, split)
+            return preds
+        return self._serve_cached(
+            plan, queries, queues, routable, trials, draining, deadline,
+            trace, split)
+
+    @staticmethod
+    def _lane_split(routable: List[str], lane_new: Optional[set],
+                    take_new: bool):
+        """The one routing decision for a laned request, shared by the
+        cache plan and the serving path: ``None`` with no lane set, else
+        ``(primary, fallback, lane, pure)`` — ``pure`` is False when the
+        CANARY label is serving a set that may contain incumbents (the
+        canary replica vanished and ``routable`` is all that's left), in
+        which case nothing served here may be cached under the new
+        version."""
+        if lane_new is None:
+            return None
         new_r = [w for w in routable if w in lane_new]
         old_r = [w for w in routable if w not in lane_new]
         if take_new and new_r:
-            primary, fallback, lane = new_r, old_r, LANE_CANARY
-        elif old_r:
-            primary, fallback, lane = old_r, [], LANE_INCUMBENT
-        else:
-            # nothing but new-version replicas left (tail of the rolling
-            # phase): they serve everything
-            primary, fallback, lane = new_r or routable, [], LANE_CANARY
+            return new_r, old_r, LANE_CANARY, True
+        if old_r:
+            return old_r, [], LANE_INCUMBENT, True
+        # nothing but new-version replicas left (tail of the rolling
+        # phase): they serve everything
+        return new_r or routable, [], LANE_CANARY, bool(new_r)
+
+    def _serve_lanes(
+        self, queries: List[Any], queues, routable: List[str],
+        trials: Dict[str, str], draining: set, deadline: float, trace,
+        split,
+    ) -> "tuple[List[Any], bool]":
+        """Route one (sub-)request through the version lanes (or the
+        whole fan-out when no lane is set) and record the lane outcome.
+        Returns ``(predictions, fillable)``: False when the answer must
+        not be cached under the plan's version key — a trial was shed or
+        SLO-dropped (a degraded ensemble must not be memorized for the
+        TTL), or a canary-lane failure FAILED OVER to the incumbents
+        (the old model's forward must never land under the new version's
+        key)."""
+        if split is None:
+            return self._predict_on(
+                queries, queues, routable, trials, draining, deadline,
+                trace)
+        primary, fallback, lane, _pure = split
         t0 = time.monotonic()
         try:
-            preds = self._predict_on(
+            preds, fillable = self._predict_on(
                 queries, queues, primary, trials, draining, deadline,
                 trace)
         except QueueFullError:
             self._lane_record(lane, "shed", time.monotonic() - t0)
             if lane == LANE_CANARY and fallback \
                     and time.monotonic() < deadline:
-                return self._predict_on(
+                preds, _ = self._predict_on(
                     queries, queues, fallback, trials, draining, deadline,
                     trace)
+                return preds, False  # incumbent forward: never cacheable
             raise
         except Exception:
             self._lane_record(lane, "error", time.monotonic() - t0)
             if lane == LANE_CANARY and fallback \
                     and time.monotonic() < deadline:
-                return self._predict_on(
+                preds, _ = self._predict_on(
                     queries, queues, fallback, trials, draining, deadline,
                     trace)
+                return preds, False  # incumbent forward: never cacheable
             raise
         self._lane_record(lane, "ok", time.monotonic() - t0)
-        return preds
+        return preds, fillable
+
+    # -- prediction result cache (predictor/result_cache.py; docs/
+    # performance.md "Prediction caching & single-flight") -------------------
+
+    def _cacheable_task(self) -> bool:
+        """Caching needs the served answer to be a deterministic function
+        of (query, model version). TEXT_GENERATION streams never ride
+        predict_batch but a misrouted probe must still be refused; a
+        non-probability task ensembled across SEVERAL trials answers with
+        whichever trial happened to respond first — stochastic under
+        failover/round-robin, so excluded."""
+        from rafiki_tpu.constants import TaskType
+
+        if self._task == TaskType.TEXT_GENERATION:
+            return False
+        if self._task in _PROB_TASKS:
+            return True
+        with self._route_lock:
+            groups = set(self._worker_trials.values())
+        return len(groups) <= 1
+
+    def _cache_plan(self, split) -> "Optional[tuple[int, bool]]":
+        """``None`` when this request must bypass the cache entirely,
+        else ``(version, read_ok)`` — the model version to key on and
+        whether cached answers may be SERVED. Canary-lane requests are
+        fill-only (``read_ok=False``): the SLO judge needs real forwards
+        to sample, and coalescing/serving from cache would starve it —
+        their fills land under the lane's version, so they can never be
+        read back by incumbent-lane traffic. An IMPURE canary split (the
+        canary replica vanished and whatever is routable serves under
+        the CANARY label) bypasses the cache outright: the serving set's
+        version is unknowable, so neither key space may be read or
+        filled."""
+        if not config.PREDICT_CACHE or not self._cacheable_task():
+            return None
+        if split is not None:
+            _primary, _fallback, lane, pure = split
+            if lane == LANE_CANARY:
+                if not pure:
+                    return None
+                with self._route_lock:
+                    lane_version = self._lane_version
+                    serving = self._serving_version
+                return ((lane_version if lane_version is not None
+                         else serving), False)
+        with self._route_lock:
+            return (self._serving_version, True)
+
+    def _cache_op(self, fn, fallback):
+        """Degrade guard around EVERY cache operation: a broken cache
+        (RAFIKI_CHAOS site=cache, or any internal fault) serves the miss
+        path, never a failed request."""
+        try:
+            return fn()
+        # lint: absorb(a broken prediction cache degrades to miss-path serving, never fails a request)
+        except Exception:
+            from rafiki_tpu.predictor import result_cache
+
+            if not self._cache_degraded_logged:
+                self._cache_degraded_logged = True
+                logger.warning(
+                    "prediction cache degraded for job %s; serving the "
+                    "miss path (logged once)", self._job_id,
+                    exc_info=True)
+            try:
+                result_cache.get_cache().note_degraded()
+            # lint: absorb(the degraded-counter bump is itself best-effort)
+            except Exception:
+                pass
+            return fallback
+
+    def _serve_cached(
+        self, plan: "tuple[int, bool]", queries: List[Any], queues,
+        routable: List[str], trials: Dict[str, str], draining: set,
+        deadline: float, trace, split,
+    ) -> List[Any]:
+        """The cache-fronted serve: answer per-query hits from the
+        versioned cache, coalesce concurrent identical misses behind one
+        single-flight leader, forward ONLY the remaining misses as one
+        sub-batch, then fill per-query entries from the resolved batch."""
+        from rafiki_tpu.predictor import result_cache
+
+        version, read_ok = plan
+        cache = result_cache.get_cache()
+        job = self._job_id
+        epoch = self._cache_op(lambda: cache.epoch(job), 0)
+        digests = self._request_digests(queries)
+        results: List[Any] = [None] * len(queries)
+        use_sf = read_ok and bool(config.PREDICT_SINGLEFLIGHT)
+        followers: Dict[int, QueryFuture] = {}
+        lead: Dict[str, List[int]] = {}  # digest -> this request's indices
+        flights: Dict[str, Any] = {}     # digest -> flight this thread leads
+        miss_idx: List[int] = []
+        for i, d in enumerate(digests):
+            if d is None:
+                miss_idx.append(i)  # uncacheable: always a forward
+                continue
+            if d in lead:
+                # duplicate inside one request: one forward, shared below
+                lead[d].append(i)
+                continue
+            if read_ok:
+                hit, value = self._cache_op(
+                    lambda d=d: cache.lookup(job, version, d),
+                    (False, None))
+                if hit:
+                    results[i] = value
+                    continue
+            if use_sf:
+                role = self._cache_op(
+                    lambda d=d: cache.join_flight(job, version, d), None)
+                if role is not None:
+                    leader, flight = role
+                    if not leader:
+                        followers[i] = flight.future
+                        continue
+                    flights[d] = flight
+            lead[d] = [i]
+            miss_idx.append(i)
+        fillable = False
+        if miss_idx:
+            try:
+                miss_preds, fillable = self._serve_lanes(
+                    [queries[i] for i in miss_idx], queues, routable,
+                    trials, draining, deadline, trace, split)
+            except BaseException as e:
+                # followers of this leader's flights must fail typed NOW,
+                # not hang to their own deadlines
+                for d, flight in flights.items():
+                    cache.fail_flight(job, version, d, flight, e)
+                raise
+            for i, pred in zip(miss_idx, miss_preds):
+                results[i] = pred
+        for d, idxs in lead.items():
+            value = results[idxs[0]]
+            for j in idxs[1:]:
+                results[j] = value
+            if d in flights:
+                cache.resolve_flight(job, version, d, flights[d], value)
+            if fillable:
+                self._cache_op(
+                    lambda d=d, v=value: cache.fill(job, version, d, v,
+                                                    epoch),
+                    False)
+        # followers LAST: every flight this thread leads is resolved
+        # above, so two requests leading/following each other's digests
+        # can never deadlock. A leader-side error re-raises here as a
+        # per-waiter copy (QueryFuture semantics); a silent leader runs
+        # this request into its own SLO timeout.
+        for i, fut in followers.items():
+            results[i] = fut.result(max(deadline - time.monotonic(), 0.0))
+        return results
+
+    def _take_digest_stash(self, queries: List[Any]):
+        """Consume the thread-local digest hand-off from
+        :meth:`admission_cost` — cleared UNCONDITIONALLY (matching or
+        not): a stash a shed request left behind must not outlive the
+        thread's next predict. (Retention bound without this call: one
+        request payload per live connection — ThreadingHTTPServer runs
+        one thread per connection — until disconnect.)"""
+        stash = getattr(self._tls, "digests", None)
+        if stash is not None:
+            self._tls.digests = None
+            if stash[0] is queries:
+                return stash[1]
+        return None
+
+    def _request_digests(self, queries: List[Any]) -> List[Optional[str]]:
+        """Per-query canonical digests, computed ONCE per request: the
+        door's :meth:`admission_cost` stashes its digests in a
+        thread-local keyed by the very ``queries`` object (the door
+        calls predict on the same handler thread with the same list), so
+        the serve path never re-hashes the payload. The stash holds a
+        strong reference to the list, so its identity cannot be recycled
+        while the entry lives."""
+        stashed = self._take_digest_stash(queries)
+        if stashed is not None:
+            return stashed
+        from rafiki_tpu.cache import wire
+
+        return [
+            self._cache_op(lambda q=q: wire.canonical_digest(q), None)
+            for q in queries]
+
+    def admission_cost(self, queries: List[Any]) -> int:
+        """The doors' misses-only admission/fairness cost: queries the
+        cache will answer shed no load, so tenant fairness (PR 7) must
+        not charge for them. Full cost while a rollout lane is set (the
+        lane draw happens per request, later) and whenever the cache is
+        off, excluded, or degraded."""
+        lane_new, _permille = self._lane_snapshot()
+        if lane_new is not None:
+            return len(queries)
+        plan = self._cache_plan(None)
+        if plan is None:
+            return len(queries)
+        version, _read_ok = plan
+
+        def peek() -> int:
+            from rafiki_tpu.predictor import result_cache
+
+            digests = self._request_digests(queries)
+            # hand the digests to the serve path on this same thread —
+            # predict_batch is the door's very next call with this list
+            self._tls.digests = (queries, digests)
+            return result_cache.get_cache().peek_misses(
+                self._job_id, version, digests)
+
+        return self._cache_op(peek, len(queries))
+
+    def _maybe_note_shareable(self, queries: List[Any]) -> None:
+        """Cache-OFF duplicate-traffic probe (sampled 1-in-16 so the
+        uncached hot path never pays a digest per request): feeds the
+        ``rafiki_cache_shareable_total`` counter the doctor reads as
+        "identical-query traffic is being forwarded redundantly — turn
+        the cache on"."""
+        if config.PREDICT_CACHE or not queries:
+            return
+        if next(self._share_rr) % 16:
+            return
+        if not self._cacheable_task():
+            return
+
+        def probe() -> None:
+            from rafiki_tpu.cache import wire
+            from rafiki_tpu.predictor import result_cache
+
+            result_cache.get_cache().note_shareable(
+                self._job_id, wire.canonical_digest(queries[0]))
+
+        self._cache_op(probe, None)
 
     def _predict_on(
         self, queries: List[Any], queues, routable: List[str],
         trials: Dict[str, str], draining: set, deadline: float, trace,
-    ) -> List[Any]:
+    ) -> "tuple[List[Any], bool]":
         """Serve one request against the given routable worker set (the
-        whole fan-out normally; one version lane during a rollout)."""
+        whole fan-out normally; one version lane during a rollout).
+        Returns ``(predictions, complete)``; ``complete`` is False when
+        any trial was shed or SLO-dropped from the ensemble (the cache
+        must not memorize a degraded answer for the TTL)."""
         # group live workers by trial; with no trial map at all (legacy
         # standalone jobs) unknown workers stand alone, but when a map
         # exists an unmapped queue is a scaled-up replica still WARMING
@@ -497,7 +832,7 @@ class Predictor:
         return [
             ensemble_predictions([w[i] for w in answered], self._task)
             for i in range(len(queries))
-        ]
+        ], len(answered) == len(groups)
 
     def _gather_with_failover(self, trial, order, queues, queries,
                               first_futs, deadline) -> Optional[List[Any]]:

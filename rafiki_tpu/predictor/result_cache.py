@@ -1,0 +1,487 @@
+"""Bounded, versioned prediction result cache with single-flight
+coalescing — the data plane's "stop doing the work at all" tier.
+
+With the binary wire codec, batched workers, and elastic replicas in
+place, the remaining serving lever is not executing redundant forwards:
+under a Zipfian traffic mix, identical queries should pay ONE model
+forward, not N. This module is that tier, answered at the Predictor
+BEFORE a worker queue is ever touched:
+
+- **Keying.** Queries are content-hashed through the canonical wire
+  encoding (``cache/wire.canonical_digest`` — the binary v1 frame for
+  array payloads, sorted-key canonical JSON otherwise) into a digest;
+  entries are keyed ``(inference_job_id, served model_version,
+  digest)``. The version component is what makes staleness structural:
+  a rollout's new version writes and reads a different key space, so a
+  cached canary answer can never be served to an incumbent-lane request
+  however the flush timing races.
+
+- **Bounds.** One TTL (``RAFIKI_PREDICT_CACHE_TTL_S``) plus a byte cap
+  (``RAFIKI_PREDICT_CACHE_MAX_BYTES``) enforced LRU — the cache can
+  never grow past its budget however hot the traffic.
+
+- **Single-flight.** Concurrent identical *in-flight* misses share one
+  :class:`~rafiki_tpu.cache.queue.QueryFuture`: the first requester
+  (the leader) executes the real forward and resolves the flight; the
+  followers wait on it and are counted ``coalesced``. A stampede of K
+  identical cold queries costs exactly one worker batch.
+
+- **Invalidation.** ``flush_job`` drops a job's entries and bumps its
+  *fill epoch*; fills carry the epoch observed at miss time and are
+  dropped when it moved — a forward that resolved against the
+  pre-flush fleet can never repopulate the cache after a deploy,
+  rollback, or recovery adoption invalidated it. Call sites:
+  ``ServicesManager._teardown_serving`` (stop/redeploy),
+  ``ServicesManager.adopt_inference_job`` (recovery adoption),
+  ``RolloutController`` (rollout DONE keeps only the new version;
+  rollback drops everything).
+
+- **Degradation.** Every operation asks ``RAFIKI_CHAOS site=cache``
+  first, and the Predictor absorbs ANY cache exception into the miss
+  path — a broken cache serves real forwards, never a failed request.
+
+Locking protocol (concurrency analyzer, docs/static-analysis.md): one
+``_lock`` guards every piece of shared state — the entry map, the byte
+total, the per-job epochs, and the single-flight registry. Public
+methods take the lock for O(1)-ish critical sections and never call
+user code or block while holding it; flight waiters block on the
+flight's own QueryFuture *outside* the lock. Registry metric objects
+are internally locked by utils/metrics.py and are incremented outside
+``_lock`` where convenient.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from rafiki_tpu.cache.queue import QueryFuture
+from rafiki_tpu.utils import chaos
+
+logger = logging.getLogger(__name__)
+
+#: byte-estimate floor per entry: the key tuple, OrderedDict slot, and
+#: list cell cost real memory even for a tiny prediction
+_ENTRY_OVERHEAD = 256
+
+
+class CacheChaosError(RuntimeError):
+    """A ``RAFIKI_CHAOS site=cache`` rule fired on a cache operation.
+    Only ever raised INTO the predictor's absorb-and-degrade guard —
+    the drill that proves a broken cache never fails a request."""
+
+
+class _Flight:
+    """One in-flight single-flight entry: the leader's pending result.
+    The object itself is the leader's resolution token — resolve/fail
+    complete THIS flight's future whether or not it is still registered
+    (a flush may have detached it; its waiters must still be answered)."""
+
+    __slots__ = ("future",)
+
+    def __init__(self) -> None:
+        self.future = QueryFuture()
+
+
+def _estimate_bytes(value: Any, depth: int = 0) -> int:
+    """Cheap recursive size estimate of a JSON-native prediction (the
+    ensemble layer strips numpy before results reach the cache). Depth-
+    bounded: a pathological nesting just over-counts via the fallback."""
+    if depth > 6:
+        return 64
+    if value is None or isinstance(value, bool):
+        return 8
+    if isinstance(value, (int, float)):
+        return 16
+    if isinstance(value, str):
+        return 48 + len(value)
+    if isinstance(value, bytes):
+        return 48 + len(value)
+    if isinstance(value, dict):
+        return 64 + sum(_estimate_bytes(k, depth + 1)
+                        + _estimate_bytes(v, depth + 1)
+                        for k, v in value.items())
+    if isinstance(value, (list, tuple)):
+        return 64 + sum(_estimate_bytes(v, depth + 1) for v in value)
+    nbytes = getattr(value, "nbytes", None)  # stray ndarray
+    if isinstance(nbytes, int):
+        return 64 + nbytes
+    return 128
+
+
+class ResultCache:
+    """Process-wide prediction result cache (one per process, like the
+    metrics registry — both serving doors of every job in this admin
+    share it; the job id in the key keeps tenants apart)."""
+
+    def __init__(self, max_bytes: Optional[int] = None,
+                 ttl_s: Optional[float] = None) -> None:
+        #: None defers to the RAFIKI_PREDICT_CACHE_* knobs lazily per
+        #: operation, so a live deployment's next request picks up a
+        #: retune without re-importing
+        self._max_bytes = max_bytes
+        self._ttl_s = ttl_s
+        self._lock = threading.Lock()
+        # (job, version, digest) -> [value, nbytes, expires_at_monotonic]
+        self._entries: "collections.OrderedDict[Tuple[str, int, str], list]" \
+            = collections.OrderedDict()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        # incremental per-job entry counts so stats() never walks the
+        # whole entry map under _lock (the serving hot path shares it)
+        self._job_entries: Dict[str, int] = {}  # guarded-by: _lock
+        # job -> fill epoch; bumped by flush_job so a fill computed
+        # against a pre-flush fleet is dropped instead of resurrecting
+        # stale answers
+        self._epochs: Dict[str, int] = {}  # guarded-by: _lock
+        # (job, version, digest) -> _Flight (single-flight registry)
+        self._flights: Dict[Tuple[str, int, str], _Flight] = {}  # guarded-by: _lock
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._m_hits = REGISTRY.counter(
+            "rafiki_cache_hits_total",
+            "prediction cache hits (per tenant job)", ("job",))
+        self._m_misses = REGISTRY.counter(
+            "rafiki_cache_misses_total",
+            "prediction cache misses (per tenant job)", ("job",))
+        self._m_coalesced = REGISTRY.counter(
+            "rafiki_cache_coalesced_total",
+            "identical in-flight queries answered by a shared "
+            "single-flight forward instead of their own", ("job",))
+        self._m_evictions = REGISTRY.counter(
+            "rafiki_cache_evictions_total",
+            "prediction cache entries evicted "
+            "(reason: ttl|bytes|flush)", ("reason",))
+        self._m_bytes = REGISTRY.gauge(
+            "rafiki_cache_bytes",
+            "estimated bytes held by the prediction result cache")
+        self._m_shareable = REGISTRY.counter(
+            "rafiki_cache_shareable_total",
+            "sampled duplicate-query observations while the prediction "
+            "cache is OFF (the doctor's enable-the-cache signal)",
+            ("job",))
+        self._m_errors = REGISTRY.counter(
+            "rafiki_cache_errors_total",
+            "cache operations absorbed into the miss path (chaos or "
+            "internal faults; serving degraded, never failed)")
+        # duplicate-digest probe for the cache-off shareable signal:
+        # bounded per-job recent-digest windows (see note_shareable)
+        self._share_seen: Dict[str, "collections.OrderedDict[str, None]"] \
+            = {}  # guarded-by: _lock
+
+    # -- knobs (lazy) --------------------------------------------------------
+
+    def _cap_bytes(self) -> int:
+        if self._max_bytes is not None:
+            return int(self._max_bytes)
+        from rafiki_tpu import config
+
+        return int(config.PREDICT_CACHE_MAX_BYTES)
+
+    def _ttl(self) -> float:
+        if self._ttl_s is not None:
+            return float(self._ttl_s)
+        from rafiki_tpu import config
+
+        return float(config.PREDICT_CACHE_TTL_S)
+
+    def _chaos(self, job: str, op: str) -> None:
+        rule = chaos.hit(chaos.SITE_CACHE, f"{job}/{op}")
+        if rule is None:
+            return
+        if rule.action == chaos.ACTION_DELAY:
+            chaos.sleep_for(rule)
+            return
+        raise CacheChaosError(
+            f"chaos-injected cache {op} failure for job {job}")
+
+    # -- epochs --------------------------------------------------------------
+
+    def epoch(self, job: str) -> int:
+        """The job's current fill epoch — read BEFORE serving a miss,
+        passed back to :meth:`fill`; a flush in between invalidates the
+        fill."""
+        with self._lock:
+            return self._epochs.get(job, 0)
+
+    # -- lookup / fill -------------------------------------------------------
+
+    def lookup(self, job: str, version: int, digest: str
+               ) -> Tuple[bool, Any]:
+        """``(hit, value)``. A TTL-expired entry is evicted here (reason
+        ``ttl``) and reads as a miss. Counts the per-job hit/miss
+        metrics; chaos may raise — callers degrade to the miss path."""
+        self._chaos(job, "lookup")
+        key = (job, int(version), digest)
+        now = time.monotonic()
+        expired = False
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry[2] <= now:
+                self._drop_locked(key, entry)
+                expired = True
+                entry = None
+            if entry is None:
+                hit = False
+                value = None
+            else:
+                self._entries.move_to_end(key)
+                hit, value = True, entry[0]
+            total = self._bytes
+        if expired:
+            self._m_evictions.labels("ttl").inc()
+            self._m_bytes.set(total)
+        (self._m_hits if hit else self._m_misses).labels(job).inc()
+        return hit, value
+
+    def peek_misses(self, job: str, version: int,
+                    digests: Iterable[Optional[str]]) -> int:
+        """How many of ``digests`` would MISS right now — the doors'
+        misses-only admission cost (tenant fairness charges what will
+        actually reach a worker). Read-only: no metrics, no LRU touch,
+        no chaos — this runs before admission on every request and must
+        stay nanoseconds."""
+        now = time.monotonic()
+        misses = 0
+        seen = set()
+        with self._lock:
+            for d in digests:
+                if d is None:
+                    misses += 1
+                    continue
+                if d in seen:
+                    # within-request duplicates coalesce into ONE forward
+                    # on the serve path — charge what actually reaches a
+                    # worker
+                    continue
+                seen.add(d)
+                entry = self._entries.get((job, int(version), d))
+                if entry is None or entry[2] <= now:
+                    misses += 1
+        return misses
+
+    def fill(self, job: str, version: int, digest: str, value: Any,
+             epoch: int) -> bool:
+        """Insert one served prediction (the batching-aware fill: each
+        resolved query of a batch lands as its own entry). Dropped when
+        the job's epoch moved past ``epoch`` (a flush invalidated the
+        fleet this forward ran against) or when the TTL/byte budget is
+        zero. Returns True when the entry landed."""
+        self._chaos(job, "fill")
+        ttl = self._ttl()
+        cap = self._cap_bytes()
+        if ttl <= 0 or cap <= 0:
+            return False
+        nbytes = _ENTRY_OVERHEAD + _estimate_bytes(value)
+        if nbytes > cap:
+            return False  # one giant prediction must not wipe the cache
+        key = (job, int(version), digest)
+        expires = time.monotonic() + ttl
+        with self._lock:
+            if self._epochs.get(job, 0) != epoch:
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            else:
+                self._job_entries[job] = self._job_entries.get(job, 0) + 1
+            self._entries[key] = [value, nbytes, expires]
+            self._bytes += nbytes
+            evicted = 0
+            while self._bytes > cap and self._entries:
+                k, e = self._entries.popitem(last=False)
+                self._bytes -= e[1]
+                self._dec_job_entries_locked(k[0])
+                evicted += 1
+            total = self._bytes
+        if evicted:
+            self._m_evictions.labels("bytes").inc(evicted)
+        self._m_bytes.set(total)
+        return True
+
+    def _drop_locked(self, key, entry) -> None:  # guarded-by: _lock
+        self._entries.pop(key, None)
+        self._bytes -= entry[1]
+        self._dec_job_entries_locked(key[0])
+
+    def _dec_job_entries_locked(self, job: str) -> None:  # guarded-by: _lock
+        n = self._job_entries.get(job, 0) - 1
+        if n > 0:
+            self._job_entries[job] = n
+        else:
+            self._job_entries.pop(job, None)
+
+    # -- single-flight -------------------------------------------------------
+
+    def join_flight(self, job: str, version: int, digest: str
+                    ) -> Tuple[bool, _Flight]:
+        """``(is_leader, flight)``. The leader keeps the flight object
+        and MUST later call :meth:`resolve_flight` or
+        :meth:`fail_flight` with it — followers block on
+        ``flight.future`` (outside any cache lock) and are counted
+        ``coalesced``. Chaos may raise; callers degrade to leaderless
+        (everyone forwards independently)."""
+        self._chaos(job, "join")
+        key = (job, int(version), digest)
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = _Flight()
+                self._flights[key] = flight
+                return True, flight
+        self._m_coalesced.labels(job).inc()
+        return False, flight
+
+    def resolve_flight(self, job: str, version: int, digest: str,
+                       flight: _Flight, value: Any) -> None:
+        """Leader-side completion: hand ``value`` to every follower of
+        THIS flight and retire it from the registry — but only when the
+        registry still holds this very object (a flush may have detached
+        it, and a NEW leader's flight under the same key must not be
+        evicted by the old leader's completion)."""
+        with self._lock:
+            key = (job, int(version), digest)
+            if self._flights.get(key) is flight:
+                del self._flights[key]
+        flight.future.set_result(value)
+
+    def fail_flight(self, job: str, version: int, digest: str,
+                    flight: _Flight, error: BaseException) -> None:
+        """Leader-side failure: this flight's followers re-raise a
+        per-waiter copy of the leader's error (QueryFuture semantics)
+        instead of hanging to their deadline."""
+        with self._lock:
+            key = (job, int(version), digest)
+            if self._flights.get(key) is flight:
+                del self._flights[key]
+        flight.future.set_error(error)
+
+    # -- invalidation --------------------------------------------------------
+
+    def flush_job(self, job: str, keep_version: Optional[int] = None,
+                  reason: str = "flush") -> int:
+        """Drop the job's entries — all of them, or (``keep_version``)
+        every version EXCEPT the one that remains valid (rollout DONE
+        keeps the just-promoted version's warm entries). Always bumps the
+        job's fill epoch, so in-flight fills that observed the pre-flush
+        fleet are dropped on arrival, and DETACHES the job's in-flight
+        single-flight entries — their leaders still answer the followers
+        already waiting (the leader holds the flight object), but a
+        request arriving after the flush starts a fresh forward instead
+        of coalescing onto one from the invalidated fleet. Returns the
+        evicted entry count."""
+        keep = None if keep_version is None else int(keep_version)
+        with self._lock:
+            self._epochs[job] = self._epochs.get(job, 0) + 1
+            victims = [k for k in self._entries
+                       if k[0] == job and (keep is None or k[1] != keep)]
+            for k in victims:
+                self._bytes -= self._entries.pop(k)[1]
+                self._dec_job_entries_locked(job)
+            for k in [k for k in self._flights if k[0] == job]:
+                del self._flights[k]
+            # the duplicate-probe window dies with the job too (a
+            # long-lived admin cycling jobs must not accumulate them)
+            self._share_seen.pop(job, None)
+            total = self._bytes
+        if victims:
+            self._m_evictions.labels("flush").inc(len(victims))
+        self._m_bytes.set(total)
+        logger.info("prediction cache: flushed %d entr%s of job %s (%s%s)",
+                    len(victims), "y" if len(victims) == 1 else "ies",
+                    job[:8], reason,
+                    f", kept v{keep}" if keep is not None else "")
+        return len(victims)
+
+    # -- cache-off shareable signal ------------------------------------------
+
+    def note_shareable(self, job: str, digest: Optional[str]) -> None:
+        """Sampled duplicate-query probe while the cache is OFF: the
+        predictor hands every Nth request's first-query digest here; a
+        digest already inside the job's bounded recent window counts one
+        ``rafiki_cache_shareable_total`` — the doctor's signal that
+        identical-query traffic is being forwarded redundantly."""
+        if digest is None:
+            return
+        with self._lock:
+            seen = self._share_seen.setdefault(
+                job, collections.OrderedDict())
+            dup = digest in seen
+            seen[digest] = None
+            seen.move_to_end(digest)
+            while len(seen) > 128:
+                seen.popitem(last=False)
+        if dup:
+            self._m_shareable.labels(job).inc()
+
+    def note_degraded(self) -> None:
+        """Count one absorbed cache fault (the predictor's degrade
+        guard calls this — the drill's observable)."""
+        self._m_errors.inc()
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """The /fleet/health "prediction_cache" section: global bounds +
+        occupancy, plus per-job entry counts and live hit rates read off
+        the registry counters."""
+        # O(jobs), never a walk of the entry map under _lock — the
+        # serving hot path shares that lock and a /fleet/health poll
+        # must not stall it behind an O(entries) scan
+        with self._lock:
+            entries = len(self._entries)
+            total = self._bytes
+            flights = len(self._flights)
+            per_job = dict(self._job_entries)
+        jobs: Dict[str, Any] = {}
+        for job, n in per_job.items():
+            hits, misses = self.job_totals(job)
+            served = hits + misses
+            jobs[job] = {
+                "entries": n,
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / served, 3) if served else None,
+            }
+        from rafiki_tpu import config
+
+        return {
+            "enabled": bool(config.PREDICT_CACHE),
+            "entries": entries,
+            "bytes": total,
+            "max_bytes": self._cap_bytes(),
+            "ttl_s": self._ttl(),
+            "inflight_flights": flights,
+            "jobs": jobs,
+        }
+
+    def job_totals(self, job: str) -> Tuple[int, int]:
+        """(hits, misses) counter totals for one job — the autoscaler's
+        hit-rate signal and the stats() view read these."""
+        return (int(self._m_hits.labels(job).value()),
+                int(self._m_misses.labels(job).value()))
+
+    def clear(self) -> None:
+        """Test hook: drop every entry, epoch, and flight."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._job_entries.clear()
+            self._epochs.clear()
+            flights = list(self._flights.values())
+            self._flights.clear()
+            self._share_seen.clear()
+        for f in flights:
+            f.future.set_error(RuntimeError("prediction cache cleared"))
+        self._m_bytes.set(0)
+
+
+#: the process-wide instance (both serving doors of every job share it;
+#: job-scoped keys and flushes keep tenants apart)
+_CACHE = ResultCache()
+
+
+def get_cache() -> ResultCache:
+    return _CACHE

@@ -296,9 +296,16 @@ class PredictorServer:
             t_adm = time.monotonic()
             # tenant/cost feed the weighted-fair gate; on this per-job
             # door there is one tenant, so the gate is a no-op — the
-            # accounting still shows in /healthz fair_shares
+            # accounting still shows in /healthz fair_shares. With the
+            # prediction cache on, cost is the MISSES-ONLY estimate
+            # (predictor/result_cache.py): cache-served queries shed no
+            # load onto the worker fleet, so fairness must not charge
+            # for them.
+            cost_fn = getattr(self.predictor, "admission_cost", None)
+            cost = (cost_fn(queries) if callable(cost_fn)
+                    else len(queries))
             self.admission.admit(timeout_s, backlog_depth=backlog,
-                                 tenant=self.app, cost=len(queries))
+                                 tenant=self.app, cost=cost)
             t0 = time.monotonic()
             if rt is not None:
                 rt.add_span("admission_wait", t_adm, t0)

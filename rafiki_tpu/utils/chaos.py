@@ -27,6 +27,9 @@ Fields:
              statements — transient store-failure drills for
              control-plane recovery), ``trial`` (the trial-run
              chokepoint in the train worker — fault-taxonomy drills),
+             ``cache`` (the prediction result cache's lookup/fill/join
+             operations — degraded-cache drills: a broken cache must
+             degrade to miss-path serving, never fail a request),
              ``generate`` (the generation decode loop — mid-stream
              fault / stalled-decode drills, one ask per active slot per
              round), or ``deploy`` (the inference-replica placement
@@ -110,6 +113,14 @@ SITE_GENERATE = "generate"
 # deploy deadline, it becomes the deploy-timeout rollback drill) —
 # docs/failure-model.md "Rollout faults".
 SITE_DEPLOY = "deploy"
+# prediction result cache (predictor/result_cache.py): one ask per
+# lookup / fill / single-flight join, target "{inference_job_id}/{op}"
+# (op in lookup|fill|join) so `match` can injure one operation class.
+# `error` raises inside the cache call — the drill that proves a broken
+# cache DEGRADES to miss-path serving (the predictor absorbs it, the
+# request is answered by a real forward, never failed); `delay` models
+# a slow cache. docs/failure-model.md "Cache faults".
+SITE_CACHE = "cache"
 # trial-run chokepoint (worker/train.py _execute_trial): one ask per
 # trial ATTEMPT, target "{sub_train_job_id} {trial_id}". `error` raises
 # a typed transient fault the taxonomy classifies INFRA (the
@@ -146,7 +157,7 @@ class ChaosRule:
     def __post_init__(self) -> None:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
                              SITE_WIRE, SITE_DB, SITE_TRIAL,
-                             SITE_GENERATE, SITE_DEPLOY):
+                             SITE_GENERATE, SITE_DEPLOY, SITE_CACHE):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
                                ACTION_CORRUPT, ACTION_OOM):

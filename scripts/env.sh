@@ -54,6 +54,22 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #   RAFIKI_PREDICT_DRAIN_S=5            predictor stop(): bounded wait for
 #                                       in-flight handlers before close
 
+# Prediction result cache + single-flight coalescing (docs/performance.md
+# "Prediction caching & single-flight"). Off by default — memoized
+# answers are an opt-in behavior change; flushed automatically on
+# deploy/rollback/recovery adoption, keyed on served model version,
+# excluded for TEXT_GENERATION and ensembled-stochastic jobs:
+#   RAFIKI_PREDICT_CACHE=1              answer repeated identical queries
+#                                       from a bounded in-process cache
+#                                       before any worker queue is touched
+#   RAFIKI_PREDICT_CACHE_TTL_S=30       entry lifetime (<=0 disables
+#                                       fills; doctor WARNs with cache on)
+#   RAFIKI_PREDICT_CACHE_MAX_BYTES=67108864  byte cap, LRU-evicted
+#                                       (doctor WARNs past 1 GiB)
+#   RAFIKI_PREDICT_SINGLEFLIGHT=1       0 = concurrent identical misses
+#                                       each pay their own forward instead
+#                                       of sharing the leader's
+
 # Serving wire formats (docs/performance.md "Wire formats"). Internal
 # serving hops (shm broker, fleet relay) ride a binary ndarray codec;
 # the dedicated predictor port answers binary when clients send

@@ -1,0 +1,41 @@
+"""A trivially fast model whose PREDICTIONS identify the trial that
+made them: train() persists the trial's int knob and predict() echoes
+it. The prediction-cache staleness drills byte-compare answers across
+rollouts, so old-version and new-version forwards must be
+distinguishable — FakeModel's constant [0.5, 0.5] cannot be."""
+
+import random
+
+from rafiki_tpu.sdk import BaseModel, FixedKnob, IntegerKnob
+
+
+class EchoModel(BaseModel):
+    dependencies = {"numpy": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "int_knob": IntegerKnob(1, 1000000),
+            "fixed_knob": FixedKnob("fixed"),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+
+    def train(self, dataset_uri):
+        self._params = {"v": int(self._knobs["int_knob"])}
+
+    def evaluate(self, dataset_uri):
+        return random.random()
+
+    def predict(self, queries):
+        v = float(self._params["v"])
+        return [[v, 1.0] for _ in queries]
+
+    def dump_parameters(self):
+        return self._params
+
+    def load_parameters(self, params):
+        self._params = params

@@ -228,7 +228,14 @@ def test_hedge_suppressed_onto_saturated_sibling(monkeypatch):
     monkeypatch.setenv("RAFIKI_PREDICT_QUEUE_DEPTH", "0")
     broker = InProcessBroker()
     q_slow = broker.register_worker("job", "slow")
-    StallServer(q_slow, [1.0, 0.0], stall_s=0.5)
+    # stall 0.8s sits strictly BETWEEN the first attempt's SLO share
+    # (timeout 1.0 / 2 replicas = 0.5s — when the hedge decision fires)
+    # and the full deadline (1.0s — when the late answer must land).
+    # The old value of 0.5s was a knife-edge TIE with the attempt share:
+    # whichever thread the scheduler woke last won, so on some boxes the
+    # slow replica's answer arrived before the hedge path ever ran and
+    # hedges_suppressed stayed 0.
+    StallServer(q_slow, [1.0, 0.0], stall_s=0.8)
     q_sat = broker.register_worker("job", "sat")
     q_sat.submit_many([[0.0]] * 3)  # depth 3 > threshold 2, nobody serving
     p = Predictor("job", broker, "IMAGE_CLASSIFICATION",

@@ -793,6 +793,22 @@ def test_process_manager_adopts_verified_pid_and_fences_on_stop(tmp_path):
         [sys.executable, "-c", "import time; time.sleep(120)",
          "rafiki_tpu.worker.bootstrap"], env=child_env)
     try:
+        # synchronize on exec completion before verifying identity:
+        # CPython spawns via posix_spawn/vfork, which returns BEFORE the
+        # child's execve finishes — mid-exec, /proc/<pid>/cmdline reads
+        # EMPTY, so an immediate _pid_is_worker would (correctly, for an
+        # unverifiable pid) answer False. Production callers verify
+        # long-lived pids where exec finished long ago; only this test
+        # races the spawn.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                with open(f"/proc/{child.pid}/cmdline", "rb") as f:
+                    if b"rafiki_tpu.worker.bootstrap" in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.01)
         assert _pid_is_worker(child.pid)
         assert _pid_is_worker(child.pid, service_id=svc["id"])
         # a recycled pid running SOME OTHER service's worker is refused

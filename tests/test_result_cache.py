@@ -1,0 +1,805 @@
+"""Prediction result cache + single-flight coalescing (ISSUE 15;
+docs/performance.md "Prediction caching & single-flight"): versioned
+keying, every invalidation edge (TTL, byte-cap LRU, deploy/teardown
+flush, recovery-adoption flush, rollout-lane isolation, rollback), the
+single-flight stampede drill (K concurrent identical queries -> exactly
+one worker batch), chaos degradation (a broken cache serves the miss
+path, never fails a request), and the end-to-end staleness drill over a
+real Admin + rollout (no response ever served from a prior model
+version, byte-compared against a fresh forward). All tier-1, CPU-only,
+deterministic."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.cache import wire
+from rafiki_tpu.cache.queue import InProcessBroker
+from rafiki_tpu.predictor import result_cache
+from rafiki_tpu.predictor.predictor import Predictor
+from rafiki_tpu.predictor.result_cache import ResultCache, get_cache
+from rafiki_tpu.utils import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache():
+    chaos.clear()
+    get_cache().clear()
+    yield
+    chaos.clear()
+    get_cache().clear()
+
+
+class EchoWorker:
+    """Serves its queue, answering with a constant vector; counts the
+    batches/queries that actually reached it (the cache's whole point is
+    keeping these counters LOW)."""
+
+    def __init__(self, broker, job_id, worker_id, answer, delay_s=0.0,
+                 fail=False):
+        self.queue = broker.register_worker(job_id, worker_id)
+        self.answer = answer
+        self.delay_s = delay_s
+        self.fail = fail
+        self.batches = 0
+        self.queries = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            batch = self.queue.take_batch(max_size=64, deadline_s=0.0,
+                                          wait_timeout_s=0.05)
+            if batch is None:
+                return
+            if not batch:
+                continue
+            self.batches += 1
+            self.queries += len(batch)
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            for fut, _q in batch:
+                if self.fail:
+                    fut.set_error(RuntimeError("worker exploded"))
+                else:
+                    fut.set_result(list(self.answer))
+
+
+def _predictor(broker, job, workers, task="IMAGE_CLASSIFICATION",
+               version=0):
+    return Predictor(job, broker, task, worker_trials=workers,
+                     serving_version=version)
+
+
+# ---------------------------------------------------------------------------
+# canonical digests (cache/wire.py)
+# ---------------------------------------------------------------------------
+
+
+def test_canonical_digest_arrays_and_json():
+    a = np.arange(12, dtype=np.float32)
+    assert wire.canonical_digest(a) == wire.canonical_digest(a.copy())
+    assert wire.canonical_digest(a) != wire.canonical_digest(a + 1)
+    # dtype is part of identity: same values, different wire bytes
+    assert wire.canonical_digest(a) != wire.canonical_digest(
+        a.astype(np.float64))
+    # JSON payloads canonicalize key order
+    assert wire.canonical_digest({"x": 1, "y": [2, 3]}) == \
+        wire.canonical_digest({"y": [2, 3], "x": 1})
+    assert wire.canonical_digest([1.5, 2.5]) != wire.canonical_digest(
+        [2.5, 1.5])
+    # nested arrays ride the wire encoding
+    assert wire.canonical_digest({"q": a}) == wire.canonical_digest(
+        {"q": a.copy()})
+
+
+def test_canonical_digest_uncacheable_returns_none():
+    class Weird:
+        pass
+
+    assert wire.canonical_digest(Weird()) is None
+    assert wire.canonical_digest({"f": Weird()}) is None
+
+
+# ---------------------------------------------------------------------------
+# ResultCache units
+# ---------------------------------------------------------------------------
+
+
+def test_ttl_expiry_evicts_and_misses():
+    c = ResultCache(max_bytes=1 << 20, ttl_s=0.05)
+    assert c.fill("job", 0, "d1", [1.0], c.epoch("job"))
+    assert c.lookup("job", 0, "d1") == (True, [1.0])
+    time.sleep(0.08)
+    hit, _ = c.lookup("job", 0, "d1")
+    assert not hit  # expired entries read as misses and are evicted
+    assert c.stats()["entries"] == 0
+
+
+def test_zero_ttl_disables_fills():
+    c = ResultCache(max_bytes=1 << 20, ttl_s=0.0)
+    assert not c.fill("job", 0, "d1", [1.0], c.epoch("job"))
+    assert c.lookup("job", 0, "d1") == (False, None)
+
+
+def test_byte_cap_lru_eviction_order():
+    # each entry ~ overhead 256 + list 64 + float 16 = ~336 bytes;
+    # cap for exactly two entries
+    c = ResultCache(max_bytes=700, ttl_s=60.0)
+    e = c.epoch("job")
+    c.fill("job", 0, "a", [1.0], e)
+    c.fill("job", 0, "b", [2.0], e)
+    c.fill("job", 0, "c", [3.0], e)  # evicts a (oldest)
+    assert c.lookup("job", 0, "a") == (False, None)
+    assert c.lookup("job", 0, "b") == (True, [2.0])  # touches b
+    c.fill("job", 0, "d", [4.0], e)  # evicts c (b was just touched)
+    assert c.lookup("job", 0, "c") == (False, None)
+    assert c.lookup("job", 0, "b") == (True, [2.0])
+    assert c.lookup("job", 0, "d") == (True, [4.0])
+
+
+def test_oversized_entry_never_wipes_cache():
+    c = ResultCache(max_bytes=700, ttl_s=60.0)
+    e = c.epoch("job")
+    c.fill("job", 0, "a", [1.0], e)
+    assert not c.fill("job", 0, "huge", ["x" * 4096], e)
+    assert c.lookup("job", 0, "a") == (True, [1.0])
+
+
+def test_flush_job_full_and_keep_version():
+    c = ResultCache(max_bytes=1 << 20, ttl_s=60.0)
+    e = c.epoch("job")
+    c.fill("job", 0, "a", [1.0], e)
+    c.fill("job", 1, "a", [2.0], e)
+    c.fill("other", 0, "a", [9.0], c.epoch("other"))
+    # keep_version drops every OTHER version of the job
+    assert c.flush_job("job", keep_version=1) == 1
+    assert c.lookup("job", 0, "a") == (False, None)
+    assert c.lookup("job", 1, "a") == (True, [2.0])
+    assert c.lookup("other", 0, "a") == (True, [9.0])  # untouched tenant
+    # full flush drops the rest of the job
+    assert c.flush_job("job") == 1
+    assert c.lookup("job", 1, "a") == (False, None)
+
+
+def test_epoch_stale_fill_dropped():
+    c = ResultCache(max_bytes=1 << 20, ttl_s=60.0)
+    e = c.epoch("job")
+    c.flush_job("job", reason="deploy")  # epoch moves past e
+    # a forward that resolved against the pre-flush fleet must NOT land
+    assert not c.fill("job", 0, "d", [1.0], e)
+    assert c.lookup("job", 0, "d") == (False, None)
+    # a fill with the fresh epoch lands
+    assert c.fill("job", 0, "d", [2.0], c.epoch("job"))
+    assert c.lookup("job", 0, "d") == (True, [2.0])
+
+
+# ---------------------------------------------------------------------------
+# predictor integration: hits, dedup, single-flight
+# ---------------------------------------------------------------------------
+
+
+def test_hit_skips_worker_and_dedups_within_request(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    w = EchoWorker(broker, "jobA", "w1", [0.7, 0.3])
+    p = _predictor(broker, "jobA", {"w1": "t1"})
+    assert p.predict([1.0, 2.0], timeout_s=5.0) == [0.7, 0.3]
+    assert p.predict([1.0, 2.0], timeout_s=5.0) == [0.7, 0.3]
+    assert w.queries == 1  # second request never touched the queue
+    # mixed request: one hit + two copies of one new query -> ONE forward
+    out = p.predict_batch([[1.0, 2.0], [3.0], [3.0]], timeout_s=5.0)
+    assert out == [[0.7, 0.3], [0.7, 0.3], [0.7, 0.3]]
+    assert w.queries == 2
+    hits, misses = get_cache().job_totals("jobA")
+    assert hits == 2 and misses >= 2
+
+
+def test_single_flight_stampede_one_worker_batch(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    # slow worker: all K requests are in flight together
+    w = EchoWorker(broker, "jobB", "w1", [1.0, 0.0], delay_s=0.2)
+    p = _predictor(broker, "jobB", {"w1": "t1"})
+    results, errors = [], []
+    barrier = threading.Barrier(8)
+
+    def shot():
+        try:
+            barrier.wait(timeout=5)
+            results.append(p.predict([5.0, 5.0], timeout_s=10.0))
+        except Exception as e:  # pragma: no cover - drill failure detail
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=shot) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert not errors
+    assert len(results) == 8
+    assert all(r == [1.0, 0.0] for r in results)
+    # THE stampede contract: one batch, one query, 7 coalesced waiters
+    assert w.batches == 1 and w.queries == 1
+    coalesced = get_cache()._m_coalesced.labels("jobB").value()
+    assert coalesced == 7
+
+
+def test_single_flight_leader_error_fails_followers_typed(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    w = EchoWorker(broker, "jobC", "w1", [0.0], delay_s=0.1, fail=True)
+    p = _predictor(broker, "jobC", {"w1": "t1"})
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def shot():
+        barrier.wait(timeout=5)
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as ei:
+            p.predict([6.0], timeout_s=30.0)
+        errors.append((type(ei.value).__name__,
+                       time.monotonic() - t0))
+
+    threads = [threading.Thread(target=shot) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert len(errors) == 4
+    # followers re-raise the leader's failure promptly (per-waiter copy),
+    # never hang out their own 30s deadline
+    assert all(dt < 10.0 for _name, dt in errors), errors
+    assert w.queries == 1  # one forward for the whole stampede
+
+
+def test_singleflight_kill_switch(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    monkeypatch.setenv("RAFIKI_PREDICT_SINGLEFLIGHT", "0")
+    broker = InProcessBroker()
+    w = EchoWorker(broker, "jobD", "w1", [1.0], delay_s=0.15)
+    p = _predictor(broker, "jobD", {"w1": "t1"})
+    barrier = threading.Barrier(3)
+    results = []
+
+    def shot():
+        barrier.wait(timeout=5)
+        results.append(p.predict([7.0], timeout_s=10.0))
+
+    threads = [threading.Thread(target=shot) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert len(results) == 3
+    assert w.queries == 3  # every miss paid its own forward
+
+
+def test_incomplete_ensemble_not_cached(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    # trial t1 serves; trial t2's only replica never answers -> the
+    # ensemble degrades (SLO drop) and the degraded answer must NOT be
+    # memorized for the TTL
+    w1 = EchoWorker(broker, "jobE", "w1", [1.0, 0.0])
+    broker.register_worker("jobE", "w2")  # registered, never served
+    p = _predictor(broker, "jobE", {"w1": "t1", "w2": "t2"})
+    out = p.predict([8.0], timeout_s=1.0)
+    assert out == [1.0, 0.0]
+    assert get_cache().stats()["entries"] == 0
+    assert w1.queries == 1
+    # and the next identical request forwards again (no stale hit)
+    p.predict([8.0], timeout_s=1.0)
+    assert w1.queries == 2
+
+
+def test_excluded_tasks_never_touch_cache(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+
+    class Boom:
+        def __getattr__(self, name):  # any cache use would explode
+            raise AssertionError("cache touched for an excluded job")
+
+    monkeypatch.setattr(result_cache, "_CACHE", Boom())
+    broker = InProcessBroker()
+    EchoWorker(broker, "jobF", "w1", [1.0])
+    # TEXT_GENERATION: excluded
+    p = Predictor("jobF", broker, "TEXT_GENERATION",
+                  worker_trials={"w1": "t1"})
+    assert p.predict([1.0], timeout_s=5.0) == [1.0]
+    # ensembled-stochastic: non-probability task, >1 trial group
+    broker2 = InProcessBroker()
+    EchoWorker(broker2, "jobG", "w1", [1.0])
+    EchoWorker(broker2, "jobG", "w2", [2.0])
+    p2 = Predictor("jobG", broker2, "POS_TAGGING",
+                   worker_trials={"w1": "t1", "w2": "t2"})
+    out = p2.predict([1.0], timeout_s=5.0)
+    assert out in ([1.0], [2.0])
+
+
+def test_cache_off_shareable_probe_counts(monkeypatch):
+    monkeypatch.delenv("RAFIKI_PREDICT_CACHE", raising=False)
+    broker = InProcessBroker()
+    EchoWorker(broker, "jobH", "w1", [1.0])
+    p = _predictor(broker, "jobH", {"w1": "t1"})
+    before = get_cache()._m_shareable.labels("jobH").value()
+    # 64 identical requests; the 1-in-16 sample must observe duplicates
+    for _ in range(64):
+        p.predict([4.0, 4.0], timeout_s=5.0)
+    after = get_cache()._m_shareable.labels("jobH").value()
+    assert after - before >= 2
+    assert get_cache().stats()["entries"] == 0  # nothing was cached
+
+
+def test_admission_cost_misses_only(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    EchoWorker(broker, "jobI", "w1", [1.0])
+    p = _predictor(broker, "jobI", {"w1": "t1"})
+    q_warm, q_cold = [1.0, 1.0], [2.0, 2.0]
+    assert p.admission_cost([q_warm, q_cold]) == 2  # nothing cached yet
+    p.predict(q_warm, timeout_s=5.0)
+    assert p.admission_cost([q_warm, q_cold]) == 1  # warm one is free
+    assert p.admission_cost([q_warm]) == 0
+    # cache off -> full charge
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "0")
+    assert p.admission_cost([q_warm]) == 1
+
+
+def test_admission_accepts_zero_cost(monkeypatch):
+    from rafiki_tpu.predictor.admission import AdmissionController
+
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_FAIR", "1")
+    adm = AdmissionController(max_inflight=8, door="cache-test",
+                              shared_tenants=True)
+    adm.admit(5.0, tenant="t1", cost=0)
+    adm.release(tenant="t1")
+    assert adm.fair_shares().get("t1", 0.0) == 0.0  # charged nothing
+
+
+# ---------------------------------------------------------------------------
+# chaos: a broken cache degrades to miss-path serving
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("op", ["lookup", "fill", "join"])
+def test_chaos_cache_error_degrades_to_miss_path(monkeypatch, op):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    chaos.install(chaos.parse_rules(
+        f"site=cache;action=error;match=/{op}"))
+    broker = InProcessBroker()
+    w = EchoWorker(broker, "jobJ", "w1", [1.0, 0.0])
+    p = _predictor(broker, "jobJ", {"w1": "t1"})
+    errors_before = get_cache()._m_errors.value()
+    # every request is answered by a real forward — never failed
+    for _ in range(3):
+        assert p.predict([3.0], timeout_s=5.0) == [1.0, 0.0]
+    assert w.queries >= 1
+    assert get_cache()._m_errors.value() > errors_before
+    chaos.clear()
+    # cache healthy again: hits resume
+    p.predict([3.0], timeout_s=5.0)
+    served = w.queries
+    p.predict([3.0], timeout_s=5.0)
+    assert w.queries == served
+
+
+@pytest.mark.chaos
+def test_chaos_cache_delay_is_tolerated(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    chaos.install(chaos.parse_rules(
+        "site=cache;action=delay;delay_s=0.02;match=/lookup"))
+    broker = InProcessBroker()
+    EchoWorker(broker, "jobK", "w1", [2.0])
+    p = _predictor(broker, "jobK", {"w1": "t1"})
+    assert p.predict([1.0], timeout_s=5.0) == [2.0]
+    assert p.predict([1.0], timeout_s=5.0) == [2.0]
+
+
+# ---------------------------------------------------------------------------
+# invalidation edges: versions, lanes, flush hooks
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_drill_version_bump_serves_fresh(monkeypatch):
+    """The predictor-level staleness contract: after the serving version
+    moves (what rollout DONE does), a warm cache can never answer with
+    the replaced version's forward — byte-compared against fresh."""
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    old = EchoWorker(broker, "jobL", "w_old", [1.0, 0.0])
+    p = _predictor(broker, "jobL", {"w_old": "t_old"})
+    q = [9.0, 9.0]
+    assert p.predict(q, timeout_s=5.0) == [1.0, 0.0]
+    assert p.predict(q, timeout_s=5.0) == [1.0, 0.0]  # warm
+    assert old.queries == 1
+    # the rollout controller's DONE sequence: new fleet, version bump,
+    # keep_version flush
+    new = EchoWorker(broker, "jobL", "w_new", [0.0, 1.0])
+    p.drop_worker("w_old")
+    p.add_worker("w_new", "t_new")
+    p.set_serving_version(1)
+    get_cache().flush_job("jobL", keep_version=1, reason="rollout done")
+    served = p.predict(q, timeout_s=5.0)
+    # fresh forward (cache cleared for this key space) must byte-match
+    get_cache().clear()
+    fresh = p.predict(q, timeout_s=5.0)
+    assert served == fresh == [0.0, 1.0]
+    assert new.queries >= 1
+
+
+def test_rollout_lane_isolation_under_concurrent_load(monkeypatch):
+    """A cached canary answer is never served to an incumbent-lane
+    request (and vice versa) under concurrent identical-query load, and
+    canary-lane requests always pay a real forward (the judge's
+    samples)."""
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    inc = EchoWorker(broker, "jobM", "w_inc", [1.0, 0.0])
+    can = EchoWorker(broker, "jobM", "w_can", [0.0, 1.0])
+    p = _predictor(broker, "jobM", {"w_inc": "t_old", "w_can": "t_new"})
+    p.set_rollout_lane({"w_can"}, 0.5, new_version=1)
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(10):
+            try:
+                r = p.predict([2.0, 2.0], timeout_s=5.0)
+            except Exception as e:  # pragma: no cover
+                with lock:
+                    errors.append(repr(e))
+                continue
+            with lock:
+                results.append(tuple(r))
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert len(results) == 60
+    n_canary_answers = sum(1 for r in results if r == (0.0, 1.0))
+    n_incumbent_answers = sum(1 for r in results if r == (1.0, 0.0))
+    assert n_canary_answers + n_incumbent_answers == 60
+    # every canary ANSWER was a real canary forward: cached canary
+    # answers are never replayed to anyone (fill-only lane), so answers
+    # == forwards, and the judge saw every one of them
+    assert can.queries == n_canary_answers
+    assert n_canary_answers > 0
+    # incumbent-lane requests were cache-served (identical query): far
+    # fewer forwards than answers, and never a canary answer among them
+    assert inc.queries < n_incumbent_answers
+    # lane stats: only real forwards were recorded for the judge
+    stats = p.rollout_stats(60.0)
+    assert stats["canary"]["requests"] == n_canary_answers
+    assert stats["incumbent"]["requests"] == inc.queries
+
+
+def test_canary_failover_answer_never_cached_under_new_version(
+        monkeypatch):
+    """Review regression: a canary-lane request whose canary replica
+    fails FAILS OVER to the incumbents — that answer is the OLD model's
+    forward and must never land under the new version's cache key (it
+    would survive the rollout-DONE keep_version flush and serve the
+    retired model after promotion)."""
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    inc = EchoWorker(broker, "jobN", "w_inc", [1.0, 0.0])
+    EchoWorker(broker, "jobN", "w_can", [0.0, 1.0], fail=True)
+    p = _predictor(broker, "jobN", {"w_inc": "t_old", "w_can": "t_new"})
+    p.set_rollout_lane({"w_can"}, 1.0, new_version=1)  # every draw canary
+    q = [4.0, 4.0]
+    assert p.predict(q, timeout_s=2.0) == [1.0, 0.0]  # failover answer
+    assert inc.queries == 1
+    d = wire.canonical_digest(q)
+    assert get_cache().lookup("jobN", 1, d) == (False, None)
+    assert get_cache().lookup("jobN", 0, d) == (False, None)
+    # with the canary lane emptied (replica dropped from the lane set),
+    # the split degenerates to INCUMBENT: answers are the incumbents'
+    # honest v0 forwards and cache under version 0 — never version 1
+    p.drop_worker("w_can")
+    p.set_rollout_lane(set(), 1.0)
+    assert p.predict(q, timeout_s=2.0) == [1.0, 0.0]
+    assert get_cache().lookup("jobN", 1, d) == (False, None)
+    assert get_cache().lookup("jobN", 0, d) == (True, [1.0, 0.0])
+
+
+def test_flush_detaches_inflight_flights(monkeypatch):
+    """Review regression: flush_job must detach in-flight single-flight
+    entries — a request arriving AFTER the flush starts a fresh forward
+    instead of coalescing onto one from the invalidated fleet, while the
+    pre-flush leader still answers its own waiters."""
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    broker = InProcessBroker()
+    w = EchoWorker(broker, "jobO", "w1", [1.0], delay_s=0.3)
+    p = _predictor(broker, "jobO", {"w1": "t1"})
+    results = []
+    t1 = threading.Thread(
+        target=lambda: results.append(p.predict([5.0], timeout_s=10.0)))
+    t1.start()
+    time.sleep(0.1)  # leader's forward is in flight
+    get_cache().flush_job("jobO", reason="teardown")
+    # post-flush request: must NOT become a follower of the pre-flush
+    # leader — it pays its own forward
+    results.append(p.predict([5.0], timeout_s=10.0))
+    t1.join(timeout=10)
+    assert len(results) == 2 and all(r == [1.0] for r in results)
+    assert w.queries == 2
+    # and the pre-flush leader's epoch-stale fill never landed
+    d = wire.canonical_digest([5.0])
+    hit, _ = get_cache().lookup("jobO", 0, d)
+    # the post-flush request's own fill MAY have landed (fresh epoch) —
+    # but never the pre-flush one; either way the entry, if present,
+    # came from the post-flush forward
+    assert hit in (True, False)
+
+
+def test_teardown_and_adoption_flush_hooks(monkeypatch, tmp_path):
+    """The control-plane invalidation hooks actually fire: job stop
+    (_teardown_serving) and recovery adoption (adopt_inference_job)
+    flush the job's entries, and the adopted Predictor carries the
+    fleet's real model_version."""
+    from rafiki_tpu.admin.services import ServicesManager
+
+    calls = []
+    real_get_cache = result_cache.get_cache
+
+    class Recorder:
+        def flush_job(self, job, keep_version=None, reason="flush"):
+            calls.append((job, keep_version, reason))
+            return real_get_cache().flush_job(job, keep_version, reason)
+
+        def __getattr__(self, name):
+            return getattr(real_get_cache(), name)
+
+    monkeypatch.setattr(result_cache, "get_cache", lambda: Recorder())
+
+    class FakeDb:
+        def __init__(self):
+            self.inference_job = {
+                "id": "inf1", "status": "RUNNING",
+                "train_job_id": "tj1", "budget": {},
+                "predictor_service_id": None,
+            }
+
+        def get_inference_job(self, _id):
+            return dict(self.inference_job)
+
+        def get_train_job(self, _id):
+            return {"id": "tj1", "task": "IMAGE_CLASSIFICATION",
+                    "app": "app1"}
+
+        def get_workers_of_inference_job(self, _id):
+            return [
+                {"service_id": "s1", "trial_id": "t1", "model_version": 2},
+                {"service_id": "s2", "trial_id": "t1", "model_version": 1},
+            ]
+
+        def mark_inference_job_as_stopped(self, _id):
+            pass
+
+        def mark_inference_job_as_running(self, _id):
+            pass
+
+        def mark_service_as_stopped(self, _id):
+            pass
+
+    mgr = ServicesManager.__new__(ServicesManager)
+    mgr._db = FakeDb()
+    mgr._broker = InProcessBroker()
+    mgr._lock = threading.Lock()
+    mgr._predictors = {}
+    mgr._predict_servers = {}
+
+    monkeypatch.setenv("RAFIKI_PREDICTOR_PORTS", "0")
+    predictor = mgr.adopt_inference_job("inf1")
+    assert calls and calls[-1] == ("inf1", None, "adoption")
+    # the adopted fleet's rollout generation (max of the worker rows)
+    assert predictor.serving_version() == 2
+
+    mgr._teardown_serving("inf1", errored=False)
+    assert calls[-1] == ("inf1", None, "teardown")
+
+
+# ---------------------------------------------------------------------------
+# THE end-to-end staleness drill: real Admin, real rollout, real doors
+# ---------------------------------------------------------------------------
+
+ECHO_FIXTURE = __file__.rsplit("/", 1)[0] + "/fixtures/echo_model.py"
+
+
+def _wait_rollout_terminal(admin, job_id, timeout_s=60):
+    from rafiki_tpu.constants import RolloutPhase
+
+    deadline = time.monotonic() + timeout_s
+    st = None
+    while time.monotonic() < deadline:
+        st = admin.rollouts.status(job_id)
+        if st and st["phase"] in RolloutPhase.TERMINAL:
+            return st
+        time.sleep(0.05)
+    raise AssertionError(f"rollout never terminal: {st}")
+
+
+def test_e2e_rollout_staleness_and_rollback(tmp_workdir, monkeypatch):
+    """Acceptance drill: deploy with the cache ON, roll out a new trial
+    under continuous load, and prove no response is ever served from a
+    prior model version — byte-compared against a fresh forward — then
+    roll back (operator abort of a second rollout) and prove the same
+    for the restored incumbent."""
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.constants import RolloutPhase, TrainJobStatus
+
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    monkeypatch.setenv("RAFIKI_ROLLOUT_JUDGE_WINDOW_S", "1.0")
+    monkeypatch.setenv("RAFIKI_ROLLOUT_MIN_REQUESTS", "3")
+    # ONE serving trial: the echo answer then identifies the version
+    monkeypatch.setattr(config, "INFERENCE_MAX_BEST_TRIALS", 1)
+    admin = Admin(params_dir=str(tmp_workdir / "params"))
+    try:
+        auth = admin.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        uid = auth["user_id"]
+        with open(ECHO_FIXTURE, "rb") as f:
+            admin.create_model(uid, "echo", "IMAGE_CLASSIFICATION",
+                               f.read(), "EchoModel")
+        admin.create_train_job(
+            uid, "echoapp", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+            budget={"MODEL_TRIAL_COUNT": 3, "CHIP_COUNT": 0})
+        job = admin.wait_until_train_job_stopped(uid, "echoapp",
+                                                 timeout_s=60)
+        assert job["status"] == TrainJobStatus.STOPPED, job
+        admin.create_inference_job(uid, "echoapp")
+        tj = admin.db.get_train_job_by_app_version(uid, "echoapp", -1)
+        inf = admin.db.get_running_inference_job_of_train_job(tj["id"])
+        job_id = inf["id"]
+
+        q = [[0.25, 0.75]]
+        v0_answer = admin.predict(uid, "echoapp", q)
+        assert admin.predict(uid, "echoapp", q) == v0_answer  # warm hit
+        hits0, _ = get_cache().job_totals(job_id)
+        assert hits0 >= 1
+
+        # rollout to a trial the job does not serve
+        serving = {w["trial_id"]
+                   for w in admin.services.live_inference_workers(job_id)}
+        target = next(
+            t["id"] for t in admin.db.get_best_trials_of_train_job(
+                tj["id"], max_count=10) if t["id"] not in serving)
+        admin.update_inference_job(uid, "echoapp", trial_id=target,
+                                   canary_fraction=0.5)
+        # continuous identical-query load while the rollout runs (feeds
+        # the judge; also the concurrent-staleness surface)
+        stop = threading.Event()
+        seen, errors = set(), []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    r = admin.predict(uid, "echoapp", q)
+                    with lock:
+                        seen.add(tuple(r[0]))
+                except Exception as e:
+                    with lock:
+                        errors.append(repr(e))
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        for t in threads:
+            t.start()
+        st = _wait_rollout_terminal(admin, job_id)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert st["phase"] == RolloutPhase.DONE, st
+        assert not errors, errors[:3]
+        # every mid-rollout answer was one of the two versions' honest
+        # forwards — never a blend, never a third value
+        new_served = admin.predict(uid, "echoapp", q)
+        assert set(seen) <= {tuple(v0_answer[0]), tuple(new_served[0])}
+
+        # staleness: the served answer byte-matches a fresh forward of
+        # the NEW version and the old answer is gone for good
+        get_cache().clear()
+        fresh = admin.predict(uid, "echoapp", q)
+        assert new_served == fresh
+        assert fresh != v0_answer
+        # warm path serves the same bytes
+        assert admin.predict(uid, "echoapp", q) == fresh
+
+        # rollback leg: start a rollout to a third trial, then abort it
+        serving = {w["trial_id"]
+                   for w in admin.services.live_inference_workers(job_id)}
+        third = next(
+            t["id"] for t in admin.db.get_best_trials_of_train_job(
+                tj["id"], max_count=10) if t["id"] not in serving)
+        admin.update_inference_job(uid, "echoapp", trial_id=third,
+                                   canary_fraction=0.5)
+        # let the canary take some (cached-poisonable) traffic first
+        for _ in range(10):
+            admin.predict(uid, "echoapp", q)
+        st = admin.abort_rollout(uid, "echoapp")
+        assert st["phase"] == RolloutPhase.ROLLED_BACK, st
+        rolled_back = admin.predict(uid, "echoapp", q)
+        get_cache().clear()
+        fresh_after_rollback = admin.predict(uid, "echoapp", q)
+        assert rolled_back == fresh_after_rollback == fresh
+    finally:
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet health + doctor
+# ---------------------------------------------------------------------------
+
+
+def test_stats_shape_and_fleet_health_section(monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    c = get_cache()
+    c.fill("jobS", 0, "d", [1.0], c.epoch("jobS"))
+    c.lookup("jobS", 0, "d")
+    stats = c.stats()
+    assert stats["enabled"] is True
+    assert stats["entries"] == 1
+    assert stats["bytes"] > 0
+    assert stats["jobs"]["jobS"]["entries"] == 1
+    assert stats["jobs"]["jobS"]["hit_rate"] is not None
+
+
+def test_doctor_prediction_cache(monkeypatch, tmp_path):
+    from rafiki_tpu import doctor
+
+    # ON + sane knobs -> PASS
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "1")
+    monkeypatch.setenv("RAFIKI_DB_PATH", str(tmp_path / "absent.sqlite3"))
+    name, status, detail = doctor.check_prediction_cache()
+    assert (name, status) == ("prediction cache", doctor.PASS)
+    assert "single-flight on" in detail
+
+    # TTL=0 with the cache on -> WARN
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE_TTL_S", "0")
+    assert doctor.check_prediction_cache()[1] == doctor.WARN
+    monkeypatch.delenv("RAFIKI_PREDICT_CACHE_TTL_S")
+
+    # byte cap past the host-memory heuristic -> WARN
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE_MAX_BYTES",
+                       str(2 * doctor.PREDICT_CACHE_BYTES_HEURISTIC))
+    assert doctor.check_prediction_cache()[1] == doctor.WARN
+    monkeypatch.delenv("RAFIKI_PREDICT_CACHE_MAX_BYTES")
+
+    # cache ON beside a live TEXT_GENERATION job -> WARN
+    from rafiki_tpu.db.database import Database
+
+    db_path = str(tmp_path / "meta.sqlite3")
+    db = Database(db_path)
+    user = db.create_user("d@e", "x", "ADMIN")
+    tj = db.create_train_job(user["id"], "genapp", 1, "TEXT_GENERATION",
+                             "uri://t", "uri://e", {})
+    inf = db.create_inference_job(user["id"], tj["id"])
+    db.mark_inference_job_as_running(inf["id"])
+    db.close()
+    monkeypatch.setenv("RAFIKI_DB_PATH", db_path)
+    name, status, detail = doctor.check_prediction_cache()
+    assert status == doctor.WARN and "TEXT_GENERATION" in detail
+
+    # OFF with duplicate-query traffic observed -> WARN
+    monkeypatch.setenv("RAFIKI_PREDICT_CACHE", "0")
+    c = get_cache()
+    c.note_shareable("jobT", "dup")
+    c.note_shareable("jobT", "dup")  # second sight counts
+    name, status, detail = doctor.check_prediction_cache()
+    assert status == doctor.WARN and "shareable" in detail
+
+    # OFF with quiet traffic -> PASS (registry probe stubbed: the
+    # counter is process-global and other tests legitimately bump it)
+    from rafiki_tpu.utils import metrics as _metrics
+
+    monkeypatch.setattr(_metrics.REGISTRY, "get", lambda _n: None)
+    assert doctor.check_prediction_cache()[1] == doctor.PASS
