@@ -201,6 +201,17 @@ class Admin:
         from rafiki_tpu.admin.rollout import RolloutController
 
         self.rollouts = RolloutController(self)
+        # the drift closed loop (admin/drift.py): detection -> bounded
+        # warm-started retrain -> SLO-guarded auto-rollout. Always
+        # constructed (fleet health + drift status/ack go through it);
+        # the monitor thread only runs with RAFIKI_DRIFT=1. Built after
+        # the rollout controller (it drives rollouts) and before
+        # recovery (whose boot pass resumes mid-loop drift rows).
+        from rafiki_tpu.admin.drift import DriftController
+
+        self.drift = DriftController(self)
+        if config.DRIFT:
+            self.drift.start()
         self._seed_superadmin()
         # -- control-plane crash recovery (admin/recovery.py) -------------
         self._recovery: Dict[str, Any] = {"state": "ready"}
@@ -536,7 +547,15 @@ class Admin:
         test_dataset_uri: str,
         budget: Optional[Dict[str, Any]] = None,
         model_names: Optional[List[str]] = None,
+        warm_start_from: Optional[str] = None,
     ) -> Dict:
+        """``warm_start_from`` (a prior train job id) seeds each new
+        sub-job's advisor with the source job's scored + infeasible
+        trials for models the two jobs share — the drift loop's cheap
+        warm-started retrain (admin/drift.py). Seeding happens BEFORE
+        the train services launch, so the first proposal already
+        benefits; the TrainWorker's own create_advisor/replay are
+        idempotent no-ops against the seeded session."""
         budget = {} if budget is None else budget
         self._validate_budget(budget)
         # pick the models: named ones, or all visible models for the task
@@ -592,8 +611,55 @@ class Admin:
         )
         for m in models:
             self.db.create_sub_train_job(job["id"], m["id"])
+        if warm_start_from:
+            self._seed_advisors_from(job["id"], warm_start_from)
         self.services.create_train_services(job["id"])
         return self.get_train_job(user_id, app, version)
+
+    def _seed_advisors_from(self, train_job_id: str,
+                            source_job_id: str) -> None:
+        """Warm-start the new job's advisors from a prior job's trial
+        history (matched per model id): replay scored feedback AND
+        infeasible observations, mirroring recovery's advisor rebuild.
+        Best-effort — a failed seed degrades to a cold-started search,
+        never a failed job creation."""
+        from rafiki_tpu.constants import TrialStatus
+        from rafiki_tpu.sdk.model import load_model_class
+        from rafiki_tpu.worker.faults import is_infeasible_row
+
+        source_subs = {
+            s["model_id"]: s
+            for s in self.db.get_sub_train_jobs_of_train_job(source_job_id)}
+        for sub in self.db.get_sub_train_jobs_of_train_job(train_job_id):
+            src = source_subs.get(sub["model_id"])
+            if src is None:
+                continue
+            try:
+                trials = self.db.get_trials_of_sub_train_job(src["id"])
+                scored = [
+                    (t["knobs"], t["score"]) for t in trials
+                    if t["status"] == TrialStatus.COMPLETED
+                    and t["score"] is not None]
+                infeasible = [
+                    (t["knobs"], t["fault_kind"]) for t in trials
+                    if is_infeasible_row(t)]
+                if not (scored or infeasible):
+                    continue
+                model = self.db.get_model(sub["model_id"])
+                clazz = load_model_class(model["model_file_bytes"],
+                                         model["model_class"])
+                self.advisor_store.create_advisor(
+                    clazz.get_knob_config(), advisor_id=sub["id"])
+                if self.advisor_store.replay_feedback(
+                        sub["id"], scored, infeasible=infeasible):
+                    logger.info(
+                        "advisor %s warm-started with %d scored + %d "
+                        "infeasible trials from job %s", sub["id"][:8],
+                        len(scored), len(infeasible), source_job_id[:8])
+            # lint: absorb(warm start is best-effort: a failed seed cold-starts the search instead of failing job creation)
+            except Exception:
+                logger.exception("advisor warm start failed for sub %s",
+                                 sub["id"][:8])
 
     @staticmethod
     def _validate_budget(budget: Dict[str, Any]) -> None:
@@ -975,6 +1041,17 @@ class Admin:
 
     def _running_inference_job(self, user_id: str, app: str,
                                app_version: int) -> Dict:
+        # version -1 means "the serving version", NOT "the newest train
+        # job": a drift auto-retrain (admin/drift.py) bumps the app's
+        # version catalog without deploying, so the newest version may
+        # have no inference job while an older one is still serving
+        if app_version == -1:
+            for job in self.db.get_train_jobs_of_app(user_id, app):
+                inf = self.db.get_running_inference_job_of_train_job(
+                    job["id"])
+                if inf is not None:
+                    return inf
+            raise InvalidRequestError("No running inference job")
         job = self.db.get_train_job_by_app_version(user_id, app, app_version)
         if job is None:
             raise InvalidRequestError(f"No such train job {app} v{app_version}")
@@ -1041,6 +1118,42 @@ class Admin:
                 continue
         raise InvalidRequestError(
             f"no unacknowledged rollback for {app}")
+
+    def get_drift_status(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        """The drift closed loop's state for the app's current inference
+        job (admin/drift.py): phase, frozen-baseline flag, live signal
+        snapshot, event tail."""
+        if app_version == -1:
+            # the drift row lives on the SERVING version's inference job;
+            # a drift retrain's own (newer) train job never has one
+            jobs = self.db.get_train_jobs_of_app(user_id, app)
+            if not jobs:
+                raise InvalidRequestError(f"No such app {app}")
+        else:
+            job = self.db.get_train_job_by_app_version(
+                user_id, app, app_version)
+            if job is None:
+                raise InvalidRequestError(
+                    f"No such train job {app} v{app_version}")
+            jobs = [job]
+        for job in jobs:
+            for inf in self.db.get_inference_jobs_of_train_job(job["id"]):
+                status = self.drift.status(inf["id"])
+                if status is not None:
+                    return status
+        raise InvalidRequestError(
+            f"no drift state recorded for {app}"
+            + (f" v{app_version}" if app_version != -1 else ""))
+
+    def ack_drift(
+        self, user_id: str, app: str, app_version: int = -1
+    ) -> Dict:
+        """Acknowledge the app's drift loop: re-arms a PARKED loop or
+        clears a rollback-flap streak (clears the doctor WARNs)."""
+        inf = self._running_inference_job(user_id, app, app_version)
+        return self.drift.ack(inf["id"])
 
     def _drop_predict_routes(self, inference_job_id: str) -> None:
         """Invalidate cached predict routes for a stopped inference job —
@@ -1110,10 +1223,23 @@ class Admin:
                     self._predict_route_cache.pop(key, None)
         with self._predict_route_lock:
             epoch = self._predict_route_epoch
-        job = self.db.get_train_job_by_app_version(user_id, app, app_version)
-        if job is None:
-            raise InvalidRequestError(f"No such app {app}")
-        inf = self.db.get_running_inference_job_of_train_job(job["id"])
+        if app_version == -1:
+            # serving resolution, not catalog resolution: skip versions
+            # with no running inference job (e.g. a drift auto-retrain's
+            # own train job, which bumps the version but never deploys)
+            jobs = self.db.get_train_jobs_of_app(user_id, app)
+            if not jobs:
+                raise InvalidRequestError(f"No such app {app}")
+            inf = next(
+                (i for i in (
+                    self.db.get_running_inference_job_of_train_job(j["id"])
+                    for j in jobs) if i is not None), None)
+        else:
+            job = self.db.get_train_job_by_app_version(
+                user_id, app, app_version)
+            if job is None:
+                raise InvalidRequestError(f"No such app {app}")
+            inf = self.db.get_running_inference_job_of_train_job(job["id"])
         if inf is None:
             raise InvalidRequestError("No running inference job for this app")
         predictor = self.services.get_predictor(inf["id"])
@@ -1265,6 +1391,10 @@ class Admin:
             # with the judge's live per-lane signals, plus recent events
             # (rollback reasons + the signal snapshots they fired on)
             "rollouts": self.rollouts.report(),
+            # drift closed loop (admin/drift.py): per-job phase +
+            # divergence signal snapshot, plus the recent event tail
+            # (drift verdicts, retrain launches, rollout outcomes)
+            "drift": self.drift.report(),
             "serving": {
                 "jobs": jobs,
                 "admission": self._predict_admission.stats(),
@@ -1450,6 +1580,11 @@ class Admin:
         # — a tick racing the teardown would re-place replicas
         if getattr(self, "autoscaler", None) is not None:
             self.autoscaler.stop()
+        # the drift loop must stop deciding before the rollout
+        # controller it drives — a tick racing the teardown could start
+        # a rollout nothing will ever judge
+        if getattr(self, "drift", None) is not None:
+            self.drift.stop()
         # rollout runs likewise: a mid-flight placement racing the
         # teardown would resurrect a replica nothing will ever stop
         if getattr(self, "rollouts", None) is not None:

@@ -293,6 +293,15 @@ class AdminServer:
                 r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/rollout/ack",
                 _APP_DEVS, lambda au, m, b, q: A.ack_rollout(
                     au["user_id"], m["app"], int(m["v"]))),
+            # drift closed loop (admin/drift.py): the job's loop state +
+            # live signals; ack re-arms a parked loop / clears a flap
+            r("GET", r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/drift",
+                _ANY, lambda au, m, b, q: A.get_drift_status(
+                    au["user_id"], m["app"], int(m["v"]))),
+            r("POST",
+                r"/inference_jobs/(?P<app>[^/]+)/(?P<v>-?\d+)/drift/ack",
+                _APP_DEVS, lambda au, m, b, q: A.ack_drift(
+                    au["user_id"], m["app"], int(m["v"]))),
             # serving (the reference exposed this on a separate predictor app,
             # reference predictor/app.py:23-31)
             r("POST", r"/predict/(?P<app>[^/]+)", _ANY, lambda au, m, b, q:

@@ -366,11 +366,13 @@ class ControlPlaneRecovery:
                     self.report["adopted"] += 1
                     if stype == ServiceType.INFERENCE:
                         adopted_serving_jobs.add(extra["inference_job_id"])
+                        self._readopt_chip_loan(row, extra)
                     continue
             if adopt_enabled and self._adopt_local_pid(row, extra):
                 self.report["adopted"] += 1
                 if stype == ServiceType.INFERENCE:
                     adopted_serving_jobs.add(extra["inference_job_id"])
+                    self._readopt_chip_loan(row, extra)
                 continue
             if not adopt_enabled:
                 # surviving LOCAL children must be fenced before anything
@@ -441,8 +443,50 @@ class ControlPlaneRecovery:
                 self._reason(f"rollout resolution failed "
                              f"({type(e).__name__}: {e})")
 
+        # -- resume the drift closed loop (admin/drift.py): rows the
+        # dead admin left RETRAINING/ROLLING_OUT re-attach by persisted
+        # retrain id (the idempotency key); a write-ahead intent whose
+        # launch fate is unknowable is adopted or parked — NEVER
+        # relaunched, so a crash cannot double-spend the retrain budget
+        drift = getattr(admin, "drift", None)
+        if drift is not None:
+            self._check_abort()
+            try:
+                drift.recover_on_boot()
+            except RecoveryAborted:
+                raise
+            except Exception as e:
+                logger.exception("boot-time drift resumption failed")
+                self._reason(f"drift resumption failed "
+                             f"({type(e).__name__}: {e})")
+
         # -- sweep: no job may stay non-terminal with nothing backing it ---
         self._sweep_jobs(snapshot)
+
+    def _readopt_chip_loan(self, row: Dict[str, Any],
+                           extra: Dict[str, Any]) -> None:
+        """Rebuild the ChipBudgetArbiter's loan book for an adopted
+        serving replica. A crashed admin's arbiter lived in memory; the
+        ``borrowed_chips`` column on the worker row (written when the
+        autoscaler's borrow committed) is the durable record, so an
+        adopted replica that held borrowed trial chips is re-entered on
+        the successor's loan book — the training plane can reclaim it
+        and the fleet-health loan picture stays truthful instead of
+        silently leaking the loan until the replica stops."""
+        n = int(row.get("borrowed_chips") or 0)
+        if n <= 0:
+            return
+        arbiter = getattr(self.admin, "chip_arbiter", None)
+        if arbiter is None:
+            return
+        try:
+            arbiter.note_borrow(row["id"], extra["inference_job_id"], n)
+            logger.info("re-adopted a %d-chip serving loan on replica %s",
+                        n, row["id"][:8])
+        # lint: absorb(the loan book is advisory accounting: a rebuild failure must not fail the adoption itself)
+        except Exception:
+            logger.exception("chip-loan re-adoption failed for %s",
+                             row["id"][:8])
 
     def _adopt_local_pid(self, row: Dict[str, Any],
                          extra: Dict[str, Any]) -> bool:

@@ -825,6 +825,19 @@ class ServicesManager:
                 self._arbiter.commit_borrow(
                     reservation, service["id"], inference_job_id, ctx.chips)
                 borrowed = len(ctx.chips)
+                # durable twin of the in-memory loan book: a successor
+                # admin rebuilds the arbiter from this column when it
+                # adopts the replica (admin/recovery.py
+                # _readopt_chip_loan) — without it, an admin restart
+                # silently leaked the loan until the replica stopped
+                try:
+                    self._db.set_worker_borrowed_chips(
+                        service["id"], borrowed)
+                # lint: absorb(the marker is recovery accounting: failing to write it must not undo a committed scale-up)
+                except Exception:
+                    logger.exception(
+                        "could not persist the %d-chip loan marker for "
+                        "replica %s", borrowed, service["id"][:8])
             else:
                 self._arbiter.cancel_borrow(reservation)
         # replica JOIN: route new requests to it (its queue is already
@@ -1083,9 +1096,18 @@ class ServicesManager:
         self._db.mark_service_as_stopped(service_id)
         # every teardown path funnels here: a destroyed replica's chip
         # loan comes home no matter WHY it died (job stop, deploy
-        # rollback, drain) — note_return is an idempotent pop
+        # rollback, drain) — note_return is an idempotent pop. The
+        # durable marker clears with it so a later admin restart cannot
+        # resurrect a loan that already came home.
         if self._arbiter is not None:
-            self._arbiter.note_return(service_id)
+            if self._arbiter.note_return(service_id) > 0:
+                try:
+                    self._db.set_worker_borrowed_chips(service_id, 0)
+                # lint: absorb(the marker is recovery accounting: a failed clear leaves a stale row for a stopped replica, which adoption ignores)
+                except Exception:
+                    logger.exception(
+                        "could not clear the loan marker for replica %s",
+                        service_id[:8])
 
     def _wait_until_services_running(self, service_ids: List[str]) -> None:
         """Poll the store until all services are RUNNING (reference :279-290)."""

@@ -370,6 +370,18 @@ class Client:
         return self._call(
             "POST", f"/inference_jobs/{app}/{app_version}/rollout/ack")
 
+    def get_drift_status(self, app: str, app_version: int = -1) -> Dict:
+        """The app's drift closed-loop state (admin/drift.py): phase,
+        frozen-baseline flag, live divergence signals, event tail."""
+        return self._call(
+            "GET", f"/inference_jobs/{app}/{app_version}/drift")
+
+    def ack_drift(self, app: str, app_version: int = -1) -> Dict:
+        """Acknowledge the app's drift loop: re-arms a ``PARKED`` loop
+        or clears a rollback-flap streak (clears the doctor WARNs)."""
+        return self._call(
+            "POST", f"/inference_jobs/{app}/{app_version}/drift/ack")
+
     def wait_until_rollout_done(
         self, app: str, app_version: int = -1, timeout_s: float = 300.0,
     ) -> Dict:

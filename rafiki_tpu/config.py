@@ -387,6 +387,60 @@ _DYNAMIC_PATHS = {
     "RECOVER_RETRY_MAX": lambda: _env_int("RAFIKI_RECOVER_RETRY_MAX", 4),
     "RECOVER_RETRY_BACKOFF_S": lambda: _env_float(
         "RAFIKI_RECOVER_RETRY_BACKOFF_S", 0.2),
+    # -- drift closed loop (docs/failure-model.md "Model drift faults").
+    # admin/drift.py watches each RUNNING inference job's serving plane
+    # for input-distribution shift / confidence decay, launches ONE
+    # bounded warm-started retrain, and auto-rolls-out a better candidate
+    # through the SLO-judged rollout. Lazy so the NEXT monitor tick picks
+    # up a retune:
+    #   RAFIKI_DRIFT=1                  enable the closed loop (off by
+    #                                   default: monitor, retrain, and
+    #                                   rollout all stay dormant)
+    #   RAFIKI_DRIFT_INTERVAL_S=2       seconds between monitor ticks
+    #   RAFIKI_DRIFT_WINDOW_S=10        trailing sample window the
+    #                                   monitor evaluates each tick
+    #   RAFIKI_DRIFT_BASELINE_WINDOW_S=10  window frozen as the baseline
+    #                                   after enable/rollout (doctor
+    #                                   WARNs when shorter than the
+    #                                   monitor window)
+    #   RAFIKI_DRIFT_MIN_SAMPLES=20    requests needed in a window before
+    #                                   a baseline freezes or a verdict
+    #                                   counts (idle jobs never flap)
+    #   RAFIKI_DRIFT_THRESHOLD=0.5     novelty fraction (share of the
+    #                                   current window's digests absent
+    #                                   from the baseline population)
+    #                                   that counts as distribution shift
+    #   RAFIKI_DRIFT_CONF_DROP=0.2     mean top-probability decay vs the
+    #                                   baseline that counts as score/
+    #                                   confidence drift (probability
+    #                                   tasks only)
+    #   RAFIKI_DRIFT_SKEW_DELTA=0.4    growth of the single most frequent
+    #                                   digest's traffic share vs baseline
+    #                                   that counts as skew (one caller
+    #                                   dominating a shared door)
+    #   RAFIKI_DRIFT_RETRAIN_BUDGET=3  MODEL_TRIAL_COUNT for the
+    #                                   auto-retrain (0 = monitor-only:
+    #                                   events fire, nothing launches)
+    #   RAFIKI_DRIFT_COOLDOWN_S=60     base per-job cooldown after a
+    #                                   retrain resolves; doubles per
+    #                                   consecutive rollback (capped x16)
+    #   RAFIKI_DRIFT_LAUNCH_RETRY_MAX=2  retrain-launch retries (one per
+    #                                   tick) before the loop parks with
+    #                                   a typed event
+    "DRIFT": lambda: os.environ.get("RAFIKI_DRIFT", "0") == "1",
+    "DRIFT_INTERVAL_S": lambda: _env_float("RAFIKI_DRIFT_INTERVAL_S", 2.0),
+    "DRIFT_WINDOW_S": lambda: _env_float("RAFIKI_DRIFT_WINDOW_S", 10.0),
+    "DRIFT_BASELINE_WINDOW_S": lambda: _env_float(
+        "RAFIKI_DRIFT_BASELINE_WINDOW_S", 10.0),
+    "DRIFT_MIN_SAMPLES": lambda: _env_int("RAFIKI_DRIFT_MIN_SAMPLES", 20),
+    "DRIFT_THRESHOLD": lambda: _env_float("RAFIKI_DRIFT_THRESHOLD", 0.5),
+    "DRIFT_CONF_DROP": lambda: _env_float("RAFIKI_DRIFT_CONF_DROP", 0.2),
+    "DRIFT_SKEW_DELTA": lambda: _env_float("RAFIKI_DRIFT_SKEW_DELTA", 0.4),
+    "DRIFT_RETRAIN_BUDGET": lambda: _env_int(
+        "RAFIKI_DRIFT_RETRAIN_BUDGET", 3),
+    "DRIFT_COOLDOWN_S": lambda: _env_float("RAFIKI_DRIFT_COOLDOWN_S", 60.0),
+    "DRIFT_LAUNCH_RETRY_MAX": lambda: _env_int(
+        "RAFIKI_DRIFT_LAUNCH_RETRY_MAX", 2),
 }
 
 

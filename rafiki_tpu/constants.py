@@ -150,6 +150,28 @@ class RolloutPhase:
     TERMINAL = (DONE, ROLLED_BACK, ABORTED)
 
 
+class DriftPhase:
+    # Drift closed loop (admin/drift.py; docs/failure-model.md "Model
+    # drift faults"): per-RUNNING-inference-job state machine persisted
+    # in the drift_state table. WATCHING = monitoring the serving plane
+    # against a frozen baseline window; RETRAINING = one bounded
+    # warm-started retrain is in flight (retrain_job_id is the
+    # idempotency key — recovery never launches a second); ROLLING_OUT =
+    # a better-scoring candidate is going through the SLO-judged rollout;
+    # COOLDOWN = backing off until cooldown_until (rollback/worse
+    # candidate/noisy signal); PARKED = the loop gave up (launch retries
+    # exhausted, state unreconcilable after a crash) and waits for an
+    # operator ack to re-arm. RETRAINING/ROLLING_OUT are the phases
+    # ControlPlaneRecovery must resume after an admin crash.
+    WATCHING = "WATCHING"
+    RETRAINING = "RETRAINING"
+    ROLLING_OUT = "ROLLING_OUT"
+    COOLDOWN = "COOLDOWN"
+    PARKED = "PARKED"
+
+    LIVE = (RETRAINING, ROLLING_OUT)
+
+
 class AgentHealth:
     # Heartbeat-derived state of a host agent (placement/hosts.py monitor;
     # docs/failure-model.md). UNKNOWN = not probed yet.

@@ -137,7 +137,8 @@ CREATE TABLE IF NOT EXISTS inference_job_worker (
     service_id TEXT PRIMARY KEY REFERENCES service(id),
     inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
     trial_id TEXT NOT NULL REFERENCES trial(id),
-    model_version INTEGER NOT NULL DEFAULT 0
+    model_version INTEGER NOT NULL DEFAULT 0,
+    borrowed_chips INTEGER NOT NULL DEFAULT 0
 );
 CREATE TABLE IF NOT EXISTS rollout (
     id TEXT PRIMARY KEY,
@@ -153,6 +154,20 @@ CREATE TABLE IF NOT EXISTS rollout (
     operator_ack INTEGER NOT NULL DEFAULT 0,
     datetime_started REAL NOT NULL,
     datetime_stopped REAL
+);
+CREATE TABLE IF NOT EXISTS drift_state (
+    inference_job_id TEXT PRIMARY KEY REFERENCES inference_job(id),
+    phase TEXT NOT NULL,
+    reason TEXT,
+    baseline TEXT,
+    signals TEXT,
+    retrain_job_id TEXT,
+    candidate_trial_id TEXT,
+    cooldown_until REAL NOT NULL DEFAULT 0,
+    consecutive_rollbacks INTEGER NOT NULL DEFAULT 0,
+    events TEXT NOT NULL DEFAULT '[]',
+    operator_ack INTEGER NOT NULL DEFAULT 0,
+    datetime_updated REAL NOT NULL
 );
 CREATE TABLE IF NOT EXISTS trial_log (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -379,6 +394,30 @@ class Database:
     operator_ack INTEGER NOT NULL DEFAULT 0,
     datetime_started REAL NOT NULL,
     datetime_stopped REAL
+)""",
+        # r16 (drift closed loop): the chip-loan marker — how many chips
+        # this serving replica borrowed from the training floor, so a
+        # restarted admin can rebuild the in-memory loan book instead of
+        # leaking the loan forever (admin/recovery.py; the PR 7
+        # restart limitation)
+        "ALTER TABLE inference_job_worker ADD COLUMN"
+        " borrowed_chips INTEGER NOT NULL DEFAULT 0",
+        # r16: drift loop state (admin/drift.py) — one mutable row per
+        # inference job; retrain_job_id is the idempotency key that
+        # keeps a recovered admin from double-launching a retrain
+        """CREATE TABLE IF NOT EXISTS drift_state (
+    inference_job_id TEXT PRIMARY KEY REFERENCES inference_job(id),
+    phase TEXT NOT NULL,
+    reason TEXT,
+    baseline TEXT,
+    signals TEXT,
+    retrain_job_id TEXT,
+    candidate_trial_id TEXT,
+    cooldown_until REAL NOT NULL DEFAULT 0,
+    consecutive_rollbacks INTEGER NOT NULL DEFAULT 0,
+    events TEXT NOT NULL DEFAULT '[]',
+    operator_ack INTEGER NOT NULL DEFAULT 0,
+    datetime_updated REAL NOT NULL
 )""",
     )
 
@@ -1083,6 +1122,18 @@ class Database:
             "SELECT * FROM inference_job_worker WHERE service_id=?", (service_id,)
         )
 
+    def set_worker_borrowed_chips(self, service_id: str, n_chips: int) -> None:
+        """Persist how many chips this serving replica borrowed from the
+        training floor (0 = none). The ChipBudgetArbiter's loan book is
+        in-memory; this marker is what lets a restarted admin rebuild it
+        for adopted replicas instead of leaking the loan
+        (admin/recovery.py)."""
+        self._exec(
+            "UPDATE inference_job_worker SET borrowed_chips=?"
+            " WHERE service_id=?",
+            (int(n_chips), service_id),
+        )
+
     def get_workers_of_inference_job(self, inference_job_id: str) -> List[Dict]:
         return self._all(
             "SELECT * FROM inference_job_worker WHERE inference_job_id=?",
@@ -1170,6 +1221,79 @@ class Database:
         self._exec(
             "UPDATE rollout SET operator_ack=1 WHERE id=?", (rollout_id,))
 
+    # -- drift loop state (admin/drift.py; docs/failure-model.md
+    # "Model drift faults") --------------------------------------------------
+
+    @staticmethod
+    def _parse_drift_state(row: Optional[Dict]) -> Optional[Dict]:
+        if row is not None:
+            for key in ("baseline", "signals"):
+                try:
+                    row[key] = (json.loads(row[key])
+                                if row.get(key) else None)
+                except ValueError:
+                    row[key] = None
+            try:
+                row["events"] = json.loads(row.get("events") or "[]")
+            except ValueError:
+                row["events"] = []
+            row["operator_ack"] = bool(row.get("operator_ack"))
+        return row
+
+    def create_drift_state(self, inference_job_id: str, phase: str) -> Dict:
+        self._exec(
+            "INSERT INTO drift_state (inference_job_id, phase,"
+            " datetime_updated) VALUES (?,?,?)",
+            (inference_job_id, phase, time.time()),
+        )
+        return self.get_drift_state(  # type: ignore[return-value]
+            inference_job_id)
+
+    def get_drift_state(self, inference_job_id: str) -> Optional[Dict]:
+        return self._parse_drift_state(self._one(
+            "SELECT * FROM drift_state WHERE inference_job_id=?",
+            (inference_job_id,)))
+
+    def get_drift_states(self) -> List[Dict]:
+        """Every drift row — recovery resumes the LIVE phases
+        (RETRAINING/ROLLING_OUT must never double-launch or strand a
+        candidate) and doctor scans for flap/parked signals."""
+        rows = self._all("SELECT * FROM drift_state")
+        return [self._parse_drift_state(r) for r in rows]
+
+    def update_drift_state(self, inference_job_id: str, **fields) -> None:
+        """Write-through for the drift loop's mutable row. JSON-typed
+        fields (baseline/signals/events) are encoded here; pass an
+        explicit None to null baseline/signals out (refreeze)."""
+        allowed = ("phase", "reason", "baseline", "signals",
+                   "retrain_job_id", "candidate_trial_id",
+                   "cooldown_until", "consecutive_rollbacks", "events",
+                   "operator_ack")
+        unknown = set(fields) - set(allowed)
+        if unknown:
+            raise ValueError(f"unknown drift_state fields {sorted(unknown)}")
+        sets, vals = [], []
+        for key in allowed:
+            if key not in fields:
+                continue
+            val = fields[key]
+            if key in ("baseline", "signals"):
+                val = json.dumps(val) if val is not None else None
+            elif key == "events":
+                val = json.dumps(val or [])
+            elif key == "operator_ack":
+                val = 1 if val else 0
+            sets.append(f"{key}=?")
+            vals.append(val)
+        sets.append("datetime_updated=?")
+        vals.append(time.time())
+        vals.append(inference_job_id)
+        self._exec(
+            "UPDATE drift_state SET " + ", ".join(sets)
+            + " WHERE inference_job_id=?",
+            tuple(vals),
+        )
+
     # -- services ------------------------------------------------------------
 
     def create_service(
@@ -1233,6 +1357,7 @@ class Database:
             " iw.inference_job_id AS inference_job_id,"
             " iw.trial_id AS trial_id,"
             " iw.model_version AS model_version,"
+            " iw.borrowed_chips AS borrowed_chips,"
             " ij.status AS inference_job_status,"
             " pj.id AS predictor_job_id,"
             " pj.status AS predictor_job_status"

@@ -346,6 +346,132 @@ def check_rollouts() -> Check:
     return ("rollouts", PASS, detail)
 
 
+def check_drift() -> Check:
+    """The drift closed loop (docs/failure-model.md "Model drift
+    faults"): WARN when the loop is enabled but can't work — no digest
+    stream will flow (metrics disabled hides the loop entirely; a
+    WATCHING row that never froze a baseline means no samples reach the
+    monitor), retrain budget 0 (monitor-only: verdicts fire, nothing is
+    ever retrained), or a baseline window shorter than the monitor
+    window (the reference population is a subset of every comparison
+    window, so novelty can never clear the threshold) — and on loop
+    rows that need an operator: a PARKED loop waiting for an ack, or
+    ≥2 consecutive auto-retrained candidates rolled back (the loop is
+    flapping — raise RAFIKI_DRIFT_COOLDOWN_S or fix the training
+    signal)."""
+    from rafiki_tpu import config
+    from rafiki_tpu.constants import DriftPhase
+    from rafiki_tpu.utils import metrics as _metrics
+
+    enabled = bool(config.DRIFT)
+    notes = []
+    warn = False
+    if enabled:
+        if not _metrics.metrics_enabled():
+            warn = True
+            notes.append(
+                "RAFIKI_DRIFT=1 with RAFIKI_METRICS=0: the loop runs "
+                "but every rafiki_drift_* signal is a no-op — its "
+                "verdicts and retrains are invisible to operators")
+        if int(config.DRIFT_RETRAIN_BUDGET) <= 0:
+            warn = True
+            notes.append(
+                "RAFIKI_DRIFT_RETRAIN_BUDGET<=0: monitor-only mode — "
+                "drift events fire but nothing is ever retrained; set "
+                "a positive trial budget to close the loop")
+        if float(config.DRIFT_BASELINE_WINDOW_S) \
+                < float(config.DRIFT_WINDOW_S):
+            warn = True
+            notes.append(
+                f"RAFIKI_DRIFT_BASELINE_WINDOW_S="
+                f"{float(config.DRIFT_BASELINE_WINDOW_S):g} < "
+                f"RAFIKI_DRIFT_WINDOW_S={float(config.DRIFT_WINDOW_S):g}"
+                ": the frozen baseline samples a shorter horizon than "
+                "every window it judges — novelty verdicts will be "
+                "noise; make the baseline window at least the monitor "
+                "window")
+    target = str(config.DB_PATH)
+    is_url = target.startswith(("postgresql://", "postgres://"))
+    stale_watch = 0
+    if is_url or os.path.exists(target):
+        try:
+            import time as _time
+
+            from rafiki_tpu.db.database import Database
+
+            now = _time.time()
+            db = Database(target)
+            try:
+                rows = db.get_drift_states()
+                parked = [r for r in rows
+                          if r["phase"] == DriftPhase.PARKED
+                          and not r["operator_ack"]]
+                if parked:
+                    warn = True
+                    notes.append(
+                        f"{len(parked)} drift loop(s) PARKED with no "
+                        "operator ack: "
+                        + "; ".join(
+                            f"job {r['inference_job_id'][:8]} "
+                            f"({(r.get('reason') or 'no reason')[:60]})"
+                            for r in parked[:3])
+                        + (" …" if len(parked) > 3 else "")
+                        + " — review, then POST .../drift/ack "
+                        "(Client.ack_drift)")
+                flapping = [r for r in rows
+                            if int(r.get("consecutive_rollbacks") or 0)
+                            >= 2]
+                if flapping:
+                    warn = True
+                    notes.append(
+                        f"{len(flapping)} drift loop(s) with >=2 "
+                        "consecutive auto-retrained candidates rolled "
+                        "back: "
+                        + ", ".join(f"job {r['inference_job_id'][:8]} "
+                                    f"(x{r['consecutive_rollbacks']})"
+                                    for r in flapping[:3])
+                        + " — the loop is flapping; raise "
+                        "RAFIKI_DRIFT_COOLDOWN_S (backoff already "
+                        "doubles per rollback) or fix the training "
+                        "signal, then .../drift/ack to clear")
+                if enabled:
+                    # a WATCHING row much older than the baseline window
+                    # that never froze a baseline: the monitor sees no
+                    # digest stream from that job's serving plane
+                    horizon = max(
+                        float(config.DRIFT_BASELINE_WINDOW_S),
+                        float(config.DRIFT_INTERVAL_S)) * 10
+                    stale_watch = sum(
+                        1 for r in rows
+                        if r["phase"] == DriftPhase.WATCHING
+                        and r.get("baseline") is None
+                        and now - float(r.get("datetime_updated") or now)
+                        > horizon)
+                    if stale_watch:
+                        warn = True
+                        notes.append(
+                            f"{stale_watch} WATCHING loop(s) never froze "
+                            "a baseline: no digest stream is flowing "
+                            "from the serving plane (job idle, or the "
+                            "admin restarted without RAFIKI_DRIFT=1)")
+            finally:
+                db.close()
+        # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+        except Exception as e:
+            return ("drift loop", WARN,
+                    f"could not scan {target}: {type(e).__name__}: {e}")
+    if warn:
+        return ("drift loop", WARN, "; ".join(notes))
+    if not enabled:
+        return ("drift loop", PASS,
+                "disabled (RAFIKI_DRIFT=0); no parked or flapping loop "
+                "rows")
+    return ("drift loop", PASS,
+            f"enabled: window {float(config.DRIFT_WINDOW_S):g}s, budget "
+            f"{int(config.DRIFT_RETRAIN_BUDGET)} trial(s), cooldown "
+            f"{float(config.DRIFT_COOLDOWN_S):g}s")
+
+
 def check_trial_faults() -> Check:
     """Training-plane fault tolerance (docs/failure-model.md,
     "Training-plane faults"): WARN when infra-retry is disabled
@@ -1129,7 +1255,8 @@ def check_agents() -> Check:
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
     check_chaos, check_overload_knobs, check_autoscaler, check_recovery,
-    check_rollouts, check_trial_faults, check_vectorized_trials,
+    check_rollouts, check_drift, check_trial_faults,
+    check_vectorized_trials,
     check_static_analysis, check_concurrency_lint,
     check_int8_serving, check_generative_serving,
     check_prediction_cache,

@@ -162,12 +162,13 @@ class ChipBudgetArbiter:
     (the local :class:`ChipAllocator` or this module's fleet inventory),
     so single-host and hosts-mode deployments arbitrate identically.
 
-    The loan book is in-memory: across an admin restart, adopted
-    borrowed replicas become ordinary replicas (targeted reclaim can no
-    longer pick them), and their chips return to the pool only when the
-    autoscaler's idle scale-down — or a job stop — eventually drains
-    them. The training floor itself is still enforced for every loan
-    granted after the restart."""
+    The loan book is in-memory, with a durable twin: every committed
+    borrow writes ``borrowed_chips`` onto the replica's worker row
+    (admin/services.py), and ControlPlaneRecovery re-enters the loan
+    here when a successor admin adopts the replica — so targeted
+    reclaim and the fleet-health loan picture survive an admin restart
+    instead of silently leaking until the replica stops. The marker is
+    cleared when the loan comes home (:meth:`note_return`)."""
 
     def __init__(self, allocator=None):
         self._alloc = allocator

@@ -40,6 +40,18 @@ LANE_INCUMBENT = "incumbent"
 LANE_CANARY = "canary"
 
 
+def _top_prob(pred: Any) -> Optional[float]:
+    """The served answer's top class probability (probability tasks
+    ensemble to one vector per query) — the drift monitor's confidence
+    signal. None for anything that isn't a non-empty numeric vector."""
+    try:
+        if isinstance(pred, (list, tuple)) and pred:
+            return float(max(pred))
+    except (TypeError, ValueError):
+        return None
+    return None
+
+
 class Predictor:
     def __init__(self, inference_job_id: str, broker: Broker,
                  task: Optional[str],
@@ -132,6 +144,13 @@ class Predictor:
         self._m_lane_lat = REGISTRY.histogram(
             "rafiki_rollout_request_seconds",
             "request latency per rollout version lane", ("job", "lane"))
+        # -- drift monitor tap (admin/drift.py; RAFIKI_DRIFT=1) ------------
+        # one (wall_ts, digest, top_prob) tuple per served query, bounded:
+        # request-handler threads append, the DriftController's tick
+        # snapshots the trailing window
+        self._drift_lock = threading.Lock()
+        self._drift_samples: collections.deque = collections.deque(
+            maxlen=4096)  # guarded-by: _drift_lock
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._ol_lock:
@@ -445,13 +464,15 @@ class Predictor:
         split = self._lane_split(routable, lane_new, take_new)
         plan = self._cache_plan(split)
         if plan is None:
-            # drop any digest stash admission_cost left on this thread —
-            # the uncached path will never consume it
-            self._take_digest_stash(queries)
+            # consume any digest stash admission_cost left on this thread
+            # (the uncached serve path has no other consumer; the drift
+            # tap reuses it when present, else hashes on demand)
+            digests = self._take_digest_stash(queries)
             self._maybe_note_shareable(queries)
             preds, _fillable = self._serve_lanes(
                 queries, queues, routable, trials, draining, deadline,
                 trace, split)
+            self._drift_note(queries, digests, preds)
             return preds
         return self._serve_cached(
             plan, queries, queues, routable, trials, draining, deadline,
@@ -673,6 +694,7 @@ class Predictor:
         # this request into its own SLO timeout.
         for i, fut in followers.items():
             results[i] = fut.result(max(deadline - time.monotonic(), 0.0))
+        self._drift_note(queries, digests, results)
         return results
 
     def _take_digest_stash(self, queries: List[Any]):
@@ -753,6 +775,46 @@ class Predictor:
                 self._job_id, wire.canonical_digest(queries[0]))
 
         self._cache_op(probe, None)
+
+    # -- drift monitor tap (admin/drift.py; docs/failure-model.md
+    # "Model drift faults") --------------------------------------------------
+
+    def _drift_note(self, queries: List[Any],
+                    digests: "Optional[List[Optional[str]]]",
+                    preds: Optional[List[Any]]) -> None:
+        """Feed the drift monitor's sample window: one (wall_ts, digest,
+        top_prob) tuple per served query. A no-op unless RAFIKI_DRIFT=1,
+        and even then strictly observational — any failure here is
+        absorbed, never surfaced to the served request."""
+        if not config.DRIFT or not queries:
+            return
+        try:
+            if digests is None:
+                from rafiki_tpu.cache import wire
+
+                digests = [
+                    self._cache_op(lambda q=q: wire.canonical_digest(q),
+                                   None)
+                    for q in queries]
+            now = time.time()
+            prob_task = self._task in _PROB_TASKS
+            with self._drift_lock:
+                for i, digest in enumerate(digests):
+                    conf = None
+                    if prob_task and preds is not None and i < len(preds):
+                        conf = _top_prob(preds[i])
+                    self._drift_samples.append((now, digest, conf))
+        # lint: absorb(the drift tap is observational: a broken monitor feed must never fail a served request)
+        except Exception:
+            logger.debug("drift tap failed for job %s", self._job_id,
+                         exc_info=True)
+
+    def drift_window(self, window_s: float) -> List[tuple]:
+        """Samples from the trailing ``window_s`` seconds (wall clock),
+        oldest first — the DriftController's per-tick snapshot."""
+        cut = time.time() - float(window_s)
+        with self._drift_lock:
+            return [s for s in self._drift_samples if s[0] >= cut]
 
     def _predict_on(
         self, queries: List[Any], queues, routable: List[str],

@@ -32,9 +32,11 @@ Fields:
              degrade to miss-path serving, never fail a request),
              ``generate`` (the generation decode loop — mid-stream
              fault / stalled-decode drills, one ask per active slot per
-             round), or ``deploy`` (the inference-replica placement
+             round), ``deploy`` (the inference-replica placement
              chokepoint — canary-failure / deploy-timeout rollback
-             drills for live rollouts). Required.
+             drills for live rollouts), or ``drift`` (the drift loop's
+             monitor-tick and retrain-launch chokepoints — degraded-
+             monitor / parked-launch drills). Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
              ``delay_s`` then proceed — a slow replica), ``error``
@@ -121,6 +123,14 @@ SITE_DEPLOY = "deploy"
 # request is answered by a real forward, never failed); `delay` models
 # a slow cache. docs/failure-model.md "Cache faults".
 SITE_CACHE = "cache"
+# drift closed loop (admin/drift.py): two chokepoints, target
+# "tick/{inference_job_id}" (the monitor's per-job evaluation) and
+# "launch/{inference_job_id}" (the bounded-retrain launch). `error` at
+# tick proves the degradation contract — a broken monitor is absorbed
+# and never touches serving; `error` at launch drives the bounded
+# launch retries and the PARKED terminal state; `delay` models a slow
+# monitor/launch — docs/failure-model.md "Model drift faults".
+SITE_DRIFT = "drift"
 # trial-run chokepoint (worker/train.py _execute_trial): one ask per
 # trial ATTEMPT, target "{sub_train_job_id} {trial_id}". `error` raises
 # a typed transient fault the taxonomy classifies INFRA (the
@@ -157,7 +167,8 @@ class ChaosRule:
     def __post_init__(self) -> None:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
                              SITE_WIRE, SITE_DB, SITE_TRIAL,
-                             SITE_GENERATE, SITE_DEPLOY, SITE_CACHE):
+                             SITE_GENERATE, SITE_DEPLOY, SITE_CACHE,
+                             SITE_DRIFT):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
                                ACTION_CORRUPT, ACTION_OOM):

@@ -242,6 +242,49 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # "rollouts" check WARNs on wedged DEPLOYING rows and unacked rollbacks
 # (POST .../rollout/ack).
 
+# Drift closed loop (docs/failure-model.md "Model drift faults"). Off by
+# default. With RAFIKI_DRIFT=1 the admin watches every RUNNING inference
+# job's serving plane (canonical-digest novelty, confidence decay,
+# traffic skew vs a frozen post-rollout baseline); a drift verdict
+# launches ONE warm-started retrain bounded by the trial budget below,
+# and a better-scoring candidate auto-rolls-out through the SLO-judged
+# rollout path (canary -> rolling -> done, automatic rollback). Every
+# non-success backs the loop off; repeated launch failures park it until
+# POST .../drift/ack:
+#   RAFIKI_DRIFT=0                      1 = run the closed loop
+#   RAFIKI_DRIFT_INTERVAL_S=2.0         monitor tick interval
+#   RAFIKI_DRIFT_WINDOW_S=10            trailing window each tick judges
+#   RAFIKI_DRIFT_BASELINE_WINDOW_S=10   window sketched into the frozen
+#                                       baseline (doctor WARNs if it is
+#                                       shorter than the monitor window)
+#   RAFIKI_DRIFT_MIN_SAMPLES=20         served samples needed before a
+#                                       baseline freezes or a verdict
+#                                       fires (idle jobs never trigger)
+#   RAFIKI_DRIFT_THRESHOLD=0.5          novelty fraction (window digests
+#                                       outside the baseline population)
+#                                       that is an input-distribution
+#                                       drift verdict
+#   RAFIKI_DRIFT_CONF_DROP=0.2          mean top-probability drop below
+#                                       the baseline that is a
+#                                       confidence-decay verdict
+#                                       (probability tasks only)
+#   RAFIKI_DRIFT_SKEW_DELTA=0.4         growth of the busiest digest's
+#                                       traffic share that is a
+#                                       per-tenant skew verdict
+#   RAFIKI_DRIFT_RETRAIN_BUDGET=3       MODEL_TRIAL_COUNT of each
+#                                       auto-retrain (0 = monitor-only;
+#                                       doctor WARNs)
+#   RAFIKI_DRIFT_COOLDOWN_S=60          base cooldown after any loop
+#                                       outcome; doubled per consecutive
+#                                       rollback (cap x16)
+#   RAFIKI_DRIFT_LAUNCH_RETRY_MAX=2     retrain-launch retries (one per
+#                                       tick) before the loop PARKs
+# New /metrics series: rafiki_drift_ticks_total and per-job
+# rafiki_drift_{events,retrains,rollouts,rollbacks,parked}_total{job}.
+# Loop state surfaces under GET /fleet/health "drift" and per app via
+# GET /inference_jobs/<app>/<v>/drift; doctor's "drift loop" check WARNs
+# on misconfiguration, parked loops, and rollback flapping.
+
 # TPU backend probe hardening (bench.py / doctor): probes serialize on a
 # machine-wide lockfile so retry loops never stack interpreters onto a
 # wedged libtpu tunnel; abandoned probe children are reaped once stale:

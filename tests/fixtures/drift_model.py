@@ -1,0 +1,49 @@
+"""A deterministic fixture model for the drift closed-loop drills
+(tests/test_drift.py): evaluation score and serving confidence are
+controlled through process env vars, so a test can make the incumbent
+decay, make a retrain's candidate better (or worse), and keep every
+outcome reproducible. The control vars deliberately do NOT use the
+RAFIKI_ prefix — they are fixture plumbing, not platform knobs."""
+
+import os
+
+from rafiki_tpu.sdk import BaseModel, FixedKnob, IntegerKnob
+
+
+class DriftModel(BaseModel):
+    dependencies = {"numpy": None}
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "int_knob": IntegerKnob(1, 32),
+            "fixed_knob": FixedKnob("fixed"),
+        }
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+        self._knobs = knobs
+        self._params = None
+
+    def train(self, dataset_uri):
+        self.logger.log("train done")
+        # the score/confidence the trial will carry are FROZEN at train
+        # time, so flipping the env after a job finishes cannot rewrite
+        # what its trials already measured
+        self._params = {
+            "score": float(os.environ.get("DRIFT_FIXTURE_SCORE", "0.5")),
+            "conf": float(os.environ.get("DRIFT_FIXTURE_CONF", "0.9")),
+        }
+
+    def evaluate(self, dataset_uri):
+        return self._params["score"]
+
+    def predict(self, queries):
+        conf = self._params["conf"]
+        return [[conf, 1.0 - conf] for _ in queries]
+
+    def dump_parameters(self):
+        return self._params
+
+    def load_parameters(self, params):
+        self._params = params

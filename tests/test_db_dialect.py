@@ -176,6 +176,18 @@ def _drive_every_dal_method(db: Database) -> None:
     db.mark_rollout_phase(ro["id"], "ROLLED_BACK", "SLO breach")
     db.ack_rollout(ro["id"])
 
+    db.set_worker_borrowed_chips(svc["id"], 1)
+    db.create_drift_state(ij["id"], "WATCHING")
+    db.get_drift_state(ij["id"])
+    db.get_drift_states()
+    db.update_drift_state(
+        ij["id"], phase="RETRAINING", reason="drill",
+        baseline={"digests": ["d"], "mean_conf": 0.9},
+        signals={"novelty": 1.0}, retrain_job_id=tj["id"],
+        candidate_trial_id=t["id"], cooldown_until=1.0,
+        consecutive_rollbacks=1, events=[{"event": "drift"}],
+        operator_ack=True)
+
     db.mark_inference_job_as_stopped(ij["id"])
     db.mark_inference_job_as_errored(ij["id"])
 

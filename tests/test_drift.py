@@ -1,0 +1,656 @@
+"""The drift closed loop (ISSUE 16; docs/failure-model.md "Model drift
+faults"): a live served job whose input distribution shifts gets a
+first-class drift event, exactly one budget-bounded warm-started
+retrain, and an SLO-guarded auto-rollout of the better candidate — all
+under continuous concurrent client load with zero client errors and
+zero operator calls. The adversarial twin (a candidate that trains
+better but fails in serving) is rolled back by the judge with zero
+client errors and pushes the loop into exponential backoff with no
+second launch. RAFIKI_CHAOS site=drift drills the degradation
+contract: a broken monitor never touches serving, a failing retrain
+launch retries bounded then parks.
+
+Tier-1, CPU-only: the drift fixture model's score/confidence are
+env-controlled (DRIFT_FIXTURE_*, deliberately un-prefixed), the loop
+thread idles on a huge interval and the tests drive tick() directly,
+so every transition is deterministic."""
+
+import time
+
+import pytest
+
+from rafiki_tpu import config
+from rafiki_tpu.admin.admin import Admin, InvalidRequestError
+from rafiki_tpu.admin.drift import DriftController
+from rafiki_tpu.constants import DriftPhase, RolloutPhase, TrainJobStatus
+from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.metrics import REGISTRY
+
+pytestmark = pytest.mark.chaos
+
+FIXTURE = __file__.rsplit("/", 1)[0] + "/fixtures/drift_model.py"
+
+#: fast drill knobs: 2 s windows, 8 samples, manual ticks (the loop
+#: thread idles on a 1 h interval), instant rollout judge
+_DRILL_ENV = {
+    "RAFIKI_DRIFT": "1",
+    "RAFIKI_DRIFT_INTERVAL_S": "3600",
+    "RAFIKI_DRIFT_WINDOW_S": "2.0",
+    "RAFIKI_DRIFT_BASELINE_WINDOW_S": "2.0",
+    "RAFIKI_DRIFT_MIN_SAMPLES": "8",
+    "RAFIKI_DRIFT_THRESHOLD": "0.5",
+    "RAFIKI_DRIFT_RETRAIN_BUDGET": "2",
+    "RAFIKI_DRIFT_COOLDOWN_S": "60",
+    "RAFIKI_ROLLOUT_JUDGE_WINDOW_S": "1.0",
+    "RAFIKI_ROLLOUT_MIN_REQUESTS": "3",
+    "DRIFT_FIXTURE_SCORE": "0.5",
+    "DRIFT_FIXTURE_CONF": "0.9",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def _deploy(tmp_workdir, monkeypatch, app, env=None):
+    merged = dict(_DRILL_ENV)
+    merged.update(env or {})
+    for k, val in merged.items():
+        monkeypatch.setenv(k, val)
+    admin = Admin(params_dir=str(tmp_workdir / "params"))
+    auth = admin.authenticate_user(
+        config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+    uid = auth["user_id"]
+    with open(FIXTURE, "rb") as f:
+        admin.create_model(uid, "driftm", "IMAGE_CLASSIFICATION",
+                           f.read(), "DriftModel")
+    admin.create_train_job(
+        uid, app, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 0})
+    job = admin.wait_until_train_job_stopped(uid, app, timeout_s=60)
+    assert job["status"] == TrainJobStatus.STOPPED, job
+    admin.create_inference_job(uid, app)
+    return admin, uid
+
+
+def _job_id(admin, uid, app):
+    tj = admin.db.get_train_job_by_app_version(uid, app, -1)
+    return admin.db.get_running_inference_job_of_train_job(tj["id"])["id"]
+
+
+def _tick_until(admin, job_id, pred, timeout_s=60):
+    deadline = time.monotonic() + timeout_s
+    st = None
+    while time.monotonic() < deadline:
+        admin.drift.tick()
+        st = admin.drift.status(job_id)
+        if pred(st):
+            return st
+        time.sleep(0.05)
+    raise AssertionError(f"drift state never converged: {st}")
+
+
+def _train_jobs_of(admin, uid, app):
+    return admin.db.get_train_jobs_of_app(uid, app)
+
+
+class _Load:
+    """Continuous concurrent predict load with a switchable payload
+    stream; every exception is a drill failure (acceptance contract:
+    zero client errors attributable to the drift loop)."""
+
+    def __init__(self, admin, uid, app, n=3):
+        import itertools
+        import threading
+
+        self._admin, self._uid, self._app = admin, uid, app
+        self.errors, self.ok = [], 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._novel = threading.Event()
+        self._seq = itertools.count(1)
+        self._threads = [threading.Thread(target=self._client)
+                         for _ in range(n)]
+        for t in self._threads:
+            t.start()
+
+    def shift(self):
+        """Switch from the constant baseline payload to a stream of
+        never-repeating payloads — an input-distribution shift."""
+        self._novel.set()
+
+    def _payload(self):
+        if self._novel.is_set():
+            return [[float(next(self._seq))]]
+        return [[0.0]]
+
+    def _client(self):
+        while not self._stop.is_set():
+            try:
+                preds = self._admin.predict(
+                    self._uid, self._app, self._payload())
+                assert preds
+                with self._lock:
+                    self.ok += 1
+            except Exception as e:
+                with self._lock:
+                    self.errors.append(repr(e))
+            time.sleep(0.01)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+
+
+def _drive_to_drift_verdict(admin, uid, app, job_id, load, monkeypatch,
+                            candidate_score="0.9"):
+    """Shared drill front half: freeze a baseline on constant traffic,
+    shift the input distribution, tick to the drift verdict + retrain
+    launch, and wait for the retrain to finish training."""
+    _tick_until(admin, job_id,
+                lambda st: st and st.get("baseline") is not None)
+    # from here on, new trials train at the candidate score
+    monkeypatch.setenv("DRIFT_FIXTURE_SCORE", candidate_score)
+    load.shift()
+    time.sleep(float(config.DRIFT_WINDOW_S) + 0.5)  # age out the old mix
+    st = _tick_until(
+        admin, job_id,
+        lambda st: st and st["phase"] == DriftPhase.RETRAINING
+        and st.get("retrain_job_id"))
+    rid = st["retrain_job_id"]
+    retrain = admin.wait_until_train_job_stopped(uid, app, timeout_s=60)
+    assert retrain["id"] == rid
+    assert retrain["status"] == TrainJobStatus.STOPPED, retrain
+    # the retrain is bounded by the drift budget, not the incumbent's
+    assert (retrain["budget"]["MODEL_TRIAL_COUNT"]
+            == int(config.DRIFT_RETRAIN_BUDGET))
+    return rid
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill, outcome (a): drift -> retrain -> rollout DONE
+# ---------------------------------------------------------------------------
+
+
+def test_drift_loop_retrains_and_rolls_out_under_load(tmp_workdir,
+                                                      monkeypatch):
+    """A served job under continuous load gets drift injected (shifted
+    input distribution): the loop raises a first-class drift event,
+    launches exactly ONE budget-bounded warm-started retrain, and
+    auto-rolls-out the better candidate through the SLO judge to DONE —
+    zero client errors, zero operator calls, everything visible in
+    GET /fleet/health and over the HTTP drift route."""
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import Client
+
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "dgood")
+    job_id = _job_id(admin, uid, "dgood")
+    server = AdminServer(admin).start()
+    load = None
+    try:
+        assert admin.drift.running  # RAFIKI_DRIFT=1 started the loop
+        ev0 = REGISTRY.counter(
+            "rafiki_drift_events_total", "", ("job",)).value(job_id)
+        load = _Load(admin, uid, "dgood")
+
+        rid = _drive_to_drift_verdict(
+            admin, uid, "dgood", job_id, load, monkeypatch,
+            candidate_score="0.9")
+        cand = admin.db.get_best_trials_of_train_job(rid, max_count=1)[0]
+        assert cand["score"] == pytest.approx(0.9)
+
+        # the loop rolls the candidate out and returns to WATCHING
+        st = _tick_until(
+            admin, job_id,
+            lambda st: st and st["phase"] == DriftPhase.WATCHING)
+        load.stop()
+
+        assert not load.errors, load.errors[:5]
+        assert load.ok > 50
+        ro = admin.rollouts.status(job_id)
+        assert ro["phase"] == RolloutPhase.DONE
+        assert ro["to_trial_id"] == cand["id"]
+        live = admin.services.live_inference_workers(job_id)
+        assert live and all(w["trial_id"] == cand["id"] for w in live)
+
+        # exactly ONE retrain: the incumbent's job + the drift retrain
+        assert len(_train_jobs_of(admin, uid, "dgood")) == 2
+        assert REGISTRY.counter(
+            "rafiki_drift_events_total", "",
+            ("job",)).value(job_id) == ev0 + 1
+        assert REGISTRY.counter(
+            "rafiki_drift_retrains_total", "",
+            ("job",)).value(job_id) == 1
+        assert REGISTRY.counter(
+            "rafiki_drift_rollouts_total", "",
+            ("job",)).value(job_id) == 1
+
+        # the whole story is first-class events in fleet health
+        names = [e["event"]
+                 for e in admin.get_fleet_health()["drift"]["events"]]
+        for expected in ("baseline_frozen", "drift", "retrain_launched",
+                         "rollout_started", "rollout_done"):
+            assert expected in names, names
+        # the baseline refroze: the next cycle judges the NEW traffic
+        assert st["baseline"] is None or st["baseline"], st
+
+        # the HTTP drift route serves the same state
+        client = Client("127.0.0.1", server.port)
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        view = client.get_drift_status("dgood")
+        assert view["phase"] == DriftPhase.WATCHING
+        assert view["enabled"] is True
+        assert view["consecutive_rollbacks"] == 0
+    finally:
+        if load is not None:
+            load.stop()
+        server.stop()
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill, outcome (b): the adversarial twin
+# ---------------------------------------------------------------------------
+
+
+def test_adversarial_candidate_rolls_back_and_backs_off(tmp_workdir,
+                                                        monkeypatch):
+    """A candidate that trains BETTER but fails in serving (chaos-failed
+    canary placement) is rolled back by the SLO judge with zero client
+    errors, and the loop enters exponential-backoff cooldown with
+    provably no second retrain inside the window."""
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "dtwin")
+    job_id = _job_id(admin, uid, "dtwin")
+    load = None
+    try:
+        load = _Load(admin, uid, "dtwin")
+        rid = _drive_to_drift_verdict(
+            admin, uid, "dtwin", job_id, load, monkeypatch,
+            candidate_score="0.9")
+        cand = admin.db.get_best_trials_of_train_job(rid, max_count=1)[0]
+        # the candidate looks great offline — but its canary placement
+        # will fail in serving
+        chaos.install([chaos.ChaosRule(
+            site=chaos.SITE_DEPLOY, action=chaos.ACTION_ERROR,
+            match=cand["id"])])
+        st = _tick_until(
+            admin, job_id,
+            lambda st: st and st["phase"] == DriftPhase.COOLDOWN)
+        load.stop()
+        chaos.clear()
+
+        # the SLO judge rolled the candidate back; clients never noticed
+        assert not load.errors, load.errors[:5]
+        ro = admin.rollouts.status(job_id)
+        assert ro["phase"] == RolloutPhase.ROLLED_BACK
+        assert ro["operator_ack"] is True  # the loop acked its own
+        assert st["consecutive_rollbacks"] == 1
+        assert "rolled back" in st["reason"]
+        assert float(st["cooldown_until"]) > time.time()
+        live = admin.services.live_inference_workers(job_id)
+        assert live and all(w["trial_id"] != cand["id"] for w in live)
+        assert admin.predict(uid, "dtwin", [[0.0]])
+
+        # backoff, not a flap: more ticks launch NOTHING new inside the
+        # cooldown window
+        retrains = REGISTRY.counter(
+            "rafiki_drift_retrains_total", "", ("job",)).value(job_id)
+        assert retrains == 1
+        for _ in range(5):
+            admin.drift.tick()
+        assert REGISTRY.counter(
+            "rafiki_drift_retrains_total", "",
+            ("job",)).value(job_id) == retrains
+        assert len(_train_jobs_of(admin, uid, "dtwin")) == 2
+        assert admin.drift.status(job_id)["phase"] == DriftPhase.COOLDOWN
+        assert REGISTRY.counter(
+            "rafiki_drift_rollbacks_total", "",
+            ("job",)).value(job_id) == 1
+
+        # the rollback + cooldown are first-class fleet-health events
+        names = [e["event"]
+                 for e in admin.get_fleet_health()["drift"]["events"]]
+        assert "cooldown" in names
+        # an operator ack clears the flap streak
+        out = admin.ack_drift(uid, "dtwin")
+        assert out["consecutive_rollbacks"] == 0
+    finally:
+        chaos.clear()
+        if load is not None:
+            load.stop()
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# degradation contract: chaos at the monitor + launch chokepoints
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_monitor_tick_never_touches_serving(tmp_workdir,
+                                                  monkeypatch):
+    """RAFIKI_CHAOS site=drift at the tick chokepoint: the broken
+    monitor is absorbed per job — tick() survives, serving is untouched,
+    and the loop resumes the moment chaos clears."""
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "dchaos")
+    job_id = _job_id(admin, uid, "dchaos")
+    load = None
+    try:
+        chaos.install([chaos.ChaosRule(
+            site=chaos.SITE_DRIFT, action=chaos.ACTION_ERROR,
+            match=f"tick/{job_id}")])
+        load = _Load(admin, uid, "dchaos")
+        time.sleep(0.3)
+        for _ in range(5):
+            assert admin.drift.tick() == []  # absorbed, never raises
+        # the broken monitor made NO state transitions for the job
+        st = admin.drift.status(job_id)
+        assert st is None or st.get("baseline") is None
+        assert admin.predict(uid, "dchaos", [[0.0]])  # serving untouched
+
+        # a delay rule slows the tick without breaking it
+        chaos.install([chaos.ChaosRule(
+            site=chaos.SITE_DRIFT, action=chaos.ACTION_DELAY,
+            match=f"tick/{job_id}", delay_s=0.05)])
+        admin.drift.tick()
+
+        chaos.clear()
+        _tick_until(admin, job_id,
+                    lambda st: st and st.get("baseline") is not None)
+        load.stop()
+        assert not load.errors, load.errors[:5]
+    finally:
+        chaos.clear()
+        if load is not None:
+            load.stop()
+        admin.shutdown()
+
+
+def test_chaos_launch_failure_retries_bounded_then_parks(tmp_workdir,
+                                                         monkeypatch):
+    """RAFIKI_CHAOS site=drift at the launch chokepoint: the retrain
+    launch retries once per tick up to RAFIKI_DRIFT_LAUNCH_RETRY_MAX,
+    then the loop PARKs with a typed event — no half-launched retrains,
+    and POST .../drift/ack re-arms."""
+    from rafiki_tpu.admin.http import AdminServer
+    from rafiki_tpu.client.client import Client
+
+    admin, uid = _deploy(
+        tmp_workdir, monkeypatch, "dpark",
+        env={"RAFIKI_DRIFT_LAUNCH_RETRY_MAX": "1"})
+    job_id = _job_id(admin, uid, "dpark")
+    server = AdminServer(admin).start()
+    load = None
+    try:
+        chaos.install([chaos.ChaosRule(
+            site=chaos.SITE_DRIFT, action=chaos.ACTION_ERROR,
+            match=f"launch/{job_id}")])
+        load = _Load(admin, uid, "dpark")
+        _tick_until(admin, job_id,
+                    lambda st: st and st.get("baseline") is not None)
+        load.shift()
+        time.sleep(float(config.DRIFT_WINDOW_S) + 0.5)
+        # attempt 1 fails -> retry event; attempt 2 (> max 1) -> PARKED
+        st = _tick_until(
+            admin, job_id,
+            lambda st: st and st["phase"] == DriftPhase.PARKED)
+        load.stop()
+        chaos.clear()
+
+        assert not load.errors, load.errors[:5]
+        assert "bounded" in st["reason"]
+        names = [e["event"] for e in st["events"]]
+        assert "retrain_launch_retry" in names and "parked" in names
+        # NOTHING was launched: the incumbent's job is still the only one
+        assert len(_train_jobs_of(admin, uid, "dpark")) == 1
+        assert REGISTRY.counter(
+            "rafiki_drift_parked_total", "", ("job",)).value(job_id) == 1
+        # parked is sticky: more ticks do nothing
+        for _ in range(3):
+            admin.drift.tick()
+        assert admin.drift.status(job_id)["phase"] == DriftPhase.PARKED
+
+        # the operator ack re-arms the loop over the real HTTP door
+        client = Client("127.0.0.1", server.port)
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        view = client.get_drift_status("dpark")
+        assert view["phase"] == DriftPhase.PARKED
+        acked = client.ack_drift("dpark")
+        assert acked["phase"] == DriftPhase.WATCHING
+        assert acked["operator_ack"] is True
+        # nothing left to acknowledge -> typed 400
+        with pytest.raises(Exception) as ei:
+            client.ack_drift("dpark")
+        assert getattr(ei.value, "status", 400) == 400
+    finally:
+        chaos.clear()
+        if load is not None:
+            load.stop()
+        server.stop()
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# policy corners: monitor-only mode, worse candidate
+# ---------------------------------------------------------------------------
+
+
+def test_budget_zero_is_monitor_only(tmp_workdir, monkeypatch):
+    """RAFIKI_DRIFT_RETRAIN_BUDGET=0: drift events still fire, but the
+    training plane is never touched and the loop cools down."""
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "dmon",
+                         env={"RAFIKI_DRIFT_RETRAIN_BUDGET": "0"})
+    job_id = _job_id(admin, uid, "dmon")
+    load = None
+    try:
+        load = _Load(admin, uid, "dmon")
+        _tick_until(admin, job_id,
+                    lambda st: st and st.get("baseline") is not None)
+        load.shift()
+        time.sleep(float(config.DRIFT_WINDOW_S) + 0.5)
+        st = _tick_until(
+            admin, job_id,
+            lambda st: st and st["phase"] == DriftPhase.COOLDOWN)
+        load.stop()
+        assert "monitor-only" in st["reason"]
+        assert len(_train_jobs_of(admin, uid, "dmon")) == 1
+        assert REGISTRY.counter(
+            "rafiki_drift_events_total", "",
+            ("job",)).value(job_id) >= 1
+    finally:
+        if load is not None:
+            load.stop()
+        admin.shutdown()
+
+
+def test_worse_candidate_never_starts_a_rollout(tmp_workdir, monkeypatch):
+    """A retrain whose best trial scores no better than the incumbent
+    costs the serving plane NOTHING: no rollout starts, the incumbents
+    keep serving, the loop backs off."""
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "dworse")
+    job_id = _job_id(admin, uid, "dworse")
+    load = None
+    try:
+        load = _Load(admin, uid, "dworse")
+        _drive_to_drift_verdict(
+            admin, uid, "dworse", job_id, load, monkeypatch,
+            candidate_score="0.1")  # retrain trains WORSE
+        st = _tick_until(
+            admin, job_id,
+            lambda st: st and st["phase"] == DriftPhase.COOLDOWN)
+        load.stop()
+        assert not load.errors, load.errors[:5]
+        assert "keeping the incumbent" in st["reason"]
+        assert admin.rollouts.status(job_id) is None  # no rollout AT ALL
+        assert REGISTRY.counter(
+            "rafiki_drift_rollouts_total", "",
+            ("job",)).value(job_id) == 0
+        assert admin.predict(uid, "dworse", [[0.0]])
+    finally:
+        if load is not None:
+            load.stop()
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# signal units: confidence decay, skew, verdict thresholds
+# ---------------------------------------------------------------------------
+
+
+def _samples(digests, confs=None, ts=None):
+    now = time.time()
+    confs = confs or [None] * len(digests)
+    return [((ts or now), d, c) for d, c in zip(digests, confs)]
+
+
+def test_signal_math_novelty_conf_skew():
+    base = DriftController._freeze_baseline(
+        _samples(["a", "a", "a", "b"], [0.9, 0.9, 0.8, 0.8]))
+    assert sorted(base["digests"]) == ["a", "b"]
+    assert base["mean_conf"] == pytest.approx(0.85)
+    assert base["top_share"] == pytest.approx(0.75)
+
+    # same mix: every signal quiet
+    sig = DriftController._signals(
+        base, _samples(["a", "a", "a", "b"], [0.9, 0.9, 0.8, 0.8]))
+    assert sig["novelty"] == 0.0
+    assert sig["conf_drop"] == pytest.approx(0.0)
+    assert sig["skew"] == pytest.approx(0.0)
+
+    # novel digests: input-distribution shift
+    sig = DriftController._signals(base, _samples(["x", "y", "a", "z"]))
+    assert sig["novelty"] == pytest.approx(0.75)
+
+    # decayed confidence on the SAME inputs
+    sig = DriftController._signals(
+        base, _samples(["a", "a", "b", "b"], [0.5, 0.5, 0.6, 0.6]))
+    assert sig["conf_drop"] == pytest.approx(0.3)
+
+    # one digest takes over the door
+    sig = DriftController._signals(base, _samples(["a"] * 10))
+    assert sig["skew"] == pytest.approx(0.25)
+
+
+def test_verdict_reasons_follow_thresholds(monkeypatch):
+    monkeypatch.setenv("RAFIKI_DRIFT_THRESHOLD", "0.5")
+    monkeypatch.setenv("RAFIKI_DRIFT_CONF_DROP", "0.2")
+    monkeypatch.setenv("RAFIKI_DRIFT_SKEW_DELTA", "0.4")
+    quiet = {"novelty": 0.1, "conf_drop": 0.0, "skew": 0.0}
+    assert DriftController._verdict(quiet) is None
+    assert "distribution" in DriftController._verdict(
+        {**quiet, "novelty": 0.6})
+    assert "confidence" in DriftController._verdict(
+        {**quiet, "conf_drop": 0.25})
+    assert "skew" in DriftController._verdict({**quiet, "skew": 0.5})
+
+
+def test_drift_status_requires_recorded_state(tmp_workdir, monkeypatch):
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "dnone")
+    try:
+        with pytest.raises(InvalidRequestError):
+            admin.get_drift_status(uid, "dnone")  # nothing recorded yet
+        with pytest.raises(InvalidRequestError):
+            admin.ack_drift(uid, "dnone")
+    finally:
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# doctor: misconfiguration + parked/flapping loops
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_drift_check(tmp_workdir, monkeypatch):
+    from rafiki_tpu import doctor
+    from rafiki_tpu.db.database import Database
+
+    db = Database(str(tmp_workdir / "rafiki.sqlite3"))
+    monkeypatch.setenv("RAFIKI_DB_PATH",
+                       str(tmp_workdir / "rafiki.sqlite3"))
+    try:
+        name, status, detail = doctor.check_drift()
+        assert status == doctor.PASS, detail
+        assert "disabled" in detail
+
+        monkeypatch.setenv("RAFIKI_DRIFT", "1")
+        name, status, detail = doctor.check_drift()
+        assert status == doctor.PASS, detail
+
+        # a dead-end budget is a WARN, not a silent no-op loop
+        monkeypatch.setenv("RAFIKI_DRIFT_RETRAIN_BUDGET", "0")
+        name, status, detail = doctor.check_drift()
+        assert status == doctor.WARN and "monitor-only" in detail
+        monkeypatch.delenv("RAFIKI_DRIFT_RETRAIN_BUDGET")
+
+        # a baseline window shorter than the monitor window cannot work
+        monkeypatch.setenv("RAFIKI_DRIFT_BASELINE_WINDOW_S", "1")
+        monkeypatch.setenv("RAFIKI_DRIFT_WINDOW_S", "10")
+        name, status, detail = doctor.check_drift()
+        assert status == doctor.WARN and "BASELINE" in detail
+        monkeypatch.delenv("RAFIKI_DRIFT_BASELINE_WINDOW_S")
+        monkeypatch.delenv("RAFIKI_DRIFT_WINDOW_S")
+
+        # a parked loop WARNs until acked; a flapping loop suggests a
+        # longer cooldown
+        u = db.create_user("d@x", "h", "ADMIN")
+        tj = db.create_train_job(u["id"], "dapp", 1, "T", "u", "u", {})
+        ij = db.create_inference_job(u["id"], tj["id"])
+        db.create_drift_state(ij["id"], DriftPhase.PARKED)
+        db.update_drift_state(ij["id"], reason="launch failed 2x")
+        name, status, detail = doctor.check_drift()
+        assert status == doctor.WARN and "PARKED" in detail
+        db.update_drift_state(ij["id"], phase=DriftPhase.COOLDOWN,
+                              consecutive_rollbacks=2)
+        name, status, detail = doctor.check_drift()
+        assert status == doctor.WARN
+        assert "RAFIKI_DRIFT_COOLDOWN_S" in detail
+        db.update_drift_state(ij["id"], consecutive_rollbacks=0)
+        name, status, detail = doctor.check_drift()
+        assert status == doctor.PASS, detail
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# stress: multiple full cycles back to back
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_drift_loop_survives_consecutive_cycles(tmp_workdir, monkeypatch):
+    """Two full drift->retrain->rollout cycles on one job: the baseline
+    refreezes on the new model's traffic after each DONE, each cycle
+    launches exactly one retrain, and each candidate ends up serving on
+    every replica."""
+    admin, uid = _deploy(tmp_workdir, monkeypatch, "dcycle",
+                         env={"RAFIKI_DRIFT_COOLDOWN_S": "1"})
+    job_id = _job_id(admin, uid, "dcycle")
+    load = None
+    try:
+        load = _Load(admin, uid, "dcycle")
+        scores = ["0.7", "0.9"]
+        for cycle, score in enumerate(scores, start=1):
+            rid = _drive_to_drift_verdict(
+                admin, uid, "dcycle", job_id, load, monkeypatch,
+                candidate_score=score)
+            cand = admin.db.get_best_trials_of_train_job(
+                rid, max_count=1)[0]
+            _tick_until(
+                admin, job_id,
+                lambda st: st and st["phase"] == DriftPhase.WATCHING,
+                timeout_s=90)
+            live = admin.services.live_inference_workers(job_id)
+            assert all(w["trial_id"] == cand["id"] for w in live)
+            assert len(_train_jobs_of(admin, uid, "dcycle")) == 1 + cycle
+            assert REGISTRY.counter(
+                "rafiki_drift_rollouts_total", "",
+                ("job",)).value(job_id) == cycle
+        load.stop()
+        assert not load.errors, load.errors[:5]
+    finally:
+        if load is not None:
+            load.stop()
+        admin.shutdown()

@@ -834,3 +834,282 @@ def test_process_manager_adopts_verified_pid_and_fences_on_stop(tmp_path):
         if child.poll() is None:
             child.kill()
         db.close()
+
+
+# ---------------------------------------------------------------------------
+# chip-loan rebuild: the arbiter's loan book survives the restart
+# ---------------------------------------------------------------------------
+
+
+def test_restart_rebuilds_chip_loans_for_adopted_borrowed_replicas(
+        tmp_workdir, monkeypatch):
+    """A borrowed serving replica (scale-up past the training floor)
+    adopted by a successor admin re-enters the ChipBudgetArbiter's loan
+    book from its durable worker-row marker: the fleet-health loan
+    picture is intact and a training reclaim drains exactly that replica
+    — before this, the loan silently leaked until the replica stopped."""
+    monkeypatch.setenv("RAFIKI_AUTOSCALE_TRAIN_FLOOR", "1")
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    # 6 chips: the initial fleet (2 trials x 2 replicas) holds 4, one is
+    # free above the training floor of 1 — exactly one borrowable chip
+    e1, s1, a1 = _spawn_host(db, [0, 1, 2])
+    e2, s2, a2 = _spawn_host(db, [3, 4, 5])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([a1, a2], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        _seed_app(admin1, uid, "loans")
+        admin1.create_inference_job(uid, "loans")
+        inf = db.get_inference_jobs_by_statuses(["RUNNING"])[0]
+        job_id = inf["id"]
+
+        report = admin1.services.scale_inference_job(job_id, 1)
+        assert report["borrowed_chips"] == 1, report
+        sid = report["added"][0]
+        # the loan's durable twin is on the worker row the moment the
+        # borrow commits — not on shutdown
+        row = next(w for w in db.get_workers_of_inference_job(job_id)
+                   if w["service_id"] == sid)
+        assert row["borrowed_chips"] == 1
+        assert admin1.chip_arbiter.borrowed()[sid] == (job_id, 1)
+
+        _crash(admin1)
+
+        admin2 = Admin(db=db, placement=_placement([a1, a2], db),
+                       params_dir=str(tmp_workdir / "params"))
+        report2 = _wait_ready(admin2)
+        assert report2["errored"] == 0
+        # the successor's loan book was rebuilt from the adopted rows
+        assert admin2.chip_arbiter.borrowed()[sid] == (job_id, 1)
+        assert admin2.chip_arbiter.borrowed_chips() == 1
+
+        # training priority still works after the restart: a reclaim
+        # drains exactly the borrowed replica and clears its marker
+        freed = admin2.chip_arbiter.reclaim_for_training(1)
+        assert freed == 1
+        assert admin2.chip_arbiter.borrowed_chips() == 0
+        assert _wait_for(
+            lambda: next(
+                (w for w in db.get_workers_of_inference_job(job_id)
+                 if w["service_id"] == sid), {}
+            ).get("borrowed_chips") == 0)
+        live = {w["service_id"]
+                for w in admin2.services.live_inference_workers(job_id)}
+        assert sid not in live
+        # the job still serves on the un-borrowed replicas
+        assert admin2.predict(uid, "loans", [[1.0]])
+        admin2.stop_all_jobs()
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        for srv in (s1, s2):
+            srv.stop()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# drift mid-loop crash drills (admin/drift.py recover_on_boot)
+# ---------------------------------------------------------------------------
+
+DRIFT_FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "drift_model.py")
+
+
+def _drift_env(monkeypatch, extra=None):
+    env = {
+        "RAFIKI_DRIFT": "1",
+        "RAFIKI_DRIFT_INTERVAL_S": "3600",  # ticks driven by the test
+        "RAFIKI_DRIFT_WINDOW_S": "2.0",
+        "RAFIKI_DRIFT_BASELINE_WINDOW_S": "2.0",
+        "RAFIKI_DRIFT_MIN_SAMPLES": "8",
+        "RAFIKI_DRIFT_THRESHOLD": "0.5",
+        "RAFIKI_DRIFT_RETRAIN_BUDGET": "2",
+        "RAFIKI_DRIFT_COOLDOWN_S": "60",
+        "RAFIKI_ROLLOUT_JUDGE_WINDOW_S": "1.0",
+        "RAFIKI_ROLLOUT_MIN_REQUESTS": "3",
+        "DRIFT_FIXTURE_SCORE": "0.5",
+    }
+    env.update(extra or {})
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+
+
+def _seed_drift_app(admin, uid, app):
+    with open(DRIFT_FIXTURE, "rb") as f:
+        admin.create_model(uid, "driftm", "IMAGE_CLASSIFICATION",
+                           f.read(), "DriftModel")
+    admin.create_train_job(
+        uid, app, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 0})
+    job = admin.wait_until_train_job_stopped(uid, app, timeout_s=60)
+    assert job["status"] == "STOPPED", job
+    admin.create_inference_job(uid, app)
+    return admin.db.get_running_inference_job_of_train_job(job["id"])["id"]
+
+
+def _drive_drift_to_retraining(admin, uid, app, job_id, monkeypatch):
+    """Freeze a baseline on constant traffic, shift the distribution,
+    tick to the drift verdict. Leaves the loop in RETRAINING (the launch
+    outcome depends on any installed chaos rule)."""
+    from rafiki_tpu.constants import DriftPhase
+
+    deadline = time.monotonic() + 30
+    st = None
+    while time.monotonic() < deadline:
+        for _ in range(4):
+            admin.predict(uid, app, [[0.0]])
+        admin.drift.tick()
+        st = admin.drift.status(job_id)
+        if st and st.get("baseline"):
+            break
+        time.sleep(0.05)
+    assert st and st.get("baseline"), f"baseline never froze: {st}"
+    # new trials train better from here on
+    monkeypatch.setenv("DRIFT_FIXTURE_SCORE", "0.9")
+    time.sleep(float(config.DRIFT_WINDOW_S) + 0.2)  # age out the old mix
+    for i in range(1, 13):  # an all-novel window: novelty 100%
+        admin.predict(uid, app, [[float(i) + 0.5]])
+    admin.drift.tick()
+    st = admin.drift.status(job_id)
+    assert st["phase"] == DriftPhase.RETRAINING, st
+    return st
+
+
+def test_restart_resumes_drift_retrain_without_double_launch(
+        tmp_workdir, monkeypatch):
+    """SIGKILL-the-admin between the drift verdict (retrain launched and
+    persisted) and the rollout-starting tick: the successor adopts the
+    fleet, resumes the SAME retrain from the persisted id — provably no
+    second launch — and carries the candidate through the SLO-guarded
+    rollout to DONE."""
+    from rafiki_tpu.constants import DriftPhase, RolloutPhase
+
+    _drift_env(monkeypatch)
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        job_id = _seed_drift_app(admin1, uid, "dresume")
+        st = _drive_drift_to_retraining(admin1, uid, "dresume", job_id,
+                                        monkeypatch)
+        rid = st["retrain_job_id"]
+        assert rid  # launched and persisted before the crash
+        retrain = admin1.wait_until_train_job_stopped(uid, "dresume",
+                                                      timeout_s=60)
+        assert retrain["id"] == rid and retrain["status"] == "STOPPED"
+
+        # crash BEFORE the tick that would start the rollout
+        _crash(admin1)
+
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        _wait_ready(admin2)
+        st2 = admin2.drift.status(job_id)
+        assert st2["phase"] == DriftPhase.RETRAINING
+        assert st2["retrain_job_id"] == rid  # the idempotency key held
+        assert "resumed" in [e["event"] for e in st2["events"]]
+
+        # the successor's ticks carry the candidate out under load
+        stop = threading.Event()
+        errors = []
+
+        def pump():
+            n = 100
+            while not stop.is_set():
+                try:
+                    admin2.predict(uid, "dresume", [[float(n)]])
+                    n += 1
+                except Exception as e:  # every error is a drill failure
+                    errors.append(repr(e))
+                time.sleep(0.01)
+
+        pumps = [threading.Thread(target=pump) for _ in range(2)]
+        for t in pumps:
+            t.start()
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                admin2.drift.tick()
+                st2 = admin2.drift.status(job_id)
+                if st2["phase"] == DriftPhase.WATCHING:
+                    break
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in pumps:
+                t.join(timeout=30)
+        assert st2["phase"] == DriftPhase.WATCHING, st2
+        assert not errors, errors[:5]
+        assert admin2.rollouts.status(job_id)["phase"] == RolloutPhase.DONE
+
+        # provably no double launch: the incumbent's job + ONE retrain
+        assert len(db.get_train_jobs_of_app(uid, "dresume")) == 2
+        admin2.stop_all_jobs()
+    finally:
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
+
+
+def test_restart_parks_write_ahead_retrain_intent(tmp_workdir, monkeypatch):
+    """The adversarial timing: the admin dies INSIDE the retrain launch —
+    the write-ahead RETRAINING intent is persisted but no retrain id is.
+    The successor finds no train job matching the intent and PARKS the
+    loop instead of relaunching (the one choice that can never double
+    launch); an operator ack re-arms it."""
+    from rafiki_tpu.constants import DriftPhase
+
+    _drift_env(monkeypatch,
+               extra={"RAFIKI_DRIFT_LAUNCH_RETRY_MAX": "5"})
+    db = Database(str(tmp_workdir / "meta.sqlite3"))
+    engine, server, addr = _spawn_host(db, [0, 1])
+    admin2 = None
+    try:
+        admin1 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        uid = admin1.authenticate_user(
+            config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+        job_id = _seed_drift_app(admin1, uid, "dpark2")
+        # the launch chokepoint fails (stands in for dying mid-create):
+        # the verdict tick leaves a persisted RETRAINING row with a NULL
+        # retrain id — exactly what a crash inside the launch leaves
+        chaos.install([chaos.ChaosRule(
+            site=chaos.SITE_DRIFT, action=chaos.ACTION_ERROR,
+            match=f"launch/{job_id}")])
+        st = _drive_drift_to_retraining(admin1, uid, "dpark2", job_id,
+                                        monkeypatch)
+        assert st["retrain_job_id"] is None  # write-ahead intent only
+        assert len(db.get_train_jobs_of_app(uid, "dpark2")) == 1
+
+        _crash(admin1)
+        chaos.clear()
+
+        admin2 = Admin(db=db, placement=_placement([addr], db),
+                       params_dir=str(tmp_workdir / "params"))
+        _wait_ready(admin2)
+        st2 = admin2.drift.status(job_id)
+        assert st2["phase"] == DriftPhase.PARKED, st2
+        assert "double launch" in st2["reason"]
+        # parked is sticky: no tick ever launches from a parked loop
+        for _ in range(3):
+            admin2.drift.tick()
+        assert len(db.get_train_jobs_of_app(uid, "dpark2")) == 1
+        assert admin2.drift.status(job_id)["phase"] == DriftPhase.PARKED
+        # the operator ack re-arms the loop
+        acked = admin2.ack_drift(uid, "dpark2")
+        assert acked["phase"] == DriftPhase.WATCHING
+        assert acked["operator_ack"] is True
+        admin2.stop_all_jobs()
+    finally:
+        chaos.clear()
+        if admin2 is not None:
+            admin2.shutdown()
+        server.stop()
+        db.close()
