@@ -1408,6 +1408,137 @@ def _bench_trials_vectorized(admin, uid, train_uri, test_uri) -> dict:
     return out
 
 
+def bench_cold_vs_warm_compile() -> dict:
+    """Cold vs warm boot through the persistent XLA compile cache
+    (sdk/compile_cache.py + worker/warmup.py): the same jitted
+    model-shaped program warmed twice against one fresh cache dir — the
+    first boot compiles from scratch (cold), then ``jax.clear_caches()``
+    wipes the in-memory executables (exactly what a replacement
+    replica's fresh interpreter starts with) and the second boot must
+    answer from the on-disk cache. Acceptance: warm <= 0.5x cold."""
+    import shutil
+
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.sdk import compile_cache
+    from rafiki_tpu.worker import warmup
+
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             f"rafiki_bench_coldstart_{os.getpid()}")
+    shutil.rmtree(cache_dir, ignore_errors=True)
+    saved = {k: os.environ.get(k) for k in (
+        "RAFIKI_COMPILE_CACHE", "RAFIKI_COMPILE_CACHE_CPU",
+        "RAFIKI_COMPILE_CACHE_MIN_COMPILE_S")}
+    os.environ["RAFIKI_COMPILE_CACHE"] = "1"
+    # CPU cache entries are machine-feature-tied (gated off by default);
+    # this phase only ever compares the box against itself
+    os.environ["RAFIKI_COMPILE_CACHE_CPU"] = "1"
+    os.environ["RAFIKI_COMPILE_CACHE_MIN_COMPILE_S"] = "0"
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(256, 256)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(64, 256)).astype(np.float32))
+
+    def _boot(service_id: str) -> dict:
+        # fresh jit wrapper per boot (same HLO -> same cache key);
+        # unrolled enough that compile time dominates the one execution
+        @jax.jit
+        def prog(v):
+            h = v
+            for _ in range(24):
+                h = jnp.tanh(h @ w) + jnp.cos(h)
+            return h.sum()
+
+        warmup.run_warmup(service_id, "bench", [
+            ("prog", lambda: prog(x).block_until_ready())])
+        return warmup.warmup_stats(service_id)
+
+    try:
+        compile_cache.reset_for_tests()
+        warmup.reset_for_tests()
+        compile_cache.enable(cache_dir)
+        cold = _boot("bench-cold-boot")
+        jax.clear_caches()
+        compile_cache.reset_for_tests()
+        warmup.reset_for_tests()
+        compile_cache.enable(cache_dir)
+        warm = _boot("bench-warm-boot")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        # later phases keep compiling: point jax back at the run-wide
+        # cache dir before the throwaway one is deleted
+        compile_cache.reset_for_tests()
+        warmup.reset_for_tests()
+        compile_cache.enable()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    out = {
+        "coldstart_cold_boot_s": round(cold["compile_s"], 3),
+        "coldstart_warm_boot_s": round(warm["compile_s"], 3),
+        "coldstart_warm_cache_hits": warm["cache_hits"],
+        "coldstart_warm_flag": bool(warm["warm"]),
+    }
+    if cold["compile_s"] > 0:
+        out["coldstart_warm_over_cold"] = round(
+            warm["compile_s"] / cold["compile_s"], 3)
+    return out
+
+
+def bench_warm_pool_scaleup(admin, uid, server_port: int, query) -> dict:
+    """Scale-up decision -> routable replica: full deploy vs warm-pool
+    promotion (admin/warm_pool.py). The same ``scale_inference_job``
+    decision is timed twice — once with an empty pool (placement +
+    deploy wait) and once with a pre-placed warm standby (standby-flag
+    flip + ``add_worker`` route) — with one authenticated predict after
+    each confirming the fleet still serves. Acceptance: promotion <=
+    0.1x deploy."""
+    from rafiki_tpu import config
+    from rafiki_tpu.client.client import Client
+
+    _wait_chips_free(admin)
+    admin.create_inference_job(uid, "benchapp")
+    out: dict = {}
+    errors = 0
+    try:
+        job = admin.db.get_train_job_by_app_version(uid, "benchapp", -1)
+        inf = admin.db.get_running_inference_job_of_train_job(job["id"])
+        c = Client(admin_host="127.0.0.1", admin_port=server_port)
+        c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        c.predict("benchapp", [query])  # connection + route warm
+        t0 = time.monotonic()
+        admin.scale_inference_job(uid, "benchapp", delta=1)
+        deploy_s = time.monotonic() - t0
+        try:
+            c.predict("benchapp", [query])
+        except Exception:
+            errors += 1
+        t0 = time.monotonic()
+        admin.services.create_standby_replica(inf["id"])
+        standby_place_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        admin.scale_inference_job(uid, "benchapp", delta=1)
+        promote_s = time.monotonic() - t0
+        try:
+            c.predict("benchapp", [query])
+        except Exception:
+            errors += 1
+        out = {
+            "coldstart_scaleup_deploy_s": round(deploy_s, 3),
+            "coldstart_scaleup_promote_s": round(promote_s, 4),
+            "coldstart_standby_place_s": round(standby_place_s, 3),
+            "coldstart_scaleup_errors": errors,
+        }
+        if deploy_s > 0:
+            out["coldstart_promote_over_deploy"] = round(
+                promote_s / deploy_s, 4)
+    finally:
+        admin.stop_inference_job(uid, "benchapp")
+    return out
+
+
 def _wait_chips_free(admin, timeout_s: float = 30.0) -> None:
     """Service teardown releases chip grants asynchronously (worker threads
     exit with destroy wait=False); a phase that needs exclusive chips must
@@ -1518,6 +1649,12 @@ def main():
     result = {}
     with tempfile.TemporaryDirectory() as d:
         os.environ.setdefault("RAFIKI_WORKDIR", d)
+        # the bench's own templates keep knobs env-tunable (so the CPU
+        # fallback can shrink the model), which the template verifier's
+        # TPL002 literal-evaluability rule rejects under the default
+        # `enforce` — these are first-party trusted uploads, so the
+        # bench admin runs at `warn` (an explicit operator setting wins)
+        os.environ.setdefault("RAFIKI_VERIFY_TEMPLATES", "warn")
         (xtr, ytr), (xte, yte) = synthetic_cifar(N_TRAIN, N_TEST)
         x = xtr.astype(np.float32) / 255.0
         train_uri = write_numpy_dataset(
@@ -1725,6 +1862,24 @@ def main():
                     serving.update(bench_serving_cached())
                 except Exception as e:
                     serving["serving_cached_error"] = repr(e)
+            # ---- cold-start resilience: compile cache + warm pool ------
+            # (sdk/compile_cache.py, admin/warm_pool.py): cold vs warm
+            # boot through the persistent XLA cache, then the same
+            # scale-up decision timed as a full deploy vs a warm-standby
+            # promotion. Acceptance: warm boot <= 0.5x cold, promotion
+            # <= 0.1x deploy.
+            if os.environ.get("RAFIKI_BENCH_COLDSTART", "1") not in (
+                    "0", "false"):
+                try:
+                    serving.update(bench_cold_vs_warm_compile())
+                except Exception as e:
+                    serving["coldstart_compile_error"] = repr(e)
+                if BENCH_SERVING:
+                    try:
+                        serving.update(bench_warm_pool_scaleup(
+                            admin, uid, server.port, query))
+                    except Exception as e:
+                        serving["coldstart_scaleup_error"] = repr(e)
             # ---- generative serving: N streaming clients, one worker ---
             # (PR 10's own phase: TTFT percentiles, aggregate tokens/s,
             # slot utilization over the continuous-batching scheduler;

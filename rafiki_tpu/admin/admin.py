@@ -194,6 +194,17 @@ class Admin:
         self.autoscaler = Autoscaler(self)
         if config.AUTOSCALE:
             self.autoscaler.start()
+        # warm standby pool (admin/warm_pool.py): K pre-loaded,
+        # pre-warmed standby replicas per hot job, so scale-up and
+        # failed-replica replacement become an add_worker route instead
+        # of a deploy. Always constructed (fleet health carries its
+        # section); the maintenance thread only runs when
+        # RAFIKI_AUTOSCALE_WARM_POOL > 0.
+        from rafiki_tpu.admin.warm_pool import WarmPool
+
+        self.warm_pool = WarmPool(self)
+        if int(config.AUTOSCALE_WARM_POOL) > 0:
+            self.warm_pool.start()
         # safe live rollouts (admin/rollout.py): canary -> rolling ->
         # done with automatic rollback, updating a RUNNING inference job
         # to a new trial in place. Constructed before recovery so the
@@ -1328,6 +1339,14 @@ class Admin:
         with self._predict_route_lock:
             for sid, s in self._remote_serving_stats.items():
                 workers.setdefault(sid, {}).update(s)
+        # per-replica warm state (worker/warmup.py): cold/warm verdict +
+        # last-boot compile seconds. Local workers' reports are read
+        # directly; process/hosts workers relay the same fields on their
+        # stats rows (merged above).
+        from rafiki_tpu.worker.warmup import stats_row_fields, warmup_stats
+
+        for sid in list(warmup_stats()):
+            workers.setdefault(sid, {}).update(stats_row_fields(sid))
         # generative serving picture, aggregated per job (the workers'
         # rows carry their job id): the paged-KV pool footprint and the
         # per-tenant prefix-cache hit rates the shared-prefix lever is
@@ -1387,6 +1406,9 @@ class Admin:
             # loop state, chip-loan picture, recent scale decisions with
             # their reason + signal snapshot
             "autoscaler": self.autoscaler.report(),
+            # warm standby pool (admin/warm_pool.py): per-job standby
+            # counts, degraded pools, loan split, recent pool events
+            "warm_pool": self.warm_pool.report(),
             # safe live rollouts (admin/rollout.py): in-flight rollouts
             # with the judge's live per-lane signals, plus recent events
             # (rollback reasons + the signal snapshots they fired on)
@@ -1570,6 +1592,20 @@ class Admin:
         if worker is None and status in ("STOPPED", "ERRORED"):
             iworker = self.db.get_inference_job_worker(service_id)
             if iworker is not None:
+                if status == "ERRORED":
+                    # zero-deploy replacement: a dead ROUTABLE replica is
+                    # replaced from the warm standby pool immediately (an
+                    # add_worker route); the pool's next tick replenishes
+                    pool = getattr(self, "warm_pool", None)
+                    if pool is not None:
+                        try:
+                            pool.on_replica_errored(
+                                service_id, iworker["inference_job_id"])
+                        # lint: absorb(replacement is a fast-path optimization: the job-status refresh below still runs either way)
+                        except Exception:
+                            logger.exception(
+                                "warm-pool replacement for %s failed",
+                                service_id[:8])
                 final = self.services.refresh_inference_job_status(
                     iworker["inference_job_id"])
                 if final is not None:
@@ -1580,6 +1616,10 @@ class Admin:
         # — a tick racing the teardown would re-place replicas
         if getattr(self, "autoscaler", None) is not None:
             self.autoscaler.stop()
+        # the warm pool likewise: a top-up racing the teardown would
+        # place standbys nothing will ever stop
+        if getattr(self, "warm_pool", None) is not None:
+            self.warm_pool.stop()
         # the drift loop must stop deciding before the rollout
         # controller it drives — a tick racing the teardown could start
         # a rollout nothing will ever judge

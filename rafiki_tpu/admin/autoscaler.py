@@ -339,6 +339,11 @@ class Autoscaler:
         # attempt is visible as the event's result.error, not a count
         acted = bool(report.get("added") or report.get("removed"))
         if acted:
+            # a scale-up served from the warm standby pool is a routing
+            # flip, not a deploy — name it so operators reading the event
+            # stream can tell elasticity-by-promotion from cold placement
+            if report.get("promoted"):
+                reason += " (served by warm-pool promotion)"
             if action == "scale_up":
                 self._m_up.labels(job_id).inc()
             else:

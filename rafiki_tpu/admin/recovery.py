@@ -481,6 +481,12 @@ class ControlPlaneRecovery:
             return
         try:
             arbiter.note_borrow(row["id"], extra["inference_job_id"], n)
+            # re-tag warm-standby loans (durable `standby` column): the
+            # successor's reclaim ordering must keep draining standbys
+            # FIRST, exactly like the admin that placed them would
+            worker = self.db.get_inference_job_worker(row["id"])
+            if worker is not None and int(worker.get("standby") or 0):
+                arbiter.mark_standby(row["id"], True)
             logger.info("re-adopted a %d-chip serving loan on replica %s",
                         n, row["id"][:8])
         # lint: absorb(the loan book is advisory accounting: a rebuild failure must not fail the adoption itself)

@@ -540,8 +540,12 @@ class ServicesManager:
         fused = bool(budget.get(BudgetType.ENSEMBLE_FUSED, 0))
         group = f"fused:{inference_job_id}" if fused else None
         workers = self._db.get_workers_of_inference_job(inference_job_id)
+        # standbys adopt like any replica (their processes were re-owned
+        # or fenced by the recovery pass) but stay OUT of the routable
+        # set: promotion, not adoption, is what makes a standby serve
         worker_trials = {
             w["service_id"]: (group or w["trial_id"]) for w in workers
+            if not int(w.get("standby") or 0)
         }
         # recovery adoption invalidates the job's prediction cache: the
         # adopted fleet may differ from what the dead admin last served
@@ -642,7 +646,8 @@ class ServicesManager:
         """The job's live serving replicas: worker rows whose service is
         non-terminal, annotated with the predictor's replica-group key
         (trial id, or the fused group). Drain-in-progress replicas are
-        excluded — they no longer take traffic."""
+        excluded — they no longer take traffic — and so are warm
+        standbys, which never took any (admin/warm_pool.py)."""
         inf = self._db.get_inference_job(inference_job_id)
         fused = bool(((inf or {}).get("budget") or {}).get(
             BudgetType.ENSEMBLE_FUSED, 0))
@@ -660,7 +665,7 @@ class ServicesManager:
                 ServiceStatus.RUNNING])}
         out: List[Dict] = []
         for w in self._db.get_workers_of_inference_job(inference_job_id):
-            if w["service_id"] in draining:
+            if w["service_id"] in draining or int(w.get("standby") or 0):
                 continue
             svc = alive.get(w["service_id"])
             if svc is not None:
@@ -697,7 +702,7 @@ class ServicesManager:
         if predictor is None:
             raise ServiceDeploymentError(
                 f"inference job {inference_job_id} has no live predictor")
-        report: Dict[str, Any] = {"added": [], "removed": [],
+        report: Dict[str, Any] = {"added": [], "removed": [], "promoted": [],
                                   "borrowed_chips": 0, "returned_chips": 0}
         if delta > 0:
             for _ in range(delta):
@@ -705,7 +710,7 @@ class ServicesManager:
                 # failure must not erase the record of replicas (and chip
                 # loans) that DID land
                 try:
-                    sid, borrowed = self._scale_up_one(
+                    sid, borrowed, promoted = self._scale_up_one(
                         inference_job_id, inf, predictor, borrow)
                 except Exception as e:
                     if not report["added"]:
@@ -716,6 +721,8 @@ class ServicesManager:
                     report["error"] = str(e)
                     break
                 report["added"].append(sid)
+                if promoted:
+                    report["promoted"].append(sid)
                 report["borrowed_chips"] += borrowed
         elif delta < 0:
             victims = self._pick_scale_down_victims(
@@ -728,9 +735,33 @@ class ServicesManager:
 
     def _scale_up_one(self, inference_job_id: str, inf: Dict,
                       predictor, borrow: bool):
-        """Place ONE extra serving replica for the trial group that
-        currently has the fewest live replicas. Returns (service_id,
-        borrowed_chip_count)."""
+        """Add ONE serving replica: promote a warm standby when the pool
+        holds one (an ``add_worker`` route, ~ms — the replica is already
+        loaded, warmed, and holding its chips), else place a fresh
+        replica for the trial group that currently has the fewest live
+        replicas. Returns (service_id, borrowed_chip_count,
+        served_by_promotion)."""
+        promoted = self.promote_standby(inference_job_id)
+        if promoted is not None:
+            return promoted, 0, True
+        sid, borrowed, group, chips = self._place_replica(
+            inference_job_id, inf, borrow=borrow, standby=False)
+        # replica JOIN: route new requests to it (its queue is already
+        # registered with the broker by the worker's startup)
+        predictor.add_worker(sid, group)
+        logger.info("scaled UP job %s: replica %s for group %s "
+                    "(chips=%s)", inference_job_id[:8], sid[:8],
+                    group[:16], chips)
+        return sid, borrowed, False
+
+    def _place_replica(self, inference_job_id: str, inf: Dict,
+                       borrow: bool, standby: bool):
+        """Deploy ONE extra serving replica for the trial group that
+        currently has the fewest live replicas (the scale-up placement
+        body, shared with the warm pool). ``standby`` marks the worker
+        row: the replica loads and pre-warms exactly like a routable one
+        but is NOT handed to the predictor — promotion does that later.
+        Returns (service_id, borrowed_chip_count, group, chips)."""
         train_job = self._db.get_train_job(inf["train_job_id"])
         assert train_job is not None
         budget = inf.get("budget") or {}
@@ -786,7 +817,7 @@ class ServicesManager:
             service = self._db.create_service(ServiceType.INFERENCE)
             self._db.create_inference_job_worker(
                 service["id"], inference_job_id, unit["trial_id"],
-                model_version=version)
+                model_version=version, standby=standby)
             worker_cls = InferenceWorker
             if train_job["task"] == TaskType.TEXT_GENERATION:
                 from rafiki_tpu.worker.generation import GenerationWorker
@@ -840,13 +871,114 @@ class ServicesManager:
                         "replica %s", borrowed, service["id"][:8])
             else:
                 self._arbiter.cancel_borrow(reservation)
-        # replica JOIN: route new requests to it (its queue is already
-        # registered with the broker by the worker's startup)
-        predictor.add_worker(service["id"], unit["group"])
-        logger.info("scaled UP job %s: replica %s for group %s "
-                    "(chips=%s)", inference_job_id[:8], service["id"][:8],
-                    unit["group"][:16], ctx.chips)
-        return service["id"], borrowed
+        return service["id"], borrowed, unit["group"], ctx.chips
+
+    # -- warm standby pool (admin/warm_pool.py; docs/failure-model.md
+    # "Cold-start faults") ---------------------------------------------------
+
+    def standby_workers(self, inference_job_id: str) -> List[Dict]:
+        """The job's warm standbys: standby-flagged worker rows whose
+        service is RUNNING (loaded + pre-warmed, holding chips, NOT
+        routed). DEPLOYING standbys are still warming and not yet
+        promotable."""
+        inf = self._db.get_inference_job(inference_job_id)
+        fused = bool(((inf or {}).get("budget") or {}).get(
+            BudgetType.ENSEMBLE_FUSED, 0))
+        group_of = (lambda t: f"fused:{inference_job_id}") if fused \
+            else (lambda t: t)
+        alive = {
+            s["id"]: s
+            for s in self._db.get_services(statuses=[ServiceStatus.RUNNING])}
+        out: List[Dict] = []
+        for w in self._db.get_workers_of_inference_job(inference_job_id):
+            if not int(w.get("standby") or 0):
+                continue
+            svc = alive.get(w["service_id"])
+            if svc is not None:
+                out.append({"service_id": w["service_id"],
+                            "trial_id": w["trial_id"],
+                            "group": group_of(w["trial_id"]),
+                            "model_version": int(
+                                w.get("model_version") or 0),
+                            "chips": svc.get("chips") or []})
+        return out
+
+    def create_standby_replica(self, inference_job_id: str) -> str:
+        """Place ONE warm standby for a RUNNING inference job: loaded,
+        pre-warmed, chips held through the arbiter's borrow book
+        (training's reclaim drains standbys FIRST), but never routed —
+        promotion is what makes it serve. Returns the service id."""
+        inf = self._db.get_inference_job(inference_job_id)
+        if inf is None or inf["status"] != InferenceJobStatus.RUNNING:
+            raise ServiceDeploymentError(
+                f"inference job {inference_job_id} is not RUNNING")
+        sid, borrowed, group, chips = self._place_replica(
+            inference_job_id, inf, borrow=True, standby=True)
+        if borrowed and self._arbiter is not None:
+            # reclaim-priority tag: training wins these chips back FIRST
+            self._arbiter.mark_standby(sid, True)
+        logger.info(
+            "warm pool: standby %s ready for job %s group %s (chips=%s,"
+            " borrowed=%d)", sid[:8], inference_job_id[:8], group[:16],
+            chips, borrowed)
+        return sid
+
+    def promote_standby(self, inference_job_id: str,
+                        group: Optional[str] = None) -> Optional[str]:
+        """Turn one warm standby into a routable replica: clear the
+        durable standby flag, then ``predictor.add_worker`` — the ~ms
+        scale-up/replacement path (no deploy, no compile; the worker's
+        queue has been registered since its boot). Standbys older than
+        what their group currently serves are skipped (rollouts retire
+        those — a promotion must never resurrect a stale version).
+        Returns the promoted service id, or None when the pool is empty
+        for the (optional) group filter."""
+        predictor = self.get_predictor(inference_job_id)
+        if predictor is None:
+            return None
+        candidates = self.standby_workers(inference_job_id)
+        if group is not None:
+            candidates = [w for w in candidates if w["group"] == group]
+        cur: Dict[str, int] = {}
+        for w in self.live_inference_workers(inference_job_id):
+            cur[w["group"]] = max(cur.get(w["group"], 0),
+                                  w["model_version"])
+        for w in candidates:
+            if w["model_version"] < cur.get(w["group"], 0):
+                continue
+            sid = w["service_id"]
+            try:
+                # flag first: a crash between the two leaves a
+                # promotable-but-unrouted replica (re-promoted or swept),
+                # never a routed row recovery would treat as a standby
+                self._db.set_worker_standby(sid, False)
+                predictor.add_worker(sid, w["group"])
+            # lint: absorb(a single unpromotable standby must not block trying its siblings; the pool loop replaces it)
+            except Exception:
+                logger.exception("promoting standby %s failed; trying "
+                                 "siblings", sid[:8])
+                continue
+            if self._arbiter is not None:
+                # now a load-bearing replica: reclaim treats its loan
+                # like any other serving replica's
+                self._arbiter.mark_standby(sid, False)
+            from rafiki_tpu.utils.metrics import REGISTRY
+
+            REGISTRY.counter(
+                "rafiki_warm_pool_promotions_total",
+                "warm standbys promoted into serving").inc()
+            logger.info("warm pool: promoted standby %s into job %s "
+                        "group %s", sid[:8], inference_job_id[:8],
+                        w["group"][:16])
+            return sid
+        return None
+
+    def drop_standby(self, service_id: str) -> None:
+        """Destroy a standby outright (stale-version retirement, pool
+        shrink): it serves no traffic, so there is nothing to drain —
+        its chip loan comes home through the _destroy_service
+        note_return chokepoint."""
+        self._destroy_service(service_id, wait=False)
 
     # -- safe live rollouts (admin/rollout.py; docs/failure-model.md
     # "Rollout faults") ------------------------------------------------------
@@ -1056,15 +1188,41 @@ class ServicesManager:
         reclaim is still a scale-down, so it honors the same guards as
         any other: never below the job's replica floor, never a trial's
         last replica while siblings hold spares (a borrowed replica may
-        have BECOME load-bearing if its siblings died since the loan)."""
+        have BECOME load-bearing if its siblings died since the loan).
+
+        Warm standbys drain FIRST: they serve no traffic, so their
+        chips come home with an outright destroy (no drain window, no
+        routing guards) before any routable replica is touched —
+        the training floor outranks warm spare capacity by contract."""
         if self._arbiter is None:
             return 0
+        freed = 0
+        for sid, (job_id, n) in list(self._arbiter.borrowed().items()):
+            if freed >= n_chips:
+                break
+            try:
+                row = self._db.get_inference_job_worker(sid)
+            # lint: absorb(an unreadable worker row just means this loan is reclaimed through the regular drain path below)
+            except Exception:
+                continue
+            if row is not None and int(row.get("standby") or 0):
+                self._destroy_service(sid, wait=False)
+                freed += n
+                from rafiki_tpu.utils.metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "rafiki_warm_pool_reclaims_total",
+                    "warm standbys destroyed to return chips to "
+                    "training").inc()
+                logger.info("reclaim: standby %s destroyed, %d chip(s) "
+                            "home", sid[:8], n)
+        if freed >= n_chips:
+            return freed
         loans = self._arbiter.borrowed()
         by_job: Dict[str, List[str]] = {}
         for sid, (job_id, _) in loans.items():
             by_job.setdefault(job_id, []).append(sid)
         min_r = max(int(config.AUTOSCALE_MIN_REPLICAS), 1)
-        freed = 0
         for job_id, sids in by_job.items():
             if freed >= n_chips:
                 break

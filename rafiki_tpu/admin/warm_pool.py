@@ -1,0 +1,277 @@
+"""Warm standby replica pool (docs/failure-model.md "Cold-start faults").
+
+`RAFIKI_AUTOSCALE_WARM_POOL=K` (off by default, like every policy) keeps
+K pre-loaded, pre-warmed STANDBY replicas per RUNNING inference job:
+placed like any scale-up replica (chips held through the
+ChipBudgetArbiter's borrow book — the training floor still outranks
+them, and training's reclaim drains standbys FIRST), fully booted and
+jit-compiled, but never handed to the predictor. Scale-up and
+failed-replica replacement then become an ``add_worker`` route (~ms)
+instead of a deploy: the MTTR cliff every recovery path used to end at
+(ROADMAP item 3, the r5 cold-compile collapse) turns into routing.
+
+The maintenance loop, each ``RAFIKI_AUTOSCALE_WARM_POOL_INTERVAL_S``:
+
+- **top-up** — place standbys until each RUNNING job holds K (bounded
+  retries: ``RAFIKI_AUTOSCALE_WARM_RETRY_MAX`` consecutive failures
+  park the job's pool DEGRADED for
+  ``RAFIKI_AUTOSCALE_WARM_RETRY_COOLDOWN_S`` instead of wedging the
+  loop against a placement that cannot succeed);
+- **retire stale versions** — a standby whose model_version fell behind
+  what its group serves (a rollout advanced past it) is destroyed and
+  replaced next tick, so a promotion can never resurrect an old version;
+- **replace on failure** — Admin._on_service_status calls
+  :meth:`on_replica_errored` when a routable serving replica dies: a
+  standby is promoted immediately (zero-deploy replacement), and the
+  next tick replenishes the pool.
+
+Recovery integration: standbys are ordinary services with a durable
+``standby`` worker-row column, so the adopt-or-fence pass treats them
+like any replica — adopted (or swept) on boot, kept out of the routable
+set (admin/services.py adopt_inference_job), their chip loans re-entered
+standby-tagged (admin/recovery.py _readopt_chip_loan).
+
+Reference analogue: none — the reference Rafiki had no warm capacity
+concept; its MTTR was container boot plus framework cold start.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.constants import InferenceJobStatus
+
+logger = logging.getLogger(__name__)
+
+
+class WarmPool:
+    """One per Admin. The loop thread only runs when
+    ``RAFIKI_AUTOSCALE_WARM_POOL`` > 0 (or :meth:`start` is called
+    explicitly); a stopped instance still answers :meth:`report` so
+    /fleet/health always has the section."""
+
+    def __init__(self, admin) -> None:
+        self._admin = admin
+        self._services = admin.services
+        self._db = admin.db
+        self._arbiter = getattr(admin, "chip_arbiter", None)
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # per-job pool state: consecutive placement failures + the
+        # DEGRADED cooldown deadline
+        # {job_id: {"failures": int, "degraded_until": float,
+        #           "last_error": str}}
+        self._jobs: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        #: bounded event log, newest last (fleet-health "warm_pool")
+        self.events: Deque[Dict[str, Any]] = (  # guarded-by: _lock
+            collections.deque(maxlen=100))
+        from rafiki_tpu.utils.metrics import REGISTRY
+
+        self._g_standbys = REGISTRY.gauge(
+            "rafiki_warm_pool_standbys",
+            "warm standby replicas currently held, per job", ("job",))
+        self._m_ticks = REGISTRY.counter(
+            "rafiki_warm_pool_ticks_total", "warm-pool maintenance ticks")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "WarmPool":
+        if self.running:
+            return self
+        self._closed.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="warm-pool", daemon=True)
+        self._thread.start()
+        logger.info(
+            "warm pool loop started (K=%d, interval %.1fs)",
+            int(config.AUTOSCALE_WARM_POOL),
+            float(config.AUTOSCALE_WARM_POOL_INTERVAL_S))
+        return self
+
+    def stop(self) -> None:
+        self._closed.set()
+        t = self._thread
+        if t is not None:
+            # a tick may sit inside a standby's deploy wait
+            t.join(timeout=float(config.SERVICE_DEPLOY_TIMEOUT_S) + 10)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._closed.wait(
+                float(config.AUTOSCALE_WARM_POOL_INTERVAL_S)):
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("warm pool tick failed")
+
+    # -- the maintenance loop -----------------------------------------------
+
+    def tick(self) -> List[Dict[str, Any]]:
+        """One maintenance pass over every RUNNING inference job. Public
+        and synchronous so tests (and an operator REPL) can drive the
+        pool deterministically without the thread."""
+        self._m_ticks.inc()
+        want = max(int(config.AUTOSCALE_WARM_POOL), 0)
+        actions: List[Dict[str, Any]] = []
+        jobs = self._db.get_inference_jobs_by_statuses(
+            [InferenceJobStatus.RUNNING])
+        seen = set()
+        for job in jobs:
+            job_id = job["id"]
+            seen.add(job_id)
+            try:
+                actions.extend(self._tick_job(job_id, want))
+            except Exception:
+                logger.exception("warm pool tick for job %s failed",
+                                 job_id[:8])
+        # drop state (and the gauge series) for jobs that ended
+        with self._lock:
+            for job_id in [j for j in self._jobs if j not in seen]:
+                del self._jobs[job_id]
+                self._g_standbys.labels(job_id).set(0)
+        return actions
+
+    def _tick_job(self, job_id: str, want: int) -> List[Dict[str, Any]]:
+        actions: List[Dict[str, Any]] = []
+        standbys = self._services.standby_workers(job_id)
+        # -- retire stale versions: a standby a rollout advanced past
+        # must never be promotable (admin/services.py promote_standby
+        # also guards, but a retired standby frees its chips NOW)
+        cur: Dict[str, int] = {}
+        for w in self._services.live_inference_workers(job_id):
+            cur[w["group"]] = max(cur.get(w["group"], 0),
+                                  w["model_version"])
+        fresh = []
+        for w in standbys:
+            if w["model_version"] < cur.get(w["group"], 0):
+                self._services.drop_standby(w["service_id"])
+                actions.append(self._event(
+                    job_id, "retire_stale",
+                    service_id=w["service_id"],
+                    version=w["model_version"],
+                    serving_version=cur.get(w["group"], 0)))
+            else:
+                fresh.append(w)
+        standbys = fresh
+        self._g_standbys.labels(job_id).set(len(standbys))
+        # -- shrink when K was lowered
+        while len(standbys) > want:
+            w = standbys.pop()
+            self._services.drop_standby(w["service_id"])
+            actions.append(self._event(job_id, "shrink",
+                                       service_id=w["service_id"]))
+        # -- top-up toward K, bounded-retry + DEGRADED cooldown
+        state = self._state(job_id)
+        now = time.monotonic()
+        if state["degraded_until"] > now:
+            return actions
+        retry_max = max(int(config.AUTOSCALE_WARM_RETRY_MAX), 1)
+        while len(standbys) < want:
+            try:
+                sid = self._services.create_standby_replica(job_id)
+            except Exception as e:
+                with self._lock:
+                    state["failures"] += 1
+                    state["last_error"] = f"{type(e).__name__}: {e}"
+                    failures = state["failures"]
+                logger.warning("warm pool: placing a standby for job %s "
+                               "failed (%d consecutive): %s", job_id[:8],
+                               failures, e)
+                if failures >= retry_max:
+                    cooldown = float(
+                        config.AUTOSCALE_WARM_RETRY_COOLDOWN_S)
+                    with self._lock:
+                        state["degraded_until"] = now + cooldown
+                        state["failures"] = 0
+                    actions.append(self._event(
+                        job_id, "degraded", error=str(e),
+                        cooldown_s=cooldown))
+                else:
+                    actions.append(self._event(job_id, "place_failed",
+                                               error=str(e)))
+                break
+            with self._lock:
+                state["failures"] = 0
+                state["last_error"] = None
+            standbys.append({"service_id": sid})
+            self._g_standbys.labels(job_id).set(len(standbys))
+            actions.append(self._event(job_id, "place",
+                                       service_id=sid))
+        return actions
+
+    # -- failure replacement (Admin._on_service_status) ----------------------
+
+    def on_replica_errored(self, service_id: str,
+                           inference_job_id: str) -> Optional[str]:
+        """A routable serving replica died: promote a standby in its
+        group NOW (an add_worker route — the zero-deploy replacement),
+        leaving the next tick to replenish the pool. Returns the
+        promoted service id, or None (empty pool / the dead replica was
+        itself a standby)."""
+        try:
+            row = self._db.get_inference_job_worker(service_id)
+        # lint: absorb(an unreadable worker row only skips the fast-path replacement; the autoscaler/operator path still works)
+        except Exception:
+            return None
+        if row is None or int(row.get("standby") or 0):
+            return None
+        promoted = self._services.promote_standby(inference_job_id)
+        if promoted is not None:
+            self._event(inference_job_id, "replace",
+                        failed=service_id, promoted=promoted)
+            logger.info(
+                "warm pool: replaced failed replica %s of job %s with "
+                "standby %s", service_id[:8], inference_job_id[:8],
+                promoted[:8])
+        return promoted
+
+    # -- reporting ----------------------------------------------------------
+
+    def _state(self, job_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return self._jobs.setdefault(
+                job_id, {"failures": 0, "degraded_until": 0.0,
+                         "last_error": None})
+
+    def _event(self, job_id: str, action: str, **detail: Any,
+               ) -> Dict[str, Any]:
+        ev = {"ts": time.time(), "job_id": job_id, "action": action,
+              **detail}
+        with self._lock:
+            self.events.append(ev)
+        return ev
+
+    def report(self) -> Dict[str, Any]:
+        """The /fleet/health "warm_pool" section."""
+        now = time.monotonic()
+        with self._lock:
+            jobs = {
+                job_id: {
+                    "failures": s["failures"],
+                    "degraded": s["degraded_until"] > now,
+                    "last_error": s["last_error"],
+                }
+                for job_id, s in self._jobs.items()
+            }
+            events = list(self.events)[-20:]
+        out: Dict[str, Any] = {
+            "enabled": int(config.AUTOSCALE_WARM_POOL) > 0,
+            "running": self.running,
+            "target_per_job": int(config.AUTOSCALE_WARM_POOL),
+            "jobs": jobs,
+            "events": events,
+        }
+        if self._arbiter is not None and hasattr(self._arbiter,
+                                                 "loan_split"):
+            out["loans"] = self._arbiter.loan_split()
+        return out

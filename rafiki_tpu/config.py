@@ -347,6 +347,62 @@ _DYNAMIC_PATHS = {
         "RAFIKI_AUTOSCALE_FAIR_BURST", 32.0),
     "AUTOSCALE_FAIR_WEIGHTS": lambda: os.environ.get(
         "RAFIKI_AUTOSCALE_FAIR_WEIGHTS", ""),
+    # -- cold-start resilience (docs/failure-model.md "Cold-start
+    # faults"). The persistent XLA executable cache makes a replacement
+    # process's jit programs a disk read instead of a compile; the warm
+    # standby pool makes scale-up/replacement an add_worker route instead
+    # of a deploy. Lazy like every serving knob:
+    #   RAFIKI_COMPILE_CACHE=1          0 disables the persistent compile
+    #                                   cache everywhere (workers still
+    #                                   warm up, every boot is cold)
+    #   RAFIKI_COMPILE_CACHE_DIR=       shared executable-cache root
+    #                                   (default WORKDIR/xla_cache); keyed
+    #                                   per topology underneath — see
+    #                                   sdk/compile_cache.py
+    #   RAFIKI_COMPILE_CACHE_CPU=1      opt the CPU backend in (entries
+    #                                   are machine-feature-tied; safe on
+    #                                   one box, default off)
+    #   RAFIKI_COMPILE_CACHE_MIN_COMPILE_S=0.5  only persist programs
+    #                                   whose compile took at least this
+    #                                   long (0 = persist everything —
+    #                                   what the drills/bench use on CPU)
+    #   RAFIKI_COMPILE_WARM_THRESHOLD_S=1.0  warm/cold classification
+    #                                   fallback when the JAX cache-event
+    #                                   listeners are unavailable: a boot
+    #                                   whose total warm-up compile time
+    #                                   stays under this reads warm
+    #   RAFIKI_AUTOSCALE_WARM_POOL=0    K pre-loaded, pre-warmed standby
+    #                                   replicas kept per RUNNING
+    #                                   inference job (0 = off). Standbys
+    #                                   hold chips via the arbiter's
+    #                                   borrow book: the training floor
+    #                                   still outranks them and reclaim
+    #                                   drains them FIRST
+    #   RAFIKI_AUTOSCALE_WARM_POOL_INTERVAL_S=5  maintenance-loop tick
+    #   RAFIKI_AUTOSCALE_WARM_RETRY_MAX=3  consecutive standby-placement
+    #                                   failures per job before the pool
+    #                                   reports that job degraded and
+    #                                   pauses retries
+    #   RAFIKI_AUTOSCALE_WARM_RETRY_COOLDOWN_S=30  how long a degraded
+    #                                   job's refill stays paused
+    "COMPILE_CACHE": lambda: os.environ.get(
+        "RAFIKI_COMPILE_CACHE", "1") != "0",
+    "COMPILE_CACHE_DIR": lambda: os.environ.get(
+        "RAFIKI_COMPILE_CACHE_DIR", ""),
+    "COMPILE_CACHE_CPU": lambda: os.environ.get(
+        "RAFIKI_COMPILE_CACHE_CPU", "") != "",
+    "COMPILE_CACHE_MIN_COMPILE_S": lambda: _env_float(
+        "RAFIKI_COMPILE_CACHE_MIN_COMPILE_S", 0.5),
+    "COMPILE_WARM_THRESHOLD_S": lambda: _env_float(
+        "RAFIKI_COMPILE_WARM_THRESHOLD_S", 1.0),
+    "AUTOSCALE_WARM_POOL": lambda: _env_int(
+        "RAFIKI_AUTOSCALE_WARM_POOL", 0),
+    "AUTOSCALE_WARM_POOL_INTERVAL_S": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_WARM_POOL_INTERVAL_S", 5.0),
+    "AUTOSCALE_WARM_RETRY_MAX": lambda: _env_int(
+        "RAFIKI_AUTOSCALE_WARM_RETRY_MAX", 3),
+    "AUTOSCALE_WARM_RETRY_COOLDOWN_S": lambda: _env_float(
+        "RAFIKI_AUTOSCALE_WARM_RETRY_COOLDOWN_S", 30.0),
     # -- safe live rollouts (docs/failure-model.md "Rollout faults").
     # admin/rollout.py updates a RUNNING inference job to a new trial in
     # place: one canary replica judged over a trailing window, then a
@@ -470,6 +526,8 @@ ENV_KNOBS = (
     "RAFIKI_SERVE_INT8",
     # training / JAX backend
     "RAFIKI_COMPILE_CACHE_DIR", "RAFIKI_COMPILE_CACHE_CPU",
+    "RAFIKI_COMPILE_CACHE", "RAFIKI_COMPILE_CACHE_MIN_COMPILE_S",
+    "RAFIKI_COMPILE_WARM_THRESHOLD_S",
     "RAFIKI_TRAINER_CACHE_CAP", "RAFIKI_SCAN_EPOCH",
     "RAFIKI_SCAN_EPOCH_MAX_BYTES", "RAFIKI_FLASH_THRESHOLD_BYTES",
     "RAFIKI_NATIVE_CACHE", "RAFIKI_VISIBLE_DEVICES",

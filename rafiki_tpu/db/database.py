@@ -138,7 +138,8 @@ CREATE TABLE IF NOT EXISTS inference_job_worker (
     inference_job_id TEXT NOT NULL REFERENCES inference_job(id),
     trial_id TEXT NOT NULL REFERENCES trial(id),
     model_version INTEGER NOT NULL DEFAULT 0,
-    borrowed_chips INTEGER NOT NULL DEFAULT 0
+    borrowed_chips INTEGER NOT NULL DEFAULT 0,
+    standby INTEGER NOT NULL DEFAULT 0
 );
 CREATE TABLE IF NOT EXISTS rollout (
     id TEXT PRIMARY KEY,
@@ -419,6 +420,14 @@ class Database:
     operator_ack INTEGER NOT NULL DEFAULT 0,
     datetime_updated REAL NOT NULL
 )""",
+        # r17 (cold-start resilience): warm standby replicas — pre-loaded
+        # and pre-warmed but NOT routed (predictor add_worker is deferred
+        # to promotion). The durable flag lets a restarted admin rebuild
+        # the standby registry and keep standbys out of the routable set
+        # during adoption (admin/warm_pool.py; docs/failure-model.md
+        # "Cold-start faults")
+        "ALTER TABLE inference_job_worker ADD COLUMN"
+        " standby INTEGER NOT NULL DEFAULT 0",
     )
 
     def _migrate(self) -> None:
@@ -1099,22 +1108,27 @@ class Database:
 
     def create_inference_job_worker(
         self, service_id: str, inference_job_id: str, trial_id: str,
-        model_version: int = 0,
+        model_version: int = 0, standby: bool = False,
     ) -> Dict:
         """``model_version`` is the rollout generation this replica
         serves (0 for the initial deploy; admin/rollout.py bumps it per
         in-place update) — recovery reads it to reconstruct a
-        mixed-version fleet mid-rollout."""
+        mixed-version fleet mid-rollout. ``standby`` marks a warm-pool
+        replica: loaded and warmed but NOT routed until promotion
+        (admin/warm_pool.py) — recovery keeps standbys out of the
+        predictor's routable set when it adopts a fleet."""
         self._exec(
             "INSERT INTO inference_job_worker (service_id, inference_job_id,"
-            " trial_id, model_version) VALUES (?,?,?,?)",
-            (service_id, inference_job_id, trial_id, int(model_version)),
+            " trial_id, model_version, standby) VALUES (?,?,?,?,?)",
+            (service_id, inference_job_id, trial_id, int(model_version),
+             1 if standby else 0),
         )
         return {
             "service_id": service_id,
             "inference_job_id": inference_job_id,
             "trial_id": trial_id,
             "model_version": int(model_version),
+            "standby": 1 if standby else 0,
         }
 
     def get_inference_job_worker(self, service_id: str) -> Optional[Dict]:
@@ -1132,6 +1146,16 @@ class Database:
             "UPDATE inference_job_worker SET borrowed_chips=?"
             " WHERE service_id=?",
             (int(n_chips), service_id),
+        )
+
+    def set_worker_standby(self, service_id: str, standby: bool) -> None:
+        """Flip a replica's warm-standby marker (0 = routable). Promotion
+        clears it BEFORE predictor add_worker, so a crash between the two
+        leaves a promotable-but-unrouted replica (re-promoted or swept),
+        never a routed row recovery would mistake for a standby."""
+        self._exec(
+            "UPDATE inference_job_worker SET standby=? WHERE service_id=?",
+            (1 if standby else 0, service_id),
         )
 
     def get_workers_of_inference_job(self, inference_job_id: str) -> List[Dict]:

@@ -18,7 +18,7 @@ import json
 import os
 import sys
 import tempfile
-from typing import Callable, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 PASS, WARN, FAIL = "PASS", "WARN", "FAIL"
 
@@ -1106,6 +1106,128 @@ def check_autoscaler(total_chips: int = None) -> Check:
     return ("autoscaler", WARN if warn else PASS, detail)
 
 
+def check_compile_cache(total_chips: Optional[int] = None) -> Check:
+    """Cold-start resilience (docs/failure-model.md "Cold-start
+    faults"): WARN when the persistent compile cache cannot actually
+    serve worker boots — the dir missing/unwritable or on a different
+    device than the workdir, the cache disabled while the autoscaler or
+    warm pool is ON (their replacement replicas would recompile from
+    scratch, defeating the point), recent boots compiling without a
+    single cache hit (a silently-misconfigured key or dir), or a
+    warm-pool floor no fleet capacity could ever hold."""
+    from rafiki_tpu import config
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    notes = []
+    warn = False
+    enabled = bool(config.COMPILE_CACHE)
+    root = (config.COMPILE_CACHE_DIR
+            or os.path.join(config.WORKDIR, "xla_cache"))
+    scaler_on = bool(config.AUTOSCALE) or int(config.AUTOSCALE_WARM_POOL) > 0
+    if not enabled and scaler_on:
+        warn = True
+        notes.append(
+            "RAFIKI_COMPILE_CACHE=0 while the autoscaler/warm pool is ON "
+            "— every replacement replica pays a full cold compile, which "
+            "is exactly the latency those loops exist to remove")
+    if enabled:
+        try:
+            os.makedirs(root, exist_ok=True)
+            probe = os.path.join(root, ".rafiki_doctor_probe")
+            with open(probe, "w", encoding="utf-8") as f:
+                f.write("ok")
+            os.unlink(probe)
+        # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+        except Exception as e:
+            warn = True
+            notes.append(
+                f"cache dir {root} is missing/unwritable "
+                f"({type(e).__name__}: {e}) — workers degrade to fresh "
+                "compiles every boot")
+        else:
+            try:
+                if (os.stat(root).st_dev
+                        != os.stat(config.WORKDIR).st_dev):
+                    warn = True
+                    notes.append(
+                        f"cache dir {root} sits on a different device "
+                        "than RAFIKI_WORKDIR — cache writes cross a "
+                        "filesystem boundary (slow, and atomic-rename "
+                        "guarantees differ)")
+            # lint: absorb(doctor checks must never crash; an unstatable workdir just skips the device comparison)
+            except OSError:
+                pass
+    # recent boots compiling without a single hit: the
+    # silently-misconfigured-key case (this process's registry plus the
+    # admin door's JSON snapshot when an admin is reachable)
+    local = REGISTRY.snapshot().get("metrics", {})
+    remote = {}
+    try:
+        import json as _json
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{config.ADMIN_HOST}:{config.ADMIN_PORT}"
+                "/metrics?format=json", timeout=2) as resp:
+            remote = _json.load(resp).get("metrics", {})
+    # lint: absorb(doctor checks must never crash; no admin on this host means in-process counters only)
+    except Exception:
+        pass
+    hits = (_sum_counter(local, "rafiki_compile_cache_hits_total")
+            + _sum_counter(remote, "rafiki_compile_cache_hits_total"))
+    misses = (_sum_counter(local, "rafiki_compile_cache_misses_total")
+              + _sum_counter(remote, "rafiki_compile_cache_misses_total"))
+    if enabled and hits == 0 and misses >= 2:
+        warn = True
+        notes.append(
+            f"{misses} program(s) compiled fresh with ZERO persistent-"
+            "cache hits — a misconfigured RAFIKI_COMPILE_CACHE_DIR or a "
+            "topology/version key that never matches (every boot is "
+            "cold)")
+    # warm-pool floor vs fleet capacity
+    pool = int(config.AUTOSCALE_WARM_POOL)
+    if total_chips is None:
+        agents = [a.strip() for a in os.environ.get(
+            "RAFIKI_AGENTS", "").split(",") if a.strip()]
+        if agents:
+            from rafiki_tpu.utils.agent_http import call_agent
+
+            total_chips = 0
+            for addr in agents:
+                try:
+                    inv = call_agent(
+                        addr, "GET", "/inventory",
+                        key=os.environ.get("RAFIKI_AGENT_KEY"),
+                        timeout_s=5, use_breaker=False)
+                    total_chips += int(inv.get("total_chips", 0))
+                # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+                except Exception:
+                    total_chips = None
+                    break
+    if total_chips is not None and pool > total_chips > 0:
+        warn = True
+        notes.append(
+            f"RAFIKI_AUTOSCALE_WARM_POOL={pool} standbys/job exceeds the "
+            f"fleet's {total_chips} chip(s) — the pool can never reach "
+            "its floor, probably a typo")
+    state = "cache ON" if enabled else "cache off"
+    pool_s = f"warm pool {pool}/job" if pool > 0 else "warm pool off"
+    detail = (f"{state} at {root}, {pool_s}, hits {hits} misses {misses}"
+              + ("; " + "; ".join(notes) if notes else ""))
+    return ("compile cache", WARN if warn else PASS, detail)
+
+
+def _sum_counter(metrics: Dict[str, Any], name: str) -> int:
+    """Sum a counter family out of a registry JSON snapshot's flat
+    {``name{labels}``: value} metric map (all label sets folded)."""
+    total = 0.0
+    for key, val in metrics.items():
+        if (key == name or key.startswith(name + "{")) \
+                and isinstance(val, (int, float)):
+            total += val
+    return int(total)
+
+
 def check_observability() -> Check:
     """Telemetry plane (docs/observability.md): the registry must render
     parseable exposition, RAFIKI_TRACE_SAMPLE must be a sane rate, and
@@ -1254,7 +1376,8 @@ def check_agents() -> Check:
 
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
-    check_chaos, check_overload_knobs, check_autoscaler, check_recovery,
+    check_chaos, check_overload_knobs, check_autoscaler,
+    check_compile_cache, check_recovery,
     check_rollouts, check_drift, check_trial_faults,
     check_vectorized_trials,
     check_static_analysis, check_concurrency_lint,

@@ -175,6 +175,9 @@ class ChipBudgetArbiter:
         self._lock = threading.Lock()
         # service_id -> (inference_job_id, n_chips) currently on loan
         self._borrowed: Dict[str, Tuple[str, int]] = {}
+        # loans held by warm STANDBY replicas — reclaim's first victims
+        # (admin/warm_pool.py tags them; note_return untags)
+        self._standby: set = set()
         # token -> n_chips of borrows DECIDED but not yet granted by the
         # allocator: counted against the floor so concurrent scale-ups
         # can't both pass the check before either takes its chips
@@ -267,6 +270,7 @@ class ChipBudgetArbiter:
     def note_return(self, service_id: str) -> int:
         with self._lock:
             job_id, n = self._borrowed.pop(service_id, (None, 0))
+            self._standby.discard(service_id)
             self._g_borrowed.set(
                 sum(c for _, c in self._borrowed.values()))
         if n:
@@ -274,13 +278,40 @@ class ChipBudgetArbiter:
                         "replica %s", n, service_id[:8])
         return n
 
+    def mark_standby(self, service_id: str, standby: bool = True) -> None:
+        """Tag a loan as held by a warm STANDBY replica (or clear the
+        tag on promotion). Standby loans are reclaim's first victims —
+        they serve no traffic, so training wins them back with an
+        outright destroy instead of a drain (admin/warm_pool.py;
+        docs/failure-model.md "Cold-start faults")."""
+        with self._lock:
+            if standby and service_id in self._borrowed:
+                self._standby.add(service_id)
+            else:
+                self._standby.discard(service_id)
+
     def borrowed(self) -> Dict[str, Tuple[str, int]]:
         with self._lock:
             return dict(self._borrowed)
 
+    def standby_loans(self) -> Dict[str, Tuple[str, int]]:
+        """The subset of the loan book held by warm standbys."""
+        with self._lock:
+            return {sid: v for sid, v in self._borrowed.items()
+                    if sid in self._standby}
+
     def borrowed_chips(self) -> int:
         with self._lock:
             return sum(n for _, n in self._borrowed.values())
+
+    def loan_split(self) -> Dict[str, int]:
+        """{"serving": n, "standby": n} chips on loan — the fleet-health
+        view of who holds what training could reclaim."""
+        with self._lock:
+            standby = sum(n for sid, (_, n) in self._borrowed.items()
+                          if sid in self._standby)
+            total = sum(n for _, n in self._borrowed.values())
+        return {"serving": total - standby, "standby": standby}
 
     def reclaim_for_training(self, n_chips: int) -> int:
         """The training plane demands ``n_chips`` it cannot allocate:

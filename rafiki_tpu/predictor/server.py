@@ -184,6 +184,24 @@ class PredictorServer:
             "queue_depths": depths,
             "admission": self.admission.stats(),
         }
+        # per-replica warm state (worker/warmup.py): cold/warm verdict +
+        # last-boot compile seconds for every in-process replica in this
+        # door's fan-out; replicas in other processes report the same
+        # fields through their stats rows (GET /fleet/health workers)
+        try:
+            from rafiki_tpu.worker.warmup import warmup_stats
+
+            reports = warmup_stats()
+            replicas = {
+                sid: {"warm": bool(r.get("warm")),
+                      "compile_s": r.get("compile_s", 0.0),
+                      "cache_hits": r.get("cache_hits", 0)}
+                for sid, r in reports.items() if sid in depths}
+            if replicas:
+                payload["replicas"] = replicas
+        # lint: absorb(/healthz must answer even when the warm-state probe crashes)
+        except Exception:
+            logger.exception("healthz warm-state probe failed")
         if callable(overload_fn):
             payload["overload"] = overload_fn()
         qstats_fn = getattr(self.predictor, "queue_stats", None)

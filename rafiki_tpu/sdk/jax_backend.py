@@ -123,38 +123,17 @@ def set_opt_hyperparams(opt_state: Any, hyperparams: Dict[str, float]) -> Any:
     return opt_state
 
 
-_persistent_cache_enabled = False
-
-
 def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     """Turn on JAX's on-disk compilation cache (idempotent). Executables
     persist across processes, so a fresh worker re-running a known program
-    skips XLA entirely. Returns the cache dir, or None if unavailable."""
-    global _persistent_cache_enabled
-    if _persistent_cache_enabled:
-        return jax.config.jax_compilation_cache_dir
-    # CPU AOT cache entries are tied to exact machine-feature sets and can
-    # fail to load (or SIGILL) when the detected features differ between
-    # compile and load; the cache pays off on TPU where compiles are slow,
-    # so restrict it there unless explicitly forced.
-    if (jax.default_backend() == "cpu"
-            and not os.environ.get("RAFIKI_COMPILE_CACHE_CPU")):
-        return None
-    from rafiki_tpu import config as rconfig
+    skips XLA entirely. Returns the cache dir, or None if unavailable.
 
-    cache_dir = (cache_dir
-                 or os.environ.get("RAFIKI_COMPILE_CACHE_DIR")
-                 or os.path.join(rconfig.WORKDIR, "xla_cache"))
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # default threshold skips small programs; trials are mostly small
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        _persistent_cache_enabled = True
-        return cache_dir
-    except Exception:
-        logger.exception("persistent compile cache unavailable")
-        return None
+    Thin alias for :func:`rafiki_tpu.sdk.compile_cache.enable`, which owns
+    the topology keying, the typed degrade path, and the hit telemetry
+    (docs/failure-model.md "Cold-start faults")."""
+    from rafiki_tpu.sdk import compile_cache
+
+    return compile_cache.enable(cache_dir)
 
 
 def restore_checkpoint_host(path: str, params: Any, opt_state: Any,

@@ -36,15 +36,19 @@ Fields:
              chokepoint — canary-failure / deploy-timeout rollback
              drills for live rollouts), or ``drift`` (the drift loop's
              monitor-tick and retrain-launch chokepoints — degraded-
-             monitor / parked-launch drills). Required.
+             monitor / parked-launch drills), or ``compile`` (the worker
+             warm-up / compile chokepoint — cold-start drills: slow
+             compiles, corrupt cache entries, failed standby warm-ups).
+             Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
              ``delay_s`` then proceed — a slow replica), ``error``
              (HTTP ``code``; at site=worker the batch fails; at
              site=trial a typed transient INFRA fault), ``corrupt``
-             (site=wire only: truncate/garble the raw frame bytes), or
-             ``oom`` (site=trial only: raise MemoryError — the MEM-class
-             drill). Required.
+             (site=wire: truncate/garble the raw frame bytes;
+             site=compile: garble the persistent compile-cache entries),
+             or ``oom`` (site=trial only: raise MemoryError — the
+             MEM-class drill). Required.
     match    substring filter on the target ("addr path" client-side,
              request path server-side). Empty matches everything.
     after    skip the first N matching requests (default 0).
@@ -139,6 +143,17 @@ SITE_DRIFT = "drift"
 # `delay` models a slow trial start — docs/failure-model.md
 # "Training-plane faults".
 SITE_TRIAL = "trial"
+# worker warm-up / compile chokepoint (worker/warmup.py run_warmup):
+# one ask per warm-up program, target
+# "{inference_job_id}/{service_id}/{program}". `delay` models a slow
+# compile (the still-warming replica stays DEPLOYING — the drill that
+# proves the predictor never routes to it), `error` raises the typed
+# WarmupError that fails the worker's startup (the bounded standby-
+# retry drill), and `corrupt` garbles the persistent compile-cache
+# entries on disk first (the bit-rot drill: JAX's reader absorbs the
+# damage and the boot degrades to a fresh compile, never a crash) —
+# docs/failure-model.md "Cold-start faults".
+SITE_COMPILE = "compile"
 
 ACTION_DROP = "drop"
 ACTION_DELAY = "delay"
@@ -168,15 +183,16 @@ class ChaosRule:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
                              SITE_WIRE, SITE_DB, SITE_TRIAL,
                              SITE_GENERATE, SITE_DEPLOY, SITE_CACHE,
-                             SITE_DRIFT):
+                             SITE_DRIFT, SITE_COMPILE):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
                                ACTION_CORRUPT, ACTION_OOM):
             raise ChaosSpecError(f"unknown chaos action {self.action!r}")
-        if self.action == ACTION_CORRUPT and self.site != SITE_WIRE:
+        if self.action == ACTION_CORRUPT and self.site not in (SITE_WIRE,
+                                                               SITE_COMPILE):
             raise ChaosSpecError(
-                "chaos action 'corrupt' only applies at site=wire "
-                "(raw frame bytes)")
+                "chaos action 'corrupt' only applies at site=wire (raw "
+                "frame bytes) or site=compile (cache entries on disk)")
         if self.action == ACTION_OOM and self.site != SITE_TRIAL:
             raise ChaosSpecError(
                 "chaos action 'oom' only applies at site=trial "

@@ -260,12 +260,13 @@ class GenerationWorker(InferenceWorker):
                     self._chunk)
             else:
                 cache = model.init_kv_cache(max_slots)
-            try:
-                model.warm_up()
-            except Exception:
-                logger.warning(
-                    "warm_up failed in generation worker %s (serving "
-                    "anyway):\n%s", ctx.service_id, traceback.format_exc())
+            # pre-warm per-bucket prefill + decode programs under the
+            # persistent compile cache, before ctx.ready(): a still-
+            # compiling generation replica stays DEPLOYING/unroutable
+            from rafiki_tpu.worker.warmup import run_warmup
+
+            run_warmup(ctx.service_id, self._job_id,
+                       [("warm_up", model.warm_up)])
             ctx.ready()
             if self._report_stats is not None:
                 threading.Thread(

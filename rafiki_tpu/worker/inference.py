@@ -298,6 +298,12 @@ class InferenceWorker:
             nonlocal last
             s = serving_stats().get(ctx.service_id,
                                     {"batches": 0, "queries": 0})
+            # warm-state fields from this boot's warm-up report ride on
+            # every row (static after boot — cheap) so fleet health can
+            # show per-replica warm + last-boot compile seconds
+            from rafiki_tpu.worker.warmup import stats_row_fields
+
+            s = {**s, **stats_row_fields(ctx.service_id)}
             if s == last:
                 return
             try:
@@ -375,16 +381,17 @@ class InferenceWorker:
         queue = self._broker.register_worker(self._job_id, ctx.service_id)
         try:
             model = self._load_model(ctx.service_id)
-            try:
-                # compile every serving batch bucket before accepting
-                # traffic — a mid-traffic XLA compile is a multi-second
-                # p99 spike (the reference never compiled anything, but
-                # paid 0.25 s polls instead)
-                model.warm_up()
-            except Exception:
-                logger.warning(
-                    "warm_up failed in worker %s (serving anyway):\n%s",
-                    ctx.service_id, traceback.format_exc())
+            # compile every serving batch bucket before accepting
+            # traffic — a mid-traffic XLA compile is a multi-second
+            # p99 spike (the reference never compiled anything, but
+            # paid 0.25 s polls instead). run_warmup enables the
+            # persistent compile cache, times the compiles, and records
+            # this boot's cold/warm verdict; it runs BEFORE ctx.ready()
+            # so a still-compiling replica stays DEPLOYING/unroutable.
+            from rafiki_tpu.worker.warmup import run_warmup
+
+            run_warmup(ctx.service_id, self._job_id,
+                       [("warm_up", model.warm_up)])
             ctx.ready()  # model + params loaded: startup succeeded
             if self._report_stats is not None:
                 threading.Thread(

@@ -35,6 +35,7 @@ from rafiki_tpu.constants import BudgetType, TrialStatus
 from rafiki_tpu.db.database import Database
 from rafiki_tpu.parallel.mesh import set_device_grant
 from rafiki_tpu.placement.manager import ServiceContext
+from rafiki_tpu.sdk import compile_cache
 from rafiki_tpu.sdk.jax_backend import enable_persistent_compile_cache
 from rafiki_tpu.sdk.artifact import write_artifact
 from rafiki_tpu.sdk.log import ModelLogger, StopTrialEarly
@@ -43,7 +44,7 @@ from rafiki_tpu.sdk.params import dump_params
 from rafiki_tpu.worker.vmap_partition import partition_for_vmap
 from rafiki_tpu.utils import chaos
 from rafiki_tpu.utils.trace import Tracer, jax_profile
-from rafiki_tpu.worker import faults
+from rafiki_tpu.worker import faults, warmup
 from rafiki_tpu.worker.faults import FaultKind, TrialChaosError, validate_score
 
 logger = logging.getLogger(__name__)
@@ -348,8 +349,18 @@ class TrainWorker:
             self._install_stop_check(trial_logger, advisor_id, trial_id)
             try:
                 self._chaos_trial(trial_id)
+                t_trial = time.monotonic()
+                hits_before = compile_cache.hit_count()
                 score, params_path = self._run_trial(
                     clazz, knobs, job, trial_id, trial_logger, tracer)
+                # the boot's FIRST completed trial carries the cold-start
+                # verdict: cache hits mean its jit programs loaded from
+                # the persistent cache instead of compiling (the r5
+                # cold-compile collapse, measured per boot)
+                warmup.note_first_program(
+                    ctx.service_id, self._sub_id, "first_trial",
+                    time.monotonic() - t_trial,
+                    compile_cache.hit_count() - hits_before)
                 # feedback BEFORE mark-complete: a sibling restarting in
                 # between sees COMPLETED only once the observation is in
                 # the GP, so its empty-only replay can't double-feed (the

@@ -150,6 +150,45 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # backlog:job:<id> + shed_rate:job:<id>. Decisions (reason + signal
 # snapshot) surface under GET /fleet/health "autoscaler".
 
+# Cold-start resilience (docs/failure-model.md "Cold-start faults",
+# sizing recipe in docs/performance.md). Compiled XLA executables
+# persist across process death/reschedule/scale-up; workers pre-warm
+# their programs BEFORE going routable; the autoscaler can hold warm
+# standby replicas so scale-up/replacement is a ~ms promotion:
+#   RAFIKI_COMPILE_CACHE=1              0 = never persist compiled
+#                                       executables (every boot is cold;
+#                                       doctor WARNs while the
+#                                       autoscaler/warm pool is on)
+#   RAFIKI_COMPILE_CACHE_DIR=...        shared cache root (default
+#                                       $RAFIKI_WORKDIR/xla_cache);
+#                                       entries keyed per topology +
+#                                       jax version underneath
+#   RAFIKI_COMPILE_CACHE_CPU=1          opt the CPU backend in (entries
+#                                       are machine-feature-tied —
+#                                       homogeneous fleets/tests only)
+#   RAFIKI_COMPILE_CACHE_MIN_COMPILE_S=0.5  programs compiling faster
+#                                       than this are not persisted
+#   RAFIKI_COMPILE_WARM_THRESHOLD_S=1.0 boot compile time under this
+#                                       still counts warm when cache-hit
+#                                       events are unavailable
+#   RAFIKI_AUTOSCALE_WARM_POOL=0        K pre-placed pre-warmed standbys
+#                                       per hot inference job (0 = off);
+#                                       chips ride the arbiter loan book
+#                                       and training reclaims drain
+#                                       standbys FIRST
+#   RAFIKI_AUTOSCALE_WARM_POOL_INTERVAL_S=5  pool top-up/retire tick
+#   RAFIKI_AUTOSCALE_WARM_RETRY_MAX=3   failed top-ups per job before
+#                                       its pool parks DEGRADED
+#   RAFIKI_AUTOSCALE_WARM_RETRY_COOLDOWN_S=30  how long a degraded pool
+#                                       waits before retrying
+# New /metrics series: rafiki_compile_cache_{hits,misses}_total,
+# rafiki_compile_seconds, rafiki_warm_pool_standbys{job},
+# rafiki_warm_pool_{promotions,reclaims,ticks}_total. Per-replica warm
+# state rides worker stats rows into GET /fleet/health "serving.workers"
+# and the predictor /healthz; the pool's report surfaces under
+# GET /fleet/health "warm_pool"; doctor's "compile cache" check WARNs on
+# the misconfigurations.
+
 # Generative serving — token-streaming TEXT_GENERATION jobs with
 # KV-cached decode and continuous batching (docs/serving-generation.md).
 # The streaming /generate door lives on the dedicated per-job predictor
@@ -417,15 +456,19 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # statements for control-plane recovery drills, trial, which
 # errors/delays/OOMs the trial-run chokepoint for fault-taxonomy
 # drills, generate, which injures/stalls one generation slot per
-# rule for mid-stream fault drills, and deploy, which fails/delays the
+# rule for mid-stream fault drills, deploy, which fails/delays the
 # inference-replica placement chokepoint for canary-failure and
-# deploy-timeout rollback drills):
+# deploy-timeout rollback drills, and compile, which delays the warm-up
+# chokepoint, corrupts on-disk compile-cache entries (the bit-rot
+# drill), or errors a boot for the standby-retry drill):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 
 # Persistent XLA compile cache shared across trials/restarts
 # (replaces the reference's per-boot `pip install` warmup cost,
-# reference scripts/start_worker.py:6-9).
+# reference scripts/start_worker.py:6-9). Rafiki processes manage their
+# own topology-keyed cache under RAFIKI_COMPILE_CACHE_DIR (above); this
+# jax-native variable only covers stray jax processes outside them.
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$RAFIKI_WORKDIR/xla_cache}"
 
 RAFIKI_PID_FILE="$RAFIKI_WORKDIR/admin.pid"

@@ -166,6 +166,8 @@ def _drive_every_dal_method(db: Database) -> None:
                                    model_version=1)
     db.get_inference_job_worker(svc["id"])
     db.get_workers_of_inference_job(ij["id"])
+    db.set_worker_standby(svc["id"], True)
+    db.set_worker_standby(svc["id"], False)
 
     ro = db.create_rollout(ij["id"], t["id"], t["id"], 0, 1, 2, "CANARY")
     db.get_rollout(ro["id"])
