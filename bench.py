@@ -881,18 +881,60 @@ def bench_serving_cached(n_clients: int = 4, query_floats: int = 512,
 _GEN_BENCH_CONTEXT = 160  # the bench LM's max_context
 
 
-def _make_gen_bench_lm():
+def _make_gen_bench_lm(dim: int = 64, depth: int = 2, heads: int = 4,
+                       train_steps: int = 0, seed: int = 0):
     """The tiny-but-real KV-cached LM behind the generative phases —
     advertises BOTH decode layouts so RAFIKI_GEN_KV_PAGED alone selects
-    the path under test."""
+    the path under test, plus the sampled/verify methods the speculative
+    phase drives. ``train_steps`` > 0 fits the LM to a deterministic
+    successor pattern (next = cur + 3 mod V) — the speculative A/B trains
+    a big target and a small draft on the SAME pattern so the measured
+    acceptance rate reflects a draft that actually tracks its target."""
     import jax
 
     from rafiki_tpu.models import lm
     from rafiki_tpu.sdk.model import BaseModel, GenerationSpec
 
-    cfg = lm.tiny(vocab=256, max_len=_GEN_BENCH_CONTEXT, dim=64, depth=2,
-                  heads=4)
-    params = lm.init(jax.random.PRNGKey(0), cfg)
+    cfg = lm.tiny(vocab=256, max_len=_GEN_BENCH_CONTEXT, dim=dim,
+                  depth=depth, heads=heads)
+    params = lm.init(jax.random.PRNGKey(seed), cfg)
+    if train_steps:
+        import jax.numpy as jnp
+        import optax
+
+        # full coverage of the successor rule next = cur + 3 (mod 256):
+        # the +3 orbit has period 256 (gcd(3, 256) = 1), so rows tracing
+        # ~144-token arcs from starts 32 apart contain every (cur, next)
+        # pair. Rows span the FULL serving context (decode positions the
+        # model never trained at otherwise fall back to positional
+        # noise) and open with a loss-masked random prefix of varying
+        # length, teaching the rule robust to the random prompt prefixes
+        # the serving phases send — target and draft must agree
+        # token-for-token or the speculative accept test has nothing to
+        # accept
+        drng = np.random.default_rng(123)
+        seq = _GEN_BENCH_CONTEXT
+        rows, masks = [], []
+        for r in range(16):
+            # leads span the serving phases' 8-96-token random prompts —
+            # a rollout's first steps see exactly this context shape
+            lead = int(drng.integers(0, 97))
+            pat = (3 * (16 * r + np.arange(seq - lead)) + 2) % 256
+            rows.append(np.concatenate(
+                [drng.integers(1, 250, size=lead), pat]))
+            mrow = np.ones(seq, np.float32)
+            mrow[:lead + 1] = 0.0   # no loss across the prefix boundary
+            masks.append(mrow)
+        ids = jnp.asarray(np.stack(rows).astype(np.int32))
+        batch = (ids, jnp.asarray(np.stack(masks)))
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+        grad = jax.jit(jax.grad(
+            lambda p, r: lm.loss_fn(p, batch, r, cfg)[0]))
+        for step in range(train_steps):
+            updates, opt_state = opt.update(
+                grad(params, jax.random.PRNGKey(step)), opt_state)
+            params = optax.apply_updates(params, updates)
     buckets = (32, 64, 128, _GEN_BENCH_CONTEXT)
 
     class _BenchLM(BaseModel):
@@ -965,6 +1007,44 @@ def _make_gen_bench_lm():
         def kv_copy_blocks(self, cache, src, dst):
             return self._jit_copy(cache, src, dst)
 
+        def decode_step_sampled(self, cache, ids, positions, sampling):
+            if getattr(self, "_jit_sampled", None) is None:
+                self._jit_sampled = jax.jit(
+                    lambda c, i, p, s: lm.decode_step_sampled(
+                        params, c, i, p, s, cfg))
+            return self._jit_sampled(cache, ids, positions, sampling)
+
+        def decode_steps_sampled(self, cache, ids, positions, k, sampling):
+            jits = getattr(self, "_jit_multi", None)
+            if jits is None:
+                jits = self._jit_multi = {}
+            if k not in jits:
+                jits[k] = jax.jit(
+                    lambda c, i, p, s: lm.decode_steps_sampled(
+                        params, c, i, p, k, s, cfg))
+            return jits[k](cache, ids, positions, sampling)
+
+        def paged_decode_step_sampled(self, cache, ids, positions,
+                                      block_tables, sampling):
+            if getattr(self, "_jit_paged_sampled", None) is None:
+                self._jit_paged_sampled = jax.jit(
+                    lambda c, i, p, bt, s: lm.paged_decode_step_sampled(
+                        params, c, i, p, bt, s, cfg))
+            return self._jit_paged_sampled(
+                cache, ids, positions,
+                np.asarray(block_tables, np.int32), sampling)
+
+        def paged_verify_step(self, cache, ids, positions, block_tables,
+                              draft_probs, sampling):
+            if getattr(self, "_jit_verify", None) is None:
+                self._jit_verify = jax.jit(
+                    lambda c, i, p, bt, q, s: lm.paged_verify_step(
+                        params, c, i, p, bt, q, s, cfg))
+            return self._jit_verify(
+                cache, ids, positions,
+                np.asarray(block_tables, np.int32), draft_probs,
+                sampling)
+
     return _BenchLM()
 
 
@@ -985,7 +1065,10 @@ def _mixed_prompt(rng, shared_prefix):
 
 def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
                            prefix: str = "serving_generate",
-                           paged: Optional[bool] = None) -> dict:
+                           paged: Optional[bool] = None,
+                           spec: Optional[bool] = None,
+                           model_factory=None,
+                           draft_factory=None) -> dict:
     """Generative serving phase (docs/serving-generation.md): N concurrent
     streaming clients at the MIXED short/long prompt distribution drive a
     real PredictorServer /generate -> Predictor -> InProcessBroker ->
@@ -994,7 +1077,10 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
     tokens/s, mean occupancy of the binding resource (KV blocks when
     paged, slots otherwise), and — under the paged allocator — the pool
     footprint and prefix-cache hit rate. ``paged`` pins
-    RAFIKI_GEN_KV_PAGED for an A/B leg; None serves at ambient config.
+    RAFIKI_GEN_KV_PAGED and ``spec`` pins RAFIKI_GEN_SPEC for an A/B
+    leg; None serves at ambient config. ``model_factory`` overrides the
+    served LM and ``draft_factory`` injects a speculative draft (the
+    speculative phase trains a matched target/draft pair).
     Deployment-free on purpose, same layers as production serving."""
     import threading as _threading
 
@@ -1011,6 +1097,10 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
     env_prev = os.environ.get("RAFIKI_GEN_KV_PAGED")
     if paged is not None:
         os.environ["RAFIKI_GEN_KV_PAGED"] = "1" if paged else "0"
+    spec_prev = os.environ.get("RAFIKI_GEN_SPEC")
+    if spec is not None:
+        os.environ["RAFIKI_GEN_SPEC"] = "1" if spec else "0"
+    make_model = model_factory or _make_gen_bench_lm
 
     class _Ctx:
         service_id = f"{prefix}-w1"
@@ -1023,7 +1113,9 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
     job = f"genbench-{prefix}"
     broker = InProcessBroker()
     worker = GenerationWorker(job, "t1", db=None, broker=broker)
-    worker._load_model = lambda sid: _make_gen_bench_lm()
+    worker._load_model = lambda sid: make_model()
+    if draft_factory is not None:
+        worker._load_draft_model = lambda sid: draft_factory()
     ctx = _Ctx()
     wt = _threading.Thread(target=worker.start, args=(ctx,), daemon=True)
     wt.start()
@@ -1039,9 +1131,9 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
         res_lock = _threading.Lock()
         shared_prefix = list(range(1, 17))
 
-        def client(seed: int):
+        def client(seed: int, warm_prompt=None):
             rng = np.random.default_rng(seed)
-            prompt = _mixed_prompt(rng, shared_prefix)
+            prompt = warm_prompt or _mixed_prompt(rng, shared_prefix)
             budget = min(max_tokens,
                          _GEN_BENCH_CONTEXT - len(prompt) - 1)
             t0 = time.monotonic()
@@ -1070,8 +1162,12 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
                                      time.monotonic() - t0))
                             return
 
-        # untimed warm-up stream: compiles prefill + decode programs
-        client(0)
+        # untimed warm-up streams: compile the decode/verify programs AND
+        # both prefill buckets the mixed distribution hits (short chat,
+        # long document) — a bucket first seen mid-phase would bill its
+        # compile to a timed client's TTFT
+        client(0, warm_prompt=[int(t) for t in range(3, 15)])
+        client(0, warm_prompt=[int(t) % 250 + 1 for t in range(90)])
         threads = [_threading.Thread(target=client, args=(i + 1,),
                                      daemon=True)
                    for i in range(n_clients)]
@@ -1118,6 +1214,19 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
                 f"{prefix}_prefix_hit_tokens": st["prefix_hit_tokens"],
                 f"{prefix}_cow_copies": st["cow_copies"],
             })
+        out[f"{prefix}_spec_on"] = bool(getattr(worker, "_spec_on",
+                                                False))
+        proposed = getattr(worker, "_spec_proposed", 0)
+        if proposed:
+            out.update({
+                f"{prefix}_spec_rounds": getattr(worker, "_spec_rounds",
+                                                 0),
+                f"{prefix}_spec_proposed": proposed,
+                f"{prefix}_spec_accepted": getattr(
+                    worker, "_spec_accepted", 0),
+                f"{prefix}_spec_acceptance_rate": round(
+                    getattr(worker, "_spec_accepted", 0) / proposed, 3),
+            })
         return out
     finally:
         ctx.stopping = True
@@ -1129,6 +1238,51 @@ def bench_serving_generate(n_clients: int = 4, max_tokens: int = 48,
                 os.environ.pop("RAFIKI_GEN_KV_PAGED", None)
             else:
                 os.environ["RAFIKI_GEN_KV_PAGED"] = env_prev
+        if spec is not None:
+            if spec_prev is None:
+                os.environ.pop("RAFIKI_GEN_SPEC", None)
+            else:
+                os.environ["RAFIKI_GEN_SPEC"] = spec_prev
+
+
+def bench_serving_generate_spec(n_clients: int = 4,
+                                max_tokens: int = 64) -> dict:
+    """Speculative decoding A/B (docs/serving-generation.md "Speculative
+    decoding & sampling"): the SAME trained target LM served twice over
+    the paged plane — once with a quarter-size draft (trained on the
+    same successor pattern, so it actually tracks its target) proposing
+    RAFIKI_GEN_SPEC_K tokens per round for one fixed-shape verify
+    forward, once plain. Reports both legs' tokens/s + TTFT p50/p95,
+    the measured acceptance rate, and the headline speedup — the claim
+    is >= 1.5x aggregate tokens/s at default knobs on CPU.
+
+    Both models are trained EAGERLY here, before any worker exists: a
+    lazy factory would train inside the worker thread while the warmup
+    client's door timeout silently expires, and the timed phase would
+    then bill the tail of training as TTFT."""
+    target = _make_gen_bench_lm(train_steps=400)
+    draft = _make_gen_bench_lm(dim=32, depth=1, heads=2,
+                               train_steps=400, seed=1)
+
+    def target_factory():
+        return target
+
+    def draft_factory():
+        return draft
+
+    out = bench_serving_generate(
+        n_clients=n_clients, max_tokens=max_tokens,
+        prefix="serving_generate_spec", paged=True, spec=True,
+        model_factory=target_factory, draft_factory=draft_factory)
+    out.update(bench_serving_generate(
+        n_clients=n_clients, max_tokens=max_tokens,
+        prefix="serving_generate_nospec", paged=True, spec=False,
+        model_factory=target_factory))
+    st = out.get("serving_generate_spec_tokens_s")
+    pt = out.get("serving_generate_nospec_tokens_s")
+    if st and pt:
+        out["serving_generate_spec_speedup"] = round(st / pt, 3)
+    return out
 
 
 def bench_kv_capacity(prefix: str = "serving_generate") -> dict:
@@ -1903,6 +2057,9 @@ def main():
                     serving.update(bench_kv_capacity())
                     # chunked-prefill long-prompt-join latency drill
                     serving.update(bench_gen_join_drill())
+                    # speculative decoding A/B: draft-verify vs plain
+                    # paged decode, same trained target, same prompts
+                    serving.update(bench_serving_generate_spec())
                 except Exception as e:
                     serving["serving_generate_error"] = repr(e)
             admin.stop_all_jobs()

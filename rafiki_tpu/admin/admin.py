@@ -1361,6 +1361,9 @@ class Admin:
                 "kv_blocks_used": 0, "kv_pool_blocks": 0,
                 "prefix_hits": 0, "prefix_misses": 0,
                 "prefix_hit_tokens": 0,
+                "spec_workers": 0, "spec_proposed": 0,
+                "spec_accepted": 0, "spec_rounds": 0,
+                "spec_degraded": [],
             })
             g["workers"] += 1
             g["slots_busy"] += int(s.get("gen_slots_busy", 0))
@@ -1371,11 +1374,23 @@ class Admin:
             g["prefix_misses"] += int(s.get("gen_prefix_misses", 0))
             g["prefix_hit_tokens"] += int(
                 s.get("gen_prefix_hit_tokens", 0))
+            # speculative decoding picture (worker/generation.py): the
+            # acceptance rate is the lever's health signal — a low rate
+            # means the draft earns its k forward passes back rarely
+            g["spec_workers"] += 1 if s.get("gen_spec_on") else 0
+            g["spec_proposed"] += int(s.get("gen_spec_proposed", 0))
+            g["spec_accepted"] += int(s.get("gen_spec_accepted", 0))
+            g["spec_rounds"] += int(s.get("gen_spec_rounds", 0))
+            if s.get("gen_spec_degraded"):
+                g["spec_degraded"].append(str(s["gen_spec_degraded"]))
         for g in generation.values():
             admitted = g["prefix_hits"] + g["prefix_misses"]
             g["prefix_hit_rate"] = (
                 round(g["prefix_hits"] / admitted, 3) if admitted
                 else None)
+            g["spec_acceptance_rate"] = (
+                round(g["spec_accepted"] / g["spec_proposed"], 3)
+                if g["spec_proposed"] else None)
         # training-plane fault picture (docs/failure-model.md,
         # "Training-plane faults"): per-job fault-kind counters and
         # absorbed retries from the STORE (covers every placement mode),
@@ -1527,10 +1542,19 @@ class Admin:
                                      "gen_kv_block_tokens",
                                      "gen_prefix_hits",
                                      "gen_prefix_misses",
-                                     "gen_prefix_hit_tokens")
+                                     "gen_prefix_hit_tokens",
+                                     "gen_spec_proposed",
+                                     "gen_spec_accepted",
+                                     "gen_spec_rounds")
+                           if k in payload},
+                        **{k: payload[k]
+                           for k in ("gen_spec_on",)
                            if k in payload},
                         **({"gen_job": str(payload["gen_job"])}
                            if "gen_job" in payload else {}),
+                        **({"gen_spec_degraded":
+                            str(payload["gen_spec_degraded"])}
+                           if "gen_spec_degraded" in payload else {}),
                     }
                     self._remote_serving_stats.move_to_end(sid)
                     while (len(self._remote_serving_stats)

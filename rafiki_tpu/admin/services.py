@@ -32,6 +32,7 @@ from rafiki_tpu.constants import (
     ServiceType,
     TaskType,
     TrainJobStatus,
+    TrialStatus,
 )
 from rafiki_tpu.db.database import Database
 from rafiki_tpu.placement.manager import (
@@ -403,6 +404,41 @@ class ServicesManager:
                 "budget ENSEMBLE_FUSED is unsupported for TEXT_GENERATION "
                 "jobs: a token stream answers from one model, not a fused "
                 "cross-trial ensemble — drop ENSEMBLE_FUSED")
+        # Speculative decoding (budget GEN_DRAFT_TRIAL): the named draft
+        # trial must exist, be COMPLETED, and be generation-capable — a
+        # bad draft is a typed deploy error HERE, never a worker-boot
+        # crash that takes the whole serving fleet down with it.
+        draft_tid = budget.get(BudgetType.GEN_DRAFT_TRIAL)
+        if draft_tid:
+            if not generative:
+                self._db.mark_inference_job_as_errored(inference_job_id)
+                raise ServiceDeploymentError(
+                    "budget GEN_DRAFT_TRIAL is only meaningful for "
+                    "TEXT_GENERATION jobs — drop it, or deploy a "
+                    "generative train job")
+            draft_trial = self._db.get_trial(str(draft_tid))
+            if draft_trial is None:
+                self._db.mark_inference_job_as_errored(inference_job_id)
+                raise ServiceDeploymentError(
+                    f"budget GEN_DRAFT_TRIAL names unknown trial "
+                    f"{draft_tid!r}")
+            if draft_trial.get("status") != TrialStatus.COMPLETED:
+                self._db.mark_inference_job_as_errored(inference_job_id)
+                raise ServiceDeploymentError(
+                    f"budget GEN_DRAFT_TRIAL trial {draft_tid!r} is "
+                    f"{draft_trial.get('status')}, not COMPLETED — a "
+                    "draft model needs trained params to propose tokens")
+            draft_model = self._db.get_model(draft_trial["model_id"])
+            from rafiki_tpu.admin.admin import Admin
+
+            if draft_model is None \
+                    or not Admin._model_generation_capable(draft_model):
+                self._db.mark_inference_job_as_errored(inference_job_id)
+                raise ServiceDeploymentError(
+                    f"budget GEN_DRAFT_TRIAL trial {draft_tid!r} is not "
+                    "generation-capable — the draft must implement the "
+                    "generation contract (init_kv_cache/prefill/"
+                    "decode_step) plus decode_step_sampled")
         if fused:
             from rafiki_tpu.sdk.sandbox import sandbox_enabled
 

@@ -611,6 +611,21 @@ PAGED_GENERATION_SIGNATURES = {
     "kv_copy_blocks": 4,       # (self, cache, src, dst)
 }
 
+#: OPTIONAL sampling + speculative-decoding refinement (sdk/model.py
+#: GENERATION_SAMPLING_METHODS / GENERATION_SPEC_METHODS): arity-checked
+#: only when overridden — absence means greedy-only / plain-decode serving
+SAMPLING_GENERATION_SIGNATURES = {
+    "decode_step_sampled": 5,        # (self, cache, ids, positions,
+                                     #  sampling)
+    "decode_steps_sampled": 6,       # (self, cache, ids, positions, k,
+                                     #  sampling) — optional fused
+                                     #  draft-proposal burst
+    "paged_decode_step_sampled": 6,  # (self, cache, ids, positions,
+                                     #  tables, sampling)
+    "paged_verify_step": 7,          # (self, cache, ids, positions,
+                                     #  tables, draft_probs, sampling)
+}
+
 
 def _check_generation(
         report: VerificationReport, target: ast.ClassDef,
@@ -660,8 +675,12 @@ def _check_generation(
                    lineno)
         return None
     to_check = dict(GENERATION_SIGNATURES)
-    # the paged refinement is opt-in: only overridden methods are checked
+    # the paged/sampling refinements are opt-in: only overridden methods
+    # are checked
     to_check.update({m: n for m, n in PAGED_GENERATION_SIGNATURES.items()
+                     if m in methods})
+    to_check.update({m: n
+                     for m, n in SAMPLING_GENERATION_SIGNATURES.items()
                      if m in methods})
     for mname, n_args in to_check.items():
         fn = methods[mname]

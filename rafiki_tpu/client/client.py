@@ -513,7 +513,11 @@ class Client:
 
     def generate(self, app: str, prompt_ids: List[int],
                  max_tokens: Optional[int] = None, app_version: int = -1,
-                 timeout_s: Optional[float] = None, binary: bool = False):
+                 timeout_s: Optional[float] = None, binary: bool = False,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None):
         """Stream a ``TEXT_GENERATION`` completion token-by-token through
         the app's dedicated predictor door (POST /generate, chunked
         transfer). Yields one delta dict per emitted increment —
@@ -526,7 +530,13 @@ class Client:
         ignore the Accept header still answer JSON — the frame sniff
         handles either). A typed terminal error frame (mid-stream worker
         fault, stalled decode) raises :class:`GenerationStreamError`
-        after yielding every token received before the fault."""
+        after yielding every token received before the fault.
+
+        ``temperature`` / ``top_k`` / ``top_p`` turn on real sampling
+        (temperature=0 or unset = greedy); a fixed ``seed`` makes the
+        sampled stream reproducible — and the platform keeps it stable
+        across mid-stream preemption/resume, so the sequence is exactly
+        the uncontended one either way."""
         key = (app, app_version)
         host, port, _ = self._dedicated_door(app, app_version)
         headers = {}
@@ -537,6 +547,14 @@ class Client:
             body["max_tokens"] = int(max_tokens)
         if timeout_s is not None:
             body["timeout_s"] = float(timeout_s)
+        if temperature is not None:
+            body["temperature"] = float(temperature)
+        if top_k is not None:
+            body["top_k"] = int(top_k)
+        if top_p is not None:
+            body["top_p"] = float(top_p)
+        if seed is not None:
+            body["seed"] = int(seed)
         if binary:
             from rafiki_tpu.cache import wire
 

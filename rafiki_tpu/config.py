@@ -302,7 +302,31 @@ _DYNAMIC_PATHS = {
     #                                   decode rounds instead of stalling
     #                                   resident streams (0 = one-shot
     #                                   prefill)
+    #   RAFIKI_GEN_SAMPLING=1           0 = greedy-only serving: requests
+    #                                   carrying temperature/top_k/top_p/
+    #                                   seed get a typed 400 instead of a
+    #                                   silent greedy answer (kill switch)
+    #   RAFIKI_GEN_SPEC=1               0 = never speculate; 1 = draft-
+    #                                   verify speculative decoding on the
+    #                                   paged path whenever the job has a
+    #                                   draft model (GEN_DRAFT_TRIAL
+    #                                   budget) and the template verifies
+    #   RAFIKI_GEN_SPEC_K=4             draft tokens proposed per round;
+    #                                   the verify forward is k+1 wide,
+    #                                   so k also sizes the per-round KV
+    #                                   write burst (doctor WARNs past 8)
+    #   RAFIKI_GEN_SPEC_MIN_RATE=0.3    acceptance rate below which the
+    #                                   doctor reads "the draft is not
+    #                                   earning its keep" (observability
+    #                                   threshold only — serving never
+    #                                   auto-disables on it)
     "GEN_MAX_SLOTS": lambda: _env_int("RAFIKI_GEN_MAX_SLOTS", 8),
+    "GEN_SAMPLING": lambda: os.environ.get(
+        "RAFIKI_GEN_SAMPLING", "1") != "0",
+    "GEN_SPEC": lambda: os.environ.get("RAFIKI_GEN_SPEC", "1") != "0",
+    "GEN_SPEC_K": lambda: _env_int("RAFIKI_GEN_SPEC_K", 4),
+    "GEN_SPEC_MIN_RATE": lambda: _env_float(
+        "RAFIKI_GEN_SPEC_MIN_RATE", 0.3),
     "GEN_KV_PAGED": lambda: os.environ.get(
         "RAFIKI_GEN_KV_PAGED", "1") != "0",
     "GEN_KV_BLOCK_TOKENS": lambda: _env_int(

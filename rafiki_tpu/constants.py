@@ -61,6 +61,14 @@ class BudgetType:
     # them sequentially in-process. Passed in create_inference_job's
     # budget.
     ENSEMBLE_FUSED = "ENSEMBLE_FUSED"
+    # Speculative decoding (generation jobs only): the trial id of a small
+    # DRAFT language model that proposes k tokens per scheduler round for
+    # the deployed target to verify in one fixed-shape forward
+    # (docs/serving-generation.md "Speculative decoding & sampling").
+    # Passed in create_inference_job's budget; validated at deploy time
+    # (the trial must exist and be generation-capable) and loaded by every
+    # generation worker of the job.
+    GEN_DRAFT_TRIAL = "GEN_DRAFT_TRIAL"
 
 
 class TaskType:

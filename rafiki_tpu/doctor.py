@@ -900,6 +900,146 @@ def check_generative_serving() -> Check:
     return ("generative serving", PASS, detail)
 
 
+#: speculative lookahead past which the draft's k proposals rarely all
+#: land — each extra position costs draft compute AND verify width, and
+#: acceptance decays geometrically with depth
+GEN_SPEC_K_HEURISTIC = 8
+
+
+def check_speculative_decoding() -> Check:
+    """Speculative decoding (docs/serving-generation.md "Speculative
+    decoding & sampling"): WARN when RAFIKI_GEN_SPEC is on without the
+    paged plane it lives on, when RAFIKI_GEN_SPEC_K is outside the sane
+    1..8 window, when a RUNNING generation job budgets a GEN_DRAFT_TRIAL
+    whose template is not generation-capable or whose max_context trails
+    the target's (long streams silently drop out of speculation), when a
+    worker reports speculation DEGRADED (gen_spec_degraded in its stats
+    row names the fault), and when the measured acceptance rate sits
+    under RAFIKI_GEN_SPEC_MIN_RATE — a draft that rarely earns its k
+    proposals back is pure overhead."""
+    from rafiki_tpu import config
+
+    notes = []
+    warn = False
+    spec_on = bool(config.GEN_SPEC)
+    k = int(config.GEN_SPEC_K)
+    if spec_on and not bool(config.GEN_KV_PAGED):
+        warn = True
+        notes.append(
+            "RAFIKI_GEN_SPEC=1 with RAFIKI_GEN_KV_PAGED=0: speculation "
+            "verifies through paged_verify_step on the paged plane — "
+            "workers will serve plain ring decode")
+    if spec_on and not (1 <= k <= GEN_SPEC_K_HEURISTIC):
+        warn = True
+        notes.append(
+            f"RAFIKI_GEN_SPEC_K={k} is outside 1..{GEN_SPEC_K_HEURISTIC}:"
+            " acceptance decays geometrically with lookahead depth, so "
+            "deep drafts burn proposal compute the verify step rejects")
+    drafted = 0
+    target = str(config.DB_PATH)
+    is_url = target.startswith(("postgresql://", "postgres://"))
+    if spec_on and (is_url or os.path.exists(target)):
+        try:
+            from rafiki_tpu import analysis
+            from rafiki_tpu.constants import BudgetType
+            from rafiki_tpu.db.database import Database
+
+            db = Database(target)
+            try:
+                for inf in db.get_inference_jobs_by_statuses(["RUNNING"]):
+                    tj = db.get_train_job(inf["train_job_id"])
+                    if not tj or tj["task"] != "TEXT_GENERATION":
+                        continue
+                    draft_tid = (inf.get("budget") or {}).get(
+                        BudgetType.GEN_DRAFT_TRIAL)
+                    if not draft_tid:
+                        continue
+                    drafted += 1
+                    trial = db.get_trial(str(draft_tid))
+                    model = (db.get_model(trial["model_id"])
+                             if trial else None)
+                    if model is None:
+                        warn = True
+                        notes.append(
+                            f"gen job {inf['id'][:8]}: GEN_DRAFT_TRIAL "
+                            f"{str(draft_tid)[:8]} has no stored model")
+                        continue
+                    dspec = analysis.static_generation_capability(
+                        model["model_file_bytes"],
+                        model.get("model_class"))
+                    if dspec is None:
+                        warn = True
+                        notes.append(
+                            f"gen job {inf['id'][:8]}: draft trial "
+                            f"{str(draft_tid)[:8]}'s template is not "
+                            "generation-capable — its workers degrade "
+                            "to plain decode at boot")
+                        continue
+                    # the TARGET's context: the job's best trial's model
+                    best = db.get_best_trials_of_train_job(
+                        tj["id"], max_count=1)
+                    tmodel = (db.get_model(best[0]["model_id"])
+                              if best else None)
+                    tspec = (analysis.static_generation_capability(
+                        tmodel["model_file_bytes"],
+                        tmodel.get("model_class"))
+                        if tmodel is not None else None)
+                    if tspec and int(dspec.get("max_context", 0)) \
+                            < int(tspec.get("max_context", 0)):
+                        warn = True
+                        notes.append(
+                            f"gen job {inf['id'][:8]}: draft max_context "
+                            f"{dspec.get('max_context')} < target "
+                            f"{tspec.get('max_context')} — streams past "
+                            "the draft's horizon drop out of speculation "
+                            "and decode plain")
+            finally:
+                db.close()
+        # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+        except Exception as e:
+            return ("speculative decoding", WARN,
+                    f"could not scan {target}: {type(e).__name__}: {e}")
+    # live worker verdicts: degradations + the measured acceptance rate
+    try:
+        from rafiki_tpu.utils.metrics import REGISTRY
+        from rafiki_tpu.worker.inference import SERVING_STATS, _stats_lock
+
+        with _stats_lock:
+            degraded = sorted({
+                str(row["gen_spec_degraded"])
+                for row in SERVING_STATS.values()
+                if row.get("gen_spec_degraded")})
+        if degraded:
+            warn = True
+            notes.append("speculation DEGRADED on live worker(s): "
+                         + "; ".join(degraded))
+        prop = REGISTRY.get("rafiki_gen_spec_proposed_total")
+        acc = REGISTRY.get("rafiki_gen_spec_accepted_total")
+        proposed = prop.value() if prop else 0
+        accepted = acc.value() if acc else 0
+        min_rate = float(config.GEN_SPEC_MIN_RATE)
+        if proposed >= 200 and accepted / proposed < min_rate:
+            warn = True
+            notes.append(
+                f"acceptance rate {accepted / proposed:.2f} < "
+                f"RAFIKI_GEN_SPEC_MIN_RATE={min_rate:g} over "
+                f"{int(proposed)} proposals: the draft disagrees with "
+                "the target too often to pay for itself — use a draft "
+                "distilled from the target, or lower RAFIKI_GEN_SPEC_K")
+    # lint: absorb(telemetry probe is best-effort inside a doctor check)
+    except Exception:
+        pass
+    if warn:
+        return ("speculative decoding", WARN, "; ".join(notes))
+    if not spec_on:
+        return ("speculative decoding", PASS,
+                "RAFIKI_GEN_SPEC=0 (plain decode)")
+    detail = f"on, k={k}"
+    if drafted:
+        detail += f"; {drafted} live job(s) budget a draft trial"
+    return ("speculative decoding", PASS, detail)
+
+
 #: prediction-cache byte cap past which the doctor reads "this cache
 #: will contend with the models for host memory" — results live in the
 #: admin process's RAM beside every Predictor, door, and broker ring
@@ -1382,6 +1522,7 @@ CHECKS: List[Callable[[], Check]] = [
     check_vectorized_trials,
     check_static_analysis, check_concurrency_lint,
     check_int8_serving, check_generative_serving,
+    check_speculative_decoding,
     check_prediction_cache,
     check_observability, check_agents, check_backend,
 ]

@@ -375,6 +375,265 @@ def greedy_token(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+# -- sampling + speculative verify (draft/verify decoding) -------------------
+#
+# Real sampling (temperature / top-k / top-p) with a COUNTER-BASED key
+# discipline: every random draw for a stream is keyed by
+# ``fold_in(fold_in(PRNGKey(seed), token_position), role)`` — a pure
+# function of (stream seed, absolute position, draw kind), never of
+# wall-clock state or round boundaries. That is what keeps sampled streams
+# exactly resumable after preemption (worker/generation.py resumes a
+# stream by re-prefilling its committed history; the keys for every future
+# position are unchanged) and makes speculative rejection-sampling
+# well-defined. temperature <= 0 collapses the modified distribution to a
+# one-hot argmax, so the greedy path is reproduced bit-identically.
+#
+# Roles (the third fold_in operand): distinct draw kinds at the same
+# position must not share a key, or the accept test would be correlated
+# with the proposal it judges.
+
+ROLE_TARGET = 0  # a draw from the target's (modified) distribution
+ROLE_DRAFT = 1   # the draft model's proposal draw
+ROLE_ACCEPT = 2  # the speculative accept/reject uniform
+
+
+def _uniform_at(seeds: jax.Array, positions: jax.Array,
+                role) -> jax.Array:
+    """One uniform in [0, 1) per entry of ``positions``, keyed by the
+    counter discipline above. ``seeds``: (S,) uint32 per-slot stream
+    seeds; ``positions``: (S,) or (S, T) int32 absolute token positions."""
+    seeds = jnp.asarray(seeds, jnp.uint32)
+    positions = jnp.asarray(positions, jnp.int32)
+    shape = positions.shape
+    sb = jnp.broadcast_to(
+        seeds.reshape((-1,) + (1,) * (len(shape) - 1)), shape)
+
+    def one(seed, pos):
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), pos), role)
+        return jax.random.uniform(key)
+
+    return jax.vmap(one)(sb.reshape(-1), positions.reshape(-1)).reshape(shape)
+
+
+def modified_dist(logits: jax.Array, temperature, top_k, top_p) -> jax.Array:
+    """The temperature/top-k/top-p-modified sampling distribution.
+
+    ``logits``: (..., V) f32; the three knobs broadcast against the
+    leading shape (per-slot arrays on a batched step). top_k <= 0 and
+    top_p >= 1 disable their filters. Rows with temperature <= 0 return
+    the exact one-hot of ``argmax(logits)`` — sampling from that
+    distribution reproduces :func:`greedy_token` bit-identically, which
+    is the invariant speculative verify and preemption-resume rely on."""
+    head = logits.shape[:-1]
+    v = logits.shape[-1]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), head)
+    tk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), head)
+    tp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), head)
+    greedy = t <= 0.0
+    scaled = logits / jnp.where(greedy, 1.0, t)[..., None]
+    # top-k: keep each row's k largest logits (ties keep all equal values)
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k = jnp.clip(jnp.where(tk <= 0, v, tk), 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[..., None], axis=-1)
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    # top-p: smallest descending-sorted prefix covering mass top_p (the
+    # first token is always kept, so the filter never empties a row)
+    order = jnp.argsort(-probs, axis=-1)
+    sp = jnp.take_along_axis(probs, order, axis=-1)
+    keep_sorted = (jnp.cumsum(sp, axis=-1) - sp) < tp[..., None]
+    inv = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    probs = probs * keep
+    probs = probs / jnp.maximum(jnp.sum(probs, -1, keepdims=True), 1e-20)
+    onehot = jax.nn.one_hot(jnp.argmax(logits, axis=-1), v,
+                            dtype=jnp.float32)
+    return jnp.where(greedy[..., None], onehot, probs)
+
+
+def sample_from(probs: jax.Array, u: jax.Array) -> jax.Array:
+    """Inverse-CDF draw: the smallest index whose cumulative mass exceeds
+    ``u``. Exact on one-hot rows (returns the hot index for any u in
+    [0, 1)), which is what makes temperature=0 sampling ≡ argmax."""
+    c = jnp.cumsum(probs, axis=-1)
+    idx = jnp.sum((c <= u[..., None]).astype(jnp.int32), axis=-1)
+    return jnp.clip(idx, 0, probs.shape[-1] - 1).astype(jnp.int32)
+
+
+def _draw(logits: jax.Array, token_positions: jax.Array,
+          sampling: Dict[str, jax.Array]
+          ) -> Tuple[jax.Array, jax.Array]:
+    """(token ids, modified distribution) for a batched single-position
+    draw. ``token_positions`` are the ABSOLUTE positions the sampled
+    tokens will occupy (write position + 1) — the counter the keys fold."""
+    v = logits.shape[-1]
+
+    def _greedy(_):
+        am = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return am, jax.nn.one_hot(am, v, dtype=jnp.float32)
+
+    def _full(_):
+        probs = modified_dist(logits, sampling["temperature"],
+                              sampling["top_k"], sampling["top_p"])
+        u = _uniform_at(sampling["seed"], token_positions,
+                        sampling["role"])
+        return sample_from(probs, u), probs
+
+    # whole-batch greedy fast path: modified_dist at temperature<=0 IS
+    # onehot(argmax) and sample_from(onehot, u) IS the argmax for any u,
+    # so skipping the vocab sorts and counter-RNG draws cannot change a
+    # single emitted token — it only makes the common greedy table cheap
+    all_greedy = jnp.all(
+        jnp.asarray(sampling["temperature"], jnp.float32) <= 0.0)
+    return jax.lax.cond(all_greedy, _greedy, _full, None)
+
+
+def decode_step_sampled(params: Params, cache: Cache, ids: jax.Array,
+                        positions: jax.Array,
+                        sampling: Dict[str, jax.Array], cfg: LMConfig
+                        ) -> Tuple[jax.Array, jax.Array, Cache]:
+    """:func:`decode_step` + an in-graph sampled draw. Returns
+    (token ids (S,), modified distribution (S, V), cache) — the full
+    distribution is returned because a draft model's proposal q is the
+    denominator of the speculative accept test."""
+    logits, cache = decode_step(params, cache, ids, positions, cfg)
+    tok, probs = _draw(logits, jnp.asarray(positions, jnp.int32) + 1,
+                       sampling)
+    return tok, probs, cache
+
+
+def decode_steps_sampled(params: Params, cache: Cache, ids: jax.Array,
+                         positions: jax.Array, k: int,
+                         sampling: Dict[str, jax.Array], cfg: LMConfig
+                         ) -> Tuple[jax.Array, jax.Array, Cache]:
+    """``k`` chained :func:`decode_step_sampled` calls fused into ONE
+    program — the draft model's whole proposal burst per speculative
+    round. The worker's fallback is k separate jitted calls, each paying
+    dispatch plus a host sync to feed the sampled token back in; fusing
+    keeps the token feedback in-graph, which is most of a small draft's
+    per-round cost on dispatch-bound backends. ``k`` is static (the
+    spec-k knob is fixed for a deployment), so the loop unrolls. Returns
+    (tokens (S, k), modified distributions q (S, k, V), cache)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    positions = jnp.asarray(positions, jnp.int32)
+    toks, probs = [], []
+    for j in range(k):
+        ids, pj, cache = decode_step_sampled(params, cache, ids,
+                                             positions + j, sampling, cfg)
+        toks.append(ids)
+        probs.append(pj)
+    return jnp.stack(toks, axis=1), jnp.stack(probs, axis=1), cache
+
+
+def paged_decode_step_sampled(params: Params, cache: Cache, ids: jax.Array,
+                              positions: jax.Array, block_tables: jax.Array,
+                              sampling: Dict[str, jax.Array], cfg: LMConfig
+                              ) -> Tuple[jax.Array, jax.Array, Cache]:
+    """:func:`paged_decode_step` + an in-graph sampled draw (see
+    :func:`decode_step_sampled`)."""
+    logits, cache = paged_decode_step(params, cache, ids, positions,
+                                      block_tables, cfg)
+    tok, probs = _draw(logits, jnp.asarray(positions, jnp.int32) + 1,
+                       sampling)
+    return tok, probs, cache
+
+
+def paged_verify_step(params: Params, cache: Cache, ids: jax.Array,
+                      positions: jax.Array, block_tables: jax.Array,
+                      draft_probs: jax.Array,
+                      sampling: Dict[str, jax.Array], cfg: LMConfig
+                      ) -> Tuple[jax.Array, jax.Array, Cache]:
+    """Verify k drafted tokens per slot in ONE fixed-shape forward.
+
+    ``ids``: (S, k+1) int32 — column 0 is each slot's last committed
+    token, columns 1..k the draft's proposals; ``positions``: (S, k+1)
+    the write positions (frontier .. frontier+k); ``draft_probs``:
+    (S, k, V) the draft's modified distributions q. Rejection sampling
+    (Leviathan et al. / Chen et al.) runs in-graph per slot: draft token
+    d_j is accepted iff u_j * q(d_j) < p(d_j) (u_j keyed ROLE_ACCEPT at
+    d_j's position), the first rejection resamples from
+    norm(max(p - q, 0)), and a fully-accepted row draws a bonus token
+    from the k+1-th target distribution — so every round commits
+    accept_len + 1 tokens. Per-slot accept lengths are data, not shape:
+    mixed acceptance across resident streams never retraces.
+
+    temperature <= 0 rows degrade exactly to greedy: p is one-hot, so a
+    draft token is accepted iff it IS the argmax and every correction or
+    bonus draw returns the argmax — bit-identical to the plain greedy
+    decode loop.
+
+    The K/V written for rejected suffixes need no device-side rollback:
+    ``_cached_forward`` writes every new row before attention and the
+    causal mask bounds reads at the query's own position, so the next
+    round's writes overwrite any stale row before it can be attended.
+    Returns (accept_len (S,) int32, tokens (S, k+1) int32 — the committed
+    tokens left-packed, entries past accept_len are padding — cache)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    positions = jnp.asarray(positions, jnp.int32)
+    bts = jnp.asarray(block_tables, jnp.int32)
+    vk = _paged_view(cache["k"], bts)
+    vv = _paged_view(cache["v"], bts)
+    logits, ck, cv = _cached_forward(params, vk, vv, ids, positions, cfg)
+    cache = {"k": _scatter_rows(cache["k"], ck, bts, positions),
+             "v": _scatter_rows(cache["v"], cv, bts, positions)}
+    s, k1 = ids.shape
+    k = k1 - 1
+    d = ids[:, 1:]                                       # (S, k) proposals
+    jj = jnp.arange(k1, dtype=jnp.int32)[None, :]
+    d_pad = jnp.concatenate([d, jnp.zeros((s, 1), jnp.int32)], axis=1)
+
+    def _greedy(_):
+        # whole-batch greedy fast path: p is onehot(argmax), so the
+        # accept test collapses to d_j == argmax_j and every correction
+        # or bonus draw returns that position's argmax — provably the
+        # same tokens as the rejection-sampling branch, minus its vocab
+        # sorts and counter-RNG draws
+        am = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, k+1)
+        accept = d == am[:, :k]
+        a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=-1),
+                    axis=-1)
+        extra = jnp.take_along_axis(am, a[:, None], axis=1)
+        toks = jnp.where(jj < a[:, None], d_pad,
+                         jnp.where(jj == a[:, None], extra, 0))
+        return a.astype(jnp.int32), toks.astype(jnp.int32)
+
+    def _full(_):
+        temp = jnp.asarray(sampling["temperature"], jnp.float32)[:, None]
+        top_k = jnp.asarray(sampling["top_k"], jnp.int32)[:, None]
+        top_p = jnp.asarray(sampling["top_p"], jnp.float32)[:, None]
+        p = modified_dist(logits, temp, top_k, top_p)    # (S, k+1, V)
+        q = jnp.asarray(draft_probs, jnp.float32)        # (S, k, V)
+        p_head = p[:, :k, :]
+        p_d = jnp.take_along_axis(p_head, d[:, :, None], axis=-1)[..., 0]
+        q_d = jnp.take_along_axis(q, d[:, :, None], axis=-1)[..., 0]
+        u_acc = _uniform_at(sampling["seed"], positions[:, 1:],
+                            ROLE_ACCEPT)
+        accept = u_acc * q_d < p_d                       # u < min(1, p/q)
+        a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=-1),
+                    axis=-1)
+        # the replacement draw at every possible rejection point j < k ...
+        resid = jnp.maximum(p_head - q, 0.0)
+        rs = jnp.sum(resid, axis=-1, keepdims=True)
+        corr = jnp.where(rs > 1e-20, resid / jnp.maximum(rs, 1e-20),
+                         p_head)
+        # ... and the bonus distribution at j == k (all k accepted)
+        dist_all = jnp.concatenate([corr, p[:, k:k + 1, :]], axis=1)
+        xdist = jnp.take_along_axis(
+            dist_all, a[:, None, None], axis=1)[:, 0, :]
+        extra_pos = positions[:, 0] + a + 1
+        u_x = _uniform_at(sampling["seed"], extra_pos, ROLE_TARGET)
+        extra = sample_from(xdist, u_x)
+        toks = jnp.where(jj < a[:, None], d_pad,
+                         jnp.where(jj == a[:, None], extra[:, None], 0))
+        return a.astype(jnp.int32), toks.astype(jnp.int32)
+
+    all_greedy = jnp.all(
+        jnp.asarray(sampling["temperature"], jnp.float32) <= 0.0)
+    a, toks = jax.lax.cond(all_greedy, _greedy, _full, None)
+    return a, toks, cache
+
+
 def partition_specs(cfg: LMConfig) -> Params:
     return {
         "embed": {"table": P(None, "model")},

@@ -402,7 +402,10 @@ class PredictorServer:
     def _generate(self, handler: BaseHTTPRequestHandler) -> None:
         """POST /generate — the token-streaming door
         (docs/serving-generation.md). The request is one JSON object
-        ``{"prompt_ids": [...], "max_tokens": N, "timeout_s": T}``;
+        ``{"prompt_ids": [...], "max_tokens": N, "timeout_s": T}`` plus
+        optional sampling knobs ``temperature`` / ``top_k`` / ``top_p`` /
+        ``seed`` (temperature=0 = greedy; a fixed seed makes a sampled
+        stream reproducible — worker/generation.py validates them typed);
         the response is chunked transfer, one delta per chunk: JSON
         lines by default, or length-prefixed v3 wire token-delta frames
         when the client sent ``Accept: application/x-rafiki-wire``
@@ -470,6 +473,18 @@ class PredictorServer:
                     "error": "max_tokens must be an integer"})
             query = {"prompt_ids": body.get("prompt_ids"),
                      "max_tokens": max_tokens}
+            # sampling knobs ride the query to the worker, whose
+            # _parse_query owns full validation (typed
+            # GenerationRequestError -> 400 below); non-numeric junk is
+            # refused HERE so it never costs an admission slot
+            for key, cast in (("temperature", float), ("top_k", int),
+                              ("top_p", float), ("seed", int)):
+                if body.get(key) is not None:
+                    try:
+                        query[key] = cast(body[key])
+                    except (TypeError, ValueError):
+                        return self._respond(handler, 400, {
+                            "error": f"{key} must be a number"})
             backlog_fn = getattr(self.predictor, "backlog_depth", None)
             backlog = backlog_fn() if callable(backlog_fn) else None
             # cost = the estimated decode footprint, not 1 (see docstring)

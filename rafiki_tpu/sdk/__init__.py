@@ -35,9 +35,12 @@ from rafiki_tpu.sdk.model import (  # noqa: F401
     GenerationSpec,
     InvalidModelClassError,
     PopulationSpec,
+    draft_capability,
     generation_capability,
     load_model_class,
     population_capability,
+    sampling_capability,
+    spec_verify_capability,
     test_model_class,
     validate_model_dependencies,
 )

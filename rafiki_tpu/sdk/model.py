@@ -275,6 +275,45 @@ class BaseModel(abc.ABC):
         copy-on-write primitive (models/lm.py ``copy_kv_blocks``)."""
         raise NotImplementedError
 
+    # -- sampling + speculative decoding (opt-in refinements) ---------------
+
+    def decode_step_sampled(self, cache: Any, ids: Any, positions: Any,
+                            sampling: Any) -> Tuple[Any, Any, Any]:
+        """``decode_step`` with an in-graph temperature/top-k/top-p draw.
+
+        ``sampling`` is a dict of per-slot arrays — ``seed`` (uint32),
+        ``temperature`` (f32), ``top_k`` (int32, 0 = off), ``top_p``
+        (f32, 1.0 = off) — plus a scalar ``role`` (see models/lm.py
+        ``ROLE_*``). Every draw MUST be keyed
+        ``fold_in(fold_in(PRNGKey(seed), token_position), role)`` so
+        sampled streams resume exactly after preemption, and
+        temperature <= 0 MUST reproduce the greedy argmax bit-identically.
+        Returns ``(token_ids, probs, cache)`` where ``probs`` is the FULL
+        modified distribution per slot — a draft model's q, the
+        denominator of the speculative accept test."""
+        raise NotImplementedError
+
+    def paged_decode_step_sampled(self, cache: Any, ids: Any,
+                                  positions: Any, block_tables: Any,
+                                  sampling: Any) -> Tuple[Any, Any, Any]:
+        """``paged_decode_step`` with the same in-graph sampled draw and
+        key discipline as ``decode_step_sampled``."""
+        raise NotImplementedError
+
+    def paged_verify_step(self, cache: Any, ids: Any, positions: Any,
+                          block_tables: Any, draft_probs: Any,
+                          sampling: Any) -> Tuple[Any, Any, Any]:
+        """Verify k drafted tokens per slot in ONE fixed-shape forward
+        (models/lm.py ``paged_verify_step``). ``ids`` (S, k+1) carries
+        each slot's last committed token then the draft's k proposals,
+        ``positions`` (S, k+1) their write positions, ``draft_probs``
+        (S, k, V) the draft's modified distributions. Returns
+        ``(accept_len, tokens, cache)``: per-slot accepted-prefix lengths
+        (data, not shape — mixed acceptance never retraces) and the
+        committed tokens left-packed per row (accept_len + 1 of them:
+        accepted prefix plus the rejection-resample or bonus token)."""
+        raise NotImplementedError
+
     def ensemble_stack(self, models: List["BaseModel"]) -> Optional[Any]:
         """Optional fused-ensemble serving hook (budget ``ENSEMBLE_FUSED``).
 
@@ -364,6 +403,97 @@ def paged_generation_capability(clazz: type) -> Optional[GenerationSpec]:
     for name in GENERATION_PAGED_METHODS:
         if getattr(clazz, name, None) is getattr(BaseModel, name):
             return None
+    return spec
+
+
+#: counter-based RNG roles shared by every sampled draw (models/lm.py)
+ROLE_TARGET = 0
+ROLE_DRAFT = 1
+ROLE_ACCEPT = 2
+
+#: the sampled-decode methods (real temperature/top-k/top-p sampling).
+#: ``decode_step_sampled`` is the base requirement; paged-capable
+#: templates must also wire the paged variant or sampling stays off.
+GENERATION_SAMPLING_METHODS = ("decode_step_sampled",
+                               "paged_decode_step_sampled")
+
+#: the one extra method of the speculative-verify contract
+GENERATION_SPEC_METHODS = ("paged_verify_step",)
+
+
+def sampling_capability(clazz: type) -> Optional[GenerationSpec]:
+    """The template's :class:`GenerationSpec` iff real sampling is fully
+    wired: the base generation contract plus ``decode_step_sampled``, and
+    — when the template is paged-capable — ``paged_decode_step_sampled``
+    too (the worker serves whichever plane the template supports; a
+    sampled method the serving plane can't reach is half-wired). None
+    degrades to greedy-only serving: the worker turns a sampled request
+    against it into a typed request error, never a silent greedy answer."""
+    spec = generation_capability(clazz)
+    if spec is None:
+        return None
+    needed = ["decode_step_sampled"]
+    if paged_generation_capability(clazz) is not None:
+        needed.append("paged_decode_step_sampled")
+    import logging
+
+    for name in needed:
+        if getattr(clazz, name, None) is getattr(BaseModel, name):
+            logging.getLogger(__name__).warning(
+                "%s does not override %s(); template is NOT "
+                "sampling-capable — sampled requests will be refused",
+                clazz.__name__, name)
+            return None
+    return spec
+
+
+def draft_capability(clazz: type) -> Optional[GenerationSpec]:
+    """The template's :class:`GenerationSpec` iff it can serve as a
+    speculative DRAFT model: the base (ring) generation contract plus
+    ``decode_step_sampled`` — drafts propose through their own contiguous
+    ring (a small model's worst-case K/V is cheap) and must return their
+    full modified distribution q for the accept test.
+
+    A draft may ALSO provide ``decode_steps_sampled(cache, ids,
+    positions, k, sampling) -> (tokens (S, k), q (S, k, V), cache)`` —
+    the whole k-token proposal burst fused into one program. Optional
+    fast path, not part of the capability: the worker falls back to k
+    chained ``decode_step_sampled`` calls (each paying dispatch plus a
+    host sync) when it is absent."""
+    spec = generation_capability(clazz)
+    if spec is None:
+        return None
+    if getattr(clazz, "decode_step_sampled", None) is \
+            getattr(BaseModel, "decode_step_sampled"):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s does not override decode_step_sampled(); template cannot "
+            "serve as a speculative draft model", clazz.__name__)
+        return None
+    return spec
+
+
+def spec_verify_capability(clazz: type) -> Optional[GenerationSpec]:
+    """The template's :class:`GenerationSpec` iff it can serve as a
+    speculative TARGET: paged-capable, sampling-capable, and
+    ``paged_verify_step`` overridden. None degrades the worker to plain
+    paged decode (a safe fallback, surfaced by the doctor's speculative-
+    decoding check and the worker's ``gen_spec_degraded`` stats field)."""
+    spec = paged_generation_capability(clazz)
+    if spec is None:
+        return None
+    if sampling_capability(clazz) is None:
+        return None
+    if getattr(clazz, "paged_verify_step", None) is \
+            getattr(BaseModel, "paged_verify_step"):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s does not override paged_verify_step(); template cannot "
+            "verify speculative drafts — serving plain paged decode",
+            clazz.__name__)
+        return None
     return spec
 
 

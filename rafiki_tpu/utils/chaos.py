@@ -108,6 +108,12 @@ SITE_DB = "db"
 # decode drill the door's inter-token timeout must convert into a typed
 # error frame, never a silent hang — and `delay` slows the whole step
 # (a slow decode) — docs/serving-generation.md "Chaos drills".
+# A second target shape lives at this site: "draft/{job_id}/{service_id}"
+# is asked once per speculative round BEFORE the draft proposes. `delay`
+# slows the round, `drop` skips speculation for that round (plain
+# decode), `error` permanently degrades the worker to plain decode with
+# a typed reason (gen_spec_degraded) — the crashing/stalling-draft drill:
+# a broken draft model must cost throughput, never correctness.
 SITE_GENERATE = "generate"
 # inference-replica placement chokepoint (admin/services.py — the
 # shared _chaos_deploy ask inside create_inference_services,

@@ -43,6 +43,22 @@ offer:
   from a fresh prefill of its tokens-so-far — greedy decode makes the
   continuation exact) instead of failing a round.
 
+On top of the paged plane, **speculative decoding** (``RAFIKI_GEN_SPEC``;
+a draft trial budgeted as ``GEN_DRAFT_TRIAL``) multiplies tokens per
+round: a small draft LM proposes ``RAFIKI_GEN_SPEC_K`` tokens per
+scheduler round and the target verifies all k+1 positions in ONE
+fixed-shape ``paged_verify_step`` forward — per-slot accept lengths are
+data, not shape, so mixed acceptance across resident streams never
+retraces. **Real sampling** (temperature / top-k / top-p,
+``RAFIKI_GEN_SAMPLING``) rides the same plane under a counter-based RNG
+key — every draw is keyed by (stream seed, absolute token position, draw
+role) — which keeps sampled streams exactly resumable through the
+preemption path above and makes the speculative accept test
+well-defined; temperature=0 reproduces the greedy path bit-identically.
+A draft fault (crash, stall, vocab mismatch) degrades the worker to
+plain decode TYPED: resident streams keep their tokens/s floor and
+``gen_spec_degraded`` in the stats row names the reason.
+
 Observability: time-to-first-token and inter-token-latency histograms,
 a slot-occupancy gauge + per-job ring (the autoscaler's generative
 backlog signal — BLOCK-pool occupancy under the paged layout, busy
@@ -66,10 +82,16 @@ import numpy as np
 
 from rafiki_tpu import config
 from rafiki_tpu.cache.queue import TokenStream
+from rafiki_tpu.constants import BudgetType
 from rafiki_tpu.sdk.model import (
     GenerationSpec,
+    ROLE_DRAFT,
+    ROLE_TARGET,
+    draft_capability,
     generation_capability,
     paged_generation_capability,
+    sampling_capability,
+    spec_verify_capability,
 )
 from rafiki_tpu.utils import chaos
 from rafiki_tpu.worker.inference import (
@@ -155,6 +177,20 @@ def _metrics():
                 "rafiki_gen_preemptions_total",
                 "streams preempted by pool exhaustion (blocks freed, "
                 "request re-queued and later resumed)"),
+            "spec_proposed": REGISTRY.counter(
+                "rafiki_gen_spec_proposed_total",
+                "draft tokens proposed to the speculative verify step"),
+            "spec_accepted": REGISTRY.counter(
+                "rafiki_gen_spec_accepted_total",
+                "draft tokens accepted by the target's verify step "
+                "(acceptance rate = accepted / proposed)"),
+            "spec_rounds": REGISTRY.counter(
+                "rafiki_gen_spec_rounds_total",
+                "speculative draft-propose/verify rounds run"),
+            "spec_degraded": REGISTRY.counter(
+                "rafiki_gen_spec_degraded_total",
+                "speculation degradations to plain decode (draft fault, "
+                "verify fault, capability mismatch)"),
         }
     return _M
 
@@ -170,12 +206,14 @@ class _Slot:
 
     __slots__ = ("stream", "last_id", "position", "produced", "max_tokens",
                  "deadline", "muted", "last_step_t", "prompt", "tokens",
-                 "pending_from", "seq", "t0")
+                 "pending_from", "seq", "t0", "temperature", "top_k",
+                 "top_p", "rng_seed", "draft_ready")
 
     def __init__(self, stream: TokenStream, prompt: List[int],
                  max_tokens: int, deadline: Optional[float], seq: int,
                  produced: int = 0,
-                 pending_from: Optional[int] = None) -> None:
+                 pending_from: Optional[int] = None,
+                 sampling: Optional[tuple] = None) -> None:
         self.stream = stream
         self.prompt = prompt          # full token history being prefilled
         self.tokens: List[int] = []   # tokens produced SINCE (re)admission
@@ -196,6 +234,18 @@ class _Slot:
         #: timeout must convert the silence into a typed error frame
         self.muted = False
         self.last_step_t = time.monotonic()
+        #: sampling params (temperature=0 = greedy); rng_seed is the
+        #: stream's counter-RNG seed, FIXED at first admission so a
+        #: preemption resume replays the identical sampled sequence
+        t, tk, tp, sd = sampling or (0.0, 0, 1.0, 0)
+        self.temperature = float(t)
+        self.top_k = int(tk)
+        self.top_p = float(tp)
+        self.rng_seed = int(sd)
+        #: draft-model KV rows cover this slot's history (speculation).
+        #: Any round a decoding slot sits out garbles its draft-ring row,
+        #: so non-participants are invalidated and re-prefilled lazily.
+        self.draft_ready = False
 
 
 class _Pending:
@@ -204,10 +254,11 @@ class _Pending:
     resumed (``stream``/``prompt`` carry its full token history)."""
 
     __slots__ = ("fut", "query", "stream", "prompt", "produced",
-                 "max_tokens", "deadline", "seq")
+                 "max_tokens", "deadline", "seq", "sampling")
 
     def __init__(self, seq: int, fut=None, query=None, stream=None,
-                 prompt=None, produced=0, max_tokens=0, deadline=None):
+                 prompt=None, produced=0, max_tokens=0, deadline=None,
+                 sampling=None):
         self.seq = seq
         self.fut = fut
         self.query = query
@@ -216,6 +267,7 @@ class _Pending:
         self.produced = produced
         self.max_tokens = max_tokens
         self.deadline = deadline
+        self.sampling = sampling
 
 
 class GenerationWorker(InferenceWorker):
@@ -260,6 +312,7 @@ class GenerationWorker(InferenceWorker):
                     self._chunk)
             else:
                 cache = model.init_kv_cache(max_slots)
+            self._init_spec(model, spec, max_slots, ctx)
             # pre-warm per-bucket prefill + decode programs under the
             # persistent compile cache, before ctx.ready(): a still-
             # compiling generation replica stays DEPLOYING/unroutable
@@ -319,20 +372,127 @@ class GenerationWorker(InferenceWorker):
                 self._stats_row(ctx.service_id, slots, max_slots)
                 if n_active == 0 and not self._pending:
                     continue
-                # -- decode: one token for every resident sequence --------
+                # -- decode: one token for every resident sequence (or a
+                # draft-propose/verify burst when speculation is live) ----
                 if any(s is not None and s.pending_from is None
                        for s in slots):
-                    cache = self._decode_round(model, spec, cache, slots,
-                                               ctx)
+                    if self._spec_on:
+                        cache = self._spec_round(model, spec, cache,
+                                                 slots, ctx)
+                    else:
+                        cache = self._decode_round(model, spec, cache,
+                                                   slots, ctx)
                 elif n_active == 0:
                     # only stashed streams remain and nothing can run —
                     # don't spin while the pool refills
                     time.sleep(0.005)
         finally:
             self._broker.unregister_worker(self._job_id, ctx.service_id)
+            if getattr(self, "_draft", None) is not None:
+                self._draft.destroy()
             if model is not None:
                 model.destroy()
             set_device_grant(None)
+
+    # -- sampling + speculation setup ----------------------------------------
+
+    def _init_spec(self, model, spec: GenerationSpec, max_slots: int,
+                   ctx) -> None:
+        """Wire sampling + speculative decoding for this worker. Sampling
+        needs only a capable template; speculation additionally needs the
+        paged plane, the verify capability, and a draft trial budgeted
+        as ``BudgetType.GEN_DRAFT_TRIAL`` on the inference job. Anything
+        missing degrades TYPED — the worker serves plain decode and the
+        reason lands in the stats row for the doctor to surface."""
+        self._sampling_cap = sampling_capability(type(model))
+        # lint: thread-confined(speculation state — only the serve thread schedules; the reporter thread reads the _stats_lock'd row copy)
+        self._spec_on = False
+        self._spec_degraded: Optional[str] = None
+        self._spec_k = min(max(int(config.GEN_SPEC_K), 1), 16)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rounds = 0
+        self._draft = None
+        self._draft_spec: Optional[GenerationSpec] = None
+        self._draft_cache = None
+        if not bool(config.GEN_SPEC) or self._alloc is None:
+            return  # speculation is opt-in and lives on the paged plane
+        if spec_verify_capability(type(model)) is None:
+            self._spec_degraded = (
+                "template lacks the speculative verify capability "
+                "(paged_verify_step + sampled decode)")
+            return
+        try:
+            draft = self._load_draft_model(ctx.service_id)
+        except Exception:
+            logger.error("draft model failed to load in generation "
+                         "worker %s:\n%s", ctx.service_id,
+                         traceback.format_exc())
+            self._spec_degraded = "draft model failed to load"
+            return
+        if draft is None:
+            return  # job budgets no draft: plain decode, not a fault
+        dspec = draft_capability(type(draft))
+        if dspec is None:
+            draft.destroy()
+            self._spec_degraded = (
+                "draft trial's template is not draft-capable (generation "
+                "contract + decode_step_sampled)")
+            return
+        self._draft = draft
+        self._draft_spec = dspec
+        self._draft_cache = draft.init_kv_cache(max_slots)
+        self._spec_on = True
+        logger.info(
+            "generation worker %s: speculative decoding on (k=%d, draft "
+            "max_context=%d)", ctx.service_id, self._spec_k,
+            dspec.max_context)
+
+    def _load_draft_model(self, service_id: str):
+        """The job's draft LM: ``BudgetType.GEN_DRAFT_TRIAL`` in the
+        inference job's budget names a (small) generation-capable trial,
+        loaded through the normal trial-artifact path. None = the job
+        budgets no draft, so speculation simply stays off."""
+        if getattr(self, "_db", None) is None:
+            return None
+        inf = self._db.get_inference_job(self._job_id)
+        draft_tid = ((inf or {}).get("budget") or {}).get(
+            BudgetType.GEN_DRAFT_TRIAL)
+        if not draft_tid:
+            return None
+        return self._load_one(str(draft_tid), f"{service_id}-draft")
+
+    def _degrade_spec(self, reason: str) -> None:
+        """Speculation faulted (draft crash/stall, verify mismatch): fall
+        back to plain paged decode TYPED. Resident streams keep decoding
+        — losing the multiplier must never lose tokens."""
+        if not self._spec_on:
+            return
+        self._spec_on = False
+        self._spec_degraded = reason
+        _metrics()["spec_degraded"].inc()
+        logger.error("generation worker: speculative decoding degraded "
+                     "to plain decode — %s", reason)
+
+    def _sampling_arrays(self, slots, role, only=None) -> Dict[str, object]:
+        """Per-slot sampling params as the fixed-shape arrays the sampled
+        model methods take. Idle (and filtered) rows get temperature 0,
+        whose modified distribution is the argmax one-hot — shape-stable
+        and harmless for rows whose writes are dropped anyway."""
+        n = len(slots)
+        seed = np.zeros(n, np.uint32)
+        temp = np.zeros(n, np.float32)
+        tk = np.zeros(n, np.int32)
+        tp = np.ones(n, np.float32)
+        for i, s in enumerate(slots):
+            if s is None or (only is not None and i not in only):
+                continue
+            seed[i] = np.uint32(s.rng_seed & 0xFFFFFFFF)
+            temp[i] = np.float32(s.temperature)
+            tk[i] = np.int32(s.top_k)
+            tp[i] = np.float32(s.top_p)
+        return {"seed": seed, "temperature": temp, "top_k": tk,
+                "top_p": tp, "role": int(role)}
 
     # -- admission -----------------------------------------------------------
 
@@ -358,9 +518,17 @@ class GenerationWorker(InferenceWorker):
         order — minting a fresh one would make the oldest waiter the
         youngest resident and the first preemption victim (starvation)."""
         try:
-            prompt, max_tokens, max_duration_s = self._parse_query(query)
+            prompt, max_tokens, max_duration_s, sampling = \
+                self._parse_query(query)
         except GenerationRequestError as e:
             fut.set_error(e)
+            return cache
+        if sampling[0] > 0.0 \
+                and getattr(self, "_sampling_cap", None) is None:
+            fut.set_error(GenerationRequestError(
+                "sampled generation (temperature > 0) needs a "
+                "sampling-capable template (decode_step_sampled; plus "
+                "paged_decode_step_sampled under the paged layout)"))
             return cache
         if not free:
             # take_batch was sized to the free count, but a same-round
@@ -389,7 +557,8 @@ class GenerationWorker(InferenceWorker):
                 return cache
             return self._admit_paged(model, spec, cache, slots, free, fut,
                                      prompt, max_tokens, deadline,
-                                     service_id, seq=seq)
+                                     service_id, seq=seq,
+                                     sampling=sampling)
         # -- contiguous-ring path -------------------------------------------
         slot_ix = free.pop(0)
         t0 = time.monotonic()
@@ -401,19 +570,31 @@ class GenerationWorker(InferenceWorker):
                          service_id, traceback.format_exc())
             fut.set_error(RuntimeError(f"prefill failed: {e}"))
             return cache
-        first_id = int(first_id)
         stream = TokenStream(seq_id=uuid.uuid4().hex[:12])
         slot = _Slot(stream, list(prompt), max_tokens, deadline,
-                     self._next_seq() if seq is None else seq, produced=1)
-        slot.last_id = first_id
-        slot.position = len(prompt)
-        slot.tokens.append(first_id)
+                     self._next_seq() if seq is None else seq,
+                     sampling=sampling)
         slots[slot_ix] = slot
         fut.set_result(stream)
         from rafiki_tpu.worker.inference import _record_batch
 
         _record_batch(service_id, 1)  # one admitted request
         m = _metrics()
+        if slot.temperature > 0.0:
+            # sampled stream: prefill's token is the GREEDY pick — do not
+            # commit it. Rewind one row so the next decode round rewrites
+            # the last prompt position (identical K/V) and SAMPLES the
+            # first token under its position-keyed counter RNG; TTFT
+            # lands on that first sampled commit.
+            slot.last_id = prompt[-1]
+            slot.position = len(prompt) - 1
+            slot.t0 = t0
+            return cache
+        first_id = int(first_id)
+        slot.last_id = first_id
+        slot.position = len(prompt)
+        slot.produced = 1
+        slot.tokens.append(first_id)
         m["ttft"].observe(time.monotonic() - t0)
         m["tokens"].inc()
         finished, reason = self._finish_reason(slot, spec, first_id)
@@ -445,7 +626,8 @@ class GenerationWorker(InferenceWorker):
     # -- paged admission / prefill -------------------------------------------
 
     def _admit_paged(self, model, spec, cache, slots, free, fut, prompt,
-                     max_tokens, deadline, service_id, seq=None):
+                     max_tokens, deadline, service_id, seq=None,
+                     sampling=None):
         """Open a block table for the prompt (mapping any cached prefix),
         run the FIRST prefill chunk synchronously, and resolve the
         request's future. Remaining chunks (long prompts) advance one per
@@ -455,7 +637,8 @@ class GenerationWorker(InferenceWorker):
         slot_ix = free.pop(0)
         slot = _Slot(TokenStream(seq_id=uuid.uuid4().hex[:12]),
                      list(prompt), max_tokens, deadline,
-                     self._next_seq() if seq is None else seq)
+                     self._next_seq() if seq is None else seq,
+                     sampling=sampling)
         plan = self._alloc.open_slot(slot_ix, prompt)
         slot.pending_from = plan.cached_tokens
         slot.position = plan.cached_tokens
@@ -479,7 +662,12 @@ class GenerationWorker(InferenceWorker):
                 self._stash(_Pending(
                     slot.seq, fut=fut,
                     query={"prompt_ids": prompt, "max_tokens": max_tokens,
-                           "max_duration_s": None},
+                           "max_duration_s": None,
+                           # carry the DERIVED seed: the resumed parse
+                           # must replay the identical sampled stream
+                           "temperature": slot.temperature,
+                           "top_k": slot.top_k, "top_p": slot.top_p,
+                           "seed": slot.rng_seed},
                     deadline=deadline))
                 return cache
         except Exception as e:
@@ -530,7 +718,8 @@ class GenerationWorker(InferenceWorker):
             slot_ix = free.pop(0)
             slot = _Slot(entry.stream, list(entry.prompt),
                          entry.max_tokens, entry.deadline, entry.seq,
-                         produced=entry.produced)
+                         produced=entry.produced,
+                         sampling=entry.sampling)
             plan = self._alloc.open_slot(slot_ix, slot.prompt)
             slot.pending_from = plan.cached_tokens
             slot.position = plan.cached_tokens
@@ -594,6 +783,17 @@ class GenerationWorker(InferenceWorker):
         slot.pending_from = end
         slot.position = end
         if end < n:
+            return True, cache
+        if slot.temperature > 0.0:
+            # sampled stream: prefill's token is the greedy pick — do not
+            # commit it. Rewind one row so the next decode rewrites the
+            # last prompt position (identical K/V) and SAMPLES the first
+            # token under its position-keyed counter RNG — which is also
+            # exactly how a preempted sampled stream resumes mid-sequence.
+            slot.pending_from = None
+            slot.last_id = slot.prompt[-1]
+            slot.position = n - 1
+            self._alloc.publish(slot_ix, slot.prompt)
             return True, cache
         # final chunk: first generated token
         tok = int(tok)
@@ -710,21 +910,28 @@ class GenerationWorker(InferenceWorker):
         self._stash(_Pending(
             slot.seq, stream=slot.stream, prompt=history,
             produced=slot.produced, max_tokens=slot.max_tokens,
-            deadline=slot.deadline))
+            deadline=slot.deadline,
+            sampling=(slot.temperature, slot.top_k, slot.top_p,
+                      slot.rng_seed)))
 
     # -- the decode round ----------------------------------------------------
 
     def _decode_round(self, model, spec: GenerationSpec, cache,
-                      slots: List[Optional[_Slot]], ctx):
+                      slots: List[Optional[_Slot]], ctx, only=None):
         """Advance every resident DECODING sequence one token. Slot-level
         chaos is consulted per sequence, so a drill injures exactly one
-        stream while siblings keep decoding."""
+        stream while siblings keep decoding. ``only`` restricts the round
+        to a subset of slot indices — the speculative round uses it to
+        advance the streams that sat out a verify burst (context edge,
+        burst-capacity demotion) without re-stepping the participants."""
         n = len(slots)
         paged = self._alloc is not None
         if paged:
             # growth + COW barriers for this round's writes
             for i, s in enumerate(slots):
                 if s is None or s.pending_from is not None:
+                    continue
+                if only is not None and i not in only:
                     continue
                 if not self._make_capacity(slots, i, s.position):
                     if slots[i] is s:
@@ -747,7 +954,8 @@ class GenerationWorker(InferenceWorker):
                 if copies:
                     cache = self._apply_copies(model, cache, copies)
         active = [(i, s) for i, s in enumerate(slots)
-                  if s is not None and s.pending_from is None]
+                  if s is not None and s.pending_from is None
+                  and (only is None or i in only)]
         if not active:
             return cache
         ids = np.zeros(n, np.int32)
@@ -755,16 +963,31 @@ class GenerationWorker(InferenceWorker):
         for i, s in active:
             ids[i] = s.last_id
             positions[i] = s.position
+        # one sampled slot puts the whole batch through the sampled step
+        # (greedy rows are bit-identical there: their modified dist is
+        # the argmax one-hot) — the program count stays at one per shape
+        sampled = (getattr(self, "_sampling_cap", None) is not None
+                   and any(s.temperature > 0.0 for _, s in active))
+        live = set(i for i, _ in active)
         try:
             if paged:
                 tables = np.stack([
-                    self._alloc.table_row(i) if (slots[i] is not None and
-                                                 slots[i].pending_from
-                                                 is None)
+                    self._alloc.table_row(i) if i in live
                     else self._alloc.idle_row()
                     for i in range(n)])
-                next_ids, cache = model.paged_decode_step(
-                    cache, ids, positions, tables)
+                if sampled:
+                    next_ids, _probs, cache = \
+                        model.paged_decode_step_sampled(
+                            cache, ids, positions, tables,
+                            self._sampling_arrays(slots, ROLE_TARGET,
+                                                  only=live))
+                else:
+                    next_ids, cache = model.paged_decode_step(
+                        cache, ids, positions, tables)
+            elif sampled:
+                next_ids, _probs, cache = model.decode_step_sampled(
+                    cache, ids, positions,
+                    self._sampling_arrays(slots, ROLE_TARGET, only=live))
             else:
                 next_ids, cache = model.decode_step(cache, ids, positions)
             next_ids = np.asarray(next_ids)
@@ -784,6 +1007,8 @@ class GenerationWorker(InferenceWorker):
         m = _metrics()
         for i, slot in enumerate(slots):
             if slot is None or slot.pending_from is not None:
+                continue
+            if i not in live:
                 continue
             rule = chaos.hit(
                 chaos.SITE_GENERATE,
@@ -817,6 +1042,11 @@ class GenerationWorker(InferenceWorker):
             slot.last_step_t = now
             m["tokens"].inc()
             self._tokens_emitted += 1
+            if slot.t0 is not None:
+                # a sampled stream's first token commits HERE (admission
+                # rewound past prefill's greedy pick)
+                m["ttft"].observe(now - slot.t0)
+                slot.t0 = None
             finished, reason = self._finish_reason(slot, spec, token)
             if slot.deadline is not None and now >= slot.deadline:
                 finished, reason = True, "deadline"
@@ -824,6 +1054,216 @@ class GenerationWorker(InferenceWorker):
                 slot.stream.push([token], finished=finished, reason=reason)
             if finished:
                 self._evict_slot(slots, i, reason)
+        return cache
+
+    # -- the speculative round -----------------------------------------------
+
+    def _spec_round(self, model, spec: GenerationSpec, cache,
+                    slots: List[Optional[_Slot]], ctx):
+        """One draft-propose/verify round: the draft LM proposes k tokens
+        per eligible resident stream, the target verifies all k+1
+        positions in ONE fixed-shape ``paged_verify_step`` forward, and
+        every participant commits accept_len+1 tokens. Streams near a
+        context edge (or demoted by a burst-capacity shortfall) take the
+        plain one-token round instead THIS round; a draft or verify fault
+        degrades speculation typed and the round finishes plain for
+        everyone — the multiplier is lost, never the streams."""
+        k = self._spec_k
+        cand = []
+        for i, s in enumerate(slots):
+            if s is None or s.pending_from is not None:
+                continue
+            if (s.position + k >= spec.max_context
+                    or s.position + k >= self._draft_spec.max_context):
+                continue  # burst would cross a context edge
+            cand.append(i)
+        if not cand:
+            return self._decode_round(model, spec, cache, slots, ctx)
+        # draft-fault drill: a crashing/stalling DRAFT must cost the
+        # multiplier, never the streams (docs/failure-model.md)
+        rule = chaos.hit(chaos.SITE_GENERATE,
+                         f"draft/{self._job_id}/{ctx.service_id}")
+        if rule is not None:
+            if rule.action == chaos.ACTION_DELAY:
+                chaos.sleep_for(rule)  # slow draft: the round still lands
+            elif rule.action == chaos.ACTION_DROP:
+                # draft stalled THIS round: skip speculation, decode plain
+                return self._decode_round(model, spec, cache, slots, ctx)
+            else:
+                self._degrade_spec("chaos-injected draft fault")
+                return self._decode_round(model, spec, cache, slots, ctx)
+        # growth + COW barriers for the whole k+1-row write burst
+        bt = self._alloc.block_tokens
+        part: List[int] = []
+        for i in cand:
+            s = slots[i]
+            if s is None:
+                continue  # preempted making room for an earlier burst
+            ok = self._make_capacity(slots, i, s.position + k)
+            if ok:
+                for bx in range(s.position // bt,
+                                (s.position + k) // bt + 1):
+                    copies = self._alloc.ensure_writable(i, bx * bt)
+                    if copies is None:
+                        ok = False
+                        break
+                    if copies:
+                        cache = self._apply_copies(model, cache, copies)
+            if ok:
+                part.append(i)
+        part = [i for i in part if slots[i] is not None]
+        rest = set(i for i, s in enumerate(slots)
+                   if s is not None and s.pending_from is None
+                   and i not in part)
+        if not part:
+            return self._decode_round(model, spec, cache, slots, ctx,
+                                      only=rest)
+        # the propose steps below write garbage into the draft-ring rows
+        # of every slot sitting this round out — invalidate them so their
+        # next participation re-prefills the draft cache
+        for i in rest:
+            slots[i].draft_ready = False
+        n = len(slots)
+        try:
+            for i in part:
+                s = slots[i]
+                if s.draft_ready:
+                    continue
+                # lazy draft prefill of the slot's committed history
+                # (positions 0..position; the first propose step rewrites
+                # row `position` with identical K/V)
+                _, self._draft_cache = self._draft.prefill(
+                    self._draft_cache, i, list(s.prompt) + list(s.tokens))
+                s.draft_ready = True
+            cur = np.zeros(n, np.int32)
+            cpos = np.zeros(n, np.int32)
+            for i in part:
+                cur[i] = slots[i].last_id
+                cpos[i] = slots[i].position
+            dsamp = self._sampling_arrays(slots, ROLE_DRAFT, only=part)
+            fused = getattr(self._draft, "decode_steps_sampled", None)
+            if callable(fused):
+                # fused proposal: all k chained steps in ONE program —
+                # the k-call loop below pays dispatch + a host sync per
+                # step just to feed the sampled token back in
+                d_j, q_j, self._draft_cache = fused(
+                    self._draft_cache, cur, cpos, k, dsamp)
+                d_ids = np.asarray(d_j, np.int32)        # (S, k)
+                draft_probs = np.asarray(q_j, np.float32)
+            else:
+                d_ids = np.zeros((n, k), np.int32)
+                q_list = []
+                for j in range(k):
+                    nxt, q, self._draft_cache = \
+                        self._draft.decode_step_sampled(
+                            self._draft_cache, cur.copy(), cpos.copy(),
+                            dsamp)
+                    nxt = np.asarray(nxt, np.int32)
+                    d_ids[:, j] = nxt
+                    q_list.append(np.asarray(q, np.float32))
+                    cur = nxt
+                    cpos = cpos + 1
+                draft_probs = np.stack(q_list, axis=1)   # (S, k, V_draft)
+        except Exception:
+            logger.error("draft propose failed in generation worker "
+                         "%s:\n%s", ctx.service_id, traceback.format_exc())
+            self._degrade_spec("draft propose failed")
+            return self._decode_round(model, spec, cache, slots, ctx)
+        ids2 = np.zeros((n, k + 1), np.int32)
+        pos2 = np.tile(np.arange(k + 1, dtype=np.int32), (n, 1))
+        for i in part:
+            s = slots[i]
+            ids2[i, 0] = s.last_id
+            ids2[i, 1:] = d_ids[i]
+            pos2[i] = s.position + np.arange(k + 1, dtype=np.int32)
+        tables = np.stack([
+            self._alloc.table_row(i) if i in part
+            else self._alloc.idle_row() for i in range(n)])
+        vsamp = self._sampling_arrays(slots, ROLE_TARGET, only=part)
+        try:
+            acc, toks, cache = model.paged_verify_step(
+                cache, ids2, pos2, tables, draft_probs, vsamp)
+            acc = np.asarray(acc)
+            toks = np.asarray(toks)
+        except Exception:
+            # the verify forward raised BEFORE returning a new cache, so
+            # the resident table is intact — degrade typed (the classic
+            # cause is a draft/target vocab mismatch) and finish the
+            # round plain for everyone
+            logger.error("speculative verify failed in generation worker "
+                         "%s:\n%s", ctx.service_id, traceback.format_exc())
+            self._degrade_spec(
+                "verify step failed (draft/target mismatch?)")
+            return self._decode_round(model, spec, cache, slots, ctx)
+        now = time.monotonic()
+        m = _metrics()
+        # lint: unguarded(scheduler thread is the only writer; the stats snapshot reads cross-thread and tolerates a stale round count)
+        self._spec_rounds += 1
+        m["spec_rounds"].inc()
+        for i in part:
+            s = slots[i]
+            if s is None:
+                continue
+            rule = chaos.hit(
+                chaos.SITE_GENERATE,
+                f"{self._job_id}/{ctx.service_id}/slot{i}/"
+                f"{s.stream.seq_id}")
+            if rule is not None:
+                if rule.action == chaos.ACTION_DELAY:
+                    chaos.sleep_for(rule)
+                elif rule.action == chaos.ACTION_DROP:
+                    logger.warning(
+                        "chaos: muting generation slot %d (%s)", i,
+                        s.stream.seq_id)
+                    s.muted = True
+                else:
+                    s.stream.fail(
+                        "chaos-injected mid-stream generation fault")
+                    self._evict_slot(slots, i, "error")
+                    continue
+            if s.stream.cancelled:
+                self._evict_slot(slots, i, "cancelled")
+                continue
+            a = int(acc[i])
+            # lint: unguarded(scheduler-thread-only writer, stale reads ok)
+            self._spec_proposed += k
+            # lint: unguarded(scheduler-thread-only writer, stale reads ok)
+            self._spec_accepted += a
+            m["spec_proposed"].inc(k)
+            m["spec_accepted"].inc(a)
+            emit: List[int] = []
+            finished, reason = False, None
+            for t in toks[i, :a + 1]:
+                token = int(t)
+                s.position += 1
+                s.last_id = token
+                s.produced += 1
+                s.tokens.append(token)
+                emit.append(token)
+                self._tokens_emitted += 1
+                finished, reason = self._finish_reason(s, spec, token)
+                if finished:
+                    break
+            if s.deadline is not None and now >= s.deadline:
+                finished, reason = True, "deadline"
+            m["intertoken"].observe(now - s.last_step_t)
+            s.last_step_t = now
+            m["tokens"].inc(len(emit))
+            if s.t0 is not None:
+                m["ttft"].observe(now - s.t0)
+                s.t0 = None
+            if not s.muted:
+                s.stream.push(emit, finished=finished, reason=reason)
+            if finished:
+                self._evict_slot(slots, i, reason)
+            else:
+                # free any block now holding ONLY rejected-suffix rows;
+                # stale rows inside the frontier block are overwritten
+                # before attention by the next round's writes
+                self._alloc.truncate_to(i, s.position)
+        if rest:
+            cache = self._decode_round(model, spec, cache, slots, ctx,
+                                       only=rest)
         return cache
 
     @staticmethod
@@ -877,7 +1317,53 @@ class GenerationWorker(InferenceWorker):
             except (TypeError, ValueError):
                 raise GenerationRequestError(
                     "max_duration_s must be a number") from None
-        return list(prompt), max_tokens, max_duration_s
+        raw_t = query.get("temperature", 0.0)
+        try:
+            temperature = float(raw_t if raw_t is not None else 0.0)
+        except (TypeError, ValueError):
+            raise GenerationRequestError(
+                f"temperature={raw_t!r} is not a number") from None
+        if temperature < 0.0:
+            raise GenerationRequestError(
+                f"temperature={temperature} must be >= 0")
+        raw_k = query.get("top_k", 0)
+        try:
+            top_k = int(raw_k if raw_k is not None else 0)
+        except (TypeError, ValueError):
+            raise GenerationRequestError(
+                f"top_k={raw_k!r} is not an integer") from None
+        if top_k < 0:
+            raise GenerationRequestError(f"top_k={top_k} must be >= 0")
+        raw_p = query.get("top_p", 1.0)
+        try:
+            top_p = float(raw_p if raw_p is not None else 1.0)
+        except (TypeError, ValueError):
+            raise GenerationRequestError(
+                f"top_p={raw_p!r} is not a number") from None
+        if not 0.0 < top_p <= 1.0:
+            raise GenerationRequestError(
+                f"top_p={top_p} must be in (0, 1]")
+        raw_s = query.get("seed")
+        if raw_s is not None:
+            try:
+                seed = int(raw_s)
+            except (TypeError, ValueError):
+                raise GenerationRequestError(
+                    f"seed={raw_s!r} is not an integer") from None
+            if seed < 0:
+                raise GenerationRequestError(f"seed={seed} must be >= 0")
+        elif temperature > 0.0:
+            # derive one NOW and keep it for the stream's whole life —
+            # a preemption resume must replay the identical sequence
+            seed = uuid.uuid4().int & 0x7FFFFFFF
+        else:
+            seed = 0
+        if temperature > 0.0 and not bool(config.GEN_SAMPLING):
+            raise GenerationRequestError(
+                "sampled generation is disabled on this deployment "
+                "(RAFIKI_GEN_SAMPLING=0)")
+        return (list(prompt), max_tokens, max_duration_s,
+                (temperature, top_k, top_p, seed))
 
     # -- observability -------------------------------------------------------
 
@@ -931,6 +1417,13 @@ class GenerationWorker(InferenceWorker):
             s["gen_slots_max"] = max_slots
             s["gen_tokens"] = getattr(self, "_tokens_emitted", 0)
             s["gen_job"] = self._job_id
+            s["gen_spec_on"] = bool(getattr(self, "_spec_on", False))
+            s["gen_spec_proposed"] = getattr(self, "_spec_proposed", 0)
+            s["gen_spec_accepted"] = getattr(self, "_spec_accepted", 0)
+            s["gen_spec_rounds"] = getattr(self, "_spec_rounds", 0)
+            deg = getattr(self, "_spec_degraded", None)
+            if deg:
+                s["gen_spec_degraded"] = deg
             if self._alloc is not None:
                 st = self._last_alloc_stats or self._alloc.stats()
                 s["gen_kv_blocks_used"] = st["used_blocks"]

@@ -240,6 +240,28 @@ class PagedKVAllocator:
             if self._refs[b] == 0:
                 self._free.append(b)
 
+    def truncate_to(self, slot: Any, tokens: int) -> int:
+        """Shed blocks wholly past logical row ``tokens - 1`` — the
+        speculative-decode rollback: a verify round allocates capacity
+        for all k drafted positions up front, and when fewer are accepted
+        the blocks that only ever held rejected-suffix K/V go back to the
+        pool (no device-side work: the model layer's write-then-attend
+        ordering guarantees stale rows are overwritten before any query
+        can attend them). Shared blocks are dereferenced exactly like
+        :meth:`close_slot` — a published prefix can never sit past the
+        committed frontier anyway. Returns blocks freed to the pool."""
+        table = self._tables[slot]
+        keep = self.blocks_for(tokens)
+        freed = 0
+        while len(table) > keep:
+            b = table.pop()
+            self._shared[slot].discard(len(table))
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+                freed += 1
+        return freed
+
     def ensure_capacity(self, slot: Any, position: int) -> bool:
         """Grow the slot's table until it covers logical ``position``
         (the next write). False = pool exhausted even after cache

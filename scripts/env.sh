@@ -241,6 +241,33 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                       long-prompt joins interleave
 #                                       with decode rounds (0 = one-shot
 #                                       prefill)
+# Sampling + speculative decoding (docs/serving-generation.md
+# "Speculative decoding & sampling") — /generate accepts temperature /
+# top_k / top_p / seed with per-token counter-based RNG (streams resume
+# bit-identically after preemption; temperature=0 IS greedy), and a
+# draft LM trained under a GEN_DRAFT_TRIAL budget proposes k tokens per
+# round that the target verifies in ONE fixed-shape forward:
+#   RAFIKI_GEN_SAMPLING=1               0 = greedy-only serving: requests
+#                                       carrying sampling params answer a
+#                                       typed 4xx instead of silently
+#                                       decoding greedy
+#   RAFIKI_GEN_SPEC=1                   0 = never speculate (plain paged
+#                                       decode); 1 = speculate whenever
+#                                       the deployed job also carries a
+#                                       draft trial and the template
+#                                       advertises the verify contract
+#   RAFIKI_GEN_SPEC_K=4                 draft tokens proposed per round —
+#                                       each round commits 1..k+1 tokens
+#                                       in one target forward (doctor
+#                                       WARNs outside 1..8)
+#   RAFIKI_GEN_SPEC_MIN_RATE=0.3        acceptance-rate floor: doctor
+#                                       WARNs when the measured rate sits
+#                                       below it (a weak draft makes
+#                                       speculation cost throughput);
+#                                       faults at the chaos target
+#                                       draft/{job}/{service} degrade the
+#                                       worker to plain decode, typed +
+#                                       permanent, never wrong tokens
 # New /metrics series: rafiki_gen_ttft_seconds,
 # rafiki_gen_door_ttft_seconds, rafiki_gen_intertoken_seconds,
 # rafiki_gen_tokens_total, rafiki_gen_slots_busy{service},
@@ -248,9 +275,11 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # rafiki_gen_kv_pool_blocks{service}, rafiki_gen_prefix_hits_total,
 # rafiki_gen_prefix_misses_total, rafiki_gen_prefix_tokens_total,
 # rafiki_gen_prefix_evictions_total, rafiki_gen_prefix_shareable_total,
-# rafiki_gen_kv_cow_copies_total, rafiki_gen_preemptions_total. Per-job
-# pool footprint + prefix hit rates surface under GET /fleet/health
-# "serving.generation".
+# rafiki_gen_kv_cow_copies_total, rafiki_gen_preemptions_total,
+# rafiki_gen_spec_rounds_total, rafiki_gen_spec_proposed_total,
+# rafiki_gen_spec_accepted_total, rafiki_gen_spec_degraded_total.
+# Per-job pool footprint, prefix hit rates and speculation acceptance
+# surface under GET /fleet/health "serving.generation".
 
 # Safe live rollouts (docs/failure-model.md "Rollout faults"). An
 # operator (or automation) updates a RUNNING inference job to a new

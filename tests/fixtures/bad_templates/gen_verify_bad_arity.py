@@ -1,0 +1,47 @@
+"""GEN002: the speculative verify method is overridden with the wrong
+arity — the scheduler calls paged_verify_step(cache, ids, positions,
+tables, draft_probs, sampling) (7 positionals with self), so the first
+speculative round would raise TypeError mid-serving."""
+
+from rafiki_tpu.sdk import BaseModel, FloatKnob, GenerationSpec
+
+
+class GenVerifyBadArity(BaseModel):
+    dependencies = {}
+    generation_spec = GenerationSpec(eos_token_id=0, max_context=64)
+
+    @staticmethod
+    def get_knob_config():
+        return {"lr": FloatKnob(1e-4, 1e-1)}
+
+    def __init__(self, **knobs):
+        super().__init__(**knobs)
+
+    def train(self, dataset_uri):
+        pass
+
+    def evaluate(self, dataset_uri):
+        return 0.5
+
+    def predict(self, queries):
+        return [0.0 for _ in queries]
+
+    def dump_parameters(self):
+        return {}
+
+    def load_parameters(self, params):
+        pass
+
+    def init_kv_cache(self, max_slots):
+        return {}
+
+    def prefill(self, cache, slot, prompt_ids):
+        return 0, cache
+
+    def decode_step(self, cache, ids, positions):
+        return ids, cache
+
+    def paged_verify_step(self, cache, ids, positions, tables):
+        # missing draft_probs + sampling: 5 positionals where the
+        # scheduler passes 7
+        return ids, ids, cache

@@ -63,6 +63,10 @@ class TinyGenLM(BaseModel):
         self._jit_paged_prefill = None
         self._jit_paged_decode = None
         self._jit_copy = None
+        self._jit_sampled = None
+        self._jit_paged_sampled = None
+        self._jit_verify = None
+        self._jit_multi = None
 
     def train(self, dataset_uri):
         import optax
@@ -110,6 +114,9 @@ class TinyGenLM(BaseModel):
         # recompile on new params
         self._jit_prefill = self._jit_decode = None
         self._jit_paged_prefill = self._jit_paged_decode = None
+        self._jit_sampled = self._jit_paged_sampled = None
+        self._jit_verify = None
+        self._jit_multi = None
 
     # -- generation contract (worker/generation.py drives these) ------------
 
@@ -172,3 +179,68 @@ class TinyGenLM(BaseModel):
 
     def kv_copy_blocks(self, cache, src, dst):
         return self._jit_copy(cache, src, dst)
+
+    # -- sampling + speculation (worker/generation.py _spec_round) -----------
+
+    def decode_step_sampled(self, cache, ids, positions, sampling):
+        if self._jit_sampled is None:
+            params, cfg = self._device_params(), self._cfg
+            self._jit_sampled = jax.jit(
+                lambda c, i, p, s: lm.decode_step_sampled(
+                    params, c, i, p, s, cfg))
+        return self._jit_sampled(cache, ids, positions, sampling)
+
+    def decode_steps_sampled(self, cache, ids, positions, k, sampling):
+        # one program per (static) k — the worker pins k for the
+        # deployment, so this compiles exactly once
+        jits = getattr(self, "_jit_multi", None)
+        if jits is None:
+            jits = self._jit_multi = {}
+        if k not in jits:
+            params, cfg = self._device_params(), self._cfg
+            jits[k] = jax.jit(
+                lambda c, i, p, s: lm.decode_steps_sampled(
+                    params, c, i, p, k, s, cfg))
+        return jits[k](cache, ids, positions, sampling)
+
+    def paged_decode_step_sampled(self, cache, ids, positions,
+                                  block_tables, sampling):
+        if self._jit_paged_sampled is None:
+            params, cfg = self._device_params(), self._cfg
+            self._jit_paged_sampled = jax.jit(
+                lambda c, i, p, bt, s: lm.paged_decode_step_sampled(
+                    params, c, i, p, bt, s, cfg))
+        return self._jit_paged_sampled(
+            cache, ids, positions, np.asarray(block_tables, np.int32),
+            sampling)
+
+    def paged_verify_step(self, cache, ids, positions, block_tables,
+                          draft_probs, sampling):
+        if self._jit_verify is None:
+            params, cfg = self._device_params(), self._cfg
+            self._jit_verify = jax.jit(
+                lambda c, i, p, bt, q, s: lm.paged_verify_step(
+                    params, c, i, p, bt, q, s, cfg))
+        return self._jit_verify(
+            cache, ids, positions, np.asarray(block_tables, np.int32),
+            draft_probs, sampling)
+
+
+class TinyDraftLM(TinyGenLM):
+    """A half-size TinyGenLM (dim 8) trained on the SAME token pattern
+    and vocab — the speculative DRAFT for e2e drills. It inherits the
+    full contract, but speculation only exercises the ring plane plus
+    ``decode_step_sampled`` (``draft_capability``): the worker gives the
+    draft its own contiguous ring cache and keeps the paged pool for the
+    target."""
+
+    @staticmethod
+    def get_knob_config():
+        return {
+            "lr": FloatKnob(1e-3, 1e-1, is_exp=True),
+            "dim": FixedKnob(8),
+        }
+
+    def __init__(self, **knobs):
+        knobs.setdefault("dim", 8)
+        super().__init__(**knobs)

@@ -51,6 +51,7 @@ CORPUS = {
     "pop_half_wired.py": "POP002",
     "pop_dynamic_branch.py": "POP003",
     "gen_half_wired.py": "GEN001",
+    "gen_verify_bad_arity.py": "GEN002",
     "tracer_item.py": "JAX001",
     "global_np_random.py": "JAX002",
     "jit_self_mutation.py": "JAX003",

@@ -281,10 +281,10 @@ def test_readmitted_request_keeps_original_seq(monkeypatch):
         orig = worker._admit_paged
 
         def spy(model, spec, cache, slots, free, fut, prompt, max_tokens,
-                deadline, service_id, seq=None):
+                deadline, service_id, seq=None, **kw):
             seen["seq"] = seq
             return orig(model, spec, cache, slots, free, fut, prompt,
-                        max_tokens, deadline, service_id, seq=seq)
+                        max_tokens, deadline, service_id, seq=seq, **kw)
 
         worker._admit_paged = spy
         from rafiki_tpu.worker.generation import _Pending

@@ -1,0 +1,566 @@
+"""Speculative decoding + real sampling (models/lm.py sampled forwards,
+worker/generation.py ``_spec_round``). THE tier-1 invariants live here:
+temperature-0 speculation is TOKEN-identical to the plain greedy decode
+loop (the verify math degrades exactly to argmax), and a sampled stream
+preempted mid-decode resumes to the exact uncontended sequence — the
+counter-based RNG keys every draw by absolute token position, never by
+round boundaries or wall clock."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(__file__)
+
+_MODELS = {}
+
+
+def _models():
+    """Train the target + draft fixtures once per process — the e2e
+    drills only need *a* deterministic pair, not a fresh one per test."""
+    if not _MODELS:
+        sys.path.insert(0, HERE)
+        try:
+            from fixtures.gen_model import TinyDraftLM, TinyGenLM
+        finally:
+            sys.path.pop(0)
+        target = TinyGenLM()
+        target.train(None)
+        draft = TinyDraftLM()
+        draft.train(None)
+        _MODELS.update(target=target, draft=draft,
+                       classes=(TinyGenLM, TinyDraftLM))
+    return _MODELS["target"], _MODELS["draft"]
+
+
+# -- model layer: the sampling primitives -------------------------------------
+
+def test_modified_dist_temp0_is_exact_argmax_one_hot():
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models import lm
+
+    logits = jnp.asarray(
+        np.random.RandomState(0).randn(3, 16), jnp.float32)
+    probs = np.asarray(lm.modified_dist(logits, 0.0, 0, 1.0))
+    hot = np.asarray(logits).argmax(-1)
+    assert (probs.argmax(-1) == hot).all()
+    assert (probs.max(-1) == 1.0).all() and (probs.sum(-1) == 1.0).all()
+    # inverse-CDF sampling from a one-hot returns the hot index for ANY u
+    for u in (0.0, 0.5, 0.999999):
+        tok = np.asarray(lm.sample_from(
+            jnp.asarray(probs), jnp.full((3,), u, jnp.float32)))
+        assert (tok == hot).all()
+
+
+def test_modified_dist_top_k_top_p_filters():
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models import lm
+
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0, 0.0]], jnp.float32)
+    # top_k=2 zeroes everything but the two largest, renormalized
+    p = np.asarray(lm.modified_dist(logits, 1.0, 2, 1.0))[0]
+    assert (p[2:] == 0.0).all() and p[0] > p[1] > 0.0
+    assert abs(p.sum() - 1.0) < 1e-6
+    # a tiny top_p keeps only the head token (the first is always kept)
+    p = np.asarray(lm.modified_dist(logits, 1.0, 0, 0.01))[0]
+    assert p[0] == 1.0 and (p[1:] == 0.0).all()
+    # temperature sharpens: lower temp concentrates mass on the head
+    warm = np.asarray(lm.modified_dist(logits, 1.0, 0, 1.0))[0]
+    cold = np.asarray(lm.modified_dist(logits, 0.25, 0, 1.0))[0]
+    assert cold[0] > warm[0]
+
+
+def test_uniform_counter_keys_are_pure_and_role_separated():
+    from rafiki_tpu.models import lm
+
+    seeds = np.asarray([7, 7], np.uint32)
+    pos = np.asarray([11, 12], np.int32)
+    a = np.asarray(lm._uniform_at(seeds, pos, lm.ROLE_TARGET))
+    b = np.asarray(lm._uniform_at(seeds, pos, lm.ROLE_TARGET))
+    assert (a == b).all()                      # pure in (seed, pos, role)
+    assert a[0] != a[1]                        # position separates draws
+    c = np.asarray(lm._uniform_at(seeds, pos, lm.ROLE_ACCEPT))
+    assert (a != c).any()                      # roles must not share keys
+    # batch shape is irrelevant: the key is (seed, position, role) alone —
+    # this is what makes preemption-resume replay the identical sequence
+    solo = np.asarray(lm._uniform_at(seeds[:1], pos[:1], lm.ROLE_TARGET))
+    assert solo[0] == a[0]
+
+
+def test_paged_verify_temp0_equals_chained_greedy_decode():
+    """The verify forward's rejection sampling at temperature 0: a draft
+    token is accepted iff it IS the target's argmax, the first rejection
+    is corrected TO the argmax, and a clean sweep earns the bonus token —
+    so a perfect draft commits k+1 greedy tokens in one forward and a
+    broken one still commits the exact greedy prefix."""
+    import jax
+
+    from rafiki_tpu.models import lm
+
+    cfg = lm.tiny(vocab=64, max_len=32, dim=16, depth=1, heads=2)
+    params = lm.init(jax.random.PRNGKey(2), cfg)
+    bt, k = 8, 4
+    prompt = np.asarray([5, 9, 2, 7, 3], np.int32)
+    n = 5
+    pool0 = lm.init_paged_kv_cache(cfg, pool_blocks=8, block_tokens=bt)
+    table = np.asarray([0, 1, 8, 8], np.int32)
+    lg, pool0 = lm.paged_prefill(params, pool0, table,
+                                 np.pad(prompt, (0, 3)), 0, n, cfg)
+    g = [int(lm.greedy_token(lg))]
+    # reference: chain k+1 plain greedy decode steps
+    pool_ref = pool0
+    ids = np.asarray([g[0]], np.int32)
+    pos = np.asarray([n], np.int32)
+    for _ in range(k + 1):
+        lg, pool_ref = lm.paged_decode_step(params, pool_ref, ids, pos,
+                                            table[None, :], cfg)
+        g.append(int(lm.greedy_token(lg)[0]))
+        ids = np.asarray([g[-1]], np.int32)
+        pos = pos + 1
+    sampling = {"seed": np.zeros(1, np.uint32),
+                "temperature": np.zeros(1, np.float32),
+                "top_k": np.zeros(1, np.int32),
+                "top_p": np.ones(1, np.float32),
+                "role": lm.ROLE_TARGET}
+    q = np.full((1, k, 64), 1.0 / 64, np.float32)   # q is irrelevant at temp 0
+    pos2 = (n + np.arange(k + 1, dtype=np.int32))[None, :]
+    # a perfect draft: proposals are the greedy chain → all accepted + bonus
+    ids2 = np.asarray([[g[0]] + g[1:k + 1]], np.int32)
+    acc, toks, _ = lm.paged_verify_step(params, pool0, ids2, pos2,
+                                        table[None, :], q, sampling, cfg)
+    assert int(np.asarray(acc)[0]) == k
+    assert list(np.asarray(toks)[0]) == g[1:k + 2]
+    # a draft wrong at j=1: the greedy prefix commits, then the correction
+    bad = [g[0], g[1], (g[2] + 1) % 64, 0, 0]
+    acc, toks, _ = lm.paged_verify_step(params, pool0,
+                                        np.asarray([bad], np.int32), pos2,
+                                        table[None, :], q, sampling, cfg)
+    a = int(np.asarray(acc)[0])
+    assert a == 1
+    assert list(np.asarray(toks)[0][:a + 1]) == [g[1], g[2]]
+
+
+# -- the worker's speculative scheduler ---------------------------------------
+
+class _Ctx:
+    def __init__(self, service_id="w1"):
+        self.service_id = service_id
+        self.chips = None
+        self.stopping = False
+
+    def ready(self):
+        pass
+
+
+def _start_worker(broker, model, job, draft=None, service_id="w1"):
+    from rafiki_tpu.worker.generation import GenerationWorker
+
+    worker = GenerationWorker(job, "trial1", db=None, broker=broker)
+    worker._load_model = lambda sid: model
+    worker._load_draft_model = lambda sid: draft
+    ctx = _Ctx(service_id)
+    t = threading.Thread(target=worker.start, args=(ctx,), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not broker.get_worker_queues(job) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert broker.get_worker_queues(job), "worker never registered"
+    return worker, ctx, t
+
+
+def _stream(q, prompt, max_tokens, timeout_s=30.0, **extra):
+    req = {"prompt_ids": list(prompt), "max_tokens": max_tokens}
+    req.update(extra)
+    fut = q.submit_many([req], deadline=time.monotonic() + timeout_s)[0]
+    return fut.result(timeout_s)
+
+
+def _drain(stream, timeout_s=30.0):
+    toks, reason = [], None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            d = stream.next_delta(1.0)
+        except TimeoutError:
+            continue
+        except StopIteration:
+            break
+        toks.extend(d.tokens)
+        if d.finished:
+            reason = d.reason
+            break
+    return toks, reason
+
+
+def test_worker_spec_temp0_matches_plain_greedy_e2e(monkeypatch):
+    """THE tier-1 speculation invariant at scheduler level: the same
+    prompts served with the draft-verify loop active and with plain
+    paged decode produce IDENTICAL token streams — mixed accept lengths,
+    the correction draw, and the bonus token never change what a greedy
+    stream says, only how fast it says it."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_POOL_BLOCKS", "16")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_PREFIX_CACHE", "0")
+    monkeypatch.setenv("RAFIKI_GEN_SPEC_K", "4")
+    target, draft = _models()
+    prompts = [[5, 9, 2, 7, 3], [1, 2, 3, 4], [40] * 6, [7, 7]]
+
+    def serve(spec_on, job):
+        monkeypatch.setenv("RAFIKI_GEN_SPEC", "1" if spec_on else "0")
+        broker = InProcessBroker()
+        worker, ctx, t = _start_worker(
+            broker, target, job, draft=draft if spec_on else None)
+        q = list(broker.get_worker_queues(job).values())[0]
+        try:
+            out = []
+            for p in prompts:
+                toks, reason = _drain(_stream(q, p, 12))
+                assert reason == "max_tokens" and len(toks) == 12
+                out.append(toks)
+            return out, worker
+        finally:
+            ctx.stopping = True
+            t.join(timeout=10)
+
+    spec_out, w = serve(True, "specjob")
+    assert w._spec_on and w._spec_degraded is None
+    assert w._spec_rounds >= 1 and w._spec_proposed > 0, \
+        "speculation must actually have driven the decode"
+    plain_out, w2 = serve(False, "plainjob")
+    assert not w2._spec_on
+    assert spec_out == plain_out
+
+
+def test_sampled_stream_flood_resumes_exact_sequence(monkeypatch):
+    """The PR 13 flood drill, sampling edition: three sampled streams
+    through a pool sized for ~1.5 of them — someone is preempted
+    mid-decode, the committed history replays through re-prefill, and
+    because every draw is keyed by (seed, absolute position, role) each
+    stream still equals its uncontended rerun token for token."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "3")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_POOL_BLOCKS", "6")   # 48 tokens
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_PREFIX_CACHE", "0")
+    monkeypatch.setenv("RAFIKI_GEN_PREFILL_CHUNK", "8")
+    monkeypatch.setenv("RAFIKI_GEN_SPEC", "0")   # pure sampling drill
+    target, _ = _models()
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, target, "sampfloodjob")
+    q = list(broker.get_worker_queues("sampfloodjob").values())[0]
+    try:
+        preempts0 = REGISTRY.get("rafiki_gen_preemptions_total").value()
+        prompts = [[10 + i] * 16 for i in range(3)]
+        seeds = [101, 202, 303]
+        kw = {"temperature": 0.9, "top_k": 8}
+        streams = [_stream(q, p, 16, seed=sd, **kw)
+                   for p, sd in zip(prompts, seeds)]
+        outs = [_drain(s, timeout_s=60) for s in streams]
+        for i, (toks, reason) in enumerate(outs):
+            assert len(toks) == 16, f"stream {i}: {reason} {toks}"
+        preempts = (REGISTRY.get("rafiki_gen_preemptions_total").value()
+                    - preempts0)
+        assert preempts >= 1, "pool pressure must have preempted someone"
+        # uncontended reruns with the same seeds: identical sequences
+        for p, sd, (toks, _) in zip(prompts, seeds, outs):
+            solo, _ = _drain(_stream(q, p, 16, seed=sd, **kw),
+                             timeout_s=60)
+            assert solo == toks
+        # and sampling is actually sampling: a different seed diverges
+        other, _ = _drain(_stream(q, prompts[0], 16, seed=99999, **kw),
+                          timeout_s=60)
+        assert other != outs[0][0]
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+def test_sampled_request_refused_without_capability(monkeypatch):
+    """A sampled request against a greedy-only template must fail TYPED
+    at admission (GenerationRequestError -> HTTP 400 at the door), never
+    silently serve greedy."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.sdk import BaseModel
+    from rafiki_tpu.worker.generation import GenerationRequestError
+
+    target, _ = _models()
+    cls = type(target)
+
+    class _GreedyOnly(cls):
+        decode_step_sampled = BaseModel.decode_step_sampled
+        paged_decode_step_sampled = BaseModel.paged_decode_step_sampled
+        paged_verify_step = BaseModel.paged_verify_step
+
+    greedy = _GreedyOnly()
+    greedy._params = target._params
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "1")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "0")
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, greedy, "greedyjob")
+    q = list(broker.get_worker_queues("greedyjob").values())[0]
+    try:
+        fut = q.submit_many(
+            [{"prompt_ids": [3, 1], "max_tokens": 2,
+              "temperature": 0.8}],
+            deadline=time.monotonic() + 10)[0]
+        with pytest.raises(GenerationRequestError, match="sampling"):
+            fut.result(10)
+        # the refusal cost no slot; a greedy request still serves
+        toks, _ = _drain(_stream(q, [3, 1], 2))
+        assert len(toks) == 2
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+def test_sampling_kill_switch_and_param_validation(monkeypatch):
+    from rafiki_tpu.worker.generation import (
+        GenerationRequestError,
+        GenerationWorker,
+    )
+
+    parse = GenerationWorker._parse_query
+    monkeypatch.setenv("RAFIKI_GEN_SAMPLING", "0")
+    with pytest.raises(GenerationRequestError, match="disabled"):
+        parse({"prompt_ids": [1], "temperature": 0.7})
+    # greedy requests ignore the kill switch
+    _, _, _, samp = parse({"prompt_ids": [1]})
+    assert samp == (0.0, 0, 1.0, 0)
+    monkeypatch.setenv("RAFIKI_GEN_SAMPLING", "1")
+    with pytest.raises(GenerationRequestError, match="temperature"):
+        parse({"prompt_ids": [1], "temperature": -0.5})
+    with pytest.raises(GenerationRequestError, match="top_p"):
+        parse({"prompt_ids": [1], "temperature": 0.5, "top_p": 1.5})
+    with pytest.raises(GenerationRequestError, match="top_k"):
+        parse({"prompt_ids": [1], "temperature": 0.5, "top_k": -1})
+    with pytest.raises(GenerationRequestError, match="seed"):
+        parse({"prompt_ids": [1], "temperature": 0.5, "seed": -3})
+    # an omitted seed is derived once and pinned for the stream's life
+    _, _, _, s1 = parse({"prompt_ids": [1], "temperature": 0.5})
+    assert s1[3] >= 0
+    _, _, _, s2 = parse({"prompt_ids": [1], "temperature": 0.5,
+                         "seed": 42})
+    assert s2 == (0.5, 0, 1.0, 42)
+
+
+@pytest.mark.chaos
+def test_chaos_draft_fault_degrades_typed_streams_survive(monkeypatch):
+    """The crashing-draft drill: a chaos ERROR at the draft target
+    degrades speculation permanently and TYPED (gen_spec_degraded names
+    the fault in the stats row) while every stream still completes with
+    the exact plain-greedy tokens — a broken draft costs the multiplier,
+    never correctness."""
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.utils import chaos
+    from rafiki_tpu.worker.inference import serving_stats
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_POOL_BLOCKS", "16")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_PREFIX_CACHE", "0")
+    monkeypatch.setenv("RAFIKI_GEN_SPEC", "1")
+    target, draft = _models()
+    chaos.install(chaos.parse_rules(
+        "site=generate;action=error;match=draft/"))
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, target, "draftfaultjob",
+                                   draft=draft, service_id="wchaos")
+    q = list(broker.get_worker_queues("draftfaultjob").values())[0]
+    try:
+        toks, reason = _drain(_stream(q, [5, 9, 2, 7, 3], 8))
+        assert reason == "max_tokens" and len(toks) == 8
+        assert not worker._spec_on
+        assert "chaos" in (worker._spec_degraded or "")
+        row = serving_stats()["wchaos"]
+        assert row["gen_spec_on"] is False or not row["gen_spec_on"]
+        assert "chaos" in row.get("gen_spec_degraded", "")
+    finally:
+        chaos.clear()
+        ctx.stopping = True
+        t.join(timeout=10)
+    # the degraded stream is still the exact greedy stream
+    monkeypatch.setenv("RAFIKI_GEN_SPEC", "0")
+    broker2 = InProcessBroker()
+    worker2, ctx2, t2 = _start_worker(broker2, target, "draftrefjob")
+    q2 = list(broker2.get_worker_queues("draftrefjob").values())[0]
+    try:
+        ref, _ = _drain(_stream(q2, [5, 9, 2, 7, 3], 8))
+        assert ref == toks
+    finally:
+        ctx2.stopping = True
+        t2.join(timeout=10)
+
+
+def test_worker_stats_row_carries_spec_picture(monkeypatch):
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.worker.inference import serving_stats
+
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    monkeypatch.setenv("RAFIKI_GEN_KV_BLOCK_TOKENS", "8")
+    monkeypatch.setenv("RAFIKI_GEN_KV_POOL_BLOCKS", "16")
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_SPEC", "1")
+    target, draft = _models()
+    broker = InProcessBroker()
+    worker, ctx, t = _start_worker(broker, target, "specstatsjob",
+                                   draft=draft, service_id="wspec")
+    q = list(broker.get_worker_queues("specstatsjob").values())[0]
+    try:
+        toks, _ = _drain(_stream(q, [3, 1, 4], 6))
+        assert len(toks) == 6
+        row = serving_stats()["wspec"]
+        assert row["gen_spec_on"] is True or row["gen_spec_on"]
+        assert row["gen_spec_rounds"] >= 1
+        assert row["gen_spec_proposed"] >= row["gen_spec_accepted"] >= 0
+        assert "gen_spec_degraded" not in row
+    finally:
+        ctx.stopping = True
+        t.join(timeout=10)
+
+
+# -- fleet health + doctor ----------------------------------------------------
+
+def test_fleet_health_aggregates_speculation():
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.db.database import Database
+    from rafiki_tpu.placement.manager import (
+        ChipAllocator,
+        LocalPlacementManager,
+    )
+
+    admin = Admin(db=Database(":memory:"),
+                  placement=LocalPlacementManager(
+                      allocator=ChipAllocator([0])))
+    try:
+        admin.db.get_inference_job_worker = (
+            lambda sid: {"service_id": sid, "inference_job_id": "jobS",
+                         "trial_id": "t"})
+        admin.handle_event("inference_worker_stats", {
+            "service_id": "svc1", "batches": 1, "queries": 2,
+            "gen_slots_busy": 1, "gen_slots_max": 2, "gen_tokens": 40,
+            "gen_job": "jobS", "gen_spec_on": True,
+            "gen_spec_proposed": 100, "gen_spec_accepted": 70,
+            "gen_spec_rounds": 25})
+        admin.handle_event("inference_worker_stats", {
+            "service_id": "svc2", "batches": 1, "queries": 2,
+            "gen_slots_busy": 1, "gen_slots_max": 2, "gen_tokens": 40,
+            "gen_job": "jobS", "gen_spec_on": False,
+            "gen_spec_degraded": "draft model failed to load"})
+        gen = admin.get_fleet_health()["serving"]["generation"]["jobS"]
+        assert gen["spec_workers"] == 1
+        assert gen["spec_proposed"] == 100 and gen["spec_accepted"] == 70
+        assert gen["spec_acceptance_rate"] == 0.7
+        assert gen["spec_degraded"] == ["draft model failed to load"]
+    finally:
+        admin.shutdown()
+
+
+def test_doctor_speculative_decoding_check(monkeypatch):
+    from rafiki_tpu import doctor
+    from rafiki_tpu.worker import inference
+
+    monkeypatch.setenv("RAFIKI_DB_PATH", "/nonexistent/nowhere.sqlite3")
+    # isolate from spec drills run earlier in this process
+    monkeypatch.setattr(inference, "SERVING_STATS", {})
+    name, status, detail = doctor.check_speculative_decoding()
+    assert name == "speculative decoding"
+    if status != "PASS":          # only the global acceptance probe may fire
+        assert "acceptance rate" in detail
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "0")
+    _, status, detail = doctor.check_speculative_decoding()
+    assert status == "WARN" and "RAFIKI_GEN_KV_PAGED" in detail
+    monkeypatch.setenv("RAFIKI_GEN_KV_PAGED", "1")
+    monkeypatch.setenv("RAFIKI_GEN_SPEC_K", "12")
+    _, status, detail = doctor.check_speculative_decoding()
+    assert status == "WARN" and "RAFIKI_GEN_SPEC_K" in detail
+    monkeypatch.setenv("RAFIKI_GEN_SPEC_K", "4")
+    # a degraded live worker is surfaced by name
+    monkeypatch.setattr(
+        inference, "SERVING_STATS",
+        {"w9": {"gen_spec_degraded": "draft propose failed"}})
+    _, status, detail = doctor.check_speculative_decoding()
+    assert status == "WARN" and "draft propose failed" in detail
+    monkeypatch.setattr(inference, "SERVING_STATS", {})
+    # the kill switch makes the check a quiet PASS
+    monkeypatch.setenv("RAFIKI_GEN_SPEC", "0")
+    _, status, detail = doctor.check_speculative_decoding()
+    assert status == "PASS" and "plain decode" in detail
+
+
+def test_capability_fns_on_fixture_templates():
+    from rafiki_tpu.sdk import (
+        draft_capability,
+        sampling_capability,
+        spec_verify_capability,
+    )
+
+    _models()
+    gen_cls, draft_cls = _MODELS["classes"]
+    assert sampling_capability(gen_cls) is not None
+    assert spec_verify_capability(gen_cls) is not None
+    assert draft_capability(draft_cls) is not None
+
+
+def test_fused_draft_burst_equals_chained_sampled_steps():
+    """The optional ``decode_steps_sampled`` fast path is an in-graph
+    fusion of k chained ``decode_step_sampled`` calls — same tokens,
+    same q distributions, same cache, greedy AND sampled: the counter
+    RNG keys draws by absolute position, so fusing the loop cannot
+    change a single draw."""
+    import jax
+
+    from rafiki_tpu.models import lm
+
+    cfg = lm.tiny(vocab=64, max_len=32, dim=16, depth=1, heads=2)
+    params = lm.init(jax.random.PRNGKey(4), cfg)
+    k, n = 4, 5
+    prompt = np.asarray([3, 8, 1, 9, 6, 0, 0, 0], np.int32)
+    for temp in (0.0, 0.8):
+        sampling = {"seed": np.asarray([11, 22], np.uint32),
+                    "temperature": np.full(2, temp, np.float32),
+                    "top_k": np.full(2, 8, np.int32),
+                    "top_p": np.full(2, 0.95, np.float32),
+                    "role": lm.ROLE_DRAFT}
+        caches, firsts = [], []
+        for s in range(2):
+            c = lm.init_kv_cache(cfg, max_slots=2, max_len=32)
+            lg, c = lm.prefill(params, c, s, prompt, n, cfg)
+            caches.append(c)
+            firsts.append(int(lm.greedy_token(lg)))
+        # both slots prefilled in ONE cache for the batched calls
+        cache = jax.tree.map(
+            lambda a, b: np.where(
+                np.arange(a.shape[0]).reshape(
+                    (-1,) + (1,) * (a.ndim - 1)) == 0, a, b),
+            jax.tree.map(np.asarray, caches[0]),
+            jax.tree.map(np.asarray, caches[1]))
+        ids = np.asarray(firsts, np.int32)
+        pos = np.full(2, n, np.int32)
+        # reference: k chained single-step calls
+        c_ref, cur = cache, ids
+        toks_ref, q_ref = [], []
+        for j in range(k):
+            cur, qj, c_ref = lm.decode_step_sampled(
+                params, c_ref, cur, pos + j, sampling, cfg)
+            toks_ref.append(np.asarray(cur))
+            q_ref.append(np.asarray(qj))
+        toks, q, c_fused = lm.decode_steps_sampled(
+            params, cache, ids, pos, k, sampling, cfg)
+        assert np.array_equal(np.asarray(toks), np.stack(toks_ref, 1))
+        assert np.allclose(np.asarray(q), np.stack(q_ref, 1))
+        for a, b in zip(jax.tree.leaves(c_fused), jax.tree.leaves(c_ref)):
+            assert np.allclose(np.asarray(a), np.asarray(b))
