@@ -1285,6 +1285,229 @@ def bench_serving_generate_spec(n_clients: int = 4,
     return out
 
 
+def bench_serving_generate_failover(n_clients: int = 4,
+                                    max_tokens: int = 48,
+                                    prefix: str =
+                                    "serving_generate_failover") -> dict:
+    """Stream-continuity failover phase (docs/failure-model.md "Stream
+    continuity"): N streaming clients drive a two-replica generation
+    fleet through the full serving stack while a chaos SIGKILL
+    (``site=worker;action=drop``) abruptly kills one replica mid-phase.
+    The door's resume journal must re-route every in-flight stream to
+    the surviving sibling; the phase reports aggregate tokens/s, the
+    worst 1-second token-arrival window (the dip while streams stall on
+    the dead replica), the p95/max of per-stream worst inter-delta gap
+    (the client-observed resume gap), the resume/migration counters,
+    and — the headline — streams completed vs client-visible errors
+    (the zero-dropped-streams claim)."""
+    import threading as _threading
+
+    import requests as _requests
+
+    from rafiki_tpu.cache.queue import InProcessBroker
+    from rafiki_tpu.predictor.predictor import Predictor
+    from rafiki_tpu.predictor.server import PredictorServer
+    from rafiki_tpu.utils.metrics import REGISTRY
+
+    from rafiki_tpu.worker.generation import GenerationWorker
+
+    env_prev = {k: os.environ.get(k) for k in
+                ("RAFIKI_CHAOS", "RAFIKI_GEN_STREAM_TIMEOUT_S",
+                 "RAFIKI_GEN_RESUME_MAX", "RAFIKI_GEN_RESUME_BACKOFF_S")}
+    os.environ.pop("RAFIKI_CHAOS", None)
+    # the inter-token stall window bounds how long a stream waits on its
+    # dead replica before the door notices and resumes it — but it is
+    # also the budget a HEALTHY stream gets between deltas, and a resume
+    # burst makes the sibling pay fresh prefill compiles for the
+    # migrated prompt shapes, so a too-tight window misfires on live
+    # streams sharing the survivor's serve loop
+    os.environ["RAFIKI_GEN_STREAM_TIMEOUT_S"] = "2.0"
+    os.environ["RAFIKI_GEN_RESUME_MAX"] = "3"
+    os.environ["RAFIKI_GEN_RESUME_BACKOFF_S"] = "0.05"
+    model = _make_gen_bench_lm()
+
+    class _Ctx:
+        chips = None
+        stopping = False
+
+        def __init__(self, sid):
+            self.service_id = sid
+
+        def ready(self):
+            pass
+
+    job = f"genbench-{prefix}"
+    broker = InProcessBroker()
+    workers, ctxs, threads_w = [], [], []
+    for i in range(2):
+        w = GenerationWorker(job, f"t{i + 1}", db=None, broker=broker)
+        w._load_model = lambda sid: model
+        ctx = _Ctx(f"{prefix}-w{i + 1}")
+        wt = _threading.Thread(target=w.start, args=(ctx,), daemon=True)
+        wt.start()
+        workers.append(w)
+        ctxs.append(ctx)
+        threads_w.append(wt)
+    for _ in range(300):
+        if len(broker.get_worker_queues(job)) >= 2:
+            break
+        time.sleep(0.02)
+    predictor = Predictor(job, broker, task=None)
+    server = PredictorServer(predictor, job, auth=False).start()
+    _mig = REGISTRY.get("rafiki_gen_streams_migrated_total")
+    mig0 = int(_mig.value()) if _mig is not None else 0
+    try:
+        results = []       # (ttft_s, tokens, max_gap_s, wall_s)
+        errors = []
+        arrivals = []      # (t_mono, n_tokens) per delta, all streams
+        res_lock = _threading.Lock()
+        stop = _threading.Event()
+        shared_prefix = list(range(1, 17))
+
+        def one_stream(rng, warm_prompt=None):
+            prompt = warm_prompt or _mixed_prompt(rng, shared_prefix)
+            budget = min(max_tokens,
+                         _GEN_BENCH_CONTEXT - len(prompt) - 1)
+            t0 = time.monotonic()
+            ttft = None
+            tokens = 0
+            max_gap = 0.0
+            last = t0
+            with _requests.post(
+                    f"http://127.0.0.1:{server.port}/generate",
+                    json={"prompt_ids": prompt, "max_tokens": budget,
+                          "temperature": 0.8, "timeout_s": 120.0},
+                    stream=True, timeout=180) as resp:
+                buf = b""
+                for data in resp.iter_content(chunk_size=None):
+                    buf += data
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        delta = json.loads(line)
+                        now = time.monotonic()
+                        if delta.get("error"):
+                            with res_lock:
+                                errors.append(str(delta["error"]))
+                            return
+                        if ttft is None:
+                            ttft = now - t0
+                        else:
+                            max_gap = max(max_gap, now - last)
+                        last = now
+                        n = len(delta.get("tokens") or [])
+                        tokens += n
+                        if n and not warm_prompt:
+                            with res_lock:
+                                arrivals.append((now, n))
+                        if delta.get("finished"):
+                            with res_lock:
+                                results.append((ttft, tokens, max_gap,
+                                                now - t0))
+                            return
+            with res_lock:
+                errors.append("stream ended without a finished frame")
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    one_stream(rng)
+                except Exception as e:
+                    with res_lock:
+                        errors.append(repr(e))
+
+        # untimed warm-up (compile both prefill buckets + decode)
+        one_stream(np.random.default_rng(0),
+                   warm_prompt=[int(t) for t in range(3, 15)])
+        one_stream(np.random.default_rng(0),
+                   warm_prompt=[int(t) % 250 + 1 for t in range(90)])
+        results.clear()
+        threads = [_threading.Thread(target=client, args=(i + 1,),
+                                     daemon=True)
+                   for i in range(n_clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # let streams get in flight on both replicas
+        # kill replica 1 abruptly: the serve loop exits at its next
+        # round without handing streams back — the SIGKILL drill. The
+        # chaos controller re-parses RAFIKI_CHAOS on change.
+        kill_t = time.monotonic()
+        os.environ["RAFIKI_CHAOS"] = (
+            f"site=worker;action=drop;match={job}/{ctxs[0].service_id}"
+            ";times=1")
+        for _ in range(200):  # dead replica's queue must vanish
+            if ctxs[0].service_id not in broker.get_worker_queues(job):
+                break
+            time.sleep(0.05)
+        death_s = time.monotonic() - kill_t
+        time.sleep(2.0)  # streams resume + fresh waves land on w2
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.monotonic() - t0
+
+        gaps = sorted(r[2] * 1000.0 for r in results)
+        total_tokens = sum(r[1] for r in results)
+        # worst sliding 1 s token-arrival window (the failover dip)
+        floor_1s = None
+        if arrivals:
+            arr = sorted(arrivals)
+            lo, in_win = 0, 0
+            floor_1s = float("inf")
+            for hi, (t_hi, n_hi) in enumerate(arr):
+                in_win += n_hi
+                while arr[lo][0] < t_hi - 1.0:
+                    in_win -= arr[lo][1]
+                    lo += 1
+                if t_hi - arr[0][0] >= 1.0:
+                    floor_1s = min(floor_1s, in_win)
+            if floor_1s == float("inf"):
+                floor_1s = in_win
+        resumes = 0
+        c = REGISTRY.get("rafiki_gen_resumes_total")
+        if c is not None:
+            for reason in ("worker_death", "migrating"):
+                try:
+                    resumes += int(c.value(job, reason))
+                except Exception:
+                    pass
+        mig = REGISTRY.get("rafiki_gen_streams_migrated_total")
+        return {
+            f"{prefix}_clients": n_clients,
+            f"{prefix}_streams_completed": len(results),
+            f"{prefix}_client_errors": len(errors),
+            f"{prefix}_error_sample": errors[0] if errors else None,
+            f"{prefix}_tokens_s": (
+                round(total_tokens / wall, 1) if wall > 0 else 0.0),
+            f"{prefix}_tokens_floor_1s": floor_1s,
+            f"{prefix}_resume_gap_p95_ms": (
+                round(gaps[min(int(len(gaps) * 0.95),
+                               len(gaps) - 1)], 1) if gaps else None),
+            f"{prefix}_resume_gap_max_ms": (
+                round(gaps[-1], 1) if gaps else None),
+            f"{prefix}_resumes": resumes,
+            f"{prefix}_streams_migrated": (
+                int(mig.value()) - mig0 if mig is not None else 0),
+            f"{prefix}_replica_death_detect_s": round(death_s, 2),
+        }
+    finally:
+        for ctx in ctxs:
+            ctx.stopping = True
+        server.stop(drain_timeout_s=0.0)
+        for ctx in ctxs:
+            broker.unregister_worker(job, ctx.service_id)
+        for wt in threads_w:
+            wt.join(timeout=10)
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def bench_kv_capacity(prefix: str = "serving_generate") -> dict:
     """streams_per_chip at the mixed prompt distribution, paged vs ring
     at EQUAL KV memory — the headline multiplier of the paged allocator,
@@ -2062,6 +2285,13 @@ def main():
                     serving.update(bench_serving_generate_spec())
                 except Exception as e:
                     serving["serving_generate_error"] = repr(e)
+                # stream-continuity failover: chaos SIGKILL of one of
+                # two replicas under continuous streaming load — the
+                # zero-dropped-streams drill with its resume-gap cost
+                try:
+                    serving.update(bench_serving_generate_failover())
+                except Exception as e:
+                    serving["serving_generate_failover_error"] = repr(e)
             admin.stop_all_jobs()
 
             # ---- vectorized trials: scalar vs vmapped-K, same budget ---

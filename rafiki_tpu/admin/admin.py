@@ -1319,7 +1319,8 @@ class Admin:
             agents = self.placement.agent_health()
         down = [a for a, h in agents.items() if h["state"] == "DOWN"]
         jobs: Dict[str, Any] = {}
-        for job_id, predictor in self.services.predictors().items():
+        predictors = self.services.predictors()
+        for job_id, predictor in predictors.items():
             try:
                 depths = predictor.queue_depths()
                 jobs[job_id] = {
@@ -1363,10 +1364,11 @@ class Admin:
                 "prefix_hit_tokens": 0,
                 "spec_workers": 0, "spec_proposed": 0,
                 "spec_accepted": 0, "spec_rounds": 0,
-                "spec_degraded": [],
+                "spec_degraded": [], "resident_streams": 0,
             })
             g["workers"] += 1
             g["slots_busy"] += int(s.get("gen_slots_busy", 0))
+            g["resident_streams"] += int(s.get("gen_resident_streams", 0))
             g["tokens"] += int(s.get("gen_tokens", 0))
             g["kv_blocks_used"] += int(s.get("gen_kv_blocks_used", 0))
             g["kv_pool_blocks"] += int(s.get("gen_kv_pool_blocks", 0))
@@ -1391,6 +1393,19 @@ class Admin:
             g["spec_acceptance_rate"] = (
                 round(g["spec_accepted"] / g["spec_proposed"], 3)
                 if g["spec_proposed"] else None)
+        # stream-continuity rollup (docs/failure-model.md "Stream
+        # continuity"): the door-side journal/resume picture per gen job
+        # — resumes by trigger, client-visible continuity losses, and
+        # the journal's occupancy — merged from each job's Predictor
+        for job_id, g in generation.items():
+            predictor = predictors.get(job_id)
+            cont_fn = getattr(predictor, "gen_continuity_stats", None)
+            if callable(cont_fn):
+                try:
+                    g["continuity"] = cont_fn()
+                except Exception:
+                    logger.exception(
+                        "continuity probe of job %s failed", job_id)
         # training-plane fault picture (docs/failure-model.md,
         # "Training-plane faults"): per-job fault-kind counters and
         # absorbed retries from the STORE (covers every placement mode),

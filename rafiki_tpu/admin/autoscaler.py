@@ -18,7 +18,11 @@ rings (worker/inference.py) — but replica counts stayed frozen at
   ``RAFIKI_AUTOSCALE_DEPTH_LOW`` across the whole window — bounded by
   ``RAFIKI_AUTOSCALE_MIN_REPLICAS``, executed as a graceful drain
   (admin/services.py ``drain_replicas``: retire from the fan-out, flush
-  the queue, then destroy — zero in-flight requests dropped);
+  the queue — for generation replicas, also wait out resident streams —
+  then destroy; zero in-flight requests dropped, and streams that can't
+  finish in the drain window are handed back typed MIGRATING for
+  door-side resume on siblings, docs/failure-model.md "Stream
+  continuity");
 - **hysteresis + cooldowns** (`DEPTH_LOW` well under `DEPTH_HIGH`;
   separate up/down cooldowns, down much longer) and the bounded step so
   the loop can never flap or stampede;

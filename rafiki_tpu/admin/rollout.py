@@ -50,6 +50,15 @@ The autoscaler pauses its decisions for a job mid-rollout (and re-windows
 after); control-plane recovery resolves a half-finished rollout at boot —
 resume-as-done when the fleet is already fully new-version, rollback
 otherwise — so a crashed admin can never strand one.
+
+TEXT_GENERATION jobs roll through the same machine with
+**stream-granularity** lanes (docs/failure-model.md "Stream
+continuity"): a stream draws its version lane once at admission and
+keeps it for life; mid-stream deaths charge an ``error`` sample to the
+stream's lane so the judge sees them; each rolling drain waits out
+``gen_resident_streams`` inside the drain budget and the worker hands
+the rest back typed MIGRATING for door-side resume on same-version
+siblings — a gen-job update drops zero streams.
 """
 
 from __future__ import annotations

@@ -1174,6 +1174,19 @@ class ServicesManager:
                 self._scale_draining.difference_update(mine)
         return freed, removed
 
+    @staticmethod
+    def _resident_streams(sid: str) -> int:
+        """Generation streams still RESIDENT on a replica (busy slots +
+        preempted-stashed) — what a drain must wait out beyond the queue
+        depth: a generation replica with an empty inbox can still be
+        minutes from finishing its admitted streams. 0 for
+        classification replicas (no such stats row key)."""
+        from rafiki_tpu.worker.inference import SERVING_STATS, _stats_lock
+
+        with _stats_lock:
+            row = SERVING_STATS.get(sid)
+            return int(row.get("gen_resident_streams", 0)) if row else 0
+
     def _drain_one(self, inference_job_id: str, sid: str, predictor,
                    drain_timeout_s: float) -> None:
         queue = self._broker.get_worker_queues(inference_job_id).get(sid)
@@ -1186,7 +1199,7 @@ class ServicesManager:
             # lint: absorb(a dead queue handle simply ends the drain wait)
             except Exception:
                 break
-            if depth <= 0:
+            if depth <= 0 and self._resident_streams(sid) <= 0:
                 # consecutive-zero confirmation: a request that snapshotted
                 # its routes before the retire may still land one submit —
                 # give those stragglers a beat to either arrive or finish

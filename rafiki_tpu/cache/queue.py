@@ -99,13 +99,29 @@ class GenerationError(RuntimeError):
     silent hang — and :meth:`TokenStream.next_delta` re-raises it."""
 
 
+#: Typed eviction/terminal reason for a stream handed BACK to the door by
+#: a retiring replica (drain handoff, docs/failure-model.md "Stream
+#: continuity"): the stream is not finished and not failed — it wants to
+#: continue on a sibling replica via the door's resume journal.
+REASON_MIGRATING = "migrating"
+
+
+class StreamMigratingError(GenerationError):
+    """INFRA-class terminal: the serving replica handed the stream back
+    (``reason="migrating"``) instead of finishing it — drain, scale-down,
+    or rollout retirement. The door's resume journal catches this
+    *before* any client-visible frame and re-routes the stream to a
+    sibling replica; it only surfaces to the client (as a plain
+    :class:`GenerationError`) when every resume attempt is exhausted."""
+
+
 class TokenDelta:
     """One increment of a generation stream: the token ids emitted since
     the previous delta, plus the terminal flags. ``finished`` is True on
     the stream's LAST delta; ``reason`` then says why (``eos`` |
     ``max_tokens`` | ``context`` | ``deadline`` | ``error`` |
-    ``cancelled``) and ``error`` carries the fault text when reason is
-    ``error``."""
+    ``cancelled`` | ``migrating``) and ``error`` carries the fault text
+    when reason is ``error`` or ``migrating``."""
 
     __slots__ = ("tokens", "finished", "reason", "error")
 
@@ -170,6 +186,21 @@ class TokenStream:
             self._finished = True
             self._cond.notify_all()
 
+    def hand_back(self, message: str) -> None:
+        """Worker side: terminal MIGRATING handback — the replica is
+        retiring (drain, scale-down, rollout) and returns the unfinished
+        stream to the door, which resumes it on a sibling from its journal
+        (:meth:`next_delta` raises :class:`StreamMigratingError`). Every
+        token delta pushed before this one is still delivered in order, so
+        the door's committed-token journal is complete at handback."""
+        with self._cond:
+            if self._finished:
+                return
+            self._deltas.append(TokenDelta(
+                [], finished=True, reason=REASON_MIGRATING, error=message))
+            self._finished = True
+            self._cond.notify_all()
+
     def cancel(self) -> None:
         """Consumer side: stop decoding for this sequence (client gone or
         the door gave up on a stalled stream). The scheduler evicts the
@@ -204,6 +235,8 @@ class TokenStream:
                     f"{(timeout or 0.0):.1f}s")
             delta = self._deltas.pop(0)
             if delta.error is not None:
+                if delta.reason == REASON_MIGRATING:
+                    raise StreamMigratingError(delta.error)
                 raise GenerationError(delta.error)
             return delta
 

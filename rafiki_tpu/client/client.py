@@ -536,7 +536,15 @@ class Client:
         (temperature=0 or unset = greedy); a fixed ``seed`` makes the
         sampled stream reproducible — and the platform keeps it stable
         across mid-stream preemption/resume, so the sequence is exactly
-        the uncontended one either way."""
+        the uncontended one either way.
+
+        Stream continuity (docs/failure-model.md "Stream continuity"):
+        the door journals the stream and transparently resumes it
+        token-identically on a sibling replica if its worker dies or is
+        drained/retired mid-stream — the client just keeps receiving
+        deltas. Only when the bounded resume is exhausted (or refused:
+        the stream's model version has no replica left) does the typed
+        terminal error frame arrive."""
         key = (app, app_version)
         host, port, _ = self._dedicated_door(app, app_version)
         headers = {}

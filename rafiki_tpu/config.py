@@ -320,6 +320,30 @@ _DYNAMIC_PATHS = {
     #                                   earning its keep" (observability
     #                                   threshold only — serving never
     #                                   auto-disables on it)
+    # -- stream continuity (docs/failure-model.md "Stream continuity"):
+    # the door journals each live stream and resumes it on a sibling
+    # replica when its replica dies or hands the stream back:
+    #   RAFIKI_GEN_RESUME_MAX=3         resume attempts per stream before
+    #                                   the fault surfaces to the client
+    #                                   (0 disables resume entirely —
+    #                                   drain handoffs then become
+    #                                   client-visible errors; doctor
+    #                                   WARNs with the autoscaler on)
+    #   RAFIKI_GEN_RESUME_BACKOFF_S=0.05  base of the jittered resume
+    #                                   backoff (attempt n sleeps up to
+    #                                   base*2^n, capped by the request
+    #                                   deadline)
+    #   RAFIKI_GEN_JOURNAL_MAX_KB=64    per-stream journal byte cap
+    #                                   (prompt + committed tokens); a
+    #                                   stream outgrowing it keeps
+    #                                   streaming but loses resume
+    #                                   eligibility (doctor WARNs when
+    #                                   the cap cannot hold a worst-case
+    #                                   GEN_MAX_TOKENS stream)
+    #   RAFIKI_GEN_JOURNAL_TTL_S=600    journal entry TTL: a stream older
+    #                                   than this is never resumed (a
+    #                                   wedged multi-hour stream must not
+    #                                   replay forever)
     "GEN_MAX_SLOTS": lambda: _env_int("RAFIKI_GEN_MAX_SLOTS", 8),
     "GEN_SAMPLING": lambda: os.environ.get(
         "RAFIKI_GEN_SAMPLING", "1") != "0",
@@ -340,6 +364,13 @@ _DYNAMIC_PATHS = {
         "RAFIKI_GEN_STREAM_TIMEOUT_S", 10.0),
     "GEN_OCCUPANCY_HIGH": lambda: _env_float(
         "RAFIKI_GEN_OCCUPANCY_HIGH", 0.85),
+    "GEN_RESUME_MAX": lambda: _env_int("RAFIKI_GEN_RESUME_MAX", 3),
+    "GEN_RESUME_BACKOFF_S": lambda: _env_float(
+        "RAFIKI_GEN_RESUME_BACKOFF_S", 0.05),
+    "GEN_JOURNAL_MAX_KB": lambda: _env_int(
+        "RAFIKI_GEN_JOURNAL_MAX_KB", 64),
+    "GEN_JOURNAL_TTL_S": lambda: _env_float(
+        "RAFIKI_GEN_JOURNAL_TTL_S", 600.0),
     "AUTOSCALE": lambda: os.environ.get("RAFIKI_AUTOSCALE", "0") == "1",
     "AUTOSCALE_INTERVAL_S": lambda: _env_float(
         "RAFIKI_AUTOSCALE_INTERVAL_S", 2.0),

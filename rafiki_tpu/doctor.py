@@ -1040,6 +1040,65 @@ def check_speculative_decoding() -> Check:
     return ("speculative decoding", PASS, detail)
 
 
+def check_stream_continuity() -> Check:
+    """Stream continuity (docs/failure-model.md "Stream continuity"):
+    WARN when the door-side resume journal's byte cap cannot hold a
+    max-length stream (~8 B per journaled token id, so a cap under
+    GEN_MAX_TOKENS*8 means long streams overflow and silently lose
+    resume eligibility before they finish), when resume is disabled
+    (RAFIKI_GEN_RESUME_MAX=0) while the autoscaler is ON (every
+    scale-down's MIGRATING handoff then surfaces as a client error
+    instead of a sibling resume), when the journal TTL is shorter than
+    the serving deadline (a stream can outlive its own resume
+    eligibility), and when a rollout's drain window is zero (every
+    rolling step force-migrates every resident stream instead of
+    letting finishable ones run out)."""
+    from rafiki_tpu import config
+
+    notes = []
+    warn = False
+    cap_bytes = int(config.GEN_JOURNAL_MAX_KB) * 1024
+    need = int(config.GEN_MAX_TOKENS) * 8
+    if 0 < cap_bytes < need:
+        warn = True
+        notes.append(
+            f"RAFIKI_GEN_JOURNAL_MAX_KB={int(config.GEN_JOURNAL_MAX_KB)} "
+            f"({cap_bytes} B) < GEN_MAX_TOKENS*8 ({need} B): max-length "
+            "streams overflow the journal and lose resume eligibility "
+            "mid-stream")
+    resume_max = int(config.GEN_RESUME_MAX)
+    if resume_max <= 0 and bool(config.AUTOSCALE):
+        warn = True
+        notes.append(
+            "RAFIKI_GEN_RESUME_MAX=0 with RAFIKI_AUTOSCALE=1: scale-down "
+            "drain handoffs of generation streams cannot be resumed — "
+            "every forced migration becomes a client-visible error")
+    ttl = float(config.GEN_JOURNAL_TTL_S)
+    if 0 < ttl < float(config.PREDICT_TIMEOUT_S):
+        warn = True
+        notes.append(
+            f"RAFIKI_GEN_JOURNAL_TTL_S={ttl:g} < "
+            f"PREDICT_TIMEOUT_S={float(config.PREDICT_TIMEOUT_S):g}: a "
+            "stream can outlive its journal entry and die unresumable "
+            "inside its own deadline")
+    if resume_max > 0 and float(config.AUTOSCALE_DRAIN_S) <= 0:
+        warn = True
+        notes.append(
+            f"RAFIKI_AUTOSCALE_DRAIN_S="
+            f"{float(config.AUTOSCALE_DRAIN_S):g}: gen rollouts/scale-"
+            "downs skip the run-out window and force-migrate EVERY "
+            "resident stream — resumes work but burn sibling prefills "
+            "for streams that could have finished in place")
+    if warn:
+        return ("stream continuity", WARN, "; ".join(notes))
+    if resume_max <= 0:
+        return ("stream continuity", PASS,
+                "resume disabled (RAFIKI_GEN_RESUME_MAX=0)")
+    return ("stream continuity", PASS,
+            f"resume on: {resume_max} attempt(s), journal cap "
+            f"{int(config.GEN_JOURNAL_MAX_KB)} KB, TTL {ttl:g}s")
+
+
 #: prediction-cache byte cap past which the doctor reads "this cache
 #: will contend with the models for host memory" — results live in the
 #: admin process's RAM beside every Predictor, door, and broker ring
@@ -1522,7 +1581,7 @@ CHECKS: List[Callable[[], Check]] = [
     check_vectorized_trials,
     check_static_analysis, check_concurrency_lint,
     check_int8_serving, check_generative_serving,
-    check_speculative_decoding,
+    check_speculative_decoding, check_stream_continuity,
     check_prediction_cache,
     check_observability, check_agents, check_backend,
 ]

@@ -369,6 +369,20 @@ class AdmissionController:
         with self._lock:
             return {t: round(s[0], 3) for t, s in self._fair.items()}
 
+    def note_backend_shed(self) -> None:
+        """Book a WHOLE-FLEET-FULL refusal: the door admitted the
+        request, then every replica's bounded queue said no
+        (``QueueFullError`` out of the predictor). The client saw the
+        same 429 + Retry-After as a deadline shed, so it lands in the
+        deadline-class books — without this the fleet-full path would be
+        invisible to the shed counters, the shed-rate ring the
+        autoscaler reads, and the fairness pressure window."""
+        with self._lock:
+            self._shed_deadline += 1
+            self._m_shed_deadline.inc()
+            self._ring_shed.add()
+            self._last_shed_mono = time.monotonic()
+
     def release(self, tenant: Optional[str] = None) -> None:
         """Pair of :meth:`admit`. Callers that admitted with a ``tenant``
         must release with the same one (the in-flight ceiling's book)."""

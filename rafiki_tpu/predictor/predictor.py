@@ -23,12 +23,20 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
+import random
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from rafiki_tpu import config
-from rafiki_tpu.cache.queue import Broker, QueryFuture, QueueFullError
+from rafiki_tpu.cache.queue import (
+    Broker,
+    GenerationError,
+    QueryFuture,
+    QueueFullError,
+    StreamMigratingError,
+)
 from rafiki_tpu.predictor.ensemble import _PROB_TASKS, ensemble_predictions
 
 logger = logging.getLogger(__name__)
@@ -50,6 +58,48 @@ def _top_prob(pred: Any) -> Optional[float]:
     except (TypeError, ValueError):
         return None
     return None
+
+
+class CrossVersionResumeError(GenerationError):
+    """A journaled stream's model_version no longer has a routable
+    replica (its version lane was rolled back, promoted away, or fully
+    drained): resuming on a DIFFERENT version would splice two models'
+    token distributions into one stream, so the resume is refused typed
+    instead (docs/failure-model.md "Stream continuity")."""
+
+
+class _JournalEntry:
+    """One stream's door-side resume journal record: the original query
+    (prompt + pinned sampling seed/params), every token delivered to the
+    client so far, and the routing facts a resume needs (model_version,
+    lane, current worker). ``tokens`` is appended only by the one door
+    thread pumping the stream; the byte books and the cancelled/closed
+    flags are shared with Predictor accounting and guarded by the
+    predictor's ``_journal_lock``."""
+
+    __slots__ = ("query", "tokens", "max_tokens", "deadline", "version",
+                 "lane", "worker_id", "t0", "bytes", "resumable",
+                 "attempts", "cancelled", "closed")
+
+    def __init__(self, query: Dict[str, Any], worker_id: str,
+                 lane: Optional[str], version: int,
+                 deadline: float) -> None:
+        self.query = query          # original submit, seed already pinned
+        # lint: thread-confined(appended only by the door thread pumping this stream)
+        self.tokens: List[int] = []
+        self.deadline = deadline
+        self.version = version
+        self.lane = lane
+        self.worker_id = worker_id  # lint: thread-confined(rebound by the pump thread on resume)
+        self.t0 = time.monotonic()
+        # bytes/resumable/cancelled/closed are shared with Predictor
+        # accounting under the OWNING predictor's _journal_lock (an
+        # external lock — see the class docstring for the contract)
+        self.bytes = 0
+        self.resumable = True
+        self.attempts = 0           # lint: thread-confined(pump thread)
+        self.cancelled = False
+        self.closed = False
 
 
 class Predictor:
@@ -151,6 +201,30 @@ class Predictor:
         self._drift_lock = threading.Lock()
         self._drift_samples: collections.deque = collections.deque(
             maxlen=4096)  # guarded-by: _drift_lock
+        # -- stream continuity: door-side resume journal (docs/
+        # failure-model.md "Stream continuity") ---------------------------
+        # Per-stream _JournalEntry objects live inside their
+        # _ResumableStream wrapper; the predictor keeps the aggregate
+        # byte/stream books and the continuity counters here.
+        self._journal_lock = threading.Lock()
+        self._journal_bytes = 0    # guarded-by: _journal_lock
+        self._journal_streams = 0  # guarded-by: _journal_lock
+        self._continuity = {       # guarded-by: _journal_lock
+            "resumes_migrating": 0,     # drain/rollout handoffs resumed
+            "resumes_worker_death": 0,  # dead-replica streams resumed
+            "resume_failures": 0,       # client-visible continuity loss
+            "journal_overflows": 0,     # streams past RAFIKI_GEN_JOURNAL_MAX_KB
+            "cross_version_refusals": 0,
+        }
+        self._m_resumes = REGISTRY.counter(
+            "rafiki_gen_resumes_total",
+            "generation streams resumed on a sibling replica, by trigger "
+            "(migrating = typed drain/rollout handoff, worker_death = "
+            "replica queue vanished mid-stream)", ("job", "reason"))
+        self._g_journal = REGISTRY.gauge(
+            "rafiki_gen_journal_bytes",
+            "bytes held by the door-side generation resume journal",
+            ("job",)).labels(inference_job_id)
 
     def _bump(self, key: str, n: int = 1) -> None:
         with self._ol_lock:
@@ -352,7 +426,8 @@ class Predictor:
     def generate(self, query: Dict[str, Any],
                  timeout_s: Optional[float] = None):
         """Route one generation request to a worker's slot scheduler and
-        return its :class:`~rafiki_tpu.cache.queue.TokenStream`.
+        return a resumable token stream (:class:`_ResumableStream`, the
+        :class:`~rafiki_tpu.cache.queue.TokenStream` surface).
 
         Generation routes to exactly ONE replica (a token stream cannot be
         ensembled across trials the way one-shot predictions are):
@@ -361,20 +436,53 @@ class Predictor:
         submit of :meth:`predict_batch`. The returned stream's deltas are
         the worker's; the streaming door owns stall detection. Raises
         QueueFullError when every queue refuses, TimeoutError when no
-        slot admits the request inside its deadline."""
+        slot admits the request inside its deadline.
+
+        Stream continuity (docs/failure-model.md "Stream continuity"):
+        the door journals the prompt, the pinned sampling seed/params,
+        and every delivered token; if the stream dies of an INFRA fault
+        (typed MIGRATING handback, or its replica's queue vanishing from
+        the broker) the wrapper resumes it on a sibling of the SAME
+        model version — prefill of prompt + committed tokens at the same
+        seed, which PR 18's position-keyed RNG makes token-identical."""
         timeout_s = (timeout_s if timeout_s is not None
                      else config.PREDICT_TIMEOUT_S)
         deadline = time.monotonic() + timeout_s
+        query = dict(query)
+        try:
+            sampled = float(query.get("temperature") or 0.0) > 0.0
+        except (TypeError, ValueError):
+            sampled = False
+        if sampled and query.get("seed") is None:
+            # pin the sampling seed DOOR-side before the first submit: a
+            # worker-chosen seed dies with the worker, and PR 18's
+            # position-keyed draws only make a resumed continuation
+            # token-identical if the sibling replays the SAME seed
+            query["seed"] = uuid.uuid4().int & 0x7FFF_FFFF
+        stream, wid, lane, version = self._generate_submit(
+            query, deadline, frozenset())
+        entry = self._journal_open(query, wid, lane, version, deadline)
+        return _ResumableStream(self, entry, stream)
+
+    def _generate_submit(self, query: Dict[str, Any], deadline: float,
+                         exclude: "frozenset[str]"):
+        """One admission pass for a generation query: pick the lane,
+        walk the routable replicas past full queues, wait for a slot to
+        admit. Returns ``(stream, worker_id, lane, model_version)``.
+        ``exclude`` drops specific replicas from the walk (a resume must
+        never land back on the worker that just died)."""
         queues = self._broker.get_worker_queues(self._job_id)
         if not queues:
             raise RuntimeError(
                 f"No inference workers registered for job {self._job_id}")
         trials, draining = self._route_snapshot()
         routable = [w for w in queues
-                    if (not trials or w in trials) and w not in draining]
+                    if (not trials or w in trials) and w not in draining
+                    and w not in exclude]
         if not routable:
-            routable = [w for w in queues if not trials or w in trials] \
-                or list(queues)
+            routable = [w for w in queues
+                        if (not trials or w in trials) and w not in exclude] \
+                or [w for w in queues if w not in exclude] or list(queues)
         # rollout lane split: a generation stream answers from ONE
         # version — canary-lane streams go only to new-version replicas
         lane_new, permille = self._lane_snapshot()
@@ -392,6 +500,7 @@ class Predictor:
         rr = next(self._rr) % len(routable)
         order = routable[rr:] + routable[:rr]
         fut = None
+        timeout_s = max(deadline - time.monotonic(), 0.0)
         for wid in order:
             try:
                 fut = queues[wid].submit_many(
@@ -417,7 +526,209 @@ class Predictor:
             raise
         if lane is not None:
             self._lane_record(lane, "ok", time.monotonic() - t0)
-        return stream
+        # the version this stream is PINNED to: a resume may only ever
+        # target replicas serving the same model
+        with self._route_lock:
+            if (self._lane_new is not None and wid in self._lane_new
+                    and self._lane_version is not None):
+                version = self._lane_version
+            else:
+                version = self._serving_version
+        return stream, wid, lane, version
+
+    # -- stream continuity: resume journal + sibling resume (docs/
+    # failure-model.md "Stream continuity") ---------------------------------
+
+    def _journal_open(self, query: Dict[str, Any], worker_id: str,
+                      lane: Optional[str], version: int,
+                      deadline: float) -> _JournalEntry:
+        entry = _JournalEntry(query, worker_id, lane, version, deadline)
+        prompt = query.get("prompt_ids")
+        n_prompt = len(prompt) if isinstance(prompt, (list, tuple)) else 0
+        cost = 8 * n_prompt + 96  # ~8 B/token id + fixed record overhead
+        with self._journal_lock:
+            entry.bytes = cost
+            self._journal_streams += 1
+            self._journal_bytes += cost
+            self._g_journal.set(self._journal_bytes)
+        return entry
+
+    def _journal_note(self, entry: _JournalEntry, delta) -> None:
+        """Commit one delivered delta to the stream's journal. Past the
+        RAFIKI_GEN_JOURNAL_MAX_KB byte cap the stream KEEPS STREAMING but
+        loses resume eligibility (its bytes are released) — a bounded
+        journal can never re-prefill what it did not keep."""
+        n = len(delta.tokens)
+        if n == 0:
+            return
+        with self._journal_lock:
+            if entry.closed or not entry.resumable:
+                return
+            entry.tokens.extend(delta.tokens)
+            add = 8 * n
+            entry.bytes += add
+            self._journal_bytes += add
+            cap = int(config.GEN_JOURNAL_MAX_KB) * 1024
+            if cap > 0 and entry.bytes > cap:
+                entry.resumable = False
+                entry.tokens = []
+                self._journal_bytes -= entry.bytes
+                entry.bytes = 0
+                self._continuity["journal_overflows"] += 1
+            self._g_journal.set(self._journal_bytes)
+
+    def _journal_close(self, entry: _JournalEntry,
+                       cancelled: bool = False) -> None:
+        """Retire a journal entry (stream finished, errored terminally,
+        or the client disconnected): release its bytes and, for a
+        cancel, mark it so an in-flight resume/backoff aborts instead of
+        re-prefilling for a listener that is gone."""
+        with self._journal_lock:
+            if cancelled:
+                entry.cancelled = True
+            if entry.closed:
+                return
+            entry.closed = True
+            entry.tokens = []
+            self._journal_streams -= 1
+            self._journal_bytes -= entry.bytes
+            entry.bytes = 0
+            self._g_journal.set(self._journal_bytes)
+
+    def _journal_fail(self, entry: _JournalEntry) -> None:
+        """A stream died client-visibly (typed terminal fault, or resume
+        exhausted): retire the entry and charge the loss to the stream's
+        rollout lane so the SLO judge sees mid-stream deaths, not just
+        admission outcomes."""
+        with self._journal_lock:
+            already = entry.closed
+        self._journal_close(entry)
+        if not already:
+            with self._journal_lock:
+                self._continuity["resume_failures"] += 1
+            if entry.lane is not None:
+                self._lane_record(entry.lane, "error", 0.0)
+
+    def _resume_candidates(self, entry: _JournalEntry):
+        """The replicas a journaled stream may resume on: routable,
+        not draining, not the replica it just died on, and serving the
+        entry's PINNED model_version — during a rollout the new-version
+        lane and the incumbent fleet are disjoint resume domains.
+        Raises :class:`CrossVersionResumeError` when the version has no
+        replica left (typed: splicing versions is never an option)."""
+        queues = self._broker.get_worker_queues(self._job_id)
+        trials, draining = self._route_snapshot()
+        with self._route_lock:
+            lane_new = (set(self._lane_new)
+                        if self._lane_new is not None else None)
+            lane_version = self._lane_version
+            serving = self._serving_version
+        routable = [w for w in queues
+                    if (not trials or w in trials) and w not in draining
+                    and w != entry.worker_id]
+        if lane_new is not None:
+            if lane_version is not None and entry.version == lane_version \
+                    and lane_version != serving:
+                cands = [w for w in routable if w in lane_new]
+            elif entry.version == serving:
+                cands = [w for w in routable if w not in lane_new]
+            else:
+                cands = []
+        else:
+            cands = routable if entry.version == serving else []
+        if not cands:
+            with self._journal_lock:
+                self._continuity["cross_version_refusals"] += 1
+            raise CrossVersionResumeError(
+                f"stream cannot resume: no routable sibling serves its "
+                f"model_version {entry.version} (fleet serves "
+                f"{serving}" + (f", canary lane {lane_version}"
+                                if lane_version is not None else "") + ")")
+        return cands, queues
+
+    def _resume_stream(self, entry: _JournalEntry, reason: str):
+        """Resume a journaled stream on a sibling: RESUME submit of
+        prompt + committed tokens at the pinned seed, under bounded
+        jittered retries (RAFIKI_GEN_RESUME_MAX across the stream's
+        lifetime, backoff base RAFIKI_GEN_RESUME_BACKOFF_S), honoring
+        the original request deadline and the journal TTL. Returns the
+        new inner TokenStream; raises :class:`GenerationError` (typed)
+        when the stream cannot be resumed."""
+        max_attempts = int(config.GEN_RESUME_MAX)
+        base = max(float(config.GEN_RESUME_BACKOFF_S), 0.0)
+        with self._journal_lock:
+            ok = entry.resumable and not entry.cancelled and not entry.closed
+        if not ok:
+            raise GenerationError(
+                "stream is not resumable (journal overflowed "
+                "RAFIKI_GEN_JOURNAL_MAX_KB, or the client is gone)")
+        if max_attempts <= 0:
+            raise GenerationError(
+                "stream resume is disabled (RAFIKI_GEN_RESUME_MAX=0)")
+        if time.monotonic() - entry.t0 > float(config.GEN_JOURNAL_TTL_S):
+            raise GenerationError(
+                "resume journal entry expired (RAFIKI_GEN_JOURNAL_TTL_S)")
+        last_err: Optional[Exception] = None
+        while entry.attempts < max_attempts:
+            entry.attempts += 1
+            if entry.attempts > 1:
+                # jittered exponential backoff, capped by the deadline;
+                # a client disconnect mid-backoff cancels the journal
+                # entry, so re-check after every sleep
+                delay = base * (2 ** (entry.attempts - 2)) \
+                    * random.uniform(0.5, 1.0)
+                delay = min(delay, entry.deadline - time.monotonic())
+                if delay > 0:
+                    time.sleep(delay)
+            with self._journal_lock:
+                if entry.cancelled or entry.closed:
+                    raise GenerationError(
+                        "stream resume abandoned: client disconnected")
+                resume_tokens = list(entry.tokens)
+            remaining = entry.deadline - time.monotonic()
+            if remaining <= 0:
+                raise GenerationError(
+                    "request deadline passed before the stream could "
+                    "be resumed")
+            cands, queues = self._resume_candidates(entry)
+            rr = next(self._rr) % len(cands)
+            for wid in cands[rr:] + cands[:rr]:
+                q = dict(entry.query)
+                q["resume_tokens"] = resume_tokens
+                q["max_duration_s"] = remaining
+                try:
+                    fut = queues[wid].submit_many(
+                        [q], deadline=entry.deadline)[0]
+                    stream = fut.result(
+                        max(entry.deadline - time.monotonic(), 0.0))
+                # lint: absorb(a sibling that refuses or fails the resume is walked past; the bounded retry loop owns giving up)
+                except Exception as e:
+                    last_err = e
+                    continue
+                entry.worker_id = wid
+                self._m_resumes.labels(self._job_id, reason).inc()
+                with self._journal_lock:
+                    self._continuity[f"resumes_{reason}"] = (
+                        self._continuity.get(f"resumes_{reason}", 0) + 1)
+                logger.info(
+                    "stream resumed on sibling %s (reason=%s, attempt "
+                    "%d/%d, %d committed tokens)", wid, reason,
+                    entry.attempts, max_attempts, len(resume_tokens))
+                return stream
+        detail = f": {last_err!r}" if last_err is not None else ""
+        raise GenerationError(
+            f"stream resume exhausted after {entry.attempts} attempt(s) "
+            f"(RAFIKI_GEN_RESUME_MAX={max_attempts}){detail}")
+
+    def gen_continuity_stats(self) -> Dict[str, int]:
+        """The job's stream-continuity picture (fleet-health's
+        serving.generation rollup + /healthz): resume counts by trigger,
+        client-visible continuity losses, journal occupancy."""
+        with self._journal_lock:
+            out = dict(self._continuity)
+            out["journal_streams"] = self._journal_streams
+            out["journal_bytes"] = self._journal_bytes
+        return out
 
     def predict_batch(
         self, queries: List[Any], timeout_s: Optional[float] = None,
@@ -1002,3 +1313,85 @@ class Predictor:
             if not issued or time.monotonic() >= until:
                 return None
             time.sleep(min(0.02, max(until - time.monotonic(), 0.0)))
+
+
+class _ResumableStream:
+    """Door-side stream continuity (docs/failure-model.md "Stream
+    continuity"): the stream handle :meth:`Predictor.generate` returns.
+    Journals every delta it delivers and, when the stream dies of an
+    INFRA-class fault, transparently resumes it on a sibling replica:
+
+    - a typed MIGRATING handback (:class:`StreamMigratingError` — the
+      replica is draining for scale-down or rollout retirement), or
+    - the replica's death (``next_delta`` timed out AND the worker's
+      queue is gone from the broker — a SIGKILL'd worker unregisters on
+      the way down, and a genuinely vanished host is indistinguishable
+      from that door-side).
+
+    A timeout while the worker is still registered is a genuine decode
+    stall and re-raises for the door's typed stall handling; a plain
+    :class:`GenerationError` is a model-class fault and is never
+    retried (resuming poison replays poison). Exposes the TokenStream
+    surface (``next_delta``/``cancel``/``seq_id``) so the streaming
+    doors and clients need no changes."""
+
+    def __init__(self, predictor: Predictor, entry: _JournalEntry,
+                 inner) -> None:
+        self._p = predictor
+        self._entry = entry
+        self._inner = inner  # lint: thread-confined(rebound only by the door thread pumping this stream)
+
+    @property
+    def seq_id(self):
+        return self._inner.seq_id
+
+    def cancel(self) -> None:
+        """Client gone: retire the journal entry FIRST so a resume
+        backoff in flight aborts, then cancel the live worker slot."""
+        self._p._journal_close(self._entry, cancelled=True)
+        self._inner.cancel()
+
+    def next_delta(self, timeout: Optional[float] = None):
+        while True:
+            try:
+                delta = self._inner.next_delta(timeout=timeout)
+            except StopIteration:
+                self._p._journal_close(self._entry)
+                raise
+            except StreamMigratingError:
+                self._resume_or_raise("migrating")
+                continue
+            except TimeoutError:
+                if self._worker_alive():
+                    raise  # genuine stall: the door owns the typed frame
+                self._resume_or_raise("worker_death")
+                continue
+            except GenerationError:
+                self._p._journal_fail(self._entry)
+                raise
+            self._p._journal_note(self._entry, delta)
+            if delta.finished:
+                self._p._journal_close(self._entry)
+            return delta
+
+    def _worker_alive(self) -> bool:
+        queues = self._p._broker.get_worker_queues(self._p._job_id)
+        return self._entry.worker_id in queues
+
+    def _resume_or_raise(self, reason: str) -> None:
+        """Swap the inner stream for a sibling's resumed one, or retire
+        the journal and surface a typed terminal fault. Cross-version
+        refusals keep their own type (:class:`CrossVersionResumeError`);
+        a MIGRATING handback must never leak to the client as such."""
+        try:
+            self._inner = self._p._resume_stream(self._entry, reason)
+        except GenerationError as e:
+            self._p._journal_fail(self._entry)
+            if isinstance(e, StreamMigratingError):
+                raise GenerationError(str(e)) from e
+            raise
+        except Exception as e:
+            self._p._journal_fail(self._entry)
+            raise GenerationError(
+                f"stream died ({reason}) and could not be resumed: "
+                f"{e!r}") from e

@@ -514,6 +514,12 @@ class PredictorServer:
         except GenerationRequestError as e:
             self._respond(handler, 400, {"error": str(e)})
         except (QueueFullError, DeadlineUnmeetableError) as e:
+            if isinstance(e, QueueFullError):
+                # whole-fleet-full: every replica's bounded queue
+                # refused the new stream AFTER door admission — book the
+                # shed so the admission metrics see it (the classifier
+                # door's semantics, mirrored)
+                self.admission.note_backend_shed()
             self._respond(handler, 429, {"error": str(e)},
                           headers=retry_after_headers(e))
         except ServerOverloadedError as e:

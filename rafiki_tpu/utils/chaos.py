@@ -85,6 +85,13 @@ SITE_AGENT = "agent"
 # the condition that triggers admission shed + hedge suppression), `drop`
 # makes it silently swallow a batch (futures never resolve; the
 # predictor's SLO machinery takes over), `error` fails the batch.
+# GENERATION replicas (worker/generation.py) ask this site once per
+# serve-loop round with target "{job_id}/{service_id}" — the kill-replica
+# chaos target: `drop` is the SIGKILL drill (the loop exits ABRUPTLY,
+# resident streams abandoned un-handed-back; the door's journal resumes
+# them on siblings when the dead replica's queue vanishes), `error` is a
+# clean kill (typed MIGRATING handoff of every resident stream first),
+# `delay` stalls the whole replica for a round.
 SITE_WORKER = "worker"
 # serving wire chokepoint (cache/shm_broker.py): frames popped off the
 # shm rings, BEFORE decode. `corrupt` garbles/truncates the raw bytes on

@@ -191,6 +191,11 @@ def _metrics():
                 "rafiki_gen_spec_degraded_total",
                 "speculation degradations to plain decode (draft fault, "
                 "verify fault, capability mismatch)"),
+            "migrated": REGISTRY.counter(
+                "rafiki_gen_streams_migrated_total",
+                "unfinished streams handed back typed (MIGRATING) by a "
+                "retiring generation replica for door-side resume on a "
+                "sibling (docs/failure-model.md \"Stream continuity\")"),
         }
     return _M
 
@@ -337,7 +342,36 @@ class GenerationWorker(InferenceWorker):
             self._pending = []
             self._recent_prefixes: "OrderedDict[str, bool]" = OrderedDict()
             self._last_alloc_stats: Dict[str, int] = {}
+            # lint: thread-confined(set by the serve thread's chaos kill only)
+            killed = False
             while not ctx.stopping:
+                # replica-level chaos (RAFIKI_CHAOS site=worker, the same
+                # target shape as the classification serve loop): the
+                # deterministic SIGKILL-mid-stream drill. drop = abrupt
+                # death — resident streams are ABANDONED without terminal
+                # deltas (exactly what a real SIGKILL leaves behind; the
+                # door detects the dead replica on its stall timeout and
+                # resumes from the journal); error = clean kill — every
+                # resident stream is handed back typed MIGRATING before
+                # the replica exits; delay = slow replica.
+                rule = chaos.hit(chaos.SITE_WORKER,
+                                 f"{self._job_id}/{ctx.service_id}")
+                if rule is not None:
+                    if rule.action == chaos.ACTION_DELAY:
+                        chaos.sleep_for(rule)
+                    elif rule.action == chaos.ACTION_DROP:
+                        logger.warning(
+                            "chaos: killing generation replica %s "
+                            "(streams abandoned, SIGKILL drill)",
+                            ctx.service_id)
+                        killed = True
+                        break
+                    else:  # ACTION_ERROR: clean kill with handoff
+                        logger.warning(
+                            "chaos: retiring generation replica %s "
+                            "(streams handed back MIGRATING)",
+                            ctx.service_id)
+                        break
                 n_active = sum(1 for s in slots if s is not None)
                 free = [i for i, s in enumerate(slots) if s is None]
                 # -- admit: resumes first, then queued requests -----------
@@ -386,6 +420,15 @@ class GenerationWorker(InferenceWorker):
                     # only stashed streams remain and nothing can run —
                     # don't spin while the pool refills
                     time.sleep(0.005)
+            # -- drain handoff (docs/failure-model.md "Stream
+            # continuity"): a retiring replica (scale-down drain, rollout
+            # retirement, queue closed, clean chaos kill) must never
+            # abandon a resident stream silently — each one is handed
+            # back typed MIGRATING so the door resumes it on a sibling.
+            # A chaos SIGKILL (killed=True) skips this on purpose: the
+            # whole point of that drill is recovering WITHOUT a handoff.
+            if not killed:
+                self._hand_back_all(slots, ctx.service_id)
         finally:
             self._broker.unregister_worker(self._job_id, ctx.service_id)
             if getattr(self, "_draft", None) is not None:
@@ -516,12 +559,27 @@ class GenerationWorker(InferenceWorker):
         slot; a prefill crash likewise never kills co-resident slots.
         ``seq`` re-admits a stashed request under its ORIGINAL admission
         order — minting a fresh one would make the oldest waiter the
-        youngest resident and the first preemption victim (starvation)."""
+        youngest resident and the first preemption victim (starvation).
+
+        A RESUME request (``resume_tokens`` carries a dead/retired
+        sibling's committed history) admits through this same path: the
+        full history is prefilled under the stream's pinned seed, the
+        position-keyed RNG continues the sampled sequence
+        token-identically, and the slot starts with ``produced`` already
+        at the committed count so ``max_tokens`` stays the ORIGINAL
+        budget — the KV charge is exactly history + remaining budget,
+        and a resume never lands a TTFT observation."""
         try:
             prompt, max_tokens, max_duration_s, sampling = \
                 self._parse_query(query)
+            resume = self._parse_resume(query)
         except GenerationRequestError as e:
             fut.set_error(e)
+            return cache
+        if resume and len(resume) >= max_tokens:
+            fut.set_error(GenerationRequestError(
+                f"resume_tokens ({len(resume)}) already meets max_tokens "
+                f"({max_tokens}) — nothing left to resume"))
             return cache
         if sampling[0] > 0.0 \
                 and getattr(self, "_sampling_cap", None) is None:
@@ -544,26 +602,29 @@ class GenerationWorker(InferenceWorker):
                 f"({spec.max_context})"))
             return cache
         self._note_shareable(prompt)
+        #: the prefill history — prompt + committed tokens for a resume
+        history = prompt + resume
+        produced = len(resume)
         deadline = (time.monotonic() + max_duration_s
                     if max_duration_s else None)
         if self._alloc is not None:
-            if self._alloc.blocks_for(len(prompt) + 1) \
+            if self._alloc.blocks_for(len(history) + 1) \
                     > self._alloc.pool_blocks:
                 fut.set_error(GenerationRequestError(
-                    f"prompt ({len(prompt)} tokens) cannot fit the KV "
-                    f"pool ({self._alloc.pool_blocks} blocks x "
+                    f"prompt+history ({len(history)} tokens) cannot fit "
+                    f"the KV pool ({self._alloc.pool_blocks} blocks x "
                     f"{self._alloc.block_tokens} tokens) — raise "
                     "RAFIKI_GEN_KV_POOL_BLOCKS"))
                 return cache
             return self._admit_paged(model, spec, cache, slots, free, fut,
-                                     prompt, max_tokens, deadline,
+                                     history, max_tokens, deadline,
                                      service_id, seq=seq,
-                                     sampling=sampling)
+                                     sampling=sampling, produced=produced)
         # -- contiguous-ring path -------------------------------------------
         slot_ix = free.pop(0)
         t0 = time.monotonic()
         try:
-            first_id, cache = model.prefill(cache, slot_ix, list(prompt))
+            first_id, cache = model.prefill(cache, slot_ix, list(history))
         except Exception as e:
             free.insert(0, slot_ix)
             logger.error("prefill failed in generation worker %s:\n%s",
@@ -571,9 +632,9 @@ class GenerationWorker(InferenceWorker):
             fut.set_error(RuntimeError(f"prefill failed: {e}"))
             return cache
         stream = TokenStream(seq_id=uuid.uuid4().hex[:12])
-        slot = _Slot(stream, list(prompt), max_tokens, deadline,
+        slot = _Slot(stream, list(history), max_tokens, deadline,
                      self._next_seq() if seq is None else seq,
-                     sampling=sampling)
+                     produced=produced, sampling=sampling)
         slots[slot_ix] = slot
         fut.set_result(stream)
         from rafiki_tpu.worker.inference import _record_batch
@@ -585,17 +646,20 @@ class GenerationWorker(InferenceWorker):
             # commit it. Rewind one row so the next decode round rewrites
             # the last prompt position (identical K/V) and SAMPLES the
             # first token under its position-keyed counter RNG; TTFT
-            # lands on that first sampled commit.
-            slot.last_id = prompt[-1]
-            slot.position = len(prompt) - 1
-            slot.t0 = t0
+            # lands on that first sampled commit. A resume rewinds the
+            # same way — onto its last COMMITTED token — and suppresses
+            # TTFT (a resumed token is never a first token).
+            slot.last_id = history[-1]
+            slot.position = len(history) - 1
+            slot.t0 = None if produced else t0
             return cache
         first_id = int(first_id)
         slot.last_id = first_id
-        slot.position = len(prompt)
-        slot.produced = 1
+        slot.position = len(history)
+        slot.produced += 1
         slot.tokens.append(first_id)
-        m["ttft"].observe(time.monotonic() - t0)
+        if not produced:
+            m["ttft"].observe(time.monotonic() - t0)
         m["tokens"].inc()
         finished, reason = self._finish_reason(slot, spec, first_id)
         stream.push([first_id], finished=finished, reason=reason)
@@ -627,22 +691,26 @@ class GenerationWorker(InferenceWorker):
 
     def _admit_paged(self, model, spec, cache, slots, free, fut, prompt,
                      max_tokens, deadline, service_id, seq=None,
-                     sampling=None):
+                     sampling=None, produced=0):
         """Open a block table for the prompt (mapping any cached prefix),
         run the FIRST prefill chunk synchronously, and resolve the
         request's future. Remaining chunks (long prompts) advance one per
         scheduler round so resident streams keep decoding in between. A
         pool too full for even the first chunk stashes the request — it
-        is the youngest stream, so IT waits, not the residents."""
+        is the youngest stream, so IT waits, not the residents.
+
+        For a door-side RESUME, ``prompt`` is the full prompt+committed
+        history and ``produced`` the committed count — the slot keeps the
+        original ``max_tokens`` budget and never lands a TTFT sample."""
         slot_ix = free.pop(0)
         slot = _Slot(TokenStream(seq_id=uuid.uuid4().hex[:12]),
                      list(prompt), max_tokens, deadline,
                      self._next_seq() if seq is None else seq,
-                     sampling=sampling)
+                     produced=produced, sampling=sampling)
         plan = self._alloc.open_slot(slot_ix, prompt)
         slot.pending_from = plan.cached_tokens
         slot.position = plan.cached_tokens
-        slot.t0 = time.monotonic()
+        slot.t0 = None if produced else time.monotonic()
         slots[slot_ix] = slot  # before _try_chunk: a same-call finish
         # (tiny prompt hitting EOS on its first token) evicts through the
         # normal path
@@ -659,16 +727,19 @@ class GenerationWorker(InferenceWorker):
                 slots[slot_ix] = None
                 self._alloc.close_slot(slot_ix)
                 free.insert(0, slot_ix)
-                self._stash(_Pending(
-                    slot.seq, fut=fut,
-                    query={"prompt_ids": prompt, "max_tokens": max_tokens,
-                           "max_duration_s": None,
-                           # carry the DERIVED seed: the resumed parse
-                           # must replay the identical sampled stream
-                           "temperature": slot.temperature,
-                           "top_k": slot.top_k, "top_p": slot.top_p,
-                           "seed": slot.rng_seed},
-                    deadline=deadline))
+                cut = len(prompt) - produced
+                query = {"prompt_ids": list(prompt[:cut]),
+                         "max_tokens": max_tokens,
+                         "max_duration_s": None,
+                         # carry the DERIVED seed: the resumed parse
+                         # must replay the identical sampled stream
+                         "temperature": slot.temperature,
+                         "top_k": slot.top_k, "top_p": slot.top_p,
+                         "seed": slot.rng_seed}
+                if produced:
+                    query["resume_tokens"] = list(prompt[cut:])
+                self._stash(_Pending(slot.seq, fut=fut, query=query,
+                                     deadline=deadline))
                 return cache
         except Exception as e:
             slots[slot_ix] = None
@@ -913,6 +984,48 @@ class GenerationWorker(InferenceWorker):
             deadline=slot.deadline,
             sampling=(slot.temperature, slot.top_k, slot.top_p,
                       slot.rng_seed)))
+
+    # -- drain handoff -------------------------------------------------------
+
+    def _hand_back_all(self, slots: List[Optional[_Slot]],
+                       service_id: str) -> None:
+        """Typed MIGRATING handback of every unfinished resident (and
+        preempted-stashed) stream — the retiring replica's half of the
+        door-side resume contract. Streams that could finish inside the
+        drain window already ran out through the normal serve loop; what
+        is left here continues on a sibling from the door's journal.
+        Pool-dry requests still waiting on their future get the same
+        queue-closed error a close() would give them (the door's submit
+        walk owns pre-stream retry)."""
+        m = _metrics()
+        handed = 0
+        for i, s in enumerate(slots):
+            if s is None:
+                continue
+            if s.stream.cancelled:
+                self._evict_slot(slots, i, "cancelled")
+                continue
+            s.stream.hand_back(
+                f"generation replica {service_id} is retiring; stream "
+                "handed back for resume on a sibling")
+            m["migrated"].inc()
+            handed += 1
+            self._evict_slot(slots, i, "migrating")
+        for entry in self._pending:
+            if entry.stream is not None:
+                if not entry.stream.cancelled:
+                    entry.stream.hand_back(
+                        f"generation replica {service_id} is retiring; "
+                        "stream handed back for resume on a sibling")
+                    m["migrated"].inc()
+                    handed += 1
+            elif entry.fut is not None:
+                entry.fut.set_error(RuntimeError("worker queue closed"))
+        self._pending = []
+        if handed:
+            logger.info(
+                "generation replica %s handed back %d unfinished "
+                "stream(s) for door-side resume", service_id, handed)
 
     # -- the decode round ----------------------------------------------------
 
@@ -1365,6 +1478,24 @@ class GenerationWorker(InferenceWorker):
         return (list(prompt), max_tokens, max_duration_s,
                 (temperature, top_k, top_p, seed))
 
+    @staticmethod
+    def _parse_resume(query) -> List[int]:
+        """The committed-token history of a door-side RESUME request
+        ([] for a fresh stream). The worker prefills prompt+history
+        under the stream's pinned seed; the position-keyed counter RNG
+        (PR 18 invariant) then continues the sampled sequence
+        token-identically from where the dead replica stopped."""
+        raw = query.get("resume_tokens") if isinstance(query, dict) \
+            else None
+        if raw is None:
+            return []
+        if (not isinstance(raw, (list, tuple))
+                or not all(isinstance(t, int) and t >= 0 for t in raw)):
+            raise GenerationRequestError(
+                "'resume_tokens' must be a list of non-negative token "
+                "ids")
+        return list(raw)
+
     # -- observability -------------------------------------------------------
 
     def _occupancy(self, slots, max_slots: int) -> float:
@@ -1415,6 +1546,10 @@ class GenerationWorker(InferenceWorker):
                 service_id, {"batches": 0, "queries": 0})
             s["gen_slots_busy"] = busy
             s["gen_slots_max"] = max_slots
+            # resident + preempted-stashed: what a drain must wait out
+            # (admin/services.py _drain_one) before destroying
+            s["gen_resident_streams"] = busy + len(
+                getattr(self, "_pending", ()))
             s["gen_tokens"] = getattr(self, "_tokens_emitted", 0)
             s["gen_job"] = self._job_id
             s["gen_spec_on"] = bool(getattr(self, "_spec_on", False))

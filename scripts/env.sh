@@ -268,6 +268,28 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                       draft/{job}/{service} degrade the
 #                                       worker to plain decode, typed +
 #                                       permanent, never wrong tokens
+# Stream continuity (docs/failure-model.md "Stream continuity") — the
+# door journals every stream (prompt, pinned seed, committed tokens) and
+# resumes it token-identically on a sibling replica when its worker dies
+# or hands it back typed MIGRATING (drain / rollout retirement); a
+# resume only ever targets the stream's original model_version:
+#   RAFIKI_GEN_RESUME_MAX=3             sibling-resume attempts per
+#                                       stream's lifetime; 0 disables
+#                                       resume (doctor WARNs with the
+#                                       autoscaler on — forced migrations
+#                                       then become client errors)
+#   RAFIKI_GEN_RESUME_BACKOFF_S=0.05    jittered exponential backoff base
+#                                       between attempts (capped by the
+#                                       request deadline; a client
+#                                       disconnect mid-backoff cancels
+#                                       the resume)
+#   RAFIKI_GEN_JOURNAL_MAX_KB=64        per-stream journal byte cap
+#                                       (~8 B/token): past it the stream
+#                                       KEEPS STREAMING but loses resume
+#                                       eligibility (doctor WARNs when
+#                                       the cap can't hold GEN_MAX_TOKENS)
+#   RAFIKI_GEN_JOURNAL_TTL_S=600        journal entry lifetime; an older
+#                                       stream is no longer resumable
 # New /metrics series: rafiki_gen_ttft_seconds,
 # rafiki_gen_door_ttft_seconds, rafiki_gen_intertoken_seconds,
 # rafiki_gen_tokens_total, rafiki_gen_slots_busy{service},
@@ -277,8 +299,11 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # rafiki_gen_prefix_evictions_total, rafiki_gen_prefix_shareable_total,
 # rafiki_gen_kv_cow_copies_total, rafiki_gen_preemptions_total,
 # rafiki_gen_spec_rounds_total, rafiki_gen_spec_proposed_total,
-# rafiki_gen_spec_accepted_total, rafiki_gen_spec_degraded_total.
-# Per-job pool footprint, prefix hit rates and speculation acceptance
+# rafiki_gen_spec_accepted_total, rafiki_gen_spec_degraded_total,
+# rafiki_gen_resumes_total{job,reason}, rafiki_gen_journal_bytes{job},
+# rafiki_gen_streams_migrated_total.
+# Per-job pool footprint, prefix hit rates, speculation acceptance and
+# the stream-continuity rollup (resumes by trigger, journal occupancy)
 # surface under GET /fleet/health "serving.generation".
 
 # Safe live rollouts (docs/failure-model.md "Rollout faults"). An
@@ -303,6 +328,12 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                       an SLO breach
 #   RAFIKI_ROLLOUT_BATCH=1              replicas replaced per rolling
 #                                       batch (place new, drain old)
+# TEXT_GENERATION jobs roll the same way with stream-granularity version
+# lanes: new streams split by the error-diffusion counter, a resumed
+# stream only ever targets its original model_version (cross-version
+# resume answers typed), and each rolling drain lets resident streams
+# run out inside RAFIKI_AUTOSCALE_DRAIN_S before handing the rest back
+# MIGRATING for sibling resume.
 # New /metrics series: rafiki_rollout_{started,completed,rollbacks}_total
 # {job}, rafiki_rollout_requests_total{job,lane,outcome},
 # rafiki_rollout_request_seconds{job,lane}. Rollout events (reason +
