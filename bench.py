@@ -506,6 +506,56 @@ def bench_wire_codec(n_floats: int = 3072, iters: int = 300) -> dict:
     }
 
 
+def bench_lease_ops(iters: int = 200) -> dict:
+    """Micro-bench the control-plane HA primitives (admin/lease.py,
+    db/database.py): lease renewal (the steady-state cost every
+    RAFIKI_ADMIN_LEASE_RENEW_S), lease acquisition (the failover-path
+    CAS), and the epoch fence's per-write tax — the same mutating store
+    write with the fence disarmed vs armed (one extra single-row SELECT
+    inside the handle lock). All sqlite-on-disk, CPU-only."""
+    import tempfile as _tf
+
+    from rafiki_tpu.db.database import Database
+
+    with _tf.TemporaryDirectory() as d:
+        db = Database(os.path.join(d, "bench_lease.sqlite3"))
+        row = db.acquire_lease("bench-holder", ttl_s=60.0, addr="127.0.0.1:0")
+        assert row is not None
+
+        def timed(fn, n):
+            fn(0)  # warm
+            t0 = time.perf_counter()
+            for i in range(1, n + 1):
+                fn(i)
+            return (time.perf_counter() - t0) / n
+
+        t_renew = timed(
+            lambda i: db.renew_lease("bench-holder", row["epoch"], 60.0,
+                                     addr="127.0.0.1:0"), iters)
+        # every acquire bumps the epoch — the takeover CAS a promoting
+        # standby pays exactly once per failover
+        t_acquire = timed(
+            lambda i: db.acquire_lease("bench-holder", 60.0,
+                                       addr="127.0.0.1:0"), iters)
+        epoch = db.read_lease()["epoch"]
+        fake_hash = "0" * 60
+        t_write = timed(
+            lambda i: db.create_user(f"plain{i}@bench", fake_hash, "ADMIN"),
+            iters)
+        db.set_fence(epoch, time.monotonic() + 3600.0)
+        t_fenced = timed(
+            lambda i: db.create_user(f"fenced{i}@bench", fake_hash, "ADMIN"),
+            iters)
+        db.clear_fence()
+        return {
+            "renew_us": round(t_renew * 1e6, 1),
+            "acquire_us": round(t_acquire * 1e6, 1),
+            "write_us": round(t_write * 1e6, 1),
+            "fenced_write_us": round(t_fenced * 1e6, 1),
+            "fence_overhead_us": round((t_fenced - t_write) * 1e6, 1),
+        }
+
+
 def _shm_binary_client_proc(port: int, n_reqs: int, query_floats: int,
                             barrier, out_q) -> None:
     """One closed-loop client for the shm-binary door phase: binary .npy
@@ -2353,6 +2403,12 @@ def main():
         result["wire_codec"] = bench_wire_codec()
     except Exception as e:
         result["wire_codec_error"] = repr(e)
+    # control-plane HA lease ops + the fence tax on fenced writes
+    # (CPU-only: pure metadata-store traffic)
+    try:
+        result["lease_ops"] = bench_lease_ops()
+    except Exception as e:
+        result["lease_ops_error"] = repr(e)
     if BENCH_ASHA:
         result["asha"] = asha
     if os.environ.get("RAFIKI_BENCH_VMAP", "1") not in ("0", "false"):

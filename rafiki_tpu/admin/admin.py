@@ -33,7 +33,8 @@ from rafiki_tpu.constants import (
     TrainJobStatus,
     UserType,
 )
-from rafiki_tpu.db.database import Database
+from rafiki_tpu.db.database import Database, StaleEpochError
+from rafiki_tpu.placement.hosts import StaleAdminEpochError
 from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
 from rafiki_tpu.sdk.knob import serialize_knob_config
 from rafiki_tpu.sdk.log import parse_logs
@@ -65,6 +66,8 @@ class Admin:
         placement: Optional[LocalPlacementManager] = None,
         params_dir: Optional[str] = None,
         recover: bool = True,
+        lease=None,
+        advertise_addr: Optional[str] = None,
     ):
         """``recover`` (default on) makes boot idempotent on an existing
         store: non-terminal jobs/services left by a crashed admin are
@@ -73,8 +76,42 @@ class Admin:
         "Control-plane faults"). The snapshot is taken synchronously here
         (state created after this constructor is never touched); the
         reconciliation itself runs off-thread behind a ``recovering ->
-        ready`` state the HTTP doors gate on."""
+        ready`` state the HTTP doors gate on.
+
+        ``lease`` is a LeaseManager that ALREADY holds leadership — the
+        hot-standby promotion path (admin/standby.py) passes the one it
+        just acquired with. Without it, RAFIKI_ADMIN_HA=1 makes this
+        constructor acquire its own lease (blocking up to
+        RAFIKI_ADMIN_LEASE_ACQUIRE_TIMEOUT_S) before touching the store;
+        HA off (the default) keeps the legacy single-admin behavior with
+        zero fencing overhead. ``advertise_addr`` ("host:port") rides the
+        lease row as the leader hint standby 503s and client failover
+        follow."""
         self.db = db or Database()
+        # -- control-plane HA: leadership lease + epoch fence --------------
+        # (admin/lease.py; docs/failure-model.md "Control-plane HA").
+        # Must be settled BEFORE the first store mutation below
+        # (_seed_superadmin / recovery): a leader's writes carry its epoch
+        # from the very first one.
+        from rafiki_tpu.admin.lease import LeaseManager, LeaseNotAcquiredError
+
+        self._lease: Optional[LeaseManager] = lease
+        if self._lease is None and config.ADMIN_HA:
+            self._lease = LeaseManager(self.db, addr=advertise_addr)
+            if not self._lease.acquire(
+                    block=True,
+                    timeout_s=config.ADMIN_LEASE_ACQUIRE_TIMEOUT_S):
+                raise LeaseNotAcquiredError(
+                    "another admin holds a live leadership lease "
+                    f"(row: {self._lease.leader_row()}); boot this one as "
+                    "a hot standby (admin/standby.py) instead")
+        if self._lease is not None:
+            # the promoted-standby path hands over a lease bound to the
+            # watcher's handle; arm the fence on THIS admin's handle too.
+            # The renewal thread starts at the END of this constructor
+            # (acquire() armed a full TTL of validity, plenty for boot);
+            # starting it here would un-confine every attribute below.
+            self._lease.bind(self.db)
         self.advisor_store = AdvisorStore()
         # predict hot path: (user, app, version) -> (ts, Predictor); the
         # epoch counter lets stop-time invalidation win over in-flight
@@ -167,6 +204,12 @@ class Admin:
             # multi-host placement registers remote serving queues with the
             # FleetBroker when it places inference workers on agents
             self.placement.set_broker(self.broker)
+        if self._lease is not None and hasattr(self.placement,
+                                               "set_epoch_provider"):
+            # agent calls carry the leadership epoch (the agent-side half
+            # of epoch fencing); last_epoch so a fenced ex-leader still
+            # gets the *typed* stale-epoch refusal
+            self.placement.set_epoch_provider(self._lease.last_epoch)
         # chip-budget arbitration between the serving and training planes
         # (placement/hosts.py ChipBudgetArbiter): autoscaler scale-ups may
         # borrow idle trial chips; a train executor that can't allocate
@@ -246,6 +289,9 @@ class Admin:
                 self._recovery_thread.start()
             else:
                 self._recovery = rec.empty_report()
+        if self._lease is not None:
+            # no-op for a promoted standby's already-running lease thread
+            self._lease.start()
 
     def _run_recovery(self, rec, snapshot) -> None:
         try:
@@ -270,6 +316,38 @@ class Admin:
         full report (counts, per-service reasons, agent addresses) stays
         behind the admin-rights GET /fleet/health."""
         return {"state": self._recovery.get("state", "ready")}
+
+    # -- control-plane HA (admin/lease.py, admin/standby.py) ---------------
+
+    @property
+    def lease(self):
+        """This admin's LeaseManager (None when HA is off)."""
+        return self._lease
+
+    def ha_role(self) -> str:
+        """``leader`` (HA off counts as leader — there is nobody else),
+        or ``fenced`` once this admin's lease lapsed or was taken over."""
+        if self._lease is None:
+            return "leader"
+        return self._lease.role()
+
+    def ha_epoch(self) -> Optional[int]:
+        return self._lease.last_epoch() if self._lease is not None else None
+
+    def leader_hint(self) -> Optional[str]:
+        """The current lease holder's advertised address — what standby /
+        fenced 503s carry so clients fail over straight to the leader."""
+        if self._lease is None:
+            return None
+        row = self._lease.leader_row()
+        return row.get("addr") if row else None
+
+    def ha_public(self) -> Dict[str, Any]:
+        """Unauthenticated HA slice for the public root: role + leader
+        hint (no holder ids, no lease internals)."""
+        if self._lease is None:
+            return {"role": "leader"}
+        return {"role": self._lease.role(), "leader": self.leader_hint()}
 
     # -- users ---------------------------------------------------------------
 
@@ -1432,6 +1510,10 @@ class Admin:
             # `recovering` while the off-thread pass runs — the HTTP
             # doors 503 until it reads `ready`
             "recovery": self.recovery_status(),
+            # control-plane HA (admin/lease.py): leadership role, epoch,
+            # lease validity — `enabled: False` when running solo
+            "ha": ({"enabled": True, **self._lease.status()}
+                   if self._lease is not None else {"enabled": False}),
             # closed-loop overload adaptation (admin/autoscaler.py):
             # loop state, chip-loan picture, recent scale decisions with
             # their reason + signal snapshot
@@ -1675,7 +1757,13 @@ class Admin:
             self._recovery_runner.abort()
         if self._recovery_thread is not None:
             self._recovery_thread.join(timeout=30)
-        self.stop_all_jobs()
+        try:
+            self.stop_all_jobs()
+        except (StaleEpochError, StaleAdminEpochError) as e:
+            # a fenced ex-leader has nothing left to tear down — the new
+            # leader adopted the fleet; forcing the teardown through would
+            # be exactly the double-teardown the fence exists to stop
+            logger.warning("shutdown teardown skipped (fenced): %s", e)
         if hasattr(self.placement, "stop_all"):
             self.placement.stop_all()
         # the shm broker holds listener threads + /dev/shm segments; the
@@ -1683,3 +1771,7 @@ class Admin:
         close = getattr(self.broker, "close", None)
         if close is not None:
             close()
+        # last: releasing the lease clears the fences, so every mutation
+        # above still ran under epoch protection
+        if self._lease is not None:
+            self._lease.stop(release=True)

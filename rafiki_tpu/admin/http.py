@@ -19,7 +19,7 @@ import os
 import re
 import threading
 import traceback
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -28,6 +28,8 @@ from rafiki_tpu.admin.admin import Admin, InvalidRequestError
 from rafiki_tpu.admin.rollout import RolloutInFlightError
 from rafiki_tpu.cache.queue import FrameTooLargeError, QueueFullError
 from rafiki_tpu.constants import UserType
+from rafiki_tpu.db.database import StaleEpochError
+from rafiki_tpu.placement.hosts import StaleAdminEpochError
 from rafiki_tpu.placement.manager import InsufficientChipsError
 from rafiki_tpu.predictor.admission import (
     DeadlineUnmeetableError,
@@ -37,7 +39,11 @@ from rafiki_tpu.predictor.admission import (
 from rafiki_tpu.sdk.artifact import ArtifactCorruptError
 from rafiki_tpu.sdk.model import InvalidModelClassError
 from rafiki_tpu.utils.auth import UnauthorizedError, auth_check, decode_token
-from rafiki_tpu.utils.reqfields import LowLatencyHandler, read_bounded_body
+from rafiki_tpu.utils.reqfields import (
+    LowLatencyHandler,
+    SeveringHTTPServer,
+    read_bounded_body,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -127,7 +133,7 @@ class AdminServer:
         self.admin = admin
         self.host = host
         self.port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._httpd: Optional[SeveringHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.routes: List[Route] = self._build_routes()
 
@@ -155,12 +161,15 @@ class AdminServer:
             def do_DELETE(self):
                 server._dispatch(self, "DELETE")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = SeveringHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         # worker *processes* coordinate HPO + events through this API;
         # tell the placement layer where it lives (placement/process.py)
-        if hasattr(self.admin.placement, "admin_addr"):
-            self.admin.placement.admin_addr = (self.host, self.port)
+        # getattr-safe: a hot standby (admin/standby.py) has no placement
+        # layer until it promotes; its door serves hints + login only
+        placement = getattr(self.admin, "placement", None)
+        if placement is not None and hasattr(placement, "admin_addr"):
+            placement.admin_addr = (self.host, self.port)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -171,6 +180,10 @@ class AdminServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+            # sever established keep-alive connections too: a stopped
+            # door must go dark like a killed process, or HA drills keep
+            # being served by the "dead" leader's handler threads
+            self._httpd.sever()
 
     # -- routing -----------------------------------------------------------
 
@@ -187,7 +200,10 @@ class AdminServer:
             # admin-rights /fleet/health)
             r("GET", "/", "public", lambda au, m, b, q: {
                 "name": "rafiki_tpu admin", "status": "ok",
-                "recovery": A.recovery_public()}),
+                "recovery": A.recovery_public(),
+                # control-plane HA role + leader hint (public on purpose:
+                # failover clients walk addresses pre-auth)
+                "ha": getattr(A, "ha_public", lambda: {"role": "leader"})()}),
             r("POST", "/tokens", "public", lambda au, m, b, q: A.authenticate_user(
                 _field(b, "email"), _field(b, "password"))),
             # users
@@ -423,6 +439,36 @@ class AdminServer:
             # reconcile is adopting keep proposing/reporting mid-trial,
             # and the advisor store is fresh in-memory state, not part of
             # what is being reconciled.
+            # the body is read BEFORE any gate can answer: an early 503
+            # that leaves the body unread desyncs HTTP/1.1 keep-alive
+            # framing — the next request on the pooled connection parses
+            # the leftover bytes as its request line (a failover client
+            # walking back to this door then sees a bogus 400)
+            body: Dict[str, Any] = {}
+            raw, berr = read_bounded_body(
+                handler, config.ADMIN_MAX_BODY_MB, fallback_mb=256.0)
+            if berr:
+                # this door's error channel is InvalidRequestError (400)
+                raise InvalidRequestError(f"{berr[1]} (ADMIN_MAX_BODY_MB)")
+            # standby gate (control-plane HA, admin/standby.py): a hot
+            # standby answers login, the public root and the fleet-health
+            # snapshot read-only; everything else sheds with 503 + the
+            # leader's address so clients fail over in one hop instead of
+            # polling. Checked BEFORE the recovery gate — a standby has no
+            # recovery state to consult until it promotes.
+            role = getattr(self.admin, "ha_role", None)
+            role = role() if callable(role) else "leader"
+            if role == "standby" and not (
+                    path == "/" or path == "/tokens"
+                    or path == "/fleet/health"):
+                self._respond(
+                    handler, 503,
+                    {"error": "admin is a hot standby; mutations go to "
+                              "the leader",
+                     "standby": True,
+                     "leader": self.admin.leader_hint()},
+                    headers={"Retry-After": "1"})
+                return
             state = self.admin.recovery_status()
             if state.get("state") == "recovering" and not (
                     path == "/" or path == "/tokens"
@@ -439,12 +485,6 @@ class AdminServer:
                     headers={"Retry-After": "1"})
                 return
             query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-            body: Dict[str, Any] = {}
-            raw, berr = read_bounded_body(
-                handler, config.ADMIN_MAX_BODY_MB, fallback_mb=256.0)
-            if berr:
-                # this door's error channel is InvalidRequestError (400)
-                raise InvalidRequestError(f"{berr[1]} (ADMIN_MAX_BODY_MB)")
             try:
                 if raw:
                     body = json.loads(raw or b"{}")
@@ -513,6 +553,19 @@ class AdminServer:
             self._respond(handler, 504, {"error": f"{type(e).__name__}: {e}"})
         except InsufficientChipsError as e:
             self._respond(handler, 503, {"error": f"{type(e).__name__}: {e}"})
+        except (StaleEpochError, StaleAdminEpochError) as e:
+            # this admin lost leadership mid-request (epoch fence fired at
+            # the DB chokepoint or an agent refused a stale epoch): answer
+            # like a standby — 503 + leader hint — so the client's
+            # multi-address failover walks to the new leader
+            self._respond(
+                handler, 503,
+                {"error": f"{type(e).__name__}: admin lost leadership; "
+                          "retry against the leader",
+                 "standby": True,
+                 "leader": getattr(self.admin, "leader_hint",
+                                   lambda: None)()},
+                headers={"Retry-After": "1"})
         except Exception:
             # log the traceback server-side; never leak it to callers
             logger.error("unhandled error on %s %s:\n%s", method,

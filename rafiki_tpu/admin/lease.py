@@ -1,0 +1,258 @@
+"""Leased control-plane leadership (docs/failure-model.md "Control-plane
+HA").
+
+One `LeaseManager` per admin process drives the single `control_lease`
+row (db/database.py, migration r20):
+
+- **acquire** — compare-and-set takeover of an absent/expired/own lease;
+  every success bumps the monotonic **epoch**. Exactly one admin can hold
+  the lease at a time, so exactly one admin is LEADER.
+- **renew** — an off-thread loop extends the lease every
+  ``RAFIKI_ADMIN_LEASE_RENEW_S`` (default TTL/3). Renewal is CAS'd on
+  (holder, epoch): a standby having promoted makes the CAS fail, which
+  hard-fences this manager immediately.
+- **self-fence** — each successful renewal arms the epoch write-fence on
+  every bound :class:`Database` handle with a ``time.monotonic()``
+  validity of one TTL. A leader that cannot renew (paused, partitioned,
+  store down) simply stops extending the fence, so its own mutating
+  writes start raising ``StaleEpochError`` at the moment the TTL lapses —
+  BEFORE the standby can acquire, because the standby also waits out the
+  TTL on the lease row's wall clock.
+
+Renewal *errors* (chaos ``site=lease``, a flaky store) never drop
+leadership by themselves — the false-lease-loss drill: only the TTL clock
+or a failed CAS demotes, so a single failed renewal round trip costs
+nothing while a genuinely partitioned leader still fences on time.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.db.database import Database
+
+logger = logging.getLogger(__name__)
+
+ROLE_LEADER = "leader"
+ROLE_FENCED = "fenced"
+ROLE_STANDBY = "standby"
+
+
+class LeaseNotAcquiredError(RuntimeError):
+    """Leadership could not be acquired within the boot timeout — another
+    admin holds a live lease. Boot the second admin as a hot standby
+    (admin/standby.py) instead of a leader."""
+
+
+def default_holder() -> str:
+    """A stable-enough unique holder id: host + pid + random tail (two
+    admins on one host — the common test/dev shape — must not collide)."""
+    return f"{socket.gethostname()}:{os.getpid()}:{uuid.uuid4().hex[:6]}"
+
+
+class LeaseManager:
+    """Owns one admin's side of the leadership lease: acquisition, the
+    off-thread renewal loop, and fence propagation to bound Database
+    handles and the placement layer's epoch provider."""
+
+    def __init__(self, db: Database, holder: Optional[str] = None,
+                 addr: Optional[str] = None,
+                 ttl_s: Optional[float] = None,
+                 renew_s: Optional[float] = None):
+        self._db = db
+        self.holder = holder or default_holder()
+        # advertised leader address ("host:port") — rides the lease row so
+        # standby 503s and client failover can hint where the leader lives
+        self.addr = addr
+        self.ttl_s = float(ttl_s if ttl_s is not None
+                           else config.ADMIN_LEASE_TTL_S)
+        r = renew_s if renew_s is not None else config.ADMIN_LEASE_RENEW_S
+        self.renew_s = float(r) if r else self.ttl_s / 3.0
+        self._lock = threading.Lock()
+        self._epoch: Optional[int] = None  # guarded-by: _lock
+        self._valid_until = 0.0  # guarded-by: _lock (monotonic)
+        self._suspended = False  # guarded-by: _lock (SIGSTOP drill hook)
+        self._dbs: List[Database] = [db]  # guarded-by: _lock
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- acquisition -------------------------------------------------------
+
+    def acquire(self, block: bool = False,
+                timeout_s: Optional[float] = None) -> bool:
+        """Try to take the lease (bumping the epoch). With ``block``,
+        keeps retrying every renewal period until ``timeout_s`` — the
+        boot path of a leader racing a dying predecessor's TTL."""
+        deadline = time.monotonic() + (timeout_s or 0.0)
+        while True:
+            try:
+                row = self._db.acquire_lease(self.holder, self.ttl_s,
+                                             addr=self.addr)
+            except Exception as e:
+                # transient store fault at the chokepoint (chaos
+                # site=lease): acquisition just didn't happen this round
+                logger.warning("lease acquisition failed: %s", e)
+                row = None
+            if row is not None:
+                valid_until = time.monotonic() + self.ttl_s
+                with self._lock:
+                    self._epoch = row["epoch"]
+                    self._valid_until = valid_until
+                    self._arm_fences_locked()
+                logger.info("leadership acquired: holder=%s epoch=%d "
+                            "ttl=%.1fs", self.holder, row["epoch"],
+                            self.ttl_s)
+                return True
+            if not block or time.monotonic() >= deadline:
+                return False
+            time.sleep(min(self.renew_s, 0.5))
+
+    # -- fence plumbing ----------------------------------------------------
+
+    def bind(self, db: Database) -> None:
+        """Arm the epoch write-fence on another Database handle (the
+        promoted Admin's own handle, when it differs from the watcher's)."""
+        with self._lock:
+            if db not in self._dbs:
+                self._dbs.append(db)
+            if self._epoch is not None:
+                self._arm_fences_locked()
+
+    def _arm_fences_locked(self) -> None:  # guarded-by: _lock
+        # caller holds _lock; Database.set_fence takes the handle's own
+        # lock — ordering is always LeaseManager._lock -> Database._lock
+        for db in self._dbs:
+            db.set_fence(self._epoch, self._valid_until)
+
+    # -- renewal loop ------------------------------------------------------
+
+    def start(self) -> "LeaseManager":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._renew_loop, name="admin-lease-renew",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _renew_loop(self) -> None:
+        while not self._stop_evt.wait(self.renew_s):
+            with self._lock:
+                suspended = self._suspended
+                epoch = self._epoch
+            if suspended or epoch is None:
+                continue
+            try:
+                ok = self._db.renew_lease(self.holder, epoch, self.ttl_s,
+                                          addr=self.addr)
+            # lint: absorb(false-lease-loss contract: a renewal ERROR must
+            # not drop leadership — only the TTL clock or a failed CAS
+            # demotes; the miss is logged and the fence simply not
+            # extended, so repeated failures self-fence at TTL)
+            except Exception as e:
+                logger.warning("lease renewal failed (epoch %d), "
+                               "self-fence in %.1fs: %s", epoch,
+                               self.valid_for_s(), e)
+                continue
+            if not ok:
+                # CAS refused: a newer epoch holds the lease row —
+                # leadership is gone for good; hard-fence NOW rather than
+                # coasting on the remaining TTL
+                logger.error("leadership lost at epoch %d (lease CAS "
+                             "refused); fencing all writes", epoch)
+                with self._lock:
+                    self._valid_until = 0.0
+                    self._arm_fences_locked()
+                continue
+            valid_until = time.monotonic() + self.ttl_s
+            with self._lock:
+                self._valid_until = valid_until
+                self._arm_fences_locked()
+
+    # -- introspection -----------------------------------------------------
+
+    def epoch(self) -> Optional[int]:
+        """The epoch this manager holds *validly* — None once the lease
+        lapsed (self-fence) or was never acquired."""
+        with self._lock:
+            if self._epoch is None or time.monotonic() >= self._valid_until:
+                return None
+            return self._epoch
+
+    def last_epoch(self) -> Optional[int]:
+        """The epoch last held, even after self-fencing — what agent
+        calls are stamped with, so a stale ex-leader's mutations are
+        refused with a *typed* stale-epoch answer instead of an ambiguous
+        missing-header one."""
+        with self._lock:
+            return self._epoch
+
+    def role(self) -> str:
+        return ROLE_LEADER if self.epoch() is not None else ROLE_FENCED
+
+    def valid_for_s(self) -> float:
+        with self._lock:
+            return max(0.0, self._valid_until - time.monotonic())
+
+    def leader_row(self) -> Optional[Dict[str, Any]]:
+        """The lease row as stored (doctor / health / leader hints).
+        Absorbs store faults — introspection must never crash a door."""
+        try:
+            return self._db.read_lease()
+        except Exception as e:  # lint: absorb(read-only introspection)
+            logger.warning("lease read failed: %s", e)
+            return None
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            epoch = self._epoch
+            valid_for = max(0.0, self._valid_until - time.monotonic())
+        return {
+            "holder": self.holder,
+            "addr": self.addr,
+            "epoch": epoch,
+            "role": (ROLE_LEADER if epoch is not None and valid_for > 0
+                     else ROLE_FENCED),
+            "ttl_s": self.ttl_s,
+            "renew_s": self.renew_s,
+            "valid_for_s": round(valid_for, 3),
+        }
+
+    # -- drill hooks (SIGSTOP stand-in for in-process tier-1 tests) --------
+
+    def suspend(self) -> None:
+        """Freeze renewal — the in-process analogue of SIGSTOP'ing the
+        leader: the fence validity lapses on the monotonic clock exactly
+        as it would for a stopped process."""
+        with self._lock:
+            self._suspended = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._suspended = False
+
+    # -- shutdown ----------------------------------------------------------
+
+    def stop(self, release: bool = True) -> None:
+        """Stop renewing; with ``release`` (graceful shutdown) expire the
+        lease now so a standby promotes without waiting out the TTL."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            epoch = self._epoch
+        if release and epoch is not None:
+            try:
+                self._db.release_lease(self.holder, epoch)
+            except Exception as e:  # lint: absorb(best-effort handoff;
+                # the TTL expires the lease anyway)
+                logger.warning("lease release failed: %s", e)
+        with self._lock:
+            for db in self._dbs:
+                db.clear_fence()

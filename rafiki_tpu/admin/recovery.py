@@ -45,6 +45,7 @@ import json
 import logging
 import os
 import random
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -713,14 +714,49 @@ class ControlPlaneRecovery:
 
     def _persist_report(self) -> None:
         """Best-effort: the doctor reads the last reconcile outcome from
-        disk (it has no admin process to ask)."""
+        disk (it has no admin process to ask).
+
+        With control-plane HA, two admins share one LOGS_DIR across a
+        failover and would clobber each other's ``recovery.json`` — the
+        promoted leader's adopt report overwriting the crashed leader's
+        is the exact evidence an operator needs to diff. So the report is
+        written twice: the unsuffixed latest (the stable doctor/test
+        path) AND an epoch-suffixed ``recovery-e<N>.json``, pruned to the
+        last ``RAFIKI_RECOVERY_REPORT_KEEP``."""
         try:
             from rafiki_tpu.sdk.artifact import atomic_write_bytes
 
             path = report_path()
             os.makedirs(os.path.dirname(path), exist_ok=True)
             payload = {**self.report, "finished_at": time.time()}
-            atomic_write_bytes(
-                path, json.dumps(payload, indent=2).encode())
+            epoch = None
+            lease = getattr(self.admin, "lease", None)
+            if lease is not None:
+                epoch = lease.last_epoch()
+                payload["epoch"] = epoch
+            blob = json.dumps(payload, indent=2).encode()
+            atomic_write_bytes(path, blob)
+            if epoch is not None:
+                atomic_write_bytes(
+                    os.path.join(os.path.dirname(path),
+                                 f"recovery-e{int(epoch)}.json"), blob)
+                self._prune_epoch_reports(os.path.dirname(path))
         except Exception:
             logger.exception("could not persist the recovery report")
+
+    @staticmethod
+    def _prune_epoch_reports(logs_dir: str) -> None:
+        """Keep the newest RAFIKI_RECOVERY_REPORT_KEEP epoch-suffixed
+        reports (sorted by epoch, which is monotonic across failovers)."""
+        keep = max(int(config.RECOVERY_REPORT_KEEP), 1)
+        found = []
+        for name in os.listdir(logs_dir):
+            m = re.fullmatch(r"recovery-e(\d+)\.json", name)
+            if m:
+                found.append((int(m.group(1)), name))
+        for _, name in sorted(found)[:-keep]:
+            try:
+                os.unlink(os.path.join(logs_dir, name))
+            except OSError as e:  # lint: absorb(prune is housekeeping;
+                # a leftover report costs bytes, not correctness)
+                logger.warning("could not prune %s: %s", name, e)

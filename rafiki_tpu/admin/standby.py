@@ -1,0 +1,253 @@
+"""Hot-standby admin (docs/failure-model.md "Control-plane HA").
+
+A second admin process boots as a :class:`StandbyAdmin` instead of a full
+:class:`Admin`: it holds no lease, runs no placement layer, and mutates
+nothing. Its HTTP door (the unchanged admin/http.py, which gates on
+``ha_role()``) answers login, the public root and a warm read-only
+fleet-health snapshot; every other route sheds with 503 + the leader's
+advertised address so clients fail over in one hop.
+
+A watch thread polls the ``control_lease`` row. The moment the leader's
+lease expires (crash, SIGSTOP past TTL, partition), the standby promotes:
+
+1. ``LeaseManager.acquire()`` — a compare-and-set takeover that bumps the
+   **epoch**. A raced sibling standby loses the CAS and simply keeps
+   watching; exactly one promotes.
+2. The admin factory runs — a full ``Admin`` boot under the already-held
+   lease, which means the existing ``ControlPlaneRecovery`` adopt-first
+   reconcile: live serving replicas are adopted (they never stopped
+   answering), surviving train workers keep flowing, controllers re-arm —
+   all under the new epoch.
+3. The facade swaps the promoted Admin in; ``__getattr__`` delegation
+   makes every admin/http.py route work against it from the next request
+   on, with no server restart and no route rebuild (route lambdas resolve
+   attributes at call time).
+
+The old leader, if it comes back, is epoch-fenced everywhere: its DB
+writes raise ``StaleEpochError`` at the Database chokepoint and its agent
+calls are refused with a typed 412 — it can never double-place or tear
+down a service the new leader owns.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from rafiki_tpu import config
+from rafiki_tpu.db.database import Database
+from rafiki_tpu.admin.lease import (
+    LeaseManager,
+    ROLE_STANDBY,
+    default_holder,
+)
+from rafiki_tpu.utils.auth import (
+    UnauthorizedError,
+    generate_token,
+    verify_password,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class StandbyAdmin:
+    """A delegating facade: read-only standby before promotion, a full
+    Admin after. ``factory`` builds the promoted Admin and receives the
+    already-acquired LeaseManager (the usual shape binds the standby's
+    ``Database`` handle or makes a fresh one):
+
+        standby = StandbyAdmin(
+            db, factory=lambda lease: Admin(db=Database(), lease=lease),
+            addr="127.0.0.1:3001")
+    """
+
+    def __init__(self, db: Database,
+                 factory: Callable[[LeaseManager], Any],
+                 addr: Optional[str] = None,
+                 holder: Optional[str] = None,
+                 poll_s: Optional[float] = None):
+        # _lock is assigned FIRST: __getattr__ reads self._admin, and any
+        # attribute touched before __init__ finishes must not recurse
+        self._lock = threading.Lock()
+        self._admin: Optional[Any] = None  # guarded-by: _lock
+        self.db = db
+        self._factory = factory
+        self._lease = LeaseManager(db, holder=holder or default_holder(),
+                                   addr=addr)
+        p = poll_s if poll_s is not None else config.ADMIN_STANDBY_POLL_S
+        self._poll_s = float(p) if p else self._lease.renew_s
+        self._snapshot: Dict[str, Any] = {}  # guarded-by: _lock (warm view)
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="admin-standby-watch", daemon=True)
+        self._thread.start()
+
+    # -- delegation --------------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        # only consulted when normal lookup fails — i.e. for everything
+        # the facade does not implement itself. Pre-promotion that is an
+        # AttributeError (the http door's getattr-safe probes rely on it);
+        # post-promotion it forwards to the real Admin.
+        admin = object.__getattribute__(self, "__dict__").get("_admin")
+        if admin is None:
+            raise AttributeError(
+                f"standby admin has no attribute {name!r} (not promoted)")
+        return getattr(admin, name)
+
+    def _promoted(self) -> Optional[Any]:
+        with self._lock:
+            return self._admin
+
+    # -- the standby-served surface ----------------------------------------
+
+    def ha_role(self) -> str:
+        admin = self._promoted()
+        if admin is not None:
+            return admin.ha_role()
+        return ROLE_STANDBY
+
+    def leader_hint(self) -> Optional[str]:
+        admin = self._promoted()
+        if admin is not None:
+            return admin.leader_hint()
+        row = self._lease.leader_row()
+        return row.get("addr") if row else None
+
+    def ha_public(self) -> Dict[str, Any]:
+        admin = self._promoted()
+        if admin is not None:
+            return admin.ha_public()
+        return {"role": ROLE_STANDBY, "leader": self.leader_hint()}
+
+    def recovery_status(self) -> Dict[str, Any]:
+        admin = self._promoted()
+        if admin is not None:
+            return admin.recovery_status()
+        return {"state": "ready"}
+
+    def recovery_public(self) -> Dict[str, Any]:
+        admin = self._promoted()
+        if admin is not None:
+            return admin.recovery_public()
+        return {"state": "ready"}
+
+    def authenticate_user(self, email: str, password: str) -> Dict[str, Any]:
+        """Same contract as Admin.authenticate_user, served read-only from
+        the shared store: a token minted here works against the leader
+        after failover (one signing secret per deployment)."""
+        admin = self._promoted()
+        if admin is not None:
+            return admin.authenticate_user(email, password)
+        user = self.db.get_user_by_email(email)
+        if user is None or not verify_password(password,
+                                               user["password_hash"]):
+            raise UnauthorizedError("Invalid email or password")
+        if user["banned"]:
+            raise UnauthorizedError("User is banned")
+        token = generate_token(
+            {"user_id": user["id"], "user_type": user["user_type"]})
+        return {"user_id": user["id"], "user_type": user["user_type"],
+                "token": token}
+
+    def get_fleet_health(self) -> Dict[str, Any]:
+        admin = self._promoted()
+        if admin is not None:
+            return admin.get_fleet_health()
+        with self._lock:
+            snapshot = dict(self._snapshot)
+        return {
+            "placement": None,
+            "standby": True,
+            "ha": {"enabled": True, **self._lease.status(),
+                   "role": ROLE_STANDBY, "leader": self.leader_hint()},
+            # the warm read-only view of the leader's world, refreshed
+            # every poll from the shared store
+            "snapshot": snapshot,
+        }
+
+    # -- the watch loop ----------------------------------------------------
+
+    def _watch_loop(self) -> None:
+        while not self._stop_evt.wait(self._poll_s):
+            if self._promoted() is not None:
+                return  # the promoted Admin's own lease thread takes over
+            try:
+                row = self.db.read_lease()
+            except Exception as e:  # lint: absorb(a flaky store must not
+                # kill the watcher; the next poll retries)
+                logger.warning("standby lease watch failed: %s", e)
+                continue
+            expired = row is None or row["expires_at"] <= time.time()
+            if not expired:
+                self._refresh_snapshot()
+                continue
+            try:
+                self._promote()
+            except Exception:
+                # a raced CAS loss is handled inside _promote; anything
+                # else (factory failure mid-boot) is logged and retried —
+                # a standby that dies on one failed promotion attempt
+                # would leave the fleet leaderless for good
+                logger.exception("standby promotion attempt failed; "
+                                 "will retry")
+            if self._promoted() is not None:
+                return
+
+    def _refresh_snapshot(self) -> None:
+        """The warm read-only view standby fleet-health serves: cheap
+        store-derived counts, never placement state (there is none)."""
+        try:
+            snap: Dict[str, Any] = {
+                "inference_jobs_running": len(
+                    self.db.get_inference_jobs_by_statuses(["RUNNING"])),
+                "refreshed_at": time.time(),
+            }
+        except Exception as e:  # lint: absorb(snapshot is best-effort
+            # observability; store faults surface in the next poll)
+            logger.warning("standby snapshot refresh failed: %s", e)
+            return
+        with self._lock:
+            self._snapshot = snap
+
+    def _promote(self) -> None:
+        """Lease takeover + full Admin boot. The CAS in acquire() makes
+        this race-safe: of N standbys watching one expired lease, exactly
+        one wins the epoch bump; losers return to watching."""
+        if not self._lease.acquire(block=False):
+            logger.info("standby %s lost the promotion race; resuming "
+                        "watch", self._lease.holder)
+            return
+        epoch = self._lease.last_epoch()
+        logger.warning("standby %s promoting to leader at epoch %s",
+                       self._lease.holder, epoch)
+        # the factory runs the full Admin boot — including the adopt-first
+        # ControlPlaneRecovery reconcile — under the already-held lease
+        admin = self._factory(self._lease)
+        with self._lock:
+            self._admin = admin
+        logger.warning("standby promotion complete: leader at epoch %s",
+                       epoch)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def wait_promoted(self, timeout_s: float) -> bool:
+        """Test/ops helper: block until this standby has promoted."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._promoted() is not None:
+                return True
+            time.sleep(0.05)
+        return self._promoted() is not None
+
+    def shutdown(self) -> None:
+        self._stop_evt.set()
+        self._thread.join(timeout=5.0)
+        admin = self._promoted()
+        if admin is not None:
+            admin.shutdown()
+        else:
+            # never held the lease; release=False keeps the row untouched
+            self._lease.stop(release=False)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import requests
@@ -37,6 +38,14 @@ class AdminRecoveringError(RafikiError):
     plane crash recovery) is still running. Retryable: poll
     :meth:`Client.wait_until_admin_ready` or just retry after the
     ``Retry-After`` interval."""
+
+
+class AdminUnavailableError(RafikiError):
+    """No configured admin address answered: every one refused the
+    connection or shed as a hot standby within the failover window
+    (``RAFIKI_ADMIN_FAILOVER_TIMEOUT_S``). Typed and retryable — a
+    failover is usually in flight; :meth:`Client.wait_until_admin_ready`
+    absorbs it while walking the address list."""
 
 
 class GenerationStreamError(RafikiError):
@@ -67,8 +76,30 @@ class RolloutRolledBackError(RafikiError):
 
 
 class Client:
-    def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000):
-        self._base = f"http://{admin_host}:{admin_port}"
+    def __init__(self, admin_host: str = "127.0.0.1", admin_port: int = 3000,
+                 admin_addrs: Optional[List[str]] = None):
+        """``admin_addrs`` (or the ``RAFIKI_ADMIN_ADDRS`` env, a comma
+        list of ``host:port``) enables control-plane HA failover: calls
+        walk the list in order on connection-refused and standby-503
+        answers, following the leader hint those 503s carry. Explicit
+        ``admin_host``/``admin_port`` arguments mean the caller picked
+        one admin on purpose, so the env list only applies to a
+        default-constructed client."""
+        from rafiki_tpu import config as _config
+
+        explicit = (admin_host != "127.0.0.1" or admin_port != 3000)
+        if admin_addrs:
+            addrs = list(admin_addrs)
+        elif not explicit and _config.ADMIN_ADDRS:
+            addrs = [a.strip() for a in _config.ADMIN_ADDRS.split(",")
+                     if a.strip()]
+        else:
+            addrs = []
+        if not addrs:
+            addrs = [f"{admin_host}:{admin_port}"]
+        self._addrs: List[str] = addrs
+        self._active = 0  # index of the last address that answered
+        self._base = f"http://{addrs[0]}"
         self._token: Optional[str] = None
         self.user: Optional[Dict[str, Any]] = None
         # pooled keep-alive connections: a fresh TCP connect per call would
@@ -98,16 +129,88 @@ class Client:
         body: Optional[Dict[str, Any]] = None,
         params: Optional[Dict[str, Any]] = None,
     ) -> Any:
+        """One admin API call, with multi-address failover.
+
+        The walk is safe for NON-idempotent calls too, because it only
+        moves on in two cases where the request provably did not execute:
+        connection refused (no server accepted it) and a standby/fenced
+        503 (the door shed before dispatch). A request the leader started
+        processing never retries. Standby 503s carry the leader's address
+        — that hint is tried first, so failover is one extra hop."""
+        from rafiki_tpu import config as _config
+
         headers = {}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
-        resp = self._http.request(
-            method, self._base + path, json=body, params=params, headers=headers
-        )
-        try:
-            payload = resp.json()
-        except ValueError:
-            raise RafikiError(f"Bad response ({resp.status_code}): {resp.text}")
+        multi = len(self._addrs) > 1
+        deadline = (time.monotonic()
+                    + float(_config.ADMIN_FAILOVER_TIMEOUT_S))
+        # the walk order: last-known-good first, then the rest in config
+        # order; a leader hint from a standby 503 jumps the queue
+        last_refusal: Optional[str] = None
+        while True:
+            order = [self._addrs[(self._active + i) % len(self._addrs)]
+                     for i in range(len(self._addrs))]
+            hint_first: List[str] = []
+            for addr in order:
+                if addr in hint_first:
+                    continue
+                hint_first.append(addr)
+            resp = None
+            for addr in hint_first:
+                try:
+                    resp = self._http.request(
+                        method, f"http://{addr}" + path, json=body,
+                        params=params, headers=headers)
+                except requests.ConnectionError as e:
+                    # the connection was refused/reset before the request
+                    # went out — it never executed, walking on is safe
+                    last_refusal = f"{addr}: {e}"
+                    continue
+                try:
+                    payload = resp.json()
+                except ValueError:
+                    raise RafikiError(
+                        f"Bad response ({resp.status_code}): {resp.text}")
+                if (resp.status_code == 503 and isinstance(payload, dict)
+                        and payload.get("standby")):
+                    # a hot standby (or a just-fenced ex-leader) shed the
+                    # call before dispatch; follow its leader hint
+                    last_refusal = f"{addr}: {payload.get('error')}"
+                    hint = payload.get("leader")
+                    if hint and hint not in self._addrs:
+                        self._addrs.append(hint)
+                    if hint and hint in self._addrs:
+                        self._active = self._addrs.index(hint)
+                    continue
+                if (multi and resp.status_code == 503
+                        and isinstance(payload, dict)
+                        and "recovery" in payload):
+                    # a just-promoted leader still reconciling its store:
+                    # the recovery gate shed the call BEFORE dispatch, so
+                    # retrying within the failover window is safe. Only in
+                    # multi-address mode — single-admin clients keep the
+                    # typed AdminRecoveringError contract.
+                    last_refusal = f"{addr}: {payload.get('error')}"
+                    continue
+                self._active = self._addrs.index(addr)
+                self._base = f"http://{addr}"
+                return self._finish_call(resp, payload)
+            if not multi and len(self._addrs) == 1:
+                # single-admin client: no list to walk — surface the
+                # refusal immediately, but TYPED (satellite of the HA
+                # work: wait_until_admin_ready retries it like any other
+                # RafikiError instead of leaking a transport exception)
+                raise AdminUnavailableError(
+                    f"admin unreachable: {last_refusal}")
+            if time.monotonic() >= deadline:
+                raise AdminUnavailableError(
+                    "no admin address answered within "
+                    f"{_config.ADMIN_FAILOVER_TIMEOUT_S:.0f}s failover "
+                    f"window (last: {last_refusal}); tried {self._addrs}")
+            time.sleep(0.1)
+
+    def _finish_call(self, resp, payload) -> Any:
         if resp.status_code != 200:
             if resp.status_code == 503 and isinstance(payload, dict) \
                     and "recovery" in payload:
@@ -743,7 +846,12 @@ class Client:
         reconciliation (recovery state `ready` on the public root) —
         no credentials needed, so deploy scripts can gate on it before
         logging in. Returns the public recovery state ({"state": ...});
-        the full report lives behind :meth:`get_fleet_health`."""
+        the full report lives behind :meth:`get_fleet_health`.
+
+        With control-plane HA the underlying call walks the whole
+        ``RAFIKI_ADMIN_ADDRS`` list (typed ``AdminUnavailableError``
+        refusals are absorbed like any other transient), so this also
+        waits out a leader failover, not just a restart."""
         import time as _time
 
         deadline = _time.monotonic() + timeout_s

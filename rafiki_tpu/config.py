@@ -552,6 +552,40 @@ _DYNAMIC_PATHS = {
     "DRIFT_COOLDOWN_S": lambda: _env_float("RAFIKI_DRIFT_COOLDOWN_S", 60.0),
     "DRIFT_LAUNCH_RETRY_MAX": lambda: _env_int(
         "RAFIKI_DRIFT_LAUNCH_RETRY_MAX", 2),
+    # -- control-plane HA (admin/lease.py, admin/standby.py;
+    #    docs/failure-model.md "Control-plane HA") --------------------------
+    #   RAFIKI_ADMIN_HA=0              leased leadership on boot: the admin
+    #                                   acquires the control_lease row (or
+    #                                   refuses to start as leader). Off by
+    #                                   default: a solo admin needs no lease
+    #   RAFIKI_ADMIN_LEASE_TTL_S=10    leadership lease TTL; a leader that
+    #                                   cannot renew self-fences at TTL, a
+    #                                   standby promotes after it
+    #   RAFIKI_ADMIN_LEASE_RENEW_S=0   renewal period (0 = TTL/3)
+    #   RAFIKI_ADMIN_LEASE_ACQUIRE_TIMEOUT_S=30  how long a booting leader
+    #                                   waits out a predecessor's lease
+    #   RAFIKI_ADMIN_ADDRS=            comma list of admin host:port for
+    #                                   client failover (leader + standbys)
+    #   RAFIKI_ADMIN_FAILOVER_TIMEOUT_S=20  how long Client._call keeps
+    #                                   walking the address list before the
+    #                                   typed AdminUnavailableError
+    #   RAFIKI_ADMIN_STANDBY_POLL_S=0  standby lease-watch period
+    #                                   (0 = the renewal period)
+    #   RAFIKI_RECOVERY_REPORT_KEEP=5  epoch-suffixed recovery-e<N>.json
+    #                                   reports kept per LOGS_DIR
+    "ADMIN_HA": lambda: _env_int("RAFIKI_ADMIN_HA", 0),
+    "ADMIN_LEASE_TTL_S": lambda: _env_float("RAFIKI_ADMIN_LEASE_TTL_S", 10.0),
+    "ADMIN_LEASE_RENEW_S": lambda: _env_float(
+        "RAFIKI_ADMIN_LEASE_RENEW_S", 0.0),
+    "ADMIN_LEASE_ACQUIRE_TIMEOUT_S": lambda: _env_float(
+        "RAFIKI_ADMIN_LEASE_ACQUIRE_TIMEOUT_S", 30.0),
+    "ADMIN_ADDRS": lambda: os.environ.get("RAFIKI_ADMIN_ADDRS", ""),
+    "ADMIN_FAILOVER_TIMEOUT_S": lambda: _env_float(
+        "RAFIKI_ADMIN_FAILOVER_TIMEOUT_S", 20.0),
+    "ADMIN_STANDBY_POLL_S": lambda: _env_float(
+        "RAFIKI_ADMIN_STANDBY_POLL_S", 0.0),
+    "RECOVERY_REPORT_KEEP": lambda: _env_int(
+        "RAFIKI_RECOVERY_REPORT_KEEP", 5),
 }
 
 

@@ -44,6 +44,24 @@ class MetadataStoreChaosError(RuntimeError):
     the drillable stand-in for a flaky/contended metadata store during
     control-plane recovery (docs/failure-model.md)."""
 
+
+class StaleEpochError(RuntimeError):
+    """A mutating control-plane write was refused by the epoch fence
+    (docs/failure-model.md "Control-plane HA"): either a newer admin has
+    acquired the leadership lease (this writer's epoch is stale), or this
+    writer could not renew its own lease within the TTL and self-fenced.
+    Terminal for the caller — a fenced ex-leader must stop mutating, not
+    retry."""
+
+    def __init__(self, message: str, expected: Optional[int] = None,
+                 current: Optional[int] = None):
+        super().__init__(message)
+        self.expected = expected
+        self.current = current
+
+# the single control-plane leadership lease row (control_lease, r20)
+LEASE_ID = "admin"
+
 # NOTE: tables are ordered so every REFERENCES target exists before its
 # referrer — PostgreSQL validates foreign keys at CREATE TABLE time
 # (SQLite only at DML time).
@@ -177,6 +195,14 @@ CREATE TABLE IF NOT EXISTS trial_log (
     datetime REAL NOT NULL
 );
 CREATE INDEX IF NOT EXISTS idx_trial_log_trial ON trial_log(trial_id);
+CREATE TABLE IF NOT EXISTS control_lease (
+    id TEXT PRIMARY KEY,
+    holder TEXT NOT NULL,
+    addr TEXT,
+    epoch INTEGER NOT NULL,
+    expires_at REAL NOT NULL,
+    datetime_updated REAL NOT NULL
+);
 """
 
 
@@ -350,6 +376,14 @@ class Database:
         conn_str = db_path or config.DB_PATH
         self._lock = threading.RLock()
         self._b = _make_backend(conn_str)
+        # epoch write-fence (control-plane HA, admin/lease.py): when armed
+        # (a leader holds the leadership lease through this handle), every
+        # mutating statement first proves — under the same lock — that the
+        # lease row still carries this epoch AND that the lease was renewed
+        # within its TTL. Disarmed (None) for non-HA deployments: zero
+        # overhead on the write path.
+        self._fence_epoch: Optional[int] = None  # guarded-by: _lock
+        self._fence_valid_until = 0.0  # guarded-by: _lock (monotonic)
         self._migrate()
 
     # additive migrations for stores created by earlier versions — the
@@ -428,6 +462,20 @@ class Database:
         # "Cold-start faults")
         "ALTER TABLE inference_job_worker ADD COLUMN"
         " standby INTEGER NOT NULL DEFAULT 0",
+        # r20 (control-plane HA): the leadership lease — ONE row (id
+        # 'admin') whose monotonic epoch bumps on every acquisition.
+        # Acquire/renew are compare-and-set under the backend's exclusive
+        # transaction, and the epoch fences every mutating write of a
+        # leader that lost it (admin/lease.py; docs/failure-model.md
+        # "Control-plane HA")
+        """CREATE TABLE IF NOT EXISTS control_lease (
+    id TEXT PRIMARY KEY,
+    holder TEXT NOT NULL,
+    addr TEXT,
+    epoch INTEGER NOT NULL,
+    expires_at REAL NOT NULL,
+    datetime_updated REAL NOT NULL
+)""",
     )
 
     def _migrate(self) -> None:
@@ -483,9 +531,55 @@ class Database:
         raise MetadataStoreChaosError(
             f"chaos-injected metadata-store fault on {sql.split(None, 1)[0]}")
 
+    # statements the epoch fence guards; DDL only runs at migrate time
+    # (before any fence is armed) and SELECTs are always safe to serve
+    _MUTATING_VERBS = ("INSERT", "UPDATE", "DELETE")
+
+    def _fence_check_locked(self) -> None:  # guarded-by: _lock
+        """Guarded compare-and-set half of epoch fencing: called with the
+        handle lock held, immediately before a mutating statement (or
+        inside an exclusive transaction). Raises StaleEpochError when this
+        writer's lease lapsed (self-fence — renewal missed its TTL) or a
+        newer epoch holds the lease row."""
+        epoch = self._fence_epoch
+        if epoch is None:
+            return
+        if time.monotonic() >= self._fence_valid_until:
+            raise StaleEpochError(
+                f"self-fenced: leadership lease (epoch {epoch}) was not "
+                "renewed within its TTL; refusing to mutate the store",
+                expected=epoch)
+        row = self._b.execute(
+            "SELECT epoch FROM control_lease WHERE id=?", (LEASE_ID,)
+        ).fetchone()
+        current = row["epoch"] if row else 0
+        if current != epoch:
+            raise StaleEpochError(
+                f"stale epoch {epoch}: the leadership lease is now held at "
+                f"epoch {current}; this admin must stop mutating",
+                expected=epoch, current=current)
+
+    def set_fence(self, epoch: int, valid_until: float) -> None:
+        """Arm/refresh the epoch write-fence. ``valid_until`` is a
+        ``time.monotonic()`` deadline — each successful lease renewal
+        extends it by the TTL, so a SIGSTOP'd/partitioned leader that
+        resumes past the TTL self-fences on its next write even before
+        the standby has taken the lease row over."""
+        with self._lock:
+            self._fence_epoch = int(epoch)
+            self._fence_valid_until = float(valid_until)
+
+    def clear_fence(self) -> None:
+        """Disarm the fence (graceful shutdown after lease release)."""
+        with self._lock:
+            self._fence_epoch = None
+
     def _exec(self, sql: str, args: tuple = ()) -> None:
         self._chaos(sql)
         with self._lock:
+            if (self._fence_epoch is not None
+                    and sql.lstrip()[:6].upper() in self._MUTATING_VERBS):
+                self._fence_check_locked()
             self._b.execute(sql, args)
 
     def _one(self, sql: str, args: tuple = ()) -> Optional[Dict[str, Any]]:
@@ -499,6 +593,130 @@ class Database:
         with self._lock:
             rows = self._b.execute(sql, args).fetchall()
         return [self._b.to_dict(r) for r in rows]
+
+    # -- control-plane leadership lease (docs/failure-model.md) ------------
+
+    @staticmethod
+    def _lease_chaos(op: str) -> None:
+        """RAFIKI_CHAOS site=lease: deterministic lease faults at the
+        acquisition/renewal chokepoint. `delay` models a slow store near
+        the TTL edge; `error` (or `drop`) is the false-lease-loss drill —
+        the renewal loop must absorb it and the TTL clock (self-fence)
+        must decide, never the error itself."""
+        rule = chaos.hit(chaos.SITE_LEASE, op)
+        if rule is None:
+            return
+        if rule.action == chaos.ACTION_DELAY:
+            chaos.sleep_for(rule)
+            return
+        raise MetadataStoreChaosError(
+            f"chaos-injected lease fault on {op}")
+
+    def acquire_lease(self, holder: str, ttl_s: float,
+                      addr: Optional[str] = None) -> Optional[Dict]:
+        """Try to take the leadership lease. Succeeds when the row is
+        absent, expired, or already ours; EVERY success bumps the
+        monotonic epoch (even a re-acquisition by the same holder — its
+        own in-flight writes from the previous incarnation must fence).
+        Read-check-write runs in one exclusive transaction (same pattern
+        as reserve_trial), so two standbys racing an expiry can never
+        both win. Returns the new lease dict, or None while a live lease
+        is held by someone else."""
+        self._lease_chaos("acquire")
+        now = time.time()
+        with self._lock:
+            self._b.begin_exclusive("control_lease")
+            try:
+                row = self._b.execute(
+                    "SELECT * FROM control_lease WHERE id=?", (LEASE_ID,)
+                ).fetchone()
+                if row is None:
+                    epoch = 1
+                    self._b.execute(
+                        "INSERT INTO control_lease (id, holder, addr, epoch,"
+                        " expires_at, datetime_updated) VALUES (?,?,?,?,?,?)",
+                        (LEASE_ID, holder, addr, epoch, now + ttl_s, now),
+                    )
+                elif row["holder"] == holder or row["expires_at"] <= now:
+                    epoch = row["epoch"] + 1
+                    self._b.execute(
+                        "UPDATE control_lease SET holder=?, addr=?, epoch=?,"
+                        " expires_at=?, datetime_updated=? WHERE id=?",
+                        (holder, addr, epoch, now + ttl_s, now, LEASE_ID),
+                    )
+                else:
+                    self._b.rollback()
+                    return None
+                self._b.commit()
+            except BaseException:
+                self._b.rollback()
+                raise
+        return {"id": LEASE_ID, "holder": holder, "addr": addr,
+                "epoch": epoch, "expires_at": now + ttl_s,
+                "datetime_updated": now}
+
+    def renew_lease(self, holder: str, epoch: int, ttl_s: float,
+                    addr: Optional[str] = None) -> bool:
+        """Extend the lease iff (holder, epoch) still match — the CAS that
+        makes renewal safe against a standby having promoted meanwhile.
+        Expiry alone does NOT fail renewal: if the epoch is unchanged,
+        nobody else acquired, so extending is split-brain-safe (the
+        holder's own self-fence clock governs whether it kept mutating in
+        the gap). False means leadership is gone for good."""
+        self._lease_chaos("renew")
+        now = time.time()
+        with self._lock:
+            self._b.begin_exclusive("control_lease")
+            try:
+                row = self._b.execute(
+                    "SELECT * FROM control_lease WHERE id=?", (LEASE_ID,)
+                ).fetchone()
+                if (row is None or row["holder"] != holder
+                        or row["epoch"] != epoch):
+                    self._b.rollback()
+                    return False
+                self._b.execute(
+                    "UPDATE control_lease SET addr=?, expires_at=?,"
+                    " datetime_updated=? WHERE id=?",
+                    (addr if addr is not None else row["addr"],
+                     now + ttl_s, now, LEASE_ID),
+                )
+                self._b.commit()
+            except BaseException:
+                self._b.rollback()
+                raise
+        return True
+
+    def release_lease(self, holder: str, epoch: int) -> bool:
+        """Graceful handoff: expire the lease NOW (CAS on holder+epoch)
+        so a standby can promote without waiting out the TTL. The row —
+        and its epoch history — stays."""
+        now = time.time()
+        with self._lock:
+            self._b.begin_exclusive("control_lease")
+            try:
+                row = self._b.execute(
+                    "SELECT * FROM control_lease WHERE id=?", (LEASE_ID,)
+                ).fetchone()
+                if (row is None or row["holder"] != holder
+                        or row["epoch"] != epoch):
+                    self._b.rollback()
+                    return False
+                self._b.execute(
+                    "UPDATE control_lease SET expires_at=?,"
+                    " datetime_updated=? WHERE id=?",
+                    (now, now, LEASE_ID),
+                )
+                self._b.commit()
+            except BaseException:
+                self._b.rollback()
+                raise
+        return True
+
+    def read_lease(self) -> Optional[Dict]:
+        """The current lease row (doctor, standby watch, fleet health)."""
+        return self._one(
+            "SELECT * FROM control_lease WHERE id=?", (LEASE_ID,))
 
     # -- users -------------------------------------------------------------
 
@@ -819,6 +1037,9 @@ class Database:
             # insert
             self._b.begin_exclusive(sub_train_job_id)
             try:
+                # epoch fence inside the exclusive transaction: the
+                # guarded-CAS form — a fenced admin cannot reserve trials
+                self._fence_check_locked()
                 if max_trials is not None:
                     row = self._b.execute(
                         "SELECT COUNT(*) AS c FROM trial"

@@ -1573,6 +1573,105 @@ def check_agents() -> Check:
             f"{len(agents)} agent(s), {total} fleet chips")
 
 
+def check_control_plane_ha() -> Check:
+    """Control-plane HA (docs/failure-model.md "Control-plane HA"): lease
+    timing sanity, standby reachability, leader-epoch agreement between
+    the store and the agent fleet, and the HA-off-but-controllers-on
+    single-point-of-failure shape."""
+    from rafiki_tpu import config
+
+    notes = []
+    warn = False
+    ha_on = bool(config.ADMIN_HA)
+    ttl = float(config.ADMIN_LEASE_TTL_S)
+    renew = float(config.ADMIN_LEASE_RENEW_S) or ttl / 3.0
+    if ha_on and ttl <= 2.0 * renew:
+        warn = True
+        notes.append(
+            f"lease TTL {ttl:g}s <= 2x renewal period {renew:g}s: one "
+            "missed renewal forfeits leadership (set "
+            "RAFIKI_ADMIN_LEASE_TTL_S >= 3x RAFIKI_ADMIN_LEASE_RENEW_S)")
+    if not ha_on and (config.AUTOSCALE or config.DRIFT):
+        warn = True
+        notes.append(
+            "closed-loop controllers on (RAFIKI_AUTOSCALE/RAFIKI_DRIFT) "
+            "with RAFIKI_ADMIN_HA=0: the deciding admin is a single "
+            "point of failure — run a hot standby")
+    addrs = [a.strip() for a in str(config.ADMIN_ADDRS).split(",")
+             if a.strip()]
+    if len(addrs) > 1:
+        import urllib.request as _ur
+
+        dead = []
+        for addr in addrs:
+            try:
+                with _ur.urlopen(f"http://{addr}/", timeout=3):
+                    pass
+            # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+            except Exception:
+                dead.append(addr)
+        if dead:
+            warn = True
+            notes.append(
+                f"RAFIKI_ADMIN_ADDRS lists unreachable admin(s): {dead} "
+                "— clients will burn the failover window walking them")
+    # leader-epoch agreement: the lease row is the truth; an agent
+    # remembering a HIGHER epoch than the store means a stale/forked
+    # store (or an admin writing to a different one)
+    lease_epoch = None
+    target = str(config.DB_PATH)
+    is_url = target.startswith(("postgresql://", "postgres://"))
+    if ha_on and (is_url or os.path.exists(target)):
+        try:
+            from rafiki_tpu.db.database import Database
+
+            db = Database(target)
+            row = db.read_lease()
+            if row is not None:
+                lease_epoch = int(row["epoch"])
+                import time as _time
+
+                live = row["expires_at"] > _time.time()
+                notes.append(
+                    f"lease: epoch {lease_epoch} held by "
+                    f"{row.get('holder')}"
+                    + ("" if live else " (EXPIRED — no leader)"))
+                if not live:
+                    warn = True
+        # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+        except Exception as e:
+            notes.append(f"lease row unreadable: {type(e).__name__}")
+    agents = [a.strip() for a in os.environ.get("RAFIKI_AGENTS", "").split(",")
+              if a.strip()]
+    if lease_epoch is not None and agents:
+        from rafiki_tpu.utils.agent_http import call_agent
+
+        skewed = []
+        for addr in agents:
+            try:
+                hz = call_agent(addr, "GET", "/healthz", timeout_s=5,
+                                use_breaker=False)
+                seen = int(hz.get("admin_epoch", 0))
+            # lint: absorb(doctor checks must never crash; the failure becomes the check detail)
+            except Exception:
+                continue  # reachability is check_agents' job, not ours
+            if seen > lease_epoch:
+                skewed.append(f"{addr}=e{seen}")
+        if skewed:
+            warn = True
+            notes.append(
+                f"agents remember a HIGHER epoch than the lease row "
+                f"({skewed} vs store e{lease_epoch}): this admin is "
+                "reading a stale or forked store")
+    if not ha_on and not notes:
+        return ("control-plane HA", PASS,
+                "off (RAFIKI_ADMIN_HA=0, no controllers demanding it)")
+    detail = "; ".join(notes) if notes else (
+        f"on: TTL {ttl:g}s, renew {renew:g}s, "
+        f"{len(addrs) or 1} admin addr(s)")
+    return ("control-plane HA", WARN if warn else PASS, detail)
+
+
 CHECKS: List[Callable[[], Check]] = [
     check_workdir, check_store, check_shm_broker, check_sandbox,
     check_chaos, check_overload_knobs, check_autoscaler,
@@ -1583,7 +1682,8 @@ CHECKS: List[Callable[[], Check]] = [
     check_int8_serving, check_generative_serving,
     check_speculative_decoding, check_stream_continuity,
     check_prediction_cache,
-    check_observability, check_agents, check_backend,
+    check_observability, check_agents, check_control_plane_ha,
+    check_backend,
 ]
 
 

@@ -59,7 +59,7 @@ import signal
 import socket
 import sys
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, Optional
 
 from rafiki_tpu.cache import wire
@@ -67,8 +67,9 @@ from rafiki_tpu.constants import ServiceType
 from rafiki_tpu.placement.manager import ChipAllocator, InsufficientChipsError
 from rafiki_tpu.placement.process import ProcessPlacementManager
 from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.agent_http import ADMIN_EPOCH_HEADER, STALE_EPOCH_STATUS
 from rafiki_tpu.utils.jsonutil import json_default
-from rafiki_tpu.utils.reqfields import LowLatencyHandler
+from rafiki_tpu.utils.reqfields import LowLatencyHandler, SeveringHTTPServer
 
 logger = logging.getLogger(__name__)
 
@@ -95,8 +96,17 @@ class AgentServer:
         # operation must be requested EXPLICITLY (RAFIKI_AGENT_INSECURE=1).
         self.allow_insecure = allow_insecure
         self.hostname = socket.gethostname()
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._httpd: Optional[SeveringHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # control-plane HA epoch fence (docs/failure-model.md
+        # "Control-plane HA"): the highest admin leadership epoch this
+        # agent has seen. Any authenticated call carrying the epoch
+        # header ratchets it up; mutating calls from a LOWER epoch — a
+        # paused/partitioned ex-leader that resumed — are refused typed
+        # (STALE_EPOCH_STATUS), so a stale admin can never double-place
+        # or tear down a service on this host.
+        self._epoch_lock = threading.Lock()
+        self._admin_epoch = 0  # guarded-by: _epoch_lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -110,7 +120,7 @@ class AgentServer:
             def do_POST(self):
                 server._dispatch(self, "POST")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = SeveringHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
@@ -121,6 +131,10 @@ class AgentServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+            # a stopped agent must go dark like a killed host: sever
+            # established keep-alive connections, don't keep answering
+            # the admin's pooled sessions from orphaned handler threads
+            self._httpd.sever()
         self.engine.stop_all()
 
     # -- request handling --------------------------------------------------
@@ -136,17 +150,38 @@ class AgentServer:
                     handler.close_connection = True
                     return  # no response: callers see a transport error
                 if rule.action == chaos.ACTION_ERROR:
+                    # the request body is unread; keep-alive framing
+                    # would desync, so the conn dies with the response
+                    # (what a genuinely faulting agent does anyway)
+                    handler.close_connection = True
                     return self._respond(handler, rule.code,
                                          {"error": "chaos-injected error"})
                 chaos.sleep_for(rule)
+            # the body is read BEFORE any refusal (bad key, stale epoch)
+            # can answer: an early response over HTTP/1.1 keep-alive with
+            # the body still buffered desyncs the connection — the
+            # admin's pooled session would parse leftover bytes as the
+            # next request line. Decode stays below; refused requests
+            # only pay the (bounded) read.
+            from rafiki_tpu import config as _config
+            from rafiki_tpu.utils.reqfields import read_bounded_body
+
+            raw, berr = read_bounded_body(
+                handler, _config.PREDICT_MAX_BODY_MB)
+            if berr:
+                return self._respond(handler, berr[0], {"error": berr[1]})
             if method == "GET" and path == "/healthz":
                 # liveness stays unauthenticated (monitors/doctor probes).
                 # wire_versions advertises the binary codec versions this
                 # agent decodes — the admin-side relay (cache/fleet.py)
                 # probes it once before shipping binary frames, so an old
                 # agent keeps receiving JSON
+                with self._epoch_lock:
+                    seen_epoch = self._admin_epoch
                 return self._respond(handler, 200, {
                     "host": self.hostname, "status": "ok",
+                    # the fence state, for the doctor's epoch-skew check
+                    "admin_epoch": seen_epoch,
                     "wire_versions": sorted(wire.SUPPORTED_VERSIONS)})
             if method == "GET" and path == "/metrics":
                 # Prometheus exposition stays unauthenticated like
@@ -170,14 +205,37 @@ class AgentServer:
                     "error": "agent has no key configured and "
                              "RAFIKI_AGENT_INSECURE=1 was not set — "
                              "refusing all placement/relay requests"})
+            # epoch fence (after auth, so only keyed admins can ratchet).
+            # Placement mutations (/services, /services/<id>/stop) from a
+            # lower epoch than the highest seen are refused typed; once an
+            # epoch has been seen, an epoch-LESS mutation is refused too —
+            # in an HA fleet "no epoch" is indistinguishable from "older
+            # than every epoch". Data-plane relays stay unfenced: an
+            # ex-leader's predictor finishing in-flight reads must not
+            # fail client requests.
+            call_epoch: Optional[int] = None
+            epoch_hdr = handler.headers.get(ADMIN_EPOCH_HEADER)
+            if epoch_hdr is not None:
+                try:
+                    call_epoch = int(epoch_hdr)
+                except ValueError:
+                    return self._respond(handler, 400, {
+                        "error": "malformed admin epoch header"})
+            with self._epoch_lock:
+                if call_epoch is not None and call_epoch > self._admin_epoch:
+                    self._admin_epoch = call_epoch
+                seen_epoch = self._admin_epoch
+            mutating = method == "POST" and (
+                path == "/services" or _SERVICE_STOP.match(path) is not None)
+            if (mutating and seen_epoch > 0
+                    and (call_epoch is None or call_epoch < seen_epoch)):
+                return self._respond(handler, STALE_EPOCH_STATUS, {
+                    "error": f"stale admin epoch "
+                             f"{call_epoch if call_epoch is not None else 0}"
+                             f" < {seen_epoch}: a newer admin holds the "
+                             "leadership lease; refusing mutation",
+                    "admin_epoch": seen_epoch})
             body: Dict[str, Any] = {}
-            from rafiki_tpu import config as _config
-            from rafiki_tpu.utils.reqfields import read_bounded_body
-
-            raw, berr = read_bounded_body(
-                handler, _config.PREDICT_MAX_BODY_MB)
-            if berr:
-                return self._respond(handler, berr[0], {"error": berr[1]})
             binary_req = False
             if raw:
                 ctype = ((handler.headers.get("Content-Type") or "")
@@ -209,6 +267,7 @@ class AgentServer:
                     "total_chips": alloc.total_chips,
                     "free_chips": alloc.free_chips,
                     "n_services": len(self.engine._runners),
+                    "admin_epoch": seen_epoch,
                     "services": list_fn() if callable(list_fn) else [],
                 })
             if method == "POST" and path == "/services":

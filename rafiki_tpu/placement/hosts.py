@@ -32,11 +32,12 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from rafiki_tpu import config
 from rafiki_tpu.constants import AgentHealth, ServiceType
 from rafiki_tpu.utils.agent_http import (
+    STALE_EPOCH_STATUS,
     AgentCircuitOpenError,
     AgentHTTPError,
     AgentTransportError,
@@ -64,6 +65,14 @@ class AgentCircuitOpenUnreachable(AgentUnreachableError):
     committed on the agent. Placement treats this as provably unplaced."""
 
 
+class StaleAdminEpochError(Exception):
+    """The agent refused this control call because a newer admin epoch
+    holds the leadership lease (STALE_EPOCH_STATUS — the agent-side half
+    of epoch fencing, docs/failure-model.md "Control-plane HA"). Terminal
+    and NOT an unreachability: the agent is alive and the refusal is
+    final — this admin must stop mutating, not fail over to a sibling."""
+
+
 class _AgentHandle:
     """Client for one host agent (wire protocol: utils/agent_http.py)."""
 
@@ -72,6 +81,12 @@ class _AgentHandle:
         self.addr = addr  # "host:port"
         self.key = key
         self.timeout_s = timeout_s
+        # control-plane HA: when the owning manager is epoch-fenced, every
+        # call stamps the leader's epoch (set_epoch_provider)
+        self.epoch_provider: Optional[Callable[[], Optional[int]]] = None
+
+    def epoch(self) -> Optional[int]:
+        return self.epoch_provider() if self.epoch_provider else None
 
     def _call(self, method: str, path: str,
               body: Optional[Dict[str, Any]] = None,
@@ -79,8 +94,10 @@ class _AgentHandle:
         try:
             return call_agent(self.addr, method, path, body=body,
                               key=self.key, timeout_s=self.timeout_s,
-                              idempotent=idempotent)
+                              idempotent=idempotent, epoch=self.epoch())
         except AgentHTTPError as e:
+            if e.code == STALE_EPOCH_STATUS:
+                raise StaleAdminEpochError(f"{self.addr}: {e.message}")
             if e.code == 503:
                 raise InsufficientChipsError(e.message)
             raise AgentUnreachableError(f"{self.addr}: {e.message}")
@@ -398,11 +415,26 @@ class HostAgentPlacementManager(PlacementManager):
             for a in agents
         }
         self._heartbeat: Optional[threading.Thread] = None
+        # control-plane HA: the leader's epoch provider (admin/lease.py);
+        # every agent call is stamped with it once set, so agents learn
+        # new epochs from ordinary authenticated traffic (a promoting
+        # admin's recovery inventory probes, first of all) and can fence
+        # a stale ex-leader's mutations
+        self.epoch_provider: Optional[Callable[[], Optional[int]]] = None
         if self._heartbeat_interval_s > 0:
             self._heartbeat = threading.Thread(
                 target=self._heartbeat_loop, name="hosts-heartbeat",
                 daemon=True)
             self._heartbeat.start()
+
+    def set_epoch_provider(
+            self, fn: Optional[Callable[[], Optional[int]]]) -> None:
+        """Wire the admin's leadership-epoch source into every agent
+        handle (and the probe/heartbeat paths) — the client-side half of
+        epoch fencing."""
+        self.epoch_provider = fn
+        for handle in self.agents.values():
+            handle.epoch_provider = fn
 
     # -- inventories -------------------------------------------------------
 
@@ -628,7 +660,8 @@ class HostAgentPlacementManager(PlacementManager):
             addr, handle = item
             try:
                 return addr, call_agent(addr, "GET", "/inventory",
-                                        key=handle.key, timeout_s=timeout_s)
+                                        key=handle.key, timeout_s=timeout_s,
+                                        epoch=handle.epoch())
             except Exception as e:
                 logger.warning("recovery probe of agent %s failed: %s",
                                addr, e)
@@ -825,7 +858,8 @@ class HostAgentPlacementManager(PlacementManager):
                         addr, "GET", "/healthz", key=handle.key,
                         timeout_s=min(config.AGENT_HEARTBEAT_TIMEOUT_S,
                                       max(self._heartbeat_interval_s, 0.1)),
-                        idempotent=False, use_breaker=False)
+                        idempotent=False, use_breaker=False,
+                        epoch=handle.epoch())
                     alive = True
                     err: Optional[str] = None
                 except AgentHTTPError as e:

@@ -219,6 +219,17 @@ class PredictorServer:
         self._respond(handler, 200, payload)
 
     def _predict(self, handler: BaseHTTPRequestHandler) -> None:
+        from rafiki_tpu import config as _config
+        from rafiki_tpu.utils.reqfields import read_bounded_body
+
+        # body first: a refusal (404/401) that leaves it unread would
+        # desync HTTP/1.1 keep-alive framing for the pooled connection
+        raw, berr = read_bounded_body(
+            handler, _config.PREDICT_MAX_BODY_MB)
+        if berr:
+            return self._respond(
+                handler, berr[0],
+                {"error": f"{berr[1]} (PREDICT_MAX_BODY_MB)"})
         if handler.path.split("?", 1)[0].rstrip("/") != "/predict":
             return self._respond(handler, 404, {"error": "no such route"})
         try:
@@ -226,15 +237,6 @@ class PredictorServer:
                 token = (handler.headers.get("Authorization")
                          or "").removeprefix("Bearer ")
                 decode_token(token)  # any authenticated user may predict
-            from rafiki_tpu import config as _config
-            from rafiki_tpu.utils.reqfields import read_bounded_body
-
-            raw, berr = read_bounded_body(
-                handler, _config.PREDICT_MAX_BODY_MB)
-            if berr:
-                return self._respond(
-                    handler, berr[0],
-                    {"error": f"{berr[1]} (PREDICT_MAX_BODY_MB)"})
             # media types are case-insensitive (RFC 9110); params follow ';'
             ctype = ((handler.headers.get("Content-Type") or "")
                      .split(";")[0].strip().lower())
@@ -438,23 +440,25 @@ class PredictorServer:
                 held[0] = False
                 self.admission.release(tenant=self.app)
 
+        from rafiki_tpu import config as _config
+        from rafiki_tpu.utils.reqfields import (
+            parse_timeout_s,
+            read_bounded_body,
+        )
+
+        # body first: a 401/413 with the body unread would desync the
+        # keep-alive connection (see _predict)
+        raw, berr = read_bounded_body(
+            handler, _config.PREDICT_MAX_BODY_MB)
+        if berr:
+            return self._respond(
+                handler, berr[0],
+                {"error": f"{berr[1]} (PREDICT_MAX_BODY_MB)"})
         try:
             if self.auth:
                 token = (handler.headers.get("Authorization")
                          or "").removeprefix("Bearer ")
                 decode_token(token)
-            from rafiki_tpu import config as _config
-            from rafiki_tpu.utils.reqfields import (
-                parse_timeout_s,
-                read_bounded_body,
-            )
-
-            raw, berr = read_bounded_body(
-                handler, _config.PREDICT_MAX_BODY_MB)
-            if berr:
-                return self._respond(
-                    handler, berr[0],
-                    {"error": f"{berr[1]} (PREDICT_MAX_BODY_MB)"})
             body = json.loads(raw or b"{}")
             if not isinstance(body, dict):
                 return self._respond(handler, 400, {

@@ -40,6 +40,12 @@ from rafiki_tpu.utils import chaos
 logger = logging.getLogger(__name__)
 
 AGENT_KEY_HEADER = "X-Rafiki-Agent-Key"
+# control-plane HA (docs/failure-model.md "Control-plane HA"): the
+# admin's leadership epoch rides every control call; agents remember the
+# highest epoch seen and answer STALE_EPOCH_STATUS to any mutating call
+# carrying a lower one — the agent-side half of epoch fencing.
+ADMIN_EPOCH_HEADER = "X-Rafiki-Admin-Epoch"
+STALE_EPOCH_STATUS = 412  # Precondition Failed: typed, never retried
 
 # breaker states (surfaced by placement/hosts.py agent_health and doctor)
 BREAKER_CLOSED = "CLOSED"
@@ -159,6 +165,7 @@ def _raw_call(
     key: Optional[str],
     timeout_s: float,
     wire_frames: bool = False,
+    epoch: Optional[int] = None,
 ) -> Dict[str, Any]:
     rule = chaos.hit(chaos.SITE_CALL_AGENT, f"{addr} {path}")
     if rule is not None:
@@ -192,6 +199,8 @@ def _raw_call(
     req.add_header("Content-Type", ctype)
     if key:
         req.add_header(AGENT_KEY_HEADER, key)
+    if epoch is not None:
+        req.add_header(ADMIN_EPOCH_HEADER, str(int(epoch)))
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             raw = resp.read() or b"{}"
@@ -225,6 +234,7 @@ def call_agent(
     idempotent: Optional[bool] = None,
     use_breaker: bool = True,
     wire_frames: bool = False,
+    epoch: Optional[int] = None,
 ) -> Dict[str, Any]:
     """One request to a host agent, with retry + circuit breaking.
 
@@ -235,6 +245,10 @@ def call_agent(
     ``wire_frames`` ships the body as one binary wire frame
     (cache/wire.py) — data-plane callers only, after negotiating support
     via the agent's /healthz ``wire_versions`` advertisement.
+    ``epoch`` stamps the admin's leadership epoch on the request
+    (control-plane HA): the agent refuses mutating calls from a lower
+    epoch with STALE_EPOCH_STATUS — an AgentHTTPError here, which never
+    retries (the host answered; the refusal is the answer).
     """
     if idempotent is None:
         idempotent = method.upper() == "GET"
@@ -253,7 +267,7 @@ def call_agent(
             time.sleep(backoff * (2 ** (attempt - 1)) * random.uniform(0.5, 1.5))
         try:
             out = _raw_call(addr, method, path, body, key, timeout_s,
-                            wire_frames=wire_frames)
+                            wire_frames=wire_frames, epoch=epoch)
         except AgentHTTPError:
             # the host answered — alive, whatever the status code says
             if breaker is not None:

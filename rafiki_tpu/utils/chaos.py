@@ -38,7 +38,10 @@ Fields:
              monitor-tick and retrain-launch chokepoints — degraded-
              monitor / parked-launch drills), or ``compile`` (the worker
              warm-up / compile chokepoint — cold-start drills: slow
-             compiles, corrupt cache entries, failed standby warm-ups).
+             compiles, corrupt cache entries, failed standby warm-ups),
+             or ``lease`` (the control-plane leadership-lease
+             acquire/renew chokepoint — false-lease-loss, slow-renewal
+             and self-fence drills for admin HA).
              Required.
     action   ``drop`` (connection-level failure; at site=worker the batch
              is silently swallowed — a stalled replica), ``delay`` (sleep
@@ -167,6 +170,15 @@ SITE_TRIAL = "trial"
 # damage and the boot degrades to a fresh compile, never a crash) —
 # docs/failure-model.md "Cold-start faults".
 SITE_COMPILE = "compile"
+# control-plane leadership lease (db/database.py acquire_lease /
+# renew_lease): one ask per lease operation, target "acquire" or
+# "renew". `error` is the false-lease-loss drill (a renewal that errors
+# must NOT drop leadership — the TTL clock decides; a leader that cannot
+# renew within the TTL self-fences its writes BEFORE the standby can
+# acquire), `delay` models a slow/contended store near the TTL edge
+# (renewal landing late, promotion racing expiry) —
+# docs/failure-model.md "Control-plane HA".
+SITE_LEASE = "lease"
 
 ACTION_DROP = "drop"
 ACTION_DELAY = "delay"
@@ -196,7 +208,7 @@ class ChaosRule:
         if self.site not in (SITE_CALL_AGENT, SITE_AGENT, SITE_WORKER,
                              SITE_WIRE, SITE_DB, SITE_TRIAL,
                              SITE_GENERATE, SITE_DEPLOY, SITE_CACHE,
-                             SITE_DRIFT, SITE_COMPILE):
+                             SITE_DRIFT, SITE_COMPILE, SITE_LEASE):
             raise ChaosSpecError(f"unknown chaos site {self.site!r}")
         if self.action not in (ACTION_DROP, ACTION_DELAY, ACTION_ERROR,
                                ACTION_CORRUPT, ACTION_OOM):

@@ -11,7 +11,10 @@ timeout at all (/root/reference/rafiki/predictor/app.py).
 from __future__ import annotations
 
 import math
-from http.server import BaseHTTPRequestHandler
+import socket
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 
@@ -34,6 +37,53 @@ class LowLatencyHandler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):  # doors log through `logging`
         pass
+
+
+class SeveringHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose ``stop`` path can sever LIVE connections.
+
+    ``shutdown() + server_close()`` only closes the LISTENER; handler
+    threads serving established HTTP/1.1 keep-alive connections keep
+    answering until the peer closes or the idle timeout reaps them — so
+    an in-process "killed" door (control-plane HA drills, restart tests)
+    keeps serving its old clients for up to ``Handler.timeout`` seconds,
+    which a real SIGKILL'd process never would. ``sever()`` resets every
+    open connection so a stopped door goes dark the way a dead process
+    does; clients see a connection reset, which the failover walk
+    (client/client.py) absorbs exactly like a refusal."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request_thread(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(request)
+
+    def handle_error(self, request, client_address):
+        # severed sockets raise in their handler threads; that teardown
+        # is expected — only non-transport errors deserve a traceback
+        exc = sys.exc_info()[1]
+        if isinstance(exc, OSError):
+            return
+        super().handle_error(request, client_address)
+
+    def sever(self) -> None:
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 def parse_timeout_s(

@@ -509,6 +509,34 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 #                                       RAFIKI_BACKEND_PROBE_STALE_S
 #   RAFIKI_PROFILE=1                    per-phase profile spans in logs
 
+# Control-plane HA (docs/failure-model.md "Control-plane HA"): leased
+# leadership + epoch-fenced writes + hot-standby promotion + client
+# multi-address failover:
+#   RAFIKI_ADMIN_HA=0                   1 = the admin acquires the
+#                                       control_lease row on boot (or
+#                                       refuses to start as leader);
+#                                       default off: a solo admin needs
+#                                       no lease and pays no fence
+#   RAFIKI_ADMIN_LEASE_TTL_S=10         leadership lease TTL; a leader
+#                                       that cannot renew self-fences at
+#                                       TTL, a standby promotes after it
+#   RAFIKI_ADMIN_LEASE_RENEW_S=0        renewal period (0 = TTL/3; keep
+#                                       TTL >= 3x renewals or doctor WARNs)
+#   RAFIKI_ADMIN_LEASE_ACQUIRE_TIMEOUT_S=30
+#                                       how long a booting leader waits
+#                                       out a predecessor's live lease
+#   RAFIKI_ADMIN_ADDRS=''               comma list of admin host:port
+#                                       (leader + standbys) the client
+#                                       SDK walks on refusal/standby-503
+#   RAFIKI_ADMIN_FAILOVER_TIMEOUT_S=20  how long Client calls keep
+#                                       walking the list before the typed
+#                                       AdminUnavailableError
+#   RAFIKI_ADMIN_STANDBY_POLL_S=0       standby lease-watch period
+#                                       (0 = the renewal period)
+#   RAFIKI_RECOVERY_REPORT_KEEP=5       epoch-suffixed recovery-e<N>.json
+#                                       reports kept per LOGS_DIR (two
+#                                       admins share one across failover)
+
 # Deterministic fault injection — MUST stay off outside drills/tests
 # (sites: call_agent, agent, worker — stalls/slows serving replicas for
 # overload drills — wire, whose `corrupt` action garbles shm frames for
@@ -518,9 +546,12 @@ export APP_SECRET="${APP_SECRET:-rafiki-tpu-dev-secret}"
 # drills, generate, which injures/stalls one generation slot per
 # rule for mid-stream fault drills, deploy, which fails/delays the
 # inference-replica placement chokepoint for canary-failure and
-# deploy-timeout rollback drills, and compile, which delays the warm-up
+# deploy-timeout rollback drills, compile, which delays the warm-up
 # chokepoint, corrupts on-disk compile-cache entries (the bit-rot
-# drill), or errors a boot for the standby-retry drill):
+# drill), or errors a boot for the standby-retry drill, and lease,
+# which errors/delays leadership-lease acquisition and renewal at the
+# store chokepoint for false-lease-loss, slow-renewal-near-TTL and
+# self-fence drills):
 #   RAFIKI_CHAOS=''                     e.g. 'site=agent;action=drop;times=3'
 export RAFIKI_CHAOS="${RAFIKI_CHAOS:-}"
 

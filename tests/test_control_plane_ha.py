@@ -1,0 +1,786 @@
+"""Control-plane HA drills (docs/failure-model.md "Control-plane HA"):
+leased leadership with a monotonic epoch, epoch-fenced store writes and
+agent calls, hot-standby promotion through the unchanged HTTP door, and
+client multi-address failover.
+
+The two acceptance drills live here:
+
+- **split-brain** — SIGSTOP the leader (lease.suspend) past its TTL, let
+  the standby promote and adopt the fleet, then resume the old leader
+  and prove EVERY one of its mutations is refused *typed*: store writes
+  raise StaleEpochError, agent calls come back 412/StaleAdminEpochError,
+  zero services are double-placed and the budget-N job scored exactly N
+  trials.
+- **kill-the-leader under load** — a continuous client predict load plus
+  an in-flight budget-N train job while the leader's door, placement and
+  renewals are all killed at once: the standby promotes within 2x TTL,
+  the client's address walk absorbs the gap with ZERO failed requests,
+  and the job still scores exactly N trials.
+
+Generative-stream continuity under fencing is drilled separately on the
+local placement path (test_generative_stream_survives_leadership_loss):
+the hosts-mode fleet broker has no generation relay, and the point there
+is precisely that the DATA plane — streams included — never consults the
+fence.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from rafiki_tpu import config
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.admin.http import AdminServer
+from rafiki_tpu.admin.lease import (
+    LeaseManager,
+    ROLE_FENCED,
+    ROLE_LEADER,
+    ROLE_STANDBY,
+)
+from rafiki_tpu.admin.recovery import ControlPlaneRecovery
+from rafiki_tpu.admin.standby import StandbyAdmin
+from rafiki_tpu.advisor.advisor import AdvisorStore
+from rafiki_tpu.cache.queue import InProcessBroker
+from rafiki_tpu.constants import ServiceType, UserType
+from rafiki_tpu.db.database import Database, StaleEpochError
+from rafiki_tpu.client.client import (
+    AdminUnavailableError,
+    Client,
+    RafikiError,
+)
+from rafiki_tpu.placement.agent import AgentServer
+from rafiki_tpu.placement.hosts import (
+    HostAgentPlacementManager,
+    StaleAdminEpochError,
+    _AgentHandle,
+)
+from rafiki_tpu.placement.manager import ChipAllocator, LocalPlacementManager
+from rafiki_tpu.utils import chaos
+from rafiki_tpu.utils.agent_http import (
+    AgentHTTPError,
+    call_agent,
+    reset_breaker,
+)
+from rafiki_tpu.worker.inference import InferenceWorker
+from rafiki_tpu.worker.train import TrainWorker
+
+HERE = os.path.dirname(__file__)
+FIXTURE = os.path.join(HERE, "fixtures", "fake_model.py")
+GEN_FIXTURE = os.path.join(HERE, "fixtures", "gen_model.py")
+TEST_KEY = "ha-drill-key"
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    chaos.clear()
+    reset_breaker()
+    yield
+    chaos.clear()
+    reset_breaker()
+
+
+# ---------------------------------------------------------------------------
+# harness (shape of test_restart_recovery.py): agents backed by thread
+# engines in THIS process, so they keep serving when an Admin is dropped
+# ---------------------------------------------------------------------------
+
+
+class _ThreadEngine:
+    def __init__(self, db, chips):
+        self.db = db
+        self.broker = InProcessBroker()
+        self.advisors = AdvisorStore()
+        self._local = LocalPlacementManager(
+            allocator=ChipAllocator(chips), on_status=self._on_status)
+        self.allocator = self._local.allocator
+
+    def _on_status(self, sid, status):
+        if status == "RUNNING":
+            self.db.mark_service_as_running(sid)
+        elif status == "STOPPED":
+            self.db.mark_service_as_stopped(sid)
+        elif status == "ERRORED":
+            self.db.mark_service_as_errored(sid)
+
+    @property
+    def _runners(self):
+        return self._local._runners
+
+    def list_services(self):
+        return self._local.list_services()
+
+    def create_service(self, service_id, service_type, n_chips=0,
+                       best_effort_chips=False, extra=None):
+        extra = dict(extra or {})
+        if service_type == ServiceType.TRAIN:
+            worker = TrainWorker(extra["sub_train_job_id"], self.db,
+                                 self.advisors)
+        else:
+            worker = InferenceWorker(
+                extra["inference_job_id"], extra["trial_id"], self.db,
+                self.broker, trial_ids=extra.get("trial_ids"))
+        return self._local.create_service(
+            service_id, service_type, worker.start, n_chips=n_chips,
+            extra=extra, best_effort_chips=best_effort_chips)
+
+    def destroy_service(self, service_id, wait=True):
+        self._local.destroy_service(service_id, wait=wait)
+
+    def stop_all(self):
+        self._local.stop_all()
+
+
+def _spawn_host(db, chips):
+    engine = _ThreadEngine(db, chips)
+    server = AgentServer(engine, key=TEST_KEY).start()
+    return engine, server, f"127.0.0.1:{server.port}"
+
+
+def _placement(agents, db):
+    return HostAgentPlacementManager(
+        agents, db=db, key=TEST_KEY, heartbeat_interval_s=0)
+
+
+def _wait_ready(admin, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if admin.recovery_status()["state"] != "recovering":
+            return admin.recovery_status()
+        time.sleep(0.02)
+    pytest.fail(f"admin never reached ready: {admin.recovery_status()}")
+
+
+def _wait_for(cond, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def _crash(admin):
+    """Abandon an admin the way a dead process would: pollers silenced,
+    dedicated predictor listeners closed, nothing drained."""
+    admin.placement._closed.set()
+    for psrv in list(admin.services._predict_servers.values()):
+        psrv.stop(drain_timeout_s=0.0)
+
+
+def _seed_app(admin, uid, app, trials=2):
+    with open(FIXTURE, "rb") as f:
+        admin.create_model(uid, f"fake-{app}", "IMAGE_CLASSIFICATION",
+                           f.read(), "FakeModel")
+    admin.create_train_job(
+        uid, app, "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+        budget={"MODEL_TRIAL_COUNT": trials, "CHIP_COUNT": 2})
+    return admin.wait_until_train_job_stopped(uid, app, timeout_s=60)
+
+
+def _superadmin(admin):
+    return admin.authenticate_user(
+        config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)["user_id"]
+
+
+# ---------------------------------------------------------------------------
+# lease primitives (db/database.py)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_acquire_bumps_epoch_and_excludes_live_holder(tmp_path):
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    row = db.acquire_lease("a", ttl_s=30.0, addr="h:1")
+    assert row is not None and row["epoch"] == 1
+    # re-acquisition by the SAME holder bumps too: its previous
+    # incarnation's in-flight writes must fence
+    row = db.acquire_lease("a", ttl_s=30.0, addr="h:1")
+    assert row["epoch"] == 2
+    # a live foreign lease excludes
+    assert db.acquire_lease("b", ttl_s=30.0) is None
+    stored = db.read_lease()
+    assert stored["holder"] == "a" and stored["epoch"] == 2
+    assert stored["addr"] == "h:1"
+    # an expired lease is up for grabs, epoch keeps climbing
+    row = db.acquire_lease("a", ttl_s=0.0)
+    assert row["epoch"] == 3
+    time.sleep(0.01)
+    row = db.acquire_lease("b", ttl_s=30.0)
+    assert row is not None and row["epoch"] == 4
+
+
+def test_lease_renew_is_cas_on_holder_and_epoch(tmp_path):
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    row = db.acquire_lease("a", ttl_s=0.05)
+    assert db.renew_lease("a", row["epoch"], ttl_s=30.0) is True
+    # expiry alone must NOT fail renewal (nobody else acquired) — let the
+    # short first TTL lapse conceptually; the epoch CAS is what guards
+    assert db.renew_lease("a", row["epoch"], ttl_s=30.0) is True
+    assert db.renew_lease("a", row["epoch"] + 1, ttl_s=30.0) is False
+    assert db.renew_lease("someone-else", row["epoch"], ttl_s=30.0) is False
+    # release expires the row NOW, so a standby acquires immediately
+    assert db.release_lease("a", row["epoch"]) is True
+    time.sleep(0.01)
+    row2 = db.acquire_lease("b", ttl_s=30.0)
+    assert row2 is not None and row2["epoch"] == row["epoch"] + 1
+    # and the old holder's renewal is refused for good
+    assert db.renew_lease("a", row["epoch"], ttl_s=30.0) is False
+
+
+# ---------------------------------------------------------------------------
+# epoch fence at the Database chokepoint
+# ---------------------------------------------------------------------------
+
+
+def test_fence_blocks_stale_writes_but_not_reads(tmp_path):
+    path = str(tmp_path / "meta.sqlite3")
+    db_stale = Database(path)
+    db_new = Database(path)
+    row = db_stale.acquire_lease("old", ttl_s=0.0)
+    db_stale.set_fence(row["epoch"], time.monotonic() + 60.0)
+    time.sleep(0.01)
+    db_new.acquire_lease("new", ttl_s=60.0)  # epoch 2 in the store
+    with pytest.raises(StaleEpochError) as ei:
+        db_stale.create_user("stale@x", "h", UserType.APP_DEVELOPER)
+    assert ei.value.expected == row["epoch"]
+    # reads keep working — a fenced ex-leader may still observe
+    assert db_stale.read_lease()["epoch"] == row["epoch"] + 1
+    assert db_stale.get_user_by_email("stale@x") is None
+    # the unfenced new-epoch handle writes fine
+    db_new.create_user("new@x", "h", UserType.APP_DEVELOPER)
+    # disarming (graceful shutdown after release) restores legacy behavior
+    db_stale.clear_fence()
+    db_stale.create_user("later@x", "h", UserType.APP_DEVELOPER)
+
+
+def test_fence_self_fences_past_validity_without_reading_store(tmp_path):
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    row = db.acquire_lease("a", ttl_s=60.0)
+    db.set_fence(row["epoch"], time.monotonic() - 0.001)
+    # the lease row is still live and ours — but the local validity
+    # lapsed, which is exactly the SIGSTOP-resume case: refuse BEFORE
+    # trusting the store
+    with pytest.raises(StaleEpochError, match="self-fenced"):
+        db.create_user("x@x", "h", UserType.APP_DEVELOPER)
+
+
+def test_reserve_trial_refuses_under_stale_fence(tmp_path):
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    db.acquire_lease("a", ttl_s=60.0)
+    db.set_fence(1, time.monotonic() - 0.001)
+    # the fence check runs INSIDE the exclusive budget transaction, so a
+    # fenced admin can never mint a trial row — the double-run guard
+    with pytest.raises(StaleEpochError):
+        db.reserve_trial("no-such-sub", "no-such-model", {}, max_trials=1)
+
+
+# ---------------------------------------------------------------------------
+# chaos site=lease (satellite): false lease loss + self-fence timing
+# ---------------------------------------------------------------------------
+
+
+def test_renewal_errors_do_not_demote_while_ttl_holds(tmp_path):
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    lease = LeaseManager(db, holder="L", ttl_s=2.0, renew_s=0.1)
+    try:
+        assert lease.acquire() is True
+        lease.start()
+        # two renewal round trips error out — the false-lease-loss drill:
+        # the loop must absorb them and stay leader on the TTL clock
+        chaos.install(chaos.parse_rules(
+            "site=lease;action=error;match=renew;times=2"))
+        time.sleep(0.45)
+        assert lease.role() == ROLE_LEADER
+        assert lease.epoch() == 1
+        # ...and once the store answers again the fence keeps extending
+        chaos.clear()
+        time.sleep(0.3)
+        assert lease.valid_for_s() > 1.0
+    finally:
+        chaos.clear()
+        lease.stop()
+
+
+def test_persistent_renewal_failure_self_fences_then_fails_over(tmp_path):
+    path = str(tmp_path / "meta.sqlite3")
+    db = Database(path)
+    lease = LeaseManager(db, holder="L", ttl_s=0.6, renew_s=0.1)
+    try:
+        assert lease.acquire() is True
+        lease.start()
+        chaos.install(chaos.parse_rules("site=lease;action=error;match=renew"))
+        # every renewal now fails -> the fence validity lapses at TTL
+        assert _wait_for(lambda: lease.role() == ROLE_FENCED, timeout_s=5.0)
+        with pytest.raises(StaleEpochError, match="self-fenced"):
+            db.create_user("x@x", "h", UserType.APP_DEVELOPER)
+        chaos.clear()
+        # only AFTER the wall-clock TTL lapses can a successor acquire
+        db2 = Database(path)
+        assert _wait_for(
+            lambda: db2.acquire_lease("S", ttl_s=30.0) is not None,
+            timeout_s=5.0)
+        assert db2.read_lease()["epoch"] == 2
+    finally:
+        chaos.clear()
+        lease.stop(release=False)
+
+
+def test_slow_lease_store_delays_but_keeps_leadership(tmp_path):
+    db = Database(str(tmp_path / "meta.sqlite3"))
+    lease = LeaseManager(db, holder="L", ttl_s=2.0, renew_s=0.1)
+    try:
+        assert lease.acquire() is True
+        lease.start()
+        # a slow store near the TTL edge: renewals land late but DO land
+        chaos.install(chaos.parse_rules(
+            "site=lease;action=delay;match=renew;delay_s=0.15"))
+        time.sleep(0.8)
+        assert lease.role() == ROLE_LEADER
+    finally:
+        chaos.clear()
+        lease.stop()
+
+
+# ---------------------------------------------------------------------------
+# recovery-report clobbering fix (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_suffixed_recovery_reports_are_pruned(tmp_path):
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    for e in range(1, 9):
+        (logs / f"recovery-e{e}.json").write_text("{}")
+    (logs / "recovery.json").write_text("{}")
+    ControlPlaneRecovery._prune_epoch_reports(str(logs))
+    keep = int(config.RECOVERY_REPORT_KEEP)
+    left = sorted(p.name for p in logs.glob("recovery-e*.json"))
+    assert left == [f"recovery-e{e}.json" for e in range(9 - keep, 9)]
+    # the stable unsuffixed report is never pruned
+    assert (logs / "recovery.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# client failover (satellite + tentpole d)
+# ---------------------------------------------------------------------------
+
+
+def _dead_addr():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def test_client_connection_refused_is_typed_and_retryable():
+    client = Client(admin_addrs=[_dead_addr()])
+    with pytest.raises(AdminUnavailableError) as ei:
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+    # typed under the existing error root, so wait_until_admin_ready and
+    # every caller that retries RafikiError absorbs it
+    assert isinstance(ei.value, RafikiError)
+
+
+def test_client_walks_address_list_to_a_live_admin(tmp_path):
+    admin = Admin(db=Database(":memory:"),
+                  placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+                  params_dir=str(tmp_path / "params"))
+    server = AdminServer(admin).start()
+    try:
+        live = f"127.0.0.1:{server.port}"
+        client = Client(admin_addrs=[_dead_addr(), live])
+        out = client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        assert out["user_id"]
+        # the walk pinned the live address for subsequent calls
+        assert client._addrs[client._active] == live
+    finally:
+        server.stop()
+        admin.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hot-standby door + promotion through the unchanged HTTP server
+# ---------------------------------------------------------------------------
+
+
+def test_standby_door_sheds_with_leader_hint_then_promotes(tmp_path):
+    path = str(tmp_path / "meta.sqlite3")
+    db_leader = Database(path)
+    lease1 = LeaseManager(db_leader, holder="L1", ttl_s=5.0, renew_s=0.2)
+    assert lease1.acquire() is True
+    admin1 = Admin(db=db_leader,
+                   placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+                   params_dir=str(tmp_path / "params"), lease=lease1)
+    srv1 = AdminServer(admin1).start()
+    leader_addr = f"127.0.0.1:{srv1.port}"
+    # the advertised address rides the lease row from the next renewal on
+    lease1.addr = leader_addr
+    assert _wait_for(lambda: (db_leader.read_lease() or {}).get("addr")
+                     == leader_addr, timeout_s=5.0)
+
+    standby = StandbyAdmin(
+        Database(path),
+        factory=lambda lease: Admin(
+            db=Database(path),
+            placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+            params_dir=str(tmp_path / "params"), lease=lease),
+        poll_s=0.1)
+    srv2 = AdminServer(standby).start()
+    try:
+        base2 = f"http://127.0.0.1:{srv2.port}"
+        # public root: role + leader hint, no auth needed
+        root = requests.get(f"{base2}/", timeout=5).json()["data"]
+        assert root["ha"]["role"] == ROLE_STANDBY
+        assert root["ha"]["leader"] == leader_addr
+        # login WORKS on the standby (one signing secret per deployment)
+        tok = requests.post(
+            f"{base2}/tokens",
+            json={"email": config.SUPERADMIN_EMAIL,
+                  "password": config.SUPERADMIN_PASSWORD},
+            timeout=5).json()["data"]["token"]
+        # a mutating route sheds 503 with the leader hint — TWICE over
+        # one pooled keep-alive connection: the shed must drain the
+        # request body, or the second request's line is parsed out of
+        # the first one's leftover bytes (bogus 400, poisoned session)
+        with requests.Session() as sess:
+            for _ in range(2):
+                resp = sess.post(
+                    f"{base2}/inference_jobs", json={"app": "nope"},
+                    headers={"Authorization": f"Bearer {tok}"}, timeout=5)
+                assert resp.status_code == 503
+                body = resp.json()
+                assert body["standby"] is True
+                assert body["leader"] == leader_addr
+        # warm read-only fleet health is served, marked as the standby view
+        health = requests.get(
+            f"{base2}/fleet/health",
+            headers={"Authorization": f"Bearer {tok}"}, timeout=5
+        ).json()["data"]
+        assert health["standby"] is True
+        assert health["ha"]["role"] == ROLE_STANDBY
+
+        # graceful handoff: the leader releases on shutdown, the standby
+        # promotes without waiting out the TTL
+        srv1.stop()
+        admin1.shutdown()
+        assert standby.wait_promoted(timeout_s=15.0)
+        _wait_ready(standby)
+        root = requests.get(f"{base2}/", timeout=5).json()["data"]
+        assert root["ha"]["role"] == ROLE_LEADER
+        # the SAME door now serves the promoted Admin: a mutating call
+        # that 503'd seconds ago reaches a real handler (404: no such app)
+        resp = requests.post(
+            f"{base2}/inference_jobs", json={"app": "nope"},
+            headers={"Authorization": f"Bearer {tok}"}, timeout=5)
+        assert resp.status_code != 503
+        assert db_leader.read_lease()["epoch"] == 2
+    finally:
+        srv2.stop()
+        standby.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill 1: split brain — resumed stale leader mutates NOTHING
+# ---------------------------------------------------------------------------
+
+
+def test_split_brain_stale_leader_is_fenced_everywhere(tmp_workdir):
+    db_agents = Database(str(tmp_workdir / "meta.sqlite3"))
+    e1, s1, a1 = _spawn_host(db_agents, [0, 1])
+    e2, s2, a2 = _spawn_host(db_agents, [2, 3])
+    db_leader = Database(str(tmp_workdir / "meta.sqlite3"))
+    lease1 = LeaseManager(db_leader, holder="L1", addr="127.0.0.1:0",
+                          ttl_s=1.2, renew_s=0.2)
+    assert lease1.acquire() is True
+    admin1 = Admin(db=db_leader, placement=_placement([a1, a2], db_leader),
+                   params_dir=str(tmp_workdir / "params"), lease=lease1)
+    admin2 = None
+    try:
+        uid = _superadmin(admin1)
+        job = _seed_app(admin1, uid, "splitserve", trials=2)
+        assert job["status"] == "STOPPED"
+        admin1.create_inference_job(uid, "splitserve")
+        assert len(admin1.predict(uid, "splitserve", [[1.0]])) == 1
+        inf = db_agents.get_inference_jobs_by_statuses(["RUNNING"])[0]
+        sids_before = sorted(
+            w["service_id"]
+            for w in db_agents.get_workers_of_inference_job(inf["id"]))
+        assert sids_before
+
+        # -- SIGSTOP the leader past its TTL ----------------------------
+        lease1.suspend()
+        assert _wait_for(
+            lambda: (db_agents.read_lease() or {"expires_at": 0})
+            ["expires_at"] <= time.time(), timeout_s=6.0)
+
+        # -- the standby side promotes: epoch+1 + adopt-first reconcile --
+        db_new = Database(str(tmp_workdir / "meta.sqlite3"))
+        lease2 = LeaseManager(db_new, holder="L2", ttl_s=30.0, renew_s=5.0)
+        assert lease2.acquire() is True
+        assert lease2.last_epoch() == 2
+        admin2 = Admin(db=db_new, placement=_placement([a1, a2], db_new),
+                       params_dir=str(tmp_workdir / "params"), lease=lease2)
+        report = _wait_ready(admin2)
+        assert report["adopted"] >= len(sids_before)
+        # satellite: the report is ALSO persisted under its epoch, so two
+        # admins sharing LOGS_DIR never clobber each other's forensics
+        assert (tmp_workdir / "logs" / "recovery.json").exists()
+        assert (tmp_workdir / "logs" / "recovery-e2.json").exists()
+
+        # -- the old leader resumes, stale at epoch 1 --------------------
+        lease1.resume()
+        # every store mutation refuses typed (self-fence first, then the
+        # epoch CAS would refuse anyway)
+        with pytest.raises(StaleEpochError):
+            db_leader.create_user("stale@x", "h", UserType.APP_DEVELOPER)
+        with pytest.raises(StaleEpochError):
+            db_leader.reserve_trial("any-sub", "any-model", {}, max_trials=9)
+        # every agent mutation refuses typed: the agents ratcheted to
+        # epoch 2 during admin2's recovery probes
+        with pytest.raises(AgentHTTPError) as ei:
+            call_agent(a1, "POST", f"/services/{sids_before[0]}/stop",
+                       {"wait": False}, key=TEST_KEY, epoch=1)
+        assert ei.value.code == 412
+        stale_handle = _AgentHandle(a1, key=TEST_KEY)
+        stale_handle.epoch_provider = lambda: 1
+        with pytest.raises(StaleAdminEpochError):
+            stale_handle.stop_service(sids_before[0], wait=False)
+        with pytest.raises(StaleAdminEpochError):
+            stale_handle.create_service(
+                "split-doomed", ServiceType.INFERENCE, 1, False,
+                {"inference_job_id": inf["id"], "trial_id": "t"})
+
+        # -- zero double-placement, zero double-run ----------------------
+        inv_sids = []
+        for addr in (a1, a2):
+            inv = call_agent(addr, "GET", "/inventory", key=TEST_KEY,
+                             timeout_s=5)
+            inv_sids += [e["service_id"] for e in inv["services"]
+                         if e["status"] == "RUNNING"]
+        assert len(inv_sids) == len(set(inv_sids))
+        assert sorted(set(inv_sids) & set(sids_before)) == sids_before
+        assert "split-doomed" not in inv_sids
+        # the budget-2 job scored exactly 2 trials — no stale double-runs
+        tj = db_agents.get_train_jobs_of_user(uid)[0]
+        done = [t for t in db_agents.get_trials_of_train_job(tj["id"])
+                if t["status"] == "COMPLETED"]
+        assert len(done) == 2
+        # the fleet still serves through the NEW leader
+        assert len(admin2.predict(uid, "splitserve", [[1.0]])) == 1
+    finally:
+        lease1.resume()
+        _crash(admin1)
+        lease1.stop(release=False)
+        if admin2 is not None:
+            admin2.shutdown()
+        s1.stop()
+        s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance drill 2: kill the leader under continuous client load
+# ---------------------------------------------------------------------------
+
+
+def test_leader_kill_failover_under_load(tmp_workdir, monkeypatch):
+    # one replica per trial: the serving plane takes 2 of the 4 chips,
+    # leaving room for the in-flight train job the drill runs through
+    # the failover
+    monkeypatch.setattr(config, "INFERENCE_WORKER_REPLICAS_PER_TRIAL", 1)
+    TTL = 2.5
+    db_agents = Database(str(tmp_workdir / "meta.sqlite3"))
+    e1, s1, a1 = _spawn_host(db_agents, [0, 1])
+    e2, s2, a2 = _spawn_host(db_agents, [2, 3])
+    db_leader = Database(str(tmp_workdir / "meta.sqlite3"))
+    lease1 = LeaseManager(db_leader, holder="L1", ttl_s=TTL, renew_s=0.4)
+    assert lease1.acquire() is True
+    admin1 = Admin(db=db_leader, placement=_placement([a1, a2], db_leader),
+                   params_dir=str(tmp_workdir / "params"), lease=lease1)
+    srv1 = AdminServer(admin1).start()
+    lease1.addr = f"127.0.0.1:{srv1.port}"
+
+    standby = StandbyAdmin(
+        Database(str(tmp_workdir / "meta.sqlite3")),
+        factory=lambda lease: Admin(
+            db=Database(str(tmp_workdir / "meta.sqlite3")),
+            placement=_placement([a1, a2],
+                                 Database(str(tmp_workdir / "meta.sqlite3"))),
+            params_dir=str(tmp_workdir / "params"), lease=lease),
+        poll_s=0.1)
+    srv2 = AdminServer(standby).start()
+    standby._lease.addr = f"127.0.0.1:{srv2.port}"
+    try:
+        uid = _superadmin(admin1)
+        job = _seed_app(admin1, uid, "hakill", trials=2)
+        assert job["status"] == "STOPPED"
+        admin1.create_inference_job(uid, "hakill")
+
+        client = Client(admin_addrs=[f"127.0.0.1:{srv1.port}",
+                                     f"127.0.0.1:{srv2.port}"])
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        assert len(client.predict("hakill", [[1.0]])) == 1
+
+        # continuous predict load: EVERY request must succeed, through
+        # the kill and the promotion — the address walk absorbs the gap
+        stop_load = threading.Event()
+        ok, failures = [0], []
+
+        def load():
+            c = Client(admin_addrs=[f"127.0.0.1:{srv1.port}",
+                                    f"127.0.0.1:{srv2.port}"])
+            c.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+            while not stop_load.is_set():
+                try:
+                    assert len(c.predict("hakill", [[1.0]])) == 1
+                    ok[0] += 1
+                except Exception as e:
+                    failures.append(repr(e))
+                time.sleep(0.02)
+
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+        _wait_for(lambda: ok[0] >= 3, timeout_s=20.0)
+
+        # a budget-2 train job IN FLIGHT across the failover: its workers
+        # live on the agents and must score exactly 2 trials, no more
+        client.create_model("fake-live", "IMAGE_CLASSIFICATION", FIXTURE,
+                            "FakeModel")
+        client.create_train_job(
+            "halive", "IMAGE_CLASSIFICATION", "uri://t", "uri://e",
+            budget={"MODEL_TRIAL_COUNT": 2, "CHIP_COUNT": 2},
+            models=["fake-live"])
+
+        # -- SIGKILL the leader: door, placement and renewals all die ----
+        t_kill = time.monotonic()
+        srv1.stop()
+        lease1.suspend()
+        _crash(admin1)
+
+        assert standby.wait_promoted(timeout_s=2 * TTL + 10.0)
+        promoted_in = time.monotonic() - t_kill
+        assert promoted_in <= 2 * TTL, (
+            f"promotion took {promoted_in:.2f}s, budget {2 * TTL:.2f}s")
+        _wait_ready(standby)
+        assert db_agents.read_lease()["epoch"] == 2
+
+        # the in-flight job completes under the new leader
+        assert _wait_for(
+            lambda: client.get_train_job("halive")["status"] == "STOPPED",
+            timeout_s=60.0)
+        stop_load.set()
+        loader.join(timeout=30.0)
+
+        assert failures == [], f"client saw failed requests: {failures[:5]}"
+        assert ok[0] >= 10
+        # exactly budget-N scored trials for the in-flight job
+        tj = client.get_train_job("halive")
+        done = [t for t in db_agents.get_trials_of_train_job(tj["id"])
+                if t["status"] == "COMPLETED"]
+        assert len(done) == 2
+        # and serving still answers through the promoted leader
+        assert len(client.predict("hakill", [[1.0]])) == 1
+    finally:
+        lease1.resume()
+        _crash(admin1)
+        lease1.stop(release=False)
+        srv2.stop()
+        standby.shutdown()
+        s1.stop()
+        s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# generative streams ride the data plane: leadership loss never drops one
+# ---------------------------------------------------------------------------
+
+
+def _collect_stream(client, app, prompt, max_tokens, record):
+    # `record["tokens"]` is the live shared list: the drill watches it to
+    # know the stream is genuinely in flight before pulling leadership
+    toks = record.setdefault("tokens", [])
+    record.setdefault("error", None)
+    try:
+        for delta in client.generate(app, prompt, max_tokens=max_tokens):
+            toks.extend(delta.get("tokens") or [])
+    except Exception as e:
+        record["error"] = e
+    record["done"] = True
+
+
+def test_generative_stream_survives_leadership_loss(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFIKI_PREDICTOR_PORTS", "1")
+    monkeypatch.setenv("RAFIKI_GEN_MAX_SLOTS", "2")
+    # plain one-token-per-round decode: with speculation on, the chaos
+    # per-round delay below would not slow the stream enough to span the
+    # leadership handover
+    monkeypatch.setenv("RAFIKI_GEN_SPEC", "0")
+    path = str(tmp_path / "meta.sqlite3")
+    db_leader = Database(path)
+    lease1 = LeaseManager(db_leader, holder="L1", ttl_s=1.0, renew_s=0.2)
+    assert lease1.acquire() is True
+    admin = Admin(db=db_leader,
+                  placement=LocalPlacementManager(allocator=ChipAllocator([0])),
+                  params_dir=str(tmp_path / "params"), lease=lease1)
+    server = AdminServer(admin).start()
+    try:
+        uid = _superadmin(admin)
+        with open(GEN_FIXTURE, "rb") as f:
+            admin.create_model(uid, "genlm", "TEXT_GENERATION", f.read(),
+                               "TinyGenLM")
+        admin.create_train_job(
+            uid, "genha", "TEXT_GENERATION", "uri://t", "uri://e",
+            budget={"MODEL_TRIAL_COUNT": 1, "CHIP_COUNT": 1})
+        job = admin.wait_until_train_job_stopped(uid, "genha", timeout_s=120)
+        assert job["status"] == "STOPPED"
+        admin.create_inference_job(uid, "genha")
+
+        client = Client(admin_port=server.port)
+        client.login(config.SUPERADMIN_EMAIL, config.SUPERADMIN_PASSWORD)
+        # slow each decode step so the stream provably SPANS the entire
+        # leadership handover below
+        chaos.install(chaos.parse_rules(
+            "site=generate;action=delay;match=slot;delay_s=0.2"))
+        rec = {}
+        t = threading.Thread(target=_collect_stream,
+                             args=(client, "genha", [2, 3, 4], 60, rec),
+                             daemon=True)
+        t.start()
+        assert _wait_for(lambda: len(rec.get("tokens") or []) > 0
+                         or rec.get("done"), timeout_s=30.0)
+
+        # mid-stream leadership loss: renewals freeze (SIGSTOP analogue),
+        # the TTL lapses, and a usurper takes the lease over at epoch 2 —
+        # the old leader is self-fenced AND stale
+        lease1.suspend()
+        usurper = Database(path)
+        assert _wait_for(
+            lambda: usurper.acquire_lease("usurper", ttl_s=60.0) is not None,
+            timeout_s=15.0)
+        assert usurper.read_lease()["epoch"] == 2
+        assert _wait_for(lambda: lease1.role() == ROLE_FENCED, timeout_s=10.0)
+        assert not rec.get("done"), "stream must still be in flight here"
+        chaos.clear()
+
+        # the stream never consults the fence: zero dropped tokens
+        t.join(timeout=60.0)
+        assert rec.get("error") is None
+        assert len(rec["tokens"]) == 60
+
+        # while every CONTROL mutation of the fenced ex-leader refuses
+        with pytest.raises(StaleEpochError):
+            db_leader.create_user("stale@x", "h", UserType.APP_DEVELOPER)
+        # ...including through its own door: the 503 is a standby-style
+        # shed, so the single-address client surfaces it typed
+        with pytest.raises((AdminUnavailableError, RafikiError)):
+            client.stop_inference_job("genha")
+    finally:
+        server.stop()
+        lease1.stop(release=False)
+        admin.shutdown()

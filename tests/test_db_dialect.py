@@ -13,6 +13,7 @@ portability (/root/reference/rafiki/db/database.py:20-34); a raw-SQL DAL
 needs its own conformance gate.
 """
 
+import time
 import re
 import sys
 import os
@@ -211,6 +212,14 @@ def _drive_every_dal_method(db: Database) -> None:
     # delete a model nothing references (m is held by sub_train_job rows)
     m2 = db.create_model(u["id"], "m2", "TASK", b"code", "Cls", {}, "PRIVATE")
     db.delete_model(m2["id"])
+
+    # control-plane leadership lease + epoch write-fence
+    lease = db.acquire_lease("holder-a", 30.0, addr="127.0.0.1:3000")
+    db.renew_lease("holder-a", lease["epoch"], 30.0, addr="127.0.0.1:3000")
+    db.read_lease()
+    db.set_fence(lease["epoch"], time.monotonic() + 60.0)
+    db.clear_fence()
+    db.release_lease("holder-a", lease["epoch"])
 
 
 def test_all_dal_statements_translate():
